@@ -1,0 +1,136 @@
+"""paddle_tpu.amp — automatic mixed precision.
+
+Parity: python/paddle/amp/auto_cast.py:1006 (O1 white/black lists from
+amp_lists.py, O2 decorate) and grad_scaler.py:657 GradScaler. On TPU the
+natural low-precision dtype is bfloat16 (no loss scaling required), but the
+fp16 + dynamic-loss-scaling path is kept for API parity.
+
+The eager hook (`_amp_transform`) is the analogue of the AMP logic the
+reference code-generates into every ad_func (eager_gen.py:645): inputs of
+white-listed ops are cast to the amp dtype before dispatch.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import numpy as np
+
+from .amp_lists import WHITE_LIST, BLACK_LIST
+
+_tls = threading.local()
+
+
+class _AmpState:
+    __slots__ = ("enable", "dtype", "level")
+
+    def __init__(self, enable, dtype, level):
+        self.enable = enable
+        self.dtype = dtype
+        self.level = level
+
+
+def _amp_state():
+    return getattr(_tls, "amp", None)
+
+
+def _amp_active() -> bool:
+    st = _amp_state()
+    return st is not None and st.enable
+
+
+def amp_state():
+    return _amp_state()
+
+
+def _cast_value(v, np_dtype):
+    import jax.numpy as jnp
+
+    d = np.dtype(v.dtype)
+    if np.issubdtype(d, np.floating) and d != np.dtype(np_dtype) and d.itemsize >= 4:
+        return jnp.asarray(v, dtype=np_dtype)
+    return v
+
+
+def _amp_transform(name, args, kwargs):
+    """Cast float32 tensor inputs of white-listed ops to the amp dtype."""
+    from ..core.tensor import Tensor
+    from ..framework import dtype as dtypes
+
+    st = _amp_state()
+    base = name.split("::")[-1]
+    if st is None or not st.enable:
+        return args, kwargs
+    if st.level == "O1" and base not in WHITE_LIST:
+        return args, kwargs
+    if base in BLACK_LIST:
+        return args, kwargs
+    if base == "cast":  # never re-enter on the cast op itself
+        return args, kwargs
+    np_dtype = dtypes.convert_dtype(st.dtype).np_dtype
+    from .. import ops as _ops
+
+    def cast_rec(obj):
+        if isinstance(obj, Tensor):
+            d = np.dtype(obj._value.dtype)
+            if np.issubdtype(d, np.floating) and d != np_dtype and d.itemsize >= 4:
+                # a recorded cast keeps the grad route to the original tensor
+                return _ops.cast(obj, st.dtype)
+            return obj
+        if isinstance(obj, (list, tuple)):
+            return type(obj)(cast_rec(o) for o in obj)
+        if isinstance(obj, dict):
+            return {k: cast_rec(v) for k, v in obj.items()}
+        return obj
+
+    return tuple(cast_rec(list(args))), cast_rec(kwargs)
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list=None, custom_black_list=None,
+              level: str = "O1", dtype: str = "bfloat16", use_promote: bool = True):
+    """paddle.amp.auto_cast parity (bfloat16 default: TPU-native choice)."""
+    global WHITE_LIST, BLACK_LIST
+    prev = _amp_state()
+    added_w = set(custom_white_list or ())
+    added_b = set(custom_black_list or ())
+    WHITE_LIST |= added_w
+    BLACK_LIST |= added_b
+    _tls.amp = _AmpState(enable, dtype, level)
+    try:
+        yield
+    finally:
+        _tls.amp = prev
+        WHITE_LIST -= added_w
+        BLACK_LIST -= added_b
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16",
+             master_weight=None, save_dtype=None):
+    """paddle.amp.decorate parity: O2 casts all float params to the amp dtype
+    (optimizers keep fp32 master weights via their multi_precision path)."""
+    from ..core.tensor import Tensor
+    from ..framework import dtype as dtypes
+
+    np_dtype = dtypes.convert_dtype(dtype).np_dtype
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        import jax.numpy as jnp
+
+        for m in model_list:
+            for p in m.parameters():
+                d = np.dtype(p._value.dtype)
+                if np.issubdtype(d, np.floating) and d.itemsize >= 4:
+                    p._replace_value(jnp.asarray(p._value, dtype=np_dtype))
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers
+
+
+from .grad_scaler import GradScaler  # noqa: E402,F401
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler"]

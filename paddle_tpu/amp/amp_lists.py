@@ -1,0 +1,18 @@
+"""AMP O1 op lists (parity: python/paddle/amp/amp_lists.py).
+
+White list: matmul/conv-class ops that are numerically safe and fast in
+bf16 on the MXU. Black list: reductions/softmax/norm ops kept in fp32.
+"""
+
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "conv2d", "conv1d", "conv3d", "conv2d_transpose",
+    "einsum", "linear", "addmm", "flash_attention",
+}
+
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "mean", "sum", "softmax",
+    "log_softmax", "cross_entropy", "layer_norm", "rms_norm", "batch_norm",
+    "group_norm", "norm", "p_norm", "logsumexp", "erf", "erfinv", "pow",
+    "square", "reciprocal", "rsqrt", "cos_sim", "softmax_with_cross_entropy",
+    "cast",
+}

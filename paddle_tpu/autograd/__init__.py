@@ -1,0 +1,200 @@
+"""paddle_tpu.autograd — public autograd API.
+
+Parity surface: python/paddle/autograd/ (backward/grad wrappers, PyLayer at
+py_layer.py:282, jacobian/hessian in autograd/functional) built on the tape in
+tape.py and the engine in backward_engine.py.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tape import (  # noqa: F401
+    AccumulateGrad, GradNode, RemovableHandle, enable_grad, is_grad_enabled,
+    no_grad, set_grad_enabled,
+)
+from .backward_engine import run_backward
+from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+
+__all__ = [
+    "backward", "grad", "no_grad", "enable_grad", "set_grad_enabled",
+    "is_grad_enabled", "PyLayer", "PyLayerContext", "jacobian", "hessian",
+    "vjp", "jvp",
+]
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def _seed_for(t, g):
+    from ..core.tensor import Tensor
+
+    if g is None:
+        if t.size != 1:
+            raise RuntimeError(
+                "grad can be implicitly created only for scalar outputs; "
+                "pass grad_tensor explicitly"
+            )
+        g_val = jnp.ones_like(t._value)
+    else:
+        g_val = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+    return g_val
+
+
+def backward(tensors, grad_tensors=None, retain_graph: bool = False):
+    """paddle.autograd.backward parity (Tensor.backward routes here)."""
+    tensors = _as_list(tensors)
+    grad_tensors = _as_list(grad_tensors) if grad_tensors else [None] * len(tensors)
+    seeds = []
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient:
+            raise RuntimeError("tensor has stop_gradient=True; nothing to backprop")
+        g_val = _seed_for(t, g)
+        if t._grad_node is not None:
+            seeds.append((t._grad_node, t._output_index, g_val))
+        else:
+            t._accumulate_grad(g_val)
+    if seeds:
+        run_backward(seeds, retain_graph=retain_graph)
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph: Optional[bool] = None,
+    create_graph: bool = False,
+    only_inputs: bool = True,
+    allow_unused: bool = False,
+    no_grad_vars=None,
+):
+    """paddle.grad parity (reference: paddle/fluid/eager/general_grad.h)."""
+    from ..core.tensor import Tensor
+    from ..ops.dispatch import _edge_for
+
+    outputs = _as_list(outputs)
+    inputs = _as_list(inputs)
+    grad_outputs = _as_list(grad_outputs) if grad_outputs else [None] * len(outputs)
+    if retain_graph is None:
+        retain_graph = create_graph
+
+    capture = {}
+    capture_outputs = {}
+    for i, inp in enumerate(inputs):
+        if inp._grad_node is not None:
+            capture_outputs[(inp._grad_node, inp._output_index)] = i
+        else:
+            target, _ = _edge_for(inp)
+            capture[target] = i
+
+    seeds = []
+    for t, g in zip(outputs, grad_outputs):
+        g_val = _seed_for(t, g)
+        if t._grad_node is not None:
+            seeds.append((t._grad_node, t._output_index, g_val))
+        else:
+            # output IS an input (identity) or a leaf; grad flows directly
+            for i, inp in enumerate(inputs):
+                if inp is t:
+                    capture.setdefault(_edge_for(inp)[0], i)
+
+    results = run_backward(
+        seeds,
+        retain_graph=retain_graph,
+        create_graph=create_graph,
+        capture=capture,
+        capture_outputs=capture_outputs,
+        accumulate_into_leaves=False,
+    )
+    out: List[Optional[Tensor]] = []
+    for i, inp in enumerate(inputs):
+        if i in results:
+            out.append(results[i])
+        elif allow_unused:
+            out.append(None)
+        else:
+            raise RuntimeError(
+                f"input {i} is unused in the graph; pass allow_unused=True"
+            )
+    return out
+
+
+# -- functional transforms (paddle.autograd.functional parity) ----------------
+def _pure_fn(func):
+    """Wrap a Tensor->Tensor function as a pure jax function."""
+    from ..core.tensor import Tensor
+
+    def pure(*vals):
+        with no_grad():
+            out = func(*[Tensor(v, stop_gradient=True) for v in vals])
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value for o in out)
+        return out._value
+
+    return pure
+
+
+def vjp(func, xs, v=None):
+    from ..core.tensor import Tensor
+
+    xs_list = _as_list(xs)
+    out_vals, vjp_fn = jax.vjp(_pure_fn(func), *[x._value for x in xs_list])
+    if v is None:
+        cots = jax.tree_util.tree_map(jnp.ones_like, out_vals)
+    else:
+        cots = jax.tree_util.tree_map(lambda t: t._value, v)
+    in_cots = vjp_fn(cots)
+    wrap = lambda a: Tensor(a)
+    outs = jax.tree_util.tree_map(wrap, out_vals)
+    grads = [wrap(g) for g in in_cots]
+    return outs, (grads if isinstance(xs, (list, tuple)) else grads[0])
+
+
+def jvp(func, xs, v=None):
+    from ..core.tensor import Tensor
+
+    xs_list = _as_list(xs)
+    primals = [x._value for x in xs_list]
+    if v is None:
+        tangents = [jnp.ones_like(p) for p in primals]
+    else:
+        tangents = [t._value for t in _as_list(v)]
+    out, tan = jax.jvp(_pure_fn(func), tuple(primals), tuple(tangents))
+    wrap = lambda a: Tensor(a)
+    return jax.tree_util.tree_map(wrap, out), jax.tree_util.tree_map(wrap, tan)
+
+
+def jacobian(func, xs, create_graph: bool = False):
+    from ..core.tensor import Tensor
+
+    xs_list = _as_list(xs)
+    jac = jax.jacrev(_pure_fn(func), argnums=tuple(range(len(xs_list))))(
+        *[x._value for x in xs_list]
+    )
+    wrap = lambda a: Tensor(a)
+    jac = jax.tree_util.tree_map(wrap, jac)
+    if not isinstance(xs, (list, tuple)):
+        return jac[0] if isinstance(jac, tuple) else jac
+    return jac
+
+
+def hessian(func, xs, create_graph: bool = False):
+    from ..core.tensor import Tensor
+
+    xs_list = _as_list(xs)
+    hes = jax.hessian(_pure_fn(func), argnums=tuple(range(len(xs_list))))(
+        *[x._value for x in xs_list]
+    )
+    wrap = lambda a: Tensor(a)
+    hes = jax.tree_util.tree_map(wrap, hes)
+    if not isinstance(xs, (list, tuple)):
+        return hes[0][0] if isinstance(hes, tuple) else hes
+    return hes

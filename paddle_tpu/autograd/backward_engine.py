@@ -1,0 +1,207 @@
+"""Backward traversal engine.
+
+Mirrors the reference's dual-queue BFS with an in-degree map
+(reference: paddle/fluid/eager/backward.cc:106 RunBackward, :25 getInDegreeMap)
+— re-expressed over GradNode/AccumulateGrad from tape.py.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .tape import AccumulateGrad, GradNode, float0, no_grad
+
+
+def _collect_dependencies(seed_nodes):
+    """DFS from the seed nodes; deps[node] = #consumer nodes that will send it
+    cotangents (the reference's in-degree map, backward.cc:25-66)."""
+    deps: Dict[GradNode, int] = {}
+    visited = set()
+    stack = list(seed_nodes)
+    while stack:
+        node = stack.pop()
+        if node in visited:
+            continue
+        visited.add(node)
+        deps.setdefault(node, 0)
+        for edge in node.edges:
+            if edge is None:
+                continue
+            target, _ = edge
+            if isinstance(target, GradNode):
+                deps[target] = deps.get(target, 0) + 1
+                if target not in visited:
+                    stack.append(target)
+    return deps, visited
+
+
+def _reachable_from(capture_nodes, capture_out_nodes, seed_nodes):
+    """Restrict traversal to nodes on a path from seeds to any capture node
+    (used by paddle.grad-style partial backward)."""
+    # reverse reachability: walk from seeds, keep nodes from which a capture
+    # accumulator (or captured node output) is reachable.
+    memo: Dict[GradNode, bool] = {}
+
+    def reaches(node) -> bool:
+        if node in memo:
+            return memo[node]
+        memo[node] = False  # cycle guard (graph is a DAG, but be safe)
+        hit = node in capture_out_nodes
+        for edge in node.edges:
+            if edge is None:
+                continue
+            target, _ = edge
+            if isinstance(target, AccumulateGrad):
+                if target in capture_nodes:
+                    hit = True
+            elif isinstance(target, GradNode):
+                if reaches(target):
+                    hit = True
+        memo[node] = hit
+        return hit
+
+    for s in seed_nodes:
+        reaches(s)
+    return {n for n, ok in memo.items() if ok}
+
+
+def run_backward(
+    seeds,  # list of (GradNode, output_index, cotangent_value)
+    retain_graph: bool = False,
+    create_graph: bool = False,
+    capture: Optional[Dict[AccumulateGrad, object]] = None,
+    capture_outputs: Optional[Dict[tuple, object]] = None,
+    accumulate_into_leaves: bool = True,
+):
+    """Run the tape backward.
+
+    capture: optional {AccumulateGrad: key} — gradients for those leaves are
+    returned in a dict instead of (or in addition to) being accumulated into
+    ``tensor.grad``. capture_outputs: {(GradNode, out_idx): key} — capture the
+    cotangent of a non-leaf tensor produced at that node output. Traversal is
+    pruned to paths reaching capture nodes when leaf accumulation is off.
+    """
+    seed_nodes = []
+    buffers: Dict[GradNode, Dict[int, object]] = {}
+    for node, idx, cot in seeds:
+        if node not in buffers:
+            buffers[node] = {}
+            seed_nodes.append(node)
+        if idx in buffers[node]:
+            buffers[node][idx] = buffers[node][idx] + cot
+        else:
+            buffers[node][idx] = cot
+
+    deps, visited = _collect_dependencies(seed_nodes)
+    capture_outputs = capture_outputs or {}
+
+    allowed = None
+    if capture is not None and not accumulate_into_leaves:
+        capture_nodes = set(capture.keys())
+        capture_out_nodes = {n for (n, _i) in capture_outputs}
+        allowed = _reachable_from(capture_nodes, capture_out_nodes, seed_nodes)
+        # recompute deps counting only allowed nodes
+        deps = {}
+        for node in allowed:
+            deps.setdefault(node, 0)
+        for node in allowed:
+            for edge in node.edges:
+                if edge is None:
+                    continue
+                target, _ = edge
+                if isinstance(target, GradNode) and target in allowed:
+                    deps[target] = deps.get(target, 0) + 1
+        seed_nodes = [n for n in seed_nodes if n in allowed]
+
+    results: Dict[object, object] = {}
+
+    ready = deque(n for n in seed_nodes if deps.get(n, 0) == 0)
+    # seeds that still await cotangents from other seeds' subgraphs enter the
+    # queue once their dependency count drains.
+    processed = set()
+
+    grad_ctx = no_grad() if not create_graph else _nullcontext()
+    with grad_ctx:
+        while ready:
+            node = ready.popleft()
+            if node in processed:
+                continue
+            processed.add(node)
+            buf = buffers.pop(node, {})
+            cotangents = []
+            for i in range(len(node.out_metas)):
+                if i in buf:
+                    cotangents.append(buf[i])
+                else:
+                    cotangents.append(node.zero_cotangent(i))
+            for i in range(len(cotangents)):
+                key = capture_outputs.get((node, i))
+                if key is not None:
+                    cot_t = _to_tensor_grad(cotangents[i], create_graph)
+                    results[key] = (results[key] + cot_t) if key in results else cot_t
+            # per-output tensor hooks (reference: eager/hooks.h)
+            for i, hooks in node.output_hooks.items():
+                for hook in list(hooks.values()):
+                    from .tape import _unwrap_grad, _wrap_grad
+
+                    out = hook(_wrap_grad(cotangents[i]))
+                    if out is not None:
+                        cotangents[i] = _unwrap_grad(out)
+            in_cots = node.apply(cotangents, create_graph=create_graph)
+            if not retain_graph and not create_graph:
+                node.release()
+            for edge, cot in zip(node.edges, in_cots):
+                if edge is None or cot is None:
+                    continue
+                if isinstance(cot, np.ndarray) and cot.dtype == float0:
+                    continue
+                target, idx = edge
+                if isinstance(target, AccumulateGrad):
+                    if capture is not None and target in capture:
+                        key = capture[target]
+                        cot_t = _to_tensor_grad(cot, create_graph)
+                        if key in results:
+                            results[key] = results[key] + cot_t
+                        else:
+                            results[key] = cot_t
+                        if accumulate_into_leaves:
+                            target.apply(_raw(cot))
+                    elif accumulate_into_leaves:
+                        target.apply(_raw(cot))
+                    continue
+                if allowed is not None and target not in allowed:
+                    continue
+                tbuf = buffers.setdefault(target, {})
+                if idx in tbuf:
+                    tbuf[idx] = tbuf[idx] + cot
+                else:
+                    tbuf[idx] = cot
+                deps[target] = deps.get(target, 0) - 1
+                if deps[target] <= 0:
+                    ready.append(target)
+    return results
+
+
+def _to_tensor_grad(cot, create_graph):
+    from ..core.tensor import Tensor
+
+    if isinstance(cot, Tensor):
+        return cot
+    return Tensor(cot, stop_gradient=not create_graph)
+
+
+def _raw(cot):
+    from ..core.tensor import Tensor
+
+    return cot._value if isinstance(cot, Tensor) else cot
+
+
+class _nullcontext:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False

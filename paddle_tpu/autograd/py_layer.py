@@ -1,0 +1,95 @@
+"""PyLayer: user-defined forward/backward.
+
+Parity: python/paddle/autograd/py_layer.py:282 and the reference C++ support in
+paddle/fluid/eager/pylayer/. The custom backward is wired into the tape as a
+GradNode whose "vjp" calls the user's ``backward`` staticmethod.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+        self._extra = {}
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+    def __setattr__(self, k, v):
+        object.__setattr__(self, k, v)
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..core.tensor import Tensor
+        from ..autograd.tape import GradNode, is_grad_enabled, no_grad
+        from ..ops.dispatch import _edge_for, _requires_grad
+
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)] + [
+            v for v in kwargs.values() if isinstance(v, Tensor)
+        ]
+        recording = is_grad_enabled() and any(
+            _requires_grad(t) for t in tensor_inputs
+        )
+        with no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(outs, (tuple, list))
+        outs_t = list(outs) if multi else [outs]
+        outs_t = [o if isinstance(o, Tensor) else Tensor(o) for o in outs_t]
+
+        if recording:
+            grad_inputs = [t for t in tensor_inputs if _requires_grad(t)]
+
+            def vjp_fn(cotangents):
+                cots = [Tensor(c, stop_gradient=True) for c in cotangents]
+                grads = cls.backward(ctx, *cots)
+                if not isinstance(grads, (tuple, list)):
+                    grads = (grads,)
+                raw = []
+                for g in grads:
+                    raw.append(None if g is None else (
+                        g._value if isinstance(g, Tensor) else jnp.asarray(g)))
+                # pad/truncate to number of differentiable inputs
+                raw = raw[: len(grad_inputs)]
+                while len(raw) < len(grad_inputs):
+                    raw.append(None)
+                return tuple(raw)
+
+            out_metas = [(tuple(o._value.shape), o._value.dtype) for o in outs_t]
+            node = GradNode(cls.__name__, vjp_fn, out_metas)
+            node.edges = [_edge_for(t) for t in grad_inputs]
+            for i, o in enumerate(outs_t):
+                o.stop_gradient = False
+                o._grad_node = node
+                o._output_index = i
+        return tuple(outs_t) if multi else outs_t[0]
+
+
+class LegacyPyLayer(PyLayer):
+    pass

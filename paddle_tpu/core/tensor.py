@@ -1,0 +1,353 @@
+"""The framework Tensor.
+
+TPU-native re-design of the reference's eager Tensor
+(reference: paddle/phi/api/include/tensor.h:82 paddle::Tensor;
+pybind surface paddle/fluid/pybind/eager_method.cc — numpy() :154,
+_copy_to :613, eager_properties.cc for .grad/.shape/.place/.dtype).
+
+A Tensor wraps an immutable jax.Array. "In-place" mutation is a buffer swap
+(the old array stays alive for any autograd residuals that captured it), with a
+version counter kept for API parity. Autograd state lives directly on the
+tensor: ``_grad_node``/``_output_index`` point into the tape
+(see autograd/tape.py), leaves own an AccumulateGrad and a ``.grad``.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..device import Place, current_jax_device, place_of_array
+from ..framework import dtype as dtypes
+
+
+class Tensor:
+    __slots__ = (
+        "_value", "stop_gradient", "_grad", "_grad_node", "_output_index",
+        "_accumulate_node", "name", "persistable", "_version", "__weakref__",
+        "is_parameter", "_trainable_attrs",
+    )
+
+    def __init__(self, value, stop_gradient: bool = True, name: Optional[str] = None):
+        if isinstance(value, Tensor):
+            value = value._value
+        if not isinstance(value, jax.Array) and not isinstance(value, jax.core.Tracer):
+            value = jnp.asarray(value)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._grad_node = None
+        self._output_index = 0
+        self._accumulate_node = None
+        self.name = name
+        self.persistable = False
+        self.is_parameter = False
+        self._version = 0
+
+    # -- basic properties ------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    dim = ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    def numel(self):
+        return self.size
+
+    @property
+    def dtype(self) -> dtypes.DType:
+        return dtypes.convert_dtype(np.dtype(self._value.dtype))
+
+    @property
+    def place(self) -> Place:
+        return place_of_array(self._value)
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    @property
+    def grad(self) -> Optional["Tensor"]:
+        return self._grad
+
+    @grad.setter
+    def grad(self, g):
+        if g is not None and not isinstance(g, Tensor):
+            g = Tensor(g)
+        self._grad = g
+
+    @property
+    def T(self):
+        from .. import ops as _ops
+
+        perm = list(range(self.ndim))[::-1]
+        return _ops.transpose(self, perm)
+
+    def t(self):
+        return self.T
+
+    @property
+    def mT(self):
+        from .. import ops as _ops
+
+        perm = list(range(self.ndim))
+        perm[-2], perm[-1] = perm[-1], perm[-2]
+        return _ops.transpose(self, perm)
+
+    # -- host interop ----------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        return self._value.item(*args)
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __repr__(self):
+        sg = self.stop_gradient
+        try:
+            data = np.asarray(self._value)
+            body = np.array2string(data, precision=6, separator=", ")
+        except Exception:
+            body = f"<traced {self._value}>"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+            f"place={self.place}, stop_gradient={sg},\n       {body})"
+        )
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __int__(self):
+        return int(self._value)
+
+    def __float__(self):
+        return float(self._value)
+
+    def __index__(self):
+        return int(self._value)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __hash__(self):
+        return id(self)
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return object.__format__(self, spec)
+
+    # -- mutation ---------------------------------------------------------
+    def _replace_value(self, new_value):
+        """In-place buffer swap (reference inplace kernels; here immutable
+        arrays make residual corruption impossible)."""
+        if not isinstance(new_value, (jax.Array, jax.core.Tracer)):
+            new_value = jnp.asarray(new_value)
+        self._value = new_value
+        self._version += 1
+        return self
+
+    def _accumulate_grad(self, cot):
+        if isinstance(cot, Tensor):
+            cot = cot._value
+        if self._grad is None:
+            self._grad = Tensor(cot, stop_gradient=True)
+        else:
+            self._grad = Tensor(self._grad._value + cot, stop_gradient=True)
+
+    def clear_grad(self, set_to_zero: bool = False):
+        if set_to_zero and self._grad is not None:
+            self._grad = Tensor(jnp.zeros_like(self._grad._value))
+        else:
+            self._grad = None
+
+    clear_gradient = clear_grad
+
+    def zero_(self):
+        return self._replace_value(jnp.zeros_like(self._value))
+
+    def fill_(self, value):
+        return self._replace_value(jnp.full_like(self._value, value))
+
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._value
+        value = jnp.asarray(value, dtype=self._value.dtype)
+        if tuple(value.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {value.shape} vs {self._value.shape}"
+            )
+        return self._replace_value(value)
+
+    def copy_(self, other, non_blocking=False):
+        return self.set_value(other)
+
+    # -- autograd ----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        from ..autograd import backward as _backward
+
+        _backward([self], [grad_tensor] if grad_tensor is not None else None,
+                  retain_graph=retain_graph)
+
+    def register_hook(self, hook):
+        from ..autograd.tape import RemovableHandle
+        from ..ops.dispatch import _edge_for
+
+        if self.stop_gradient:
+            raise RuntimeError("cannot register hook on a stop_gradient tensor")
+        if self._grad_node is not None:
+            hooks = self._grad_node.output_hooks.setdefault(self._output_index, {})
+        else:
+            target, _ = _edge_for(self)
+            hooks = target.hooks
+        h = RemovableHandle(hooks)
+        hooks[h.id] = hook
+        return h
+
+    def retain_grads(self):
+        if self._grad_node is None:
+            return
+        import weakref
+
+        ref = weakref.ref(self)
+
+        def _save(g):
+            t = ref()
+            if t is not None:
+                t._accumulate_grad(g._value)
+            return None
+
+        self.register_hook(_save)
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._value, stop_gradient=True, name=self.name)
+        return t
+
+    def detach_(self):
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from .. import ops as _ops
+
+        return _ops.assign(self)
+
+    # -- device movement ---------------------------------------------------
+    def _copy_to(self, place, blocking: bool = True) -> "Tensor":
+        from ..device import jax_device
+
+        dev = jax_device(place) if not hasattr(place, "jax_device") else place.jax_device()
+        return Tensor(jax.device_put(self._value, dev), stop_gradient=self.stop_gradient)
+
+    def cpu(self):
+        return self._copy_to("cpu:0")
+
+    def tpu(self, idx: int = 0):
+        return self._copy_to(f"tpu:{idx}")
+
+    def to(self, *args, **kwargs):
+        # accepts dtype-like or device-like (paddle Tensor.to parity)
+        out = self
+        for a in list(args) + list(kwargs.values()):
+            if a is None or a in ("float32",) and False:
+                continue
+            try:
+                d = dtypes.convert_dtype(a)
+                out = out.astype(d)
+                continue
+            except (ValueError, TypeError):
+                pass
+            if isinstance(a, (str, Place)):
+                out = out._copy_to(a)
+        return out
+
+    def pin_memory(self):
+        return self
+
+    def cuda(self, *a, **k):
+        raise RuntimeError("paddle_tpu is a TPU-native framework; CUDA is not available")
+
+    # -- dtype -------------------------------------------------------------
+    def astype(self, dtype) -> "Tensor":
+        from .. import ops as _ops
+
+        return _ops.cast(self, dtype)
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    # -- misc helpers used everywhere --------------------------------------
+    def apply(self, func):
+        return func(self)
+
+    def element_size(self):
+        return self.dtype.itemsize
+
+    def get_tensor(self):
+        return self
+
+    def value(self):
+        return self
+
+    def contiguous(self):
+        return self
+
+    def is_contiguous(self):
+        return True
+
+
+def _tensor_flatten(t: Tensor):
+    return (t._value,), (t.stop_gradient,)
+
+
+def _tensor_unflatten(aux, children):
+    return Tensor(children[0], stop_gradient=aux[0])
+
+
+jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: python/paddle/base/framework.py
+    EagerParamBase); stop_gradient defaults to False."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip")
+
+    def __init__(self, value, trainable: bool = True, name: Optional[str] = None):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.is_parameter = True
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+
+
+jax.tree_util.register_pytree_node(
+    Parameter,
+    lambda p: ((p._value,), (p.stop_gradient,)),
+    lambda aux, ch: Parameter(ch[0], trainable=not aux[0]),
+)

@@ -1,0 +1,171 @@
+"""Device / Place layer.
+
+TPU-native equivalent of the reference's Place/Backend machinery
+(reference: paddle/phi/common/place.h:31-39, python/paddle/device/__init__.py:284
+set_device). Here 'tpu' is the first-class backend; 'cpu' always exists; any
+platform jax exposes (gpu, axon, ...) is addressable through the same API.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+
+__all__ = [
+    "Place", "CPUPlace", "TPUPlace", "CustomPlace",
+    "set_device", "get_device", "get_all_devices", "device_count",
+    "is_compiled_with_tpu", "jax_device", "current_jax_device",
+    "synchronize",
+]
+
+
+class Place:
+    """A (device_type, device_id) pair, resolvable to a concrete jax.Device."""
+
+    __slots__ = ("device_type", "device_id")
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        self.device_type = device_type
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def jax_device(self) -> Optional[jax.Device]:
+        return _resolve_jax_device(self.device_type, self.device_id)
+
+    def is_cpu_place(self):
+        return self.device_type == "cpu"
+
+    def is_tpu_place(self):
+        return self.device_type == "tpu"
+
+
+def CPUPlace(idx: int = 0) -> Place:
+    return Place("cpu", idx)
+
+
+def TPUPlace(idx: int = 0) -> Place:
+    return Place("tpu", idx)
+
+
+def CustomPlace(device_type: str, idx: int = 0) -> Place:
+    """Counterpart of the reference's pluggable CustomPlace
+    (paddle/phi/common/place.h:41 CustomRegisteredDeviceMap)."""
+    return Place(device_type, idx)
+
+
+_TPU_LIKE = ("tpu", "axon")  # axon = tunneled TPU platform name in this environment
+
+
+def _platform_of(dev: jax.Device) -> str:
+    p = dev.platform.lower()
+    return "tpu" if p in _TPU_LIKE else p
+
+
+def _resolve_jax_device(device_type: str, device_id: int) -> Optional[jax.Device]:
+    for d in jax.devices():
+        if _platform_of(d) == device_type and d.id == device_id:
+            return d
+    # fall back to local index within the platform
+    same = [d for d in jax.devices() if _platform_of(d) == device_type]
+    if same and device_id < len(same):
+        return same[device_id]
+    if device_type == "cpu":
+        try:
+            return jax.devices("cpu")[device_id]
+        except RuntimeError:
+            return None
+    return None
+
+
+_state = threading.local()
+
+
+def _default_place() -> Place:
+    try:
+        d = jax.devices()[0]
+    except RuntimeError:
+        return CPUPlace()
+    return Place(_platform_of(d), d.id)
+
+
+def set_device(device: str) -> Place:
+    """paddle.device.set_device parity: 'tpu', 'tpu:0', 'cpu', ..."""
+    if ":" in device:
+        kind, _, idx = device.partition(":")
+        place = Place(kind, int(idx))
+    else:
+        place = Place(device, 0)
+    if place.jax_device() is None:
+        raise ValueError(
+            f"device '{device}' not available; visible platforms: "
+            f"{sorted({_platform_of(d) for d in jax.devices()})}"
+        )
+    _state.place = place
+    return place
+
+
+def get_device() -> str:
+    place = getattr(_state, "place", None) or _default_place()
+    return f"{place.device_type}:{place.device_id}"
+
+
+def current_place() -> Place:
+    place = getattr(_state, "place", None)
+    if place is None:
+        place = _default_place()
+        _state.place = place
+    return place
+
+
+def current_jax_device() -> Optional[jax.Device]:
+    return current_place().jax_device()
+
+
+def jax_device(place=None) -> Optional[jax.Device]:
+    if place is None:
+        return current_jax_device()
+    if isinstance(place, str):
+        kind, _, idx = place.partition(":")
+        place = Place(kind, int(idx or 0))
+    return place.jax_device()
+
+
+def get_all_devices():
+    return [f"{_platform_of(d)}:{d.id}" for d in jax.devices()]
+
+
+def device_count(device_type: Optional[str] = None) -> int:
+    if device_type is None:
+        return len(jax.devices())
+    return sum(1 for d in jax.devices() if _platform_of(d) == device_type)
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(_platform_of(d) == "tpu" for d in jax.devices())
+
+
+def synchronize(device=None):
+    """Block until all outstanding device work completes
+    (counterpart of paddle.device.synchronize)."""
+    jax.effects_barrier()
+
+
+def place_of_array(arr) -> Place:
+    try:
+        dev = list(arr.devices())[0]
+        return Place(_platform_of(dev), dev.id)
+    except Exception:
+        return CPUPlace()

@@ -1,0 +1,145 @@
+"""Data types for paddle_tpu.
+
+TPU-native equivalent of the reference's ``phi::DataType``
+(reference: paddle/phi/common/data_type.h) — here a thin, canonical layer over
+numpy/jax dtypes so every public API accepts strings ("float32"), numpy dtypes,
+jax dtypes, or the module-level singletons (paddle_tpu.float32).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    import ml_dtypes  # bundled with jax
+
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+    _FP8_E4M3 = np.dtype(ml_dtypes.float8_e4m3fn)
+    _FP8_E5M2 = np.dtype(ml_dtypes.float8_e5m2)
+except Exception:  # pragma: no cover
+    _BF16 = np.dtype("float32")
+    _FP8_E4M3 = None
+    _FP8_E5M2 = None
+
+
+class DType:
+    """Canonical dtype wrapper (compares equal to its string name and numpy dtype)."""
+
+    __slots__ = ("name", "np_dtype")
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype)
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __str__(self):
+        return self.name
+
+    def __hash__(self):
+        return hash(self.name)
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        if isinstance(other, str):
+            return self.name == other or str(self.np_dtype) == other
+        try:
+            return self.np_dtype == np.dtype(other)
+        except TypeError:
+            return NotImplemented
+
+    @property
+    def is_floating_point(self) -> bool:
+        return self.name in _FLOATING
+
+    @property
+    def is_complex(self) -> bool:
+        return self.name in ("complex64", "complex128")
+
+    @property
+    def is_integer(self) -> bool:
+        return np.issubdtype(self.np_dtype, np.integer)
+
+    @property
+    def itemsize(self) -> int:
+        return self.np_dtype.itemsize
+
+
+bool_ = DType("bool", np.bool_)
+uint8 = DType("uint8", np.uint8)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", _BF16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+float8_e4m3fn = DType("float8_e4m3fn", _FP8_E4M3) if _FP8_E4M3 is not None else None
+float8_e5m2 = DType("float8_e5m2", _FP8_E5M2) if _FP8_E5M2 is not None else None
+
+_FLOATING = {"float16", "bfloat16", "float32", "float64", "float8_e4m3fn", "float8_e5m2"}
+
+_ALL = [
+    bool_, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
+    float64, complex64, complex128,
+]
+if float8_e4m3fn is not None:
+    _ALL += [float8_e4m3fn, float8_e5m2]
+
+_BY_NAME = {d.name: d for d in _ALL}
+_BY_NAME["bool"] = bool_
+_BY_NAME["float"] = float32
+_BY_NAME["double"] = float64
+_BY_NAME["half"] = float16
+_BY_NAME["int"] = int32
+_BY_NAME["long"] = int64
+
+
+def convert_dtype(dtype) -> DType:
+    """Normalize any dtype-like object to a :class:`DType`."""
+    if dtype is None:
+        raise ValueError("dtype must not be None")
+    if isinstance(dtype, DType):
+        return dtype
+    if isinstance(dtype, str):
+        if dtype in _BY_NAME:
+            return _BY_NAME[dtype]
+        raise ValueError(f"unknown dtype name: {dtype!r}")
+    npd = np.dtype(dtype)
+    name = npd.name
+    if name in _BY_NAME:
+        return _BY_NAME[name]
+    raise ValueError(f"unsupported dtype: {dtype!r}")
+
+
+def to_np(dtype) -> np.dtype:
+    return convert_dtype(dtype).np_dtype
+
+
+def is_floating(dtype_like) -> bool:
+    try:
+        return convert_dtype(dtype_like).is_floating_point
+    except ValueError:
+        return False
+
+
+# -- default dtype ------------------------------------------------------------
+_default_dtype = float32
+
+
+def set_default_dtype(d):
+    """Set the default floating dtype used by creation ops (paddle parity:
+    python/paddle/framework/framework.py set_default_dtype)."""
+    global _default_dtype
+    d = convert_dtype(d)
+    if not d.is_floating_point:
+        raise TypeError(f"default dtype must be floating point, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype() -> DType:
+    return _default_dtype

@@ -1,0 +1,90 @@
+"""Global flag system.
+
+TPU-native equivalent of the reference's PD_DEFINE_* flag registry
+(reference: paddle/common/flags.h:38,93 and paddle/common/flags_native.cc):
+a process-wide registry of typed flags, overridable from ``FLAGS_*``
+environment variables and from Python via set_flags/get_flags
+(reference: python/paddle/base/framework.py:132,157).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict
+
+_lock = threading.Lock()
+_registry: Dict[str, "_Flag"] = {}
+
+
+class _Flag:
+    __slots__ = ("name", "default", "value", "type", "help")
+
+    def __init__(self, name, default, type_, help_):
+        self.name = name
+        self.default = default
+        self.value = default
+        self.type = type_
+        self.help = help_
+
+
+def _coerce(type_, raw):
+    if type_ is bool:
+        if isinstance(raw, str):
+            return raw.lower() in ("1", "true", "yes", "on")
+        return bool(raw)
+    return type_(raw)
+
+
+def define_flag(name: str, default: Any, help: str = "", type_=None):
+    """Register a flag; ``FLAGS_<name>`` in the environment overrides the default."""
+    if type_ is None:
+        type_ = type(default)
+    with _lock:
+        if name in _registry:
+            return _registry[name].value
+        flag = _Flag(name, default, type_, help)
+        env = os.environ.get("FLAGS_" + name)
+        if env is not None:
+            flag.value = _coerce(type_, env)
+        _registry[name] = flag
+        return flag.value
+
+
+def set_flags(flags: Dict[str, Any]):
+    """paddle.set_flags parity."""
+    with _lock:
+        for k, v in flags.items():
+            if k.startswith("FLAGS_"):
+                k = k[len("FLAGS_"):]
+            if k not in _registry:
+                raise ValueError(f"unknown flag: {k}")
+            f = _registry[k]
+            f.value = _coerce(f.type, v)
+
+
+def get_flags(flags=None) -> Dict[str, Any]:
+    """paddle.get_flags parity."""
+    with _lock:
+        if flags is None:
+            return {"FLAGS_" + k: f.value for k, f in _registry.items()}
+        if isinstance(flags, str):
+            flags = [flags]
+        out = {}
+        for k in flags:
+            key = k[len("FLAGS_"):] if k.startswith("FLAGS_") else k
+            if key not in _registry:
+                raise ValueError(f"unknown flag: {k}")
+            out["FLAGS_" + key] = _registry[key].value
+        return out
+
+
+def get_flag(name: str):
+    with _lock:
+        return _registry[name].value
+
+
+# Core flags (counterparts of the reference's most-used runtime flags).
+define_flag("check_nan_inf", False, "scan op outputs for nan/inf like the reference's FLAGS_check_nan_inf")
+define_flag("paddle_tpu_log_level", 0, "verbosity for framework logging")
+define_flag("use_pallas_kernels", True, "use Pallas custom kernels where available (flash attention etc.)")
+define_flag("eager_delete_tensor_gb", 0.0, "kept for API parity; GC is handled by jax/XLA")

@@ -1,0 +1,151 @@
+"""Op dispatch: the eager hot path.
+
+TPU-native analogue of the reference's generated ``<op>_ad_func`` forward
+functions (reference: paddle/fluid/eager/auto_code_generator/generator/
+eager_gen.py:367 — AMP cast → kernel call → GradNode capture), except nothing
+is code-generated per op: one generic ``apply`` routes any pure-jax op
+implementation, records a GradNode holding a jax.vjp closure when gradients are
+required, and wraps results as framework Tensors.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.tape import AccumulateGrad, GradNode, is_grad_enabled
+from ..framework import flags as _flags
+
+
+class _Ph:
+    """Placeholder standing in for the i-th collected Tensor."""
+
+    __slots__ = ("i",)
+
+    def __init__(self, i):
+        self.i = i
+
+
+def _scan(obj, tensors: List):
+    from ..core.tensor import Tensor
+
+    if isinstance(obj, Tensor):
+        tensors.append(obj)
+        return _Ph(len(tensors) - 1)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_scan(o, tensors) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _scan(v, tensors) for k, v in obj.items()}
+    return obj
+
+
+def _fill(obj, vals):
+    if isinstance(obj, _Ph):
+        return vals[obj.i]
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_fill(o, vals) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _fill(v, vals) for k, v in obj.items()}
+    return obj
+
+
+def _requires_grad(t) -> bool:
+    if t.stop_gradient:
+        return False
+    d = np.dtype(t._value.dtype)
+    return np.issubdtype(d, np.floating) or np.issubdtype(d, np.complexfloating)
+
+
+def apply(name: str, fn: Callable, *args, **kwargs):
+    """Run ``fn`` (a pure jax function) over Tensor-bearing args.
+
+    Returns Tensor / tuple-of-Tensors mirroring fn's output structure.
+    """
+    out, multi = _apply_impl(name, fn, args, kwargs)
+    return out if multi else out[0]
+
+
+def apply_raw_multi(name: str, fn: Callable, cot_list):
+    """Used by GradNode.apply under create_graph: fn(*cots) -> tuple."""
+    out, _ = _apply_impl(name, fn, tuple(cot_list), {})
+    return out
+
+
+def _apply_impl(name, fn, args, kwargs):
+    from ..core.tensor import Tensor
+    from .. import amp as _amp
+
+    if _amp._amp_active():
+        args, kwargs = _amp._amp_transform(name, args, kwargs)
+
+    tensors: List[Tensor] = []
+    s_args = _scan(args, tensors)
+    s_kwargs = _scan(kwargs, tensors)
+    raw_vals = [t._value for t in tensors]
+
+    recording = is_grad_enabled() and any(_requires_grad(t) for t in tensors)
+    multi_box = {}
+
+    def run_with(vals):
+        out = fn(*_fill(s_args, vals), **_fill(s_kwargs, vals))
+        multi = isinstance(out, (tuple, list))
+        multi_box["multi"] = multi
+        return tuple(out) if multi else (out,)
+
+    if not recording:
+        out_vals = run_with(raw_vals)
+        outs = tuple(Tensor(v, stop_gradient=True) for v in out_vals)
+        _maybe_check_nan_inf(name, out_vals)
+        return outs, multi_box["multi"]
+
+    primal_idx = [i for i, t in enumerate(tensors) if _requires_grad(t)]
+
+    def pure(*primals):
+        vals = list(raw_vals)
+        for i, p in zip(primal_idx, primals):
+            vals[i] = p
+        return run_with(vals)
+
+    out_vals, vjp_fn = jax.vjp(pure, *[raw_vals[i] for i in primal_idx])
+    _maybe_check_nan_inf(name, out_vals)
+
+    out_metas = [(tuple(v.shape), v.dtype) for v in out_vals]
+    node = GradNode(name, vjp_fn, out_metas)
+    node.edges = [_edge_for(tensors[i]) for i in primal_idx]
+
+    outs = []
+    for i, v in enumerate(out_vals):
+        d = np.dtype(v.dtype)
+        is_float = np.issubdtype(d, np.floating) or np.issubdtype(d, np.complexfloating)
+        t = Tensor(v, stop_gradient=not is_float)
+        if is_float:
+            t._grad_node = node
+            t._output_index = i
+        outs.append(t)
+    return tuple(outs), multi_box["multi"]
+
+
+def _edge_for(t):
+    node = getattr(t, "_grad_node", None)
+    if node is not None:
+        return (node, t._output_index)
+    accum = getattr(t, "_accumulate_node", None)
+    if accum is None:
+        accum = AccumulateGrad(t)
+        t._accumulate_node = accum
+    return (accum, 0)
+
+
+def _maybe_check_nan_inf(name, out_vals):
+    # reference: FLAGS_check_nan_inf + eager/nan_inf_utils.h — debug-only scan
+    if not _flags.get_flag("check_nan_inf"):
+        return
+    for i, v in enumerate(out_vals):
+        d = np.dtype(v.dtype)
+        if np.issubdtype(d, np.floating):
+            if not bool(jnp.all(jnp.isfinite(v))):
+                raise FloatingPointError(
+                    f"nan/inf detected in output {i} of op '{name}'"
+                )
