@@ -1,0 +1,91 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+Brand-new framework with the capability surface of PaddlePaddle
+(see SURVEY.md for the reference map), built on JAX/XLA/Pallas/pjit:
+- eager Tensors with per-op autograd (tape of jax.vjp closures),
+- a functional op corpus lowering to XLA,
+- nn/optimizer/amp/io layers,
+- jit capture ("to_static") over jax.jit with guard-based retrace,
+- a distributed stack (DP/TP/PP/SEP/EP/ZeRO + SPMD auto-parallel) expressed as
+  GSPMD shardings over a TPU mesh instead of NCCL process groups.
+"""
+from __future__ import annotations
+
+import jax as _jax
+
+# 64-bit types first-class (paddle defaults int64 indices; float64 available
+# on CPU; models use f32/bf16 explicitly on TPU).
+_jax.config.update("jax_enable_x64", True)
+
+from .framework import dtype as _dtype_mod
+from .framework.dtype import (  # noqa: F401
+    bfloat16, bool_, complex64, complex128, float16, float32, float64,
+    get_default_dtype, int8, int16, int32, int64, set_default_dtype, uint8,
+)
+from .framework.dtype import bool_ as bool  # noqa: F401,A001
+from .framework.flags import get_flags, set_flags  # noqa: F401
+from .framework.random import get_rng_state, seed, set_rng_state  # noqa: F401
+from .core.tensor import Parameter, Tensor, is_tensor  # noqa: F401
+from . import device  # noqa: F401
+from .device import (  # noqa: F401
+    CPUPlace, CustomPlace, Place, TPUPlace, get_device, is_compiled_with_tpu,
+    set_device,
+)
+from . import autograd  # noqa: F401
+from .autograd import enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
+from .autograd.py_layer import PyLayer  # noqa: F401
+from . import ops  # noqa: F401
+from .ops import *  # noqa: F401,F403
+from .ops import _C_ops  # noqa: F401
+from . import amp  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import io  # noqa: F401
+from . import metric  # noqa: F401
+from . import vision  # noqa: F401
+from . import jit  # noqa: F401
+from .framework import io as _fio
+from .framework.io import load, save  # noqa: F401
+from .jit import to_static  # noqa: F401
+from . import distributed  # noqa: F401
+from . import incubate  # noqa: F401
+from . import profiler  # noqa: F401
+from . import distribution  # noqa: F401
+from . import sparse  # noqa: F401
+from . import static  # noqa: F401
+from . import hapi  # noqa: F401
+from .hapi.model import Model  # noqa: F401
+from .nn.layer.layers import Layer  # noqa: F401
+
+# paddle-parity aliases
+disable_static = lambda place=None: None  # dygraph is the only eager mode
+enable_static = lambda: None
+
+def in_dynamic_mode():
+    return True
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def is_compiled_with_distribute():
+    return True
+
+
+version = type("version", (), {"full_version": "0.1.0", "major": 0, "minor": 1,
+                               "patch": 0, "cuda": staticmethod(lambda: False),
+                               "show": staticmethod(lambda: print("paddle_tpu 0.1.0"))})
+__version__ = "0.1.0"

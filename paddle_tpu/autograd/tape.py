@@ -119,10 +119,11 @@ class GradNode:
 
     __slots__ = (
         "name", "vjp_fn", "out_metas", "edges", "output_hooks", "released",
-        "__weakref__",
+        "pure_fn", "primal_tensors", "__weakref__",
     )
 
-    def __init__(self, name: str, vjp_fn: Callable, out_metas: List[Tuple]):
+    def __init__(self, name: str, vjp_fn: Callable, out_metas: List[Tuple],
+                 pure_fn: Optional[Callable] = None, primal_tensors=None):
         self.name = name
         self.vjp_fn = vjp_fn
         # (shape, dtype) per output so missing cotangents can be zero-filled
@@ -130,6 +131,11 @@ class GradNode:
         self.edges: List[Optional[Tuple[object, int]]] = []
         self.output_hooks: Dict[int, Dict[int, Callable]] = {}
         self.released = False
+        # retained for higher-order grad: re-differentiating the vjp w.r.t.
+        # the original primals requires re-linearizing the pure function
+        # (reference: paddle/fluid/eager/general_grad.h keeps the full graph)
+        self.pure_fn = pure_fn
+        self.primal_tensors = list(primal_tensors) if primal_tensors else []
 
     def __repr__(self):
         return f"<GradNode {self.name} outs={len(self.out_metas)}>"
@@ -148,10 +154,27 @@ class GradNode:
                 f"grad node {self.name} was already released; pass "
                 "retain_graph=True to backward() to backprop twice"
             )
-        if create_graph:
-            # route the vjp application itself through the dispatcher so the
-            # cotangent computation is recorded (higher-order grad,
+        if create_graph and self.pure_fn is not None:
+            # route the vjp application through the dispatcher as a function
+            # of BOTH the original primals and the cotangents, so the produced
+            # gradients connect back to the forward inputs (higher-order grad,
             # reference: paddle/fluid/eager/general_grad.h)
+            from ..ops import dispatch
+
+            n = len(self.primal_tensors)
+            pure_fn = self.pure_fn
+
+            def grad_fn(*args):
+                primals = args[:n]
+                cots = args[n:]
+                _, vjp_fn = jax.vjp(pure_fn, *primals)
+                return vjp_fn(tuple(cots))
+
+            return dispatch.apply_raw_multi(
+                "grad::" + self.name, grad_fn,
+                list(self.primal_tensors) + list(cotangents),
+            )
+        if create_graph:
             from ..ops import dispatch
 
             return dispatch.apply_raw_multi(
@@ -162,6 +185,8 @@ class GradNode:
 
     def release(self):
         self.vjp_fn = None
+        self.pure_fn = None
+        self.primal_tensors = []
         self.released = True
 
 

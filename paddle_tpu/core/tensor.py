@@ -27,7 +27,7 @@ class Tensor:
     __slots__ = (
         "_value", "stop_gradient", "_grad", "_grad_node", "_output_index",
         "_accumulate_node", "name", "persistable", "_version", "__weakref__",
-        "is_parameter", "_trainable_attrs",
+        "is_parameter", "_trainable_attrs", "_dist_attr",
     )
 
     def __init__(self, value, stop_gradient: bool = True, name: Optional[str] = None):
@@ -162,6 +162,17 @@ class Tensor:
         if not isinstance(new_value, (jax.Array, jax.core.Tracer)):
             new_value = jnp.asarray(new_value)
         self._value = new_value
+        self._version += 1
+        return self
+
+    def _adopt(self, result: "Tensor"):
+        """Adopt another tensor's value and autograd position (used by the
+        in-place op variants: the reference's inplace kernels + version
+        counting, here expressed as out-of-place + identity rebind)."""
+        self._value = result._value
+        self._grad_node = result._grad_node
+        self._output_index = result._output_index
+        self.stop_gradient = result.stop_gradient
         self._version += 1
         return self
 

@@ -1,0 +1,38 @@
+"""paddle_tpu.distributed (parity: python/paddle/distributed/).
+
+The distributed stack re-designed TPU-first (SURVEY.md §2.3, §5.8):
+- env/collective: process bootstrap + eager collective API surface
+- auto_parallel: dtensor API over jax.sharding (GSPMD replaces SPMD rules)
+- parallel/mesh: the hybrid topology (dp/pp/sharding/sep/mp axes) as ONE
+  jax Mesh; fleet wrappers express DP/TP/PP/SEP/ZeRO as sharding recipes
+- fleet: paddle.distributed.fleet parity layer
+"""
+from __future__ import annotations
+
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    Partial, Placement, ProcessMesh, Replicate, Shard, dtensor_from_fn,
+    get_mesh, reshard, set_mesh, shard_layer, shard_tensor,
+)
+from .collective import (  # noqa: F401
+    Group, ReduceOp, all_gather, all_gather_object, all_reduce, all_to_all,
+    barrier, broadcast, destroy_process_group, get_backend, get_group,
+    is_available, new_group, recv, reduce, reduce_scatter, scatter, send, wait,
+)
+from .env import (  # noqa: F401
+    ParallelEnv, get_rank, get_world_size, init_parallel_env, is_initialized,
+)
+from . import fleet  # noqa: F401
+from .parallel import DataParallel  # noqa: F401
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """parity: paddle.distributed.spawn. In the SPMD model one process drives
+    all local chips, so spawn degenerates to a direct call for nprocs<=1 and
+    is otherwise handled by the launcher (paddle_tpu.distributed.launch)."""
+    if nprocs in (-1, 0, 1):
+        func(*args)
+        return None
+    raise NotImplementedError(
+        "multi-process spawn: use `python -m paddle_tpu.distributed.launch` "
+        "(one process per host; chips are driven SPMD)")

@@ -1,0 +1,289 @@
+"""Auto-parallel (SPMD dtensor) API.
+
+Parity: python/paddle/distributed/auto_parallel/api.py — shard_tensor (:220),
+reshard (:797), shard_layer (:908), shard_optimizer (:1735), to_static →
+DistModel (:2952); C++ core parity: ProcessMesh (process_mesh.h:34),
+DistTensor (dist_tensor.h:39), placements (placement_types.h), the SPMD rule
+registry (inferspmd_utils.h:230) and reshard engine (reshard_function.h:29).
+
+TPU-native re-design: a "DistTensor" is simply a framework Tensor whose
+jax.Array carries a NamedSharding over a jax.sharding.Mesh. SPMD rule
+propagation is GSPMD inside XLA (no per-op rule table needed); ``reshard`` is
+jax.device_put with a new sharding (XLA emits the collectives — the 12
+conversion functions of the reference's reshard engine collapse into this one
+primitive).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ...core.tensor import Tensor
+
+__all__ = [
+    "ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
+    "shard_tensor", "reshard", "shard_layer", "dtensor_from_fn",
+    "get_mesh", "set_mesh", "DistAttr",
+]
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    """Shard the tensor's dim-th axis over the corresponding mesh axis."""
+
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+
+class Replicate(Placement):
+    def is_replicated(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+
+class Partial(Placement):
+    """Pending-reduction placement. XLA tracks partial sums internally during
+    GSPMD propagation; materializing a Partial tensor at the API boundary
+    reduces it (documented divergence from the reference's lazy p-state)."""
+
+    def __init__(self, reduce_type=None):
+        self.reduce_type = reduce_type or "sum"
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+
+class ProcessMesh:
+    """parity: paddle.distributed.ProcessMesh (process_mesh.h:34)."""
+
+    def __init__(self, mesh, dim_names: Optional[List[str]] = None,
+                 process_ids=None):
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self._shape = list(arr.shape)
+        self._dim_names = list(dim_names)
+        self._process_ids = arr.reshape(-1).tolist()
+        self._mesh_array = arr
+        self._jax_mesh = None
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dim_names(self):
+        return self._dim_names
+
+    @property
+    def process_ids(self):
+        return self._process_ids
+
+    @property
+    def mesh(self):
+        return self._mesh_array
+
+    def get_dim_size(self, name):
+        return self._shape[self._dim_names.index(name)]
+
+    def get_mesh_with_dim(self, dim_name, index=None):
+        """Sub-mesh along one axis (parity: ProcessMesh slicing used by PP
+        stage meshes, auto_parallel/api.py get_mesh(pp_idx))."""
+        axis = self._dim_names.index(dim_name)
+        if index is None:
+            order = [axis] + [i for i in range(self.ndim) if i != axis]
+            arr = np.transpose(self._mesh_array, order)
+            names = [dim_name] + [n for n in self._dim_names if n != dim_name]
+            return ProcessMesh(arr, names)
+        arr = np.take(self._mesh_array, index, axis=axis)
+        names = [n for i, n in enumerate(self._dim_names) if i != axis]
+        return ProcessMesh(arr, names)
+
+    def __getitem__(self, idx):
+        arr = self._mesh_array[idx]
+        names = self._dim_names[1:] if not isinstance(idx, slice) else self._dim_names
+        if arr.ndim == 0:
+            arr = arr.reshape(1)
+            names = ["d0"]
+        return ProcessMesh(arr, names)
+
+    def jax_mesh(self) -> Mesh:
+        """Materialize as a jax.sharding.Mesh over real devices."""
+        if self._jax_mesh is None:
+            devices = np.asarray(jax.devices())
+            if devices.size < self._mesh_array.size:
+                raise RuntimeError(
+                    f"mesh wants {self._mesh_array.size} devices, have "
+                    f"{devices.size}")
+            dev_arr = devices[self._mesh_array.reshape(-1)].reshape(
+                self._mesh_array.shape)
+            self._jax_mesh = Mesh(dev_arr, tuple(self._dim_names))
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return isinstance(other, ProcessMesh) and \
+            self._shape == other._shape and \
+            self._process_ids == other._process_ids and \
+            self._dim_names == other._dim_names
+
+    def __hash__(self):
+        return hash((tuple(self._shape), tuple(self._process_ids),
+                     tuple(self._dim_names)))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self._shape}, dim_names={self._dim_names})"
+
+
+_global_mesh: Optional[ProcessMesh] = None
+
+
+def set_mesh(mesh: ProcessMesh):
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _global_mesh
+
+
+def placements_to_spec(placements: Sequence[Placement], mesh: ProcessMesh,
+                       ndim: int) -> PartitionSpec:
+    """Translate paddle placements (one per MESH axis) into a jax
+    PartitionSpec (one entry per TENSOR axis)."""
+    entries: List = [None] * ndim
+    for mesh_axis, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            d = pl.dim % ndim
+            name = mesh.dim_names[mesh_axis]
+            if entries[d] is None:
+                entries[d] = name
+            elif isinstance(entries[d], tuple):
+                entries[d] = entries[d] + (name,)
+            else:
+                entries[d] = (entries[d], name)
+    return PartitionSpec(*entries)
+
+
+def spec_to_placements(spec: PartitionSpec, mesh: ProcessMesh) -> List[Placement]:
+    placements: List[Placement] = [Replicate() for _ in mesh.dim_names]
+    for tensor_dim, entry in enumerate(spec):
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        for name in names:
+            placements[mesh.dim_names.index(name)] = Shard(tensor_dim)
+    return placements
+
+
+class DistAttr:
+    """parity: TensorDistAttr (dist_attr.h)."""
+
+    def __init__(self, mesh: ProcessMesh, placements: Sequence[Placement]):
+        self.process_mesh = mesh
+        self.placements = list(placements)
+
+    def __repr__(self):
+        return f"DistAttr(mesh={self.process_mesh}, placements={self.placements})"
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements: Sequence[Placement],
+                 dtype=None, place=None, stop_gradient=None) -> Tensor:
+    """parity: dist.shard_tensor (api.py:220). Returns the same framework
+    Tensor type whose value is a global jax.Array laid out per placements."""
+    t = data if isinstance(data, Tensor) else Tensor(data)
+    spec = placements_to_spec(placements, mesh, t.ndim)
+    sharding = NamedSharding(mesh.jax_mesh(), spec)
+    val = jax.device_put(t._value, sharding)
+    out = Tensor(val, stop_gradient=t.stop_gradient if stop_gradient is None
+                 else stop_gradient)
+    out._dist_attr = DistAttr(mesh, placements)
+    if hasattr(t, "is_parameter") and t.is_parameter:
+        t._replace_value(val)
+        t._dist_attr = out._dist_attr
+        return t
+    return out
+
+
+def reshard(x: Tensor, mesh: ProcessMesh, placements: Sequence[Placement]) -> Tensor:
+    """parity: dist.reshard (api.py:797). One primitive covers the reference's
+    12 conversion functions (r_to_s, s_to_r, p_to_r, ... —
+    phi/core/distributed/auto_parallel/reshard/): XLA inserts the collectives.
+    """
+    spec = placements_to_spec(placements, mesh, x.ndim)
+    sharding = NamedSharding(mesh.jax_mesh(), spec)
+    out = Tensor(jax.device_put(x._value, sharding), stop_gradient=x.stop_gradient)
+    out._dist_attr = DistAttr(mesh, placements)
+    return out
+
+
+def dtensor_from_fn(fn, mesh: ProcessMesh, placements, *args, **kwargs) -> Tensor:
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def shard_layer(layer, process_mesh: ProcessMesh, shard_fn=None,
+                input_fn=None, output_fn=None):
+    """parity: dist.shard_layer (api.py:908)."""
+    if shard_fn is None:
+        def shard_fn(name, sublayer, mesh):
+            for pname, param in list(sublayer._parameters.items()):
+                if param is not None and getattr(param, "_dist_attr", None) is None:
+                    shard_tensor(param, mesh,
+                                 [Replicate() for _ in mesh.dim_names])
+
+    for name, sub in layer.named_sublayers(include_self=True):
+        shard_fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda lyr, inputs: input_fn(inputs, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda lyr, inputs, outputs: output_fn(outputs, process_mesh))
+    return layer
+
+
+def get_placements(x: Tensor):
+    attr = getattr(x, "_dist_attr", None)
+    return attr.placements if attr else None

@@ -1,0 +1,215 @@
+"""Communication groups & eager collectives.
+
+Parity: python/paddle/distributed/collective.py (group management) +
+communication/ (all_reduce.py, all_gather.py, all_to_all.py, ...;
+reference C++: ProcessGroupNCCL — paddle/fluid/distributed/collective/
+process_group_nccl.cc:267 AllReduce).
+
+TPU-native re-design: there are no per-rank NCCL process groups. The compiled
+SPMD path (shard_map/pjit over the mesh — see parallel/mesh.py) is where
+collectives become XLA ICI ops. This module provides the *eager* API surface:
+within one process the data is already global (collectives are arithmetic
+no-ops or local reshapes); across processes it rides
+jax.experimental.multihost_utils.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .env import get_rank, get_world_size
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """parity: paddle.distributed.collective.Group."""
+
+    _next_id = 0
+
+    def __init__(self, ranks: Optional[List[int]] = None, pg=None, name=None):
+        self.ranks = list(ranks) if ranks is not None else \
+            list(range(get_world_size()))
+        self.id = Group._next_id
+        Group._next_id += 1
+        self.name = name or f"group_{self.id}"
+
+    @property
+    def nranks(self):
+        return len(self.ranks)
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    @property
+    def rank(self):
+        return self.get_group_rank(get_rank())
+
+    def is_member(self):
+        return get_rank() in self.ranks
+
+    @property
+    def process_group(self):
+        return self
+
+    def __repr__(self):
+        return f"Group(id={self.id}, ranks={self.ranks})"
+
+
+_default_group: Optional[Group] = None
+
+
+def _get_default_group() -> Group:
+    global _default_group
+    if _default_group is None:
+        _default_group = Group()
+    return _default_group
+
+
+def new_group(ranks=None, backend=None, timeout=None) -> Group:
+    return Group(ranks)
+
+
+def get_group(gid=0) -> Group:
+    return _get_default_group()
+
+
+def is_available() -> bool:
+    return True
+
+
+def _multi_process(group: Optional[Group]) -> bool:
+    g = group or _get_default_group()
+    return get_world_size() > 1 and g.nranks > 1
+
+
+def _allgather_arrays(value, group):
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(value, tiled=False)
+
+
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
+               sync_op: bool = True):
+    if not _multi_process(group):
+        return tensor
+    gathered = _allgather_arrays(tensor._value, group)  # [world, ...]
+    red = {ReduceOp.SUM: jnp.sum, ReduceOp.MAX: jnp.max, ReduceOp.MIN: jnp.min,
+           ReduceOp.PROD: jnp.prod,
+           ReduceOp.AVG: jnp.mean}[op]
+    tensor._replace_value(red(gathered, axis=0))
+    return tensor
+
+
+def all_gather(tensor_list: List[Tensor], tensor: Tensor,
+               group: Optional[Group] = None, sync_op: bool = True):
+    if not _multi_process(group):
+        tensor_list.extend([Tensor(tensor._value)])
+        return
+    gathered = _allgather_arrays(tensor._value, group)
+    for i in range(gathered.shape[0]):
+        tensor_list.append(Tensor(gathered[i]))
+
+
+def all_gather_object(object_list: List, obj, group=None):
+    if not _multi_process(group):
+        object_list.append(obj)
+        return
+    from jax.experimental import multihost_utils
+
+    raise NotImplementedError("all_gather_object across hosts")
+
+
+def broadcast(tensor: Tensor, src: int = 0, group: Optional[Group] = None,
+              sync_op: bool = True):
+    if not _multi_process(group):
+        return tensor
+    from jax.experimental import multihost_utils
+
+    val = multihost_utils.broadcast_one_to_all(
+        tensor._value, is_source=get_rank() == src)
+    tensor._replace_value(val)
+    return tensor
+
+
+def reduce(tensor: Tensor, dst: int = 0, op=ReduceOp.SUM,  # noqa: A001
+           group: Optional[Group] = None, sync_op: bool = True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def scatter(tensor: Tensor, tensor_list=None, src: int = 0,
+            group: Optional[Group] = None, sync_op: bool = True):
+    if not _multi_process(group):
+        if tensor_list:
+            tensor._replace_value(tensor_list[0]._value)
+        return tensor
+    raise NotImplementedError("cross-host eager scatter; use the SPMD path")
+
+
+def reduce_scatter(tensor: Tensor, tensor_list: List[Tensor], op=ReduceOp.SUM,
+                   group: Optional[Group] = None, sync_op: bool = True):
+    if not _multi_process(group):
+        vals = [t._value for t in tensor_list]
+        tensor._replace_value(vals[0] if len(vals) == 1 else sum(vals))
+        return tensor
+    raise NotImplementedError("cross-host eager reduce_scatter; use the SPMD path")
+
+
+def all_to_all(out_tensor_list: List[Tensor], in_tensor_list: List[Tensor],
+               group: Optional[Group] = None, sync_op: bool = True):
+    if not _multi_process(group):
+        out_tensor_list.extend(Tensor(t._value) for t in in_tensor_list)
+        return
+    raise NotImplementedError("cross-host eager all_to_all; use the SPMD path")
+
+
+def send(tensor: Tensor, dst: int = 0, group=None, sync_op=True):
+    if not _multi_process(group):
+        _p2p_buffer.append(tensor._value)
+        return
+    raise NotImplementedError("cross-host eager send; use the SPMD path")
+
+
+def recv(tensor: Tensor, src: int = 0, group=None, sync_op=True):
+    if not _multi_process(group):
+        if _p2p_buffer:
+            tensor._replace_value(_p2p_buffer.pop(0))
+        return tensor
+    raise NotImplementedError("cross-host eager recv; use the SPMD path")
+
+
+_p2p_buffer: List = []
+
+
+def barrier(group: Optional[Group] = None):
+    if get_world_size() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    jax.block_until_ready(tensor._value if isinstance(tensor, Tensor) else tensor)
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    _default_group = None
+
+
+def get_backend(group=None) -> str:
+    return "xla"

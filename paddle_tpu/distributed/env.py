@@ -1,0 +1,96 @@
+"""Process/bootstrap environment.
+
+Parity: python/paddle/distributed/parallel.py:978 init_parallel_env and the
+PADDLE_* env contract (launch/controllers/collective.py:126-241). TPU-native
+backing: jax.distributed.initialize over the pod's coordination service — no
+TCPStore, no process groups; one process per host, all chips visible as one
+global device set.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = False
+
+
+class ParallelEnv:
+    """parity: paddle.distributed.ParallelEnv."""
+
+    @property
+    def rank(self) -> int:
+        return get_rank()
+
+    @property
+    def world_size(self) -> int:
+        return get_world_size()
+
+    @property
+    def local_rank(self) -> int:
+        return int(os.environ.get("PADDLE_LOCAL_RANK", 0))
+
+    @property
+    def dev_id(self) -> int:
+        return self.local_rank
+
+    @property
+    def nranks(self) -> int:
+        return get_world_size()
+
+    @property
+    def current_endpoint(self) -> str:
+        eps = self.trainer_endpoints
+        return eps[self.rank] if self.rank < len(eps) else ""
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+
+def get_rank(group=None) -> int:
+    """Process index (one process per TPU host in the JAX model)."""
+    if group is not None:
+        return group.get_group_rank(jax.process_index())
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    return jax.process_count()
+
+
+def is_initialized() -> bool:
+    return _initialized or jax.process_count() > 1
+
+
+def init_parallel_env():
+    """parity: paddle.distributed.init_parallel_env (parallel.py:978).
+
+    Reads the PADDLE_* / coordinator env contract and brings up
+    jax.distributed when a multi-host job is described. Single-host (any chip
+    count) needs no initialization: all local devices are already one SPMD
+    world.
+    """
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    coord = os.environ.get("PADDLE_MASTER") or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    pid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if coord and nprocs > 1 and jax.process_count() == 1:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=nprocs, process_id=pid)
+    _initialized = True
+    return ParallelEnv()
+
+
+def device_world_size() -> int:
+    """Total chips in the job (the SPMD parallel width)."""
+    return jax.device_count()
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()

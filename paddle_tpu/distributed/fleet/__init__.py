@@ -1,0 +1,150 @@
+"""paddle_tpu.distributed.fleet (parity: python/paddle/distributed/fleet/).
+
+fleet.init (reference fleet.py:218) builds the hybrid topology; here that
+means constructing the ONE jax Mesh whose axes are the hybrid-parallel axes
+(order configurable via hybrid_configs["order"], default outside→inside
+['dp','pp','sharding','sep','mp'] — reference:
+fleet/base/distributed_strategy.py:1892-1931).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..env import get_rank, get_world_size, init_parallel_env
+from .topology import (
+    CommunicateTopology, HybridCommunicateGroup, get_hybrid_communicate_group,
+    set_hybrid_communicate_group,
+)
+
+_AXIS_TO_NAME = {"dp": "data", "pp": "pipe", "sharding": "sharding",
+                 "sep": "sep", "mp": "model"}
+
+
+class DistributedStrategy:
+    """parity: fleet/base/distributed_strategy.py:284 (proto-backed config
+    re-expressed as a plain attribute bag)."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+            "order": ["dp", "pp", "sharding", "sep", "mp"],
+        }
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {}
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.gradient_scale_configs = {"scale_strategy": "avg"}
+
+    def __repr__(self):
+        return f"DistributedStrategy(hybrid={self.hybrid_configs})"
+
+
+class _Fleet:
+    def __init__(self):
+        self._strategy: Optional[DistributedStrategy] = None
+        self._hcg: Optional[HybridCommunicateGroup] = None
+        self._is_initialized = False
+        self._user_defined_optimizer = None
+
+    def init(self, role_maker=None, is_collective=True, strategy=None, log_level=0):
+        """parity: fleet.fleet.init (fleet.py:218)."""
+        init_parallel_env()
+        self._strategy = strategy or DistributedStrategy()
+        hc = self._strategy.hybrid_configs
+        order = hc.get("order", ["dp", "pp", "sharding", "sep", "mp"])
+        total_chips = _spmd_world_size()
+        degrees = {a: int(hc.get(f"{a}_degree", 1) or 1) for a in order}
+        # fill a -1/unset dp axis with the remaining parallelism
+        known = int(np.prod([d for a, d in degrees.items() if d > 0 and a != "dp"]))
+        if degrees.get("dp", 1) in (-1, 0) or \
+                (degrees.get("dp", 1) == 1 and known < total_chips and
+                 total_chips % max(known, 1) == 0):
+            degrees["dp"] = total_chips // max(known, 1)
+        names = [_AXIS_TO_NAME[a] for a in order]
+        dims = [degrees[a] for a in order]
+        topo = CommunicateTopology(names, dims)
+        self._hcg = HybridCommunicateGroup(topo)
+        set_hybrid_communicate_group(self._hcg)
+        self._is_initialized = True
+        return self
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    def is_first_worker(self):
+        return get_rank() == 0
+
+    def worker_index(self):
+        return get_rank()
+
+    def worker_num(self):
+        return get_world_size()
+
+    def barrier_worker(self):
+        from ..collective import barrier
+
+        barrier()
+
+    def distributed_model(self, model):
+        """parity: fleet/model.py:33 — wrap by strategy."""
+        from .meta_parallel import PipelineParallel, TensorParallel
+        from ..parallel import DataParallel
+
+        if self._hcg is None:
+            return model
+        if self._hcg.get_pipe_parallel_world_size() > 1:
+            if not isinstance(model, PipelineParallel):
+                model = PipelineParallel(model, self._hcg, self._strategy)
+            return model
+        if self._hcg.get_model_parallel_world_size() > 1:
+            return TensorParallel(model, self._hcg, self._strategy)
+        if self._hcg.get_data_parallel_world_size() > 1:
+            return DataParallel(model)
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        """parity: fleet.py:1448 → HybridParallelOptimizer."""
+        from .hybrid_optimizer import HybridParallelOptimizer
+
+        self._user_defined_optimizer = optimizer
+        if self._hcg is None:
+            return optimizer
+        return HybridParallelOptimizer(optimizer, self._hcg,
+                                       self._strategy or DistributedStrategy())
+
+    @property
+    def worker_endpoints(self):
+        import os
+
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+
+def _spmd_world_size():
+    import jax
+
+    return jax.device_count()
+
+
+fleet = _Fleet()
+
+# module-level function parity (paddle.distributed.fleet.init etc.)
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+worker_index = fleet.worker_index
+worker_num = fleet.worker_num
+is_first_worker = fleet.is_first_worker
+barrier_worker = fleet.barrier_worker

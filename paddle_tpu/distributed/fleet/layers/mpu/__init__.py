@@ -1,0 +1,6 @@
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from . import mp_ops  # noqa: F401
+from . import random  # noqa: F401

@@ -1,0 +1,52 @@
+"""Model-parallel comm primitives.
+
+Parity: fleet/layers/mpu/mp_ops.py:76-272 — _c_identity/_c_concat/_c_split/
+_mp_allreduce. TPU-native: these are sharding-constraint expressions; inside a
+compiled region GSPMD turns them into ICI collectives. They exist mostly for
+API compatibility — the mp_layers above no longer need them.
+"""
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from ....core.tensor import Tensor
+from ...shard_utils import with_sharding_constraint
+
+MP_AXIS = "mp"
+
+
+def _c_identity(tensor, group=None, skip_c_identity_dynamic=False):
+    """Forward identity / backward allreduce over mp — in GSPMD this is just
+    'input replicated over mp'."""
+    return with_sharding_constraint(tensor, P(*([None] * len(tensor.shape))))
+
+
+def _mp_allreduce(tensor, op=None, group=None, use_calc_stream=True,
+                  use_model_parallel=True):
+    """Forward allreduce / backward identity: constrain output replicated."""
+    return with_sharding_constraint(tensor, P(*([None] * len(tensor.shape))))
+
+
+def _c_split(tensor, group=None):
+    spec = [None] * (len(tensor.shape) - 1) + [MP_AXIS]
+    return with_sharding_constraint(tensor, P(*spec))
+
+
+def _c_concat(tensor, group=None):
+    return with_sharding_constraint(tensor, P(*([None] * len(tensor.shape))))
+
+
+def _c_lookup_table(table, index, start_index=0, name=None):
+    from .....nn import functional as F
+
+    return F.embedding(index, table)
+
+
+def _c_softmax_with_cross_entropy(logits, label, group=None,
+                                  return_softmax=False):
+    from .....nn import functional as F
+
+    loss = F.cross_entropy(logits, label, reduction="none")
+    if return_softmax:
+        return loss, F.softmax(logits)
+    return loss

@@ -1,0 +1,62 @@
+"""Model-parallel RNG state management
+(parity: fleet/layers/mpu/random.py — RNGStatesTracker for distinct dropout
+seeds inside vs outside TP regions)."""
+from __future__ import annotations
+
+import contextlib
+
+from .....framework.random import Generator
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states = {}
+        self.seeds = set()
+
+    def reset(self):
+        self.states = {}
+        self.seeds = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds:
+            raise ValueError(f"seed {seed} already exists")
+        self.seeds.add(seed)
+        self.states[name] = Generator(seed)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states:
+            raise ValueError(f"rng state {name} not added")
+        from .....framework import random as R
+
+        gen = self.states[name]
+        prev = getattr(R._tls, "generator", None)
+        R._tls.generator = gen
+        try:
+            yield
+        finally:
+            R._tls.generator = prev
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _tracker
+
+
+def model_parallel_random_seed(seed=None):
+    import random as _pyrandom
+
+    from ....env import get_rank
+
+    seed = seed or (_pyrandom.randint(0, 2 ** 31 - 1))
+    global_seed = seed
+    local_seed = seed + 1024 + get_rank()
+    _tracker.reset()
+    _tracker.add(MODEL_PARALLEL_RNG, local_seed)
+    from ..... import framework
+
+    framework.random.seed(global_seed)

@@ -1,0 +1,18 @@
+"""fleet.meta_parallel (parity: python/paddle/distributed/fleet/meta_parallel/).
+
+TPU-native: the wrappers mark HOW a model is parallelized over the hybrid
+mesh; the heavy lifting (collective insertion) is GSPMD under pjit. TP layers
+live in ../layers/mpu; PP scheduling in pp_parallel.py.
+"""
+from __future__ import annotations
+
+from ...parallel import DataParallel  # noqa: F401
+from ..layers.mpu.mp_layers import (  # noqa: F401
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
+from .pp_parallel import PipelineParallel  # noqa: F401
+from .segment_parallel import SegmentParallel  # noqa: F401
+from .tensor_parallel import TensorParallel  # noqa: F401
+from .sharding_parallel import ShardingParallel  # noqa: F401

@@ -1,0 +1,22 @@
+"""Helpers for PipelineLayer construction."""
+from __future__ import annotations
+
+
+def build_desc(d, shared_layers):
+    from .pp_layers import LayerDesc, SharedLayerDesc
+
+    if isinstance(d, SharedLayerDesc):
+        if d.layer_name not in shared_layers:
+            shared_layers[d.layer_name] = d.build_layer()
+        layer = shared_layers[d.layer_name]
+        if d.forward_func is not None:
+            fwd = d.forward_func
+
+            def call(*args, _layer=layer, **kw):
+                return fwd(_layer, *args, **kw)
+
+            return call
+        return layer
+    if isinstance(d, LayerDesc):
+        return d.build_layer()
+    return d  # already a Layer or callable

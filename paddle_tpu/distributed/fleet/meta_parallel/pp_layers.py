@@ -1,0 +1,115 @@
+"""PipelineLayer — layer segmentation for pipeline parallelism.
+
+Parity: fleet/meta_parallel/parallel_layers/pp_layers.py — LayerDesc (:57),
+SharedLayerDesc (:77), PipelineLayer (:258), PipelineLayerChunk (:208 for
+interleaved VPP).
+
+TPU-native: segmentation assigns each segment to a pipeline stage; execution
+happens either (a) single-program with all stages resident (stage axis folded
+into the mesh via GSPMD) or (b) the shard_map/ppermute microbatch schedule in
+parallel/pipeline.py.
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ....nn.layer.layers import Layer
+
+
+class LayerDesc:
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({getattr(self.layer_func, '__name__', self.layer_func)})"
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_func, forward_func=None, shared_weight_attr
+                 ="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._layers_desc = list(layers)
+        self._topo = topology
+        self._loss_fn = loss_fn
+        self._num_stages = num_stages or 1
+        self._recompute_interval = recompute_interval
+        self._seg_method = seg_method
+        self.segment_parts = self._segment(len(self._layers_desc),
+                                           self._num_stages)
+        # build ALL layers (single-program SPMD keeps every stage resident;
+        # the stage split drives the pipeline schedule, not process-local
+        # ownership as in the reference)
+        self.run_function: List = []
+        self._shared_layers = {}
+        from .container_utils import build_desc
+
+        for i, d in enumerate(self._layers_desc):
+            layer = build_desc(d, self._shared_layers)
+            self.run_function.append(layer)
+            if isinstance(layer, Layer):
+                self.add_sublayer(str(i), layer)
+
+    def _segment(self, num_layers, num_stages):
+        if self._seg_method == "uniform" or not isinstance(self._seg_method, str):
+            per = num_layers / num_stages
+            return [int(round(per * i)) for i in range(num_stages)] + [num_layers]
+        if self._seg_method.startswith("layer:"):
+            name = self._seg_method.split(":")[1]
+            marks = [0]
+            for i, d in enumerate(self._layers_desc):
+                fn = d.layer_func if isinstance(d, LayerDesc) else type(d)
+                if getattr(fn, "__name__", "") == name and i > 0:
+                    marks.append(i)
+            # group marked blocks evenly into stages
+            blocks = len(marks)
+            per = blocks / num_stages
+            parts = [marks[int(round(per * i))] for i in range(num_stages)]
+            return parts + [num_layers]
+        raise ValueError(f"unknown seg_method {self._seg_method}")
+
+    def get_stage_layers(self, stage_id):
+        lo, hi = self.segment_parts[stage_id], self.segment_parts[stage_id + 1]
+        return self.run_function[lo:hi]
+
+    def forward(self, x, **kwargs):
+        for fn in self.run_function:
+            x = fn(x) if not isinstance(x, tuple) else fn(*x)
+        return x
+
+    def loss(self, output, label):
+        if self._loss_fn is None:
+            return output
+        return self._loss_fn(output, label)
+
+
+# keep VPP naming parity
+class PipelineLayerChunk(Layer):
+    def __init__(self, layers):
+        super().__init__()
+        self.run_function = layers
+        for i, l in enumerate(layers):
+            if isinstance(l, Layer):
+                self.add_sublayer(str(i), l)
+
+    def forward(self, x):
+        for fn in self.run_function:
+            x = fn(x)
+        return x

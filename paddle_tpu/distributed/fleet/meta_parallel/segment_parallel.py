@@ -1,0 +1,30 @@
+"""SegmentParallel (SEP) wrapper — the sequence-dimension axis
+(parity: fleet/meta_parallel/segment_parallel.py:26; topology sep groups
+fleet/base/topology.py:199-260).
+
+TPU-native: sequence parallelism = sharding the sequence dim over the 'sep'
+mesh axis; attention over the full sequence uses ring attention
+(parallel mesh utilities + kernels/ring_attention.py) or Ulysses all-to-all.
+"""
+from __future__ import annotations
+
+from ....nn.layer.layers import Layer
+
+
+class SegmentParallel(Layer):
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state, *args, **kwargs):
+        return self._layers.set_state_dict(state, *args, **kwargs)

@@ -1,0 +1,29 @@
+"""ShardingParallel wrapper (parity: fleet/meta_parallel/sharding_parallel.py).
+
+ZeRO semantics on TPU: optimizer state (stage 1), gradients (stage 2) and
+parameters (stage 3) are sharded over the 'sharding' mesh axis via sharding
+annotations on the optimizer-state pytree — see
+distributed/sharding/group_sharded.py for the stage implementations.
+"""
+from __future__ import annotations
+
+from ....nn.layer.layers import Layer
+
+
+class ShardingParallel(Layer):
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state, *args, **kwargs):
+        return self._layers.set_state_dict(state, *args, **kwargs)

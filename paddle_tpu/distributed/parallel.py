@@ -1,0 +1,80 @@
+"""DataParallel wrapper.
+
+Parity: python/paddle/distributed/parallel.py:219 DataParallel (+ the C++
+EagerReducer bucketed allreduce, reference: fluid/distributed/collective/
+reducer.h:88).
+
+TPU-native semantics: in the SPMD model a "DataParallel" layer means inputs
+are sharded over the 'dp' mesh axis and gradients are mean-reduced across it —
+inside one process this is automatic (global batch arrays), across hosts the
+eager path averages grads with a cross-process allreduce after backward.
+"""
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+from .collective import ReduceOp, all_reduce
+from .env import get_world_size
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self._group = group
+        self._sync = True
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def no_sync(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            prev = self._sync
+            self._sync = False
+            try:
+                yield
+            finally:
+                self._sync = prev
+
+        return ctx()
+
+    def apply_collective_grads(self):
+        """Average grads across data-parallel ranks (eager path; the compiled
+        path gets this for free from GSPMD on the 'dp' axis)."""
+        if get_world_size() <= 1 or not self._sync:
+            return
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                all_reduce(p.grad, op=ReduceOp.AVG, group=self._group)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def train(self):
+        self._layers.train()
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        return self
+
+    @property
+    def training(self):
+        return self._layers.training
+
+    @training.setter
+    def training(self, v):
+        pass

@@ -1,0 +1,67 @@
+"""Sharding-constraint helpers shared by the parallel layers.
+
+The single most important TPU-native mechanism: a layer does NOT issue
+collectives (the reference's _c_identity/_mp_allreduce,
+fleet/layers/mpu/mp_ops.py:76-272); it annotates the desired sharding and XLA
+GSPMD materializes the collectives over ICI.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import apply
+
+_active_mesh: Optional[Mesh] = None
+
+
+def set_active_mesh(mesh: Optional[Mesh]):
+    """Install the mesh used by sharding constraints (set by fleet.init /
+    DistModel / shard_map contexts)."""
+    global _active_mesh
+    _active_mesh = mesh
+
+
+def get_active_mesh() -> Optional[Mesh]:
+    if _active_mesh is not None:
+        return _active_mesh
+    from .auto_parallel import get_mesh
+
+    pm = get_mesh()
+    return pm.jax_mesh() if pm is not None else None
+
+
+def _mesh_has_axes(mesh: Mesh, spec: PartitionSpec) -> bool:
+    names = set(mesh.axis_names)
+    for entry in spec:
+        if entry is None:
+            continue
+        for n in (entry if isinstance(entry, tuple) else (entry,)):
+            if n not in names:
+                return False
+    return True
+
+
+def with_sharding_constraint(x: Tensor, spec: Union[PartitionSpec, Sequence]) -> Tensor:
+    """Annotate x with a PartitionSpec if a mesh is active; no-op otherwise.
+    Recorded through dispatch so gradients flow (the constraint is its own
+    transpose)."""
+    if not isinstance(spec, PartitionSpec):
+        spec = PartitionSpec(*spec)
+    mesh = get_active_mesh()
+    if mesh is None or not _mesh_has_axes(mesh, spec):
+        return x
+
+    def fn(v):
+        if v.ndim < len([e for e in spec if e is not None]):
+            return v
+        return jax.lax.with_sharding_constraint(v, NamedSharding(mesh, spec))
+
+    try:
+        return apply("sharding_constraint", fn, x)
+    except Exception:
+        # eager value whose layout can't be constrained (e.g. no mesh context)
+        return x

@@ -1,0 +1,63 @@
+"""paddle.save / paddle.load parity
+(reference: python/paddle/framework/io.py:773,1020).
+
+Serialization format: pickle of a structure whose Tensors are converted to
+numpy arrays (same contract as the reference's pickled state_dicts). Layer /
+Optimizer state_dicts round-trip; nested dicts/lists/tuples are supported.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _to_serializable(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj._value), str(obj.dtype),
+                              obj.stop_gradient)
+    if isinstance(obj, dict):
+        return {k: _to_serializable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_serializable(v) for v in obj)
+    return obj
+
+
+def _from_serializable(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        t = Tensor(obj.array)
+        return t
+    if isinstance(obj, dict):
+        return {k: _from_serializable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_serializable(v, return_numpy) for v in obj)
+    return obj
+
+
+class _TensorPayload:
+    __slots__ = ("array", "dtype", "stop_gradient")
+
+    def __init__(self, array, dtype, stop_gradient):
+        self.array = array
+        self.dtype = dtype
+        self.stop_gradient = stop_gradient
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_serializable(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False, **configs) -> Any:
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    return _from_serializable(payload, return_numpy=return_numpy)

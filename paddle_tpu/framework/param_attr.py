@@ -1,0 +1,37 @@
+"""ParamAttr (parity: python/paddle/base/param_attr.py)."""
+from __future__ import annotations
+
+from typing import Optional
+
+
+class ParamAttr:
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        initializer=None,
+        learning_rate: float = 1.0,
+        regularizer=None,
+        trainable: bool = True,
+        do_model_average: bool = True,
+        need_clip: bool = True,
+    ):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return None
+        if isinstance(attr, ParamAttr):
+            return attr
+        if attr is False:
+            return ParamAttr(trainable=False)
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        # an initializer instance
+        return ParamAttr(initializer=attr)

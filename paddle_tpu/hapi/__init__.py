@@ -1,0 +1,6 @@
+"""paddle_tpu.hapi (parity: python/paddle/hapi/)."""
+from .model import Model  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+)
+from .summary import summary  # noqa: F401

@@ -1,0 +1,235 @@
+"""High-level Model API.
+
+Parity: python/paddle/hapi/model.py:1472 (paddle.Model; fit at :2200,
+train_batch/eval_batch/predict_batch adapters at :371,759,1237).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..autograd import no_grad
+from .callbacks import CallbackList, ProgBarLogger
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else (
+            [metrics] if metrics is not None else [])
+        return self
+
+    # -- single-batch entry points ----------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outputs = self.network(*inputs)
+        losses = self._compute_loss(outputs, labels)
+        total = losses if isinstance(losses, Tensor) else sum(losses)
+        total.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(outputs, labels)
+        return [float(total.item())] + metrics
+
+    @no_grad()
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outputs = self.network(*inputs)
+        losses = self._compute_loss(outputs, labels)
+        total = losses if isinstance(losses, Tensor) else sum(losses)
+        metrics = self._update_metrics(outputs, labels)
+        return [float(total.item())] + metrics
+
+    @no_grad()
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        return self.network(*inputs)
+
+    def _compute_loss(self, outputs, labels):
+        if self._loss is None:
+            return outputs
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        return self._loss(*outs, *labels)
+
+    def _update_metrics(self, outputs, labels):
+        res = []
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        for m in self._metrics:
+            inter = m.compute(*outs, *labels)
+            inter = inter if isinstance(inter, (list, tuple)) else [inter]
+            r = m.update(*[np.asarray(i._value) if isinstance(i, Tensor) else i
+                           for i in inter])
+            res.append(r)
+        return res
+
+    # -- loops -------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        from ..io import DataLoader, Dataset
+
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        if eval_data is not None and isinstance(eval_data, Dataset):
+            eval_loader = DataLoader(eval_data, batch_size=batch_size,
+                                     num_workers=num_workers)
+        else:
+            eval_loader = eval_data
+
+        cbks = CallbackList(callbacks or ([ProgBarLogger(log_freq, verbose)]
+                                          if verbose else []))
+        cbks.set_model(self)
+        cbks.set_params({"epochs": epochs, "steps": _steps(train_loader),
+                         "verbose": verbose,
+                         "metrics": ["loss"] + self._metrics_names()})
+        cbks.on_begin("train")
+        step_count = 0
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, data in enumerate(train_loader):
+                cbks.on_batch_begin("train", step, logs)
+                ins, labs = _split_data(data)
+                res = self.train_batch(
+                    ins, labs, update=(step + 1) % accumulate_grad_batches == 0)
+                logs = self._make_logs(res)
+                logs["step"] = step
+                cbks.on_batch_end("train", step, logs)
+                step_count += 1
+                if num_iters is not None and step_count >= num_iters:
+                    break
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0)
+                logs.update({"eval_" + k: v for k, v in eval_logs.items()})
+            cbks.on_epoch_end(epoch, logs)
+            if self.stop_training:
+                break
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/{epoch}")
+        cbks.on_end("train", logs)
+        if save_dir:
+            self.save(f"{save_dir}/final")
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        from ..io import DataLoader, Dataset
+
+        if isinstance(eval_data, Dataset):
+            loader = DataLoader(eval_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = eval_data
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for step, data in enumerate(loader):
+            ins, labs = _split_data(data)
+            res = self.eval_batch(ins, labs)
+            losses.append(res[0])
+            if num_iters is not None and step + 1 >= num_iters:
+                break
+        logs = {"loss": float(np.mean(losses)) if losses else 0.0}
+        for m in self._metrics:
+            name = m.name()
+            acc = m.accumulate()
+            if isinstance(name, list):
+                logs.update(dict(zip(name, acc)))
+            else:
+                logs[name] = acc
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        from ..io import DataLoader, Dataset
+
+        if isinstance(test_data, Dataset):
+            loader = DataLoader(test_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = test_data
+        outputs = []
+        for data in loader:
+            ins, _ = _split_data(data)
+            outputs.append(self.predict_batch(ins))
+        return outputs
+
+    # -- persistence --------------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework.io import save as _save
+
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import os
+
+        from ..framework.io import load as _load
+
+        self.network.set_state_dict(_load(path + ".pdparams"))
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path):
+            self._optimizer.set_state_dict(_load(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary as _summary
+
+        return _summary(self.network, input_size, dtypes=dtype)
+
+    def _metrics_names(self):
+        names = []
+        for m in self._metrics:
+            n = m.name()
+            names += n if isinstance(n, list) else [n]
+        return names
+
+    def _make_logs(self, res):
+        logs = {"loss": res[0]}
+        for name, val in zip(self._metrics_names(), res[1:]):
+            logs[name] = val
+        return logs
+
+
+def _steps(loader):
+    try:
+        return len(loader)
+    except TypeError:
+        return None
+
+
+def _split_data(data):
+    if isinstance(data, (list, tuple)):
+        if len(data) >= 2:
+            return list(data[:-1]), [data[-1]]
+        return [data[0]], None
+    return [data], None

@@ -1,0 +1,22 @@
+"""paddle.summary parity (python/paddle/hapi/model_summary.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def summary(net, input_size=None, dtypes=None, input=None):  # noqa: A002
+    total_params = 0
+    trainable_params = 0
+    lines = [f"{'Layer':<40}{'Param #':>12}"]
+    lines.append("-" * 52)
+    for name, p in net.named_parameters():
+        n = p.size
+        total_params += n
+        if not p.stop_gradient:
+            trainable_params += n
+        lines.append(f"{name:<40}{n:>12,}")
+    lines.append("-" * 52)
+    lines.append(f"Total params: {total_params:,}")
+    lines.append(f"Trainable params: {trainable_params:,}")
+    print("\n".join(lines))
+    return {"total_params": total_params, "trainable_params": trainable_params}

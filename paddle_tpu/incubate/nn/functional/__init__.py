@@ -1,0 +1,155 @@
+"""Fused op surface (parity: python/paddle/incubate/nn/functional/ —
+fused_rms_norm, fused_rotary_position_embedding, swiglu, fused_matmul_bias,
+fused_moe, masked/block multihead attention).
+
+On TPU "fused" means XLA fusion or a Pallas kernel — the API contract is what
+matters; implementations route to the ops/kernels layers.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....ops.creation import _t
+from ....ops.dispatch import apply
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, bias=None, residual=None,
+                   quant_scale=-1, **kw):
+    from ....nn import functional as F
+
+    def fn(v, w, *rest):
+        i = 0
+        res = None
+        b = None
+        if residual is not None:
+            res = rest[i]
+            i += 1
+        if bias is not None:
+            b = rest[i]
+        if b is not None:
+            v = v + b
+        if res is not None:
+            v = v + res
+        var = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=-1, keepdims=True)
+        out = (v.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)).astype(v.dtype)
+        out = out * w
+        if norm_bias is not None:
+            out = out + norm_bias._value
+        return out
+
+    args = [_t(x), _t(norm_weight)]
+    if residual is not None:
+        args.append(_t(residual))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply("fused_rms_norm", fn, *args)
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=-1, **kw):
+    from ....nn import functional as F
+
+    return F.layer_norm(x, [x.shape[-1]], norm_weight, norm_bias, epsilon)
+
+
+def swiglu(x, y=None, name=None):
+    """parity: incubate/nn/functional/swiglu — silu(x) * y (or split x)."""
+    if y is None:
+        def fn(v):
+            a, b = jnp.split(v, 2, axis=-1)
+            return jax.nn.silu(a) * b
+
+        return apply("swiglu", fn, _t(x))
+    return apply("swiglu", lambda a, b: jax.nn.silu(a) * b, _t(x), _t(y))
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    time_major=False, rotary_emb_base=10000.0):
+    """parity: incubate/nn/functional/fused_rotary_position_embedding.
+    Inputs [batch, seq, heads, head_dim]."""
+
+    def rope_one(x_val, sin_val, cos_val):
+        if use_neox_rotary_style:
+            x1, x2 = jnp.split(x_val, 2, axis=-1)
+            rotated = jnp.concatenate([-x2, x1], axis=-1)
+            return x_val * cos_val + rotated * sin_val
+        x1 = x_val[..., 0::2]
+        x2 = x_val[..., 1::2]
+        rot = jnp.stack([-x2, x1], axis=-1).reshape(x_val.shape)
+        return x_val * cos_val + rot * sin_val
+
+    def make_sincos(x_val):
+        seq = x_val.shape[1]
+        dim = x_val.shape[-1]
+        inv = 1.0 / (rotary_emb_base ** (jnp.arange(0, dim, 2,
+                                                    dtype=jnp.float32) / dim))
+        t = jnp.arange(seq, dtype=jnp.float32)
+        freqs = jnp.outer(t, inv)
+        emb = jnp.concatenate([freqs, freqs], axis=-1)
+        return (jnp.sin(emb)[None, :, None, :].astype(x_val.dtype),
+                jnp.cos(emb)[None, :, None, :].astype(x_val.dtype))
+
+    outs = []
+    for t_in in (q, k, v):
+        if t_in is None:
+            outs.append(None)
+            continue
+        if sin is not None and cos is not None:
+            def fn(v_, s_, c_):
+                s_ = s_.reshape(1, s_.shape[-2], 1, s_.shape[-1]) if s_.ndim != 4 else s_
+                c_ = c_.reshape(1, c_.shape[-2], 1, c_.shape[-1]) if c_.ndim != 4 else c_
+                return rope_one(v_, s_.astype(v_.dtype), c_.astype(v_.dtype))
+
+            outs.append(apply("fused_rope", fn, _t(t_in), _t(sin), _t(cos)))
+        else:
+            def fn(v_):
+                s_, c_ = make_sincos(v_)
+                return rope_one(v_, s_, c_)
+
+            outs.append(apply("fused_rope", fn, _t(t_in)))
+    return tuple(outs)
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    from ....ops.linalg import matmul
+
+    out = matmul(x, y, transpose_x, transpose_y)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    return fused_matmul_bias(x, weight, bias, transpose_y=transpose_weight)
+
+
+def fused_linear_activation(x, y, bias=None, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    from ....nn import functional as F
+
+    out = fused_matmul_bias(x, y, bias, trans_x, trans_y)
+    return getattr(F, activation)(out)
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.0, ln_epsilon=1e-5,
+                                           training=True, **kw):
+    from ....nn import functional as F
+
+    out = x if bias is None else x + bias
+    if dropout_rate:
+        out = F.dropout(out, dropout_rate, training=training)
+    out = out + residual
+    return F.layer_norm(out, [out.shape[-1]], ln_scale, ln_bias, ln_epsilon)
+
+
+def fused_dropout_add(x, y, p=0.0, training=True, mode="upscale_in_train",
+                      name=None):
+    from ....nn import functional as F
+
+    return F.dropout(x, p, training=training, mode=mode) + y

@@ -1,0 +1,1143 @@
+"""paddle_tpu.nn.functional.
+
+Parity surface: python/paddle/nn/functional/ (activation.py, common.py,
+conv.py, loss.py, norm.py, pooling.py, input.py, flash_attention.py:358).
+Convs/pools lower to lax.conv_general_dilated / lax.reduce_window — the MXU
+path; everything is recorded through ops.dispatch for eager autograd.
+"""
+from __future__ import annotations
+
+import math as _math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...framework import dtype as dtypes
+from ...framework.random import next_key
+from ...ops.creation import _t
+from ...ops.dispatch import apply
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+def _unary(opname, jfn):
+    def op(x, name=None):
+        return apply(opname, jfn, _t(x))
+
+    op.__name__ = opname
+    return op
+
+
+relu = _unary("relu", jax.nn.relu)
+relu6 = _unary("relu6", jax.nn.relu6)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+tanh = _unary("tanh", jnp.tanh)
+silu = _unary("silu", jax.nn.silu)
+swish = silu
+mish = _unary("mish", lambda v: v * jnp.tanh(jax.nn.softplus(v)))
+softsign = _unary("softsign", jax.nn.soft_sign)
+tanhshrink = _unary("tanhshrink", lambda v: v - jnp.tanh(v))
+hardswish = _unary("hardswish", lambda v: v * jnp.clip(v + 3.0, 0.0, 6.0) / 6.0)
+hardsigmoid = _unary("hardsigmoid", lambda v: jnp.clip(v / 6.0 + 0.5, 0.0, 1.0))
+
+
+def gelu(x, approximate=False, name=None):
+    return apply("gelu", lambda v: jax.nn.gelu(v, approximate=approximate), _t(x))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply("leaky_relu", lambda v: jax.nn.leaky_relu(v, negative_slope), _t(x))
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply("elu", lambda v: jax.nn.elu(v, alpha), _t(x))
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply("celu", lambda v: jax.nn.celu(v, alpha), _t(x))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply(
+        "selu", lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)), _t(x)
+    )
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fn(v, w):
+        if w.size == 1:
+            return jnp.where(v > 0, v, w.reshape(()) * v)
+        shape = [1] * v.ndim
+        ch_axis = 1 if data_format[1] == "C" else v.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(v > 0, v, w.reshape(shape) * v)
+
+    return apply("prelu", fn, _t(x), _t(weight))
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    if training:
+        a = jax.random.uniform(next_key(), tuple(x.shape), np.dtype(x._value.dtype),
+                               lower, upper)
+    else:
+        a = (lower + upper) / 2.0
+    return apply("rrelu", lambda v: jnp.where(v >= 0, v, a * v), _t(x))
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    return apply("hardtanh", lambda v: jnp.clip(v, min, max), _t(x))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply(
+        "hardshrink", lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0), _t(x)
+    )
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(
+        "softshrink",
+        lambda v: jnp.where(v > threshold, v - threshold,
+                            jnp.where(v < -threshold, v + threshold, 0.0)),
+        _t(x),
+    )
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply(
+        "softplus",
+        lambda v: jnp.where(v * beta > threshold, v, jax.nn.softplus(v * beta) / beta),
+        _t(x),
+    )
+
+
+def logsigmoid(x, name=None):
+    return apply("logsigmoid", jax.nn.log_sigmoid, _t(x))
+
+
+def log_sigmoid(x, name=None):
+    return logsigmoid(x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def fn(v):
+        if dtype is not None:
+            v = v.astype(dtypes.convert_dtype(dtype).np_dtype)
+        return jax.nn.softmax(v, axis=axis)
+
+    return apply("softmax", fn, _t(x))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def fn(v):
+        if dtype is not None:
+            v = v.astype(dtypes.convert_dtype(dtype).np_dtype)
+        return jax.nn.log_softmax(v, axis=axis)
+
+    return apply("log_softmax", fn, _t(x))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    return x._adopt(softmax(x, axis, dtype))
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    g = -jnp.log(-jnp.log(
+        jax.random.uniform(next_key(), tuple(x.shape), np.dtype(x._value.dtype),
+                           1e-20, 1.0)))
+
+    def fn(v):
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False) \
+                if hasattr(jnp, "put_along_axis") else \
+                y_hard.at[_along(idx, axis, y.shape)].set(1.0)
+            y = jax.lax.stop_gradient(y_hard - y) + y
+        return y
+
+    return apply("gumbel_softmax", fn, _t(x))
+
+
+def _along(idx, axis, shape):
+    grids = list(jnp.meshgrid(*[jnp.arange(s) for s in shape], indexing="ij"))
+    grids[axis] = jnp.broadcast_to(idx, shape)
+    return tuple(grids)
+
+
+def glu(x, axis=-1, name=None):
+    return apply("glu", lambda v: jax.nn.glu(v, axis=axis), _t(x))
+
+
+# ---------------------------------------------------------------------------
+# linear / embedding
+# ---------------------------------------------------------------------------
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with W shaped [in, out] (paddle layout,
+    reference: python/paddle/nn/functional/common.py linear)."""
+    if bias is None:
+        return apply("linear", lambda v, w: v @ w, _t(x), _t(weight))
+    return apply("linear", lambda v, w, b: v @ w + b, _t(x), _t(weight), _t(bias))
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, max_norm=None,
+              norm_type=2.0, name=None):
+    def fn(idx, w):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return apply("embedding", fn, _t(x), _t(weight))
+
+
+def one_hot(x, num_classes, name=None):
+    from ...ops.creation import one_hot as _oh
+
+    return _oh(x, num_classes)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def fn(a, b, w, *bb):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bb:
+            out = out + bb[0]
+        return out
+
+    args = [_t(x1), _t(x2), _t(weight)] + ([_t(bias)] if bias is not None else [])
+    return apply("bilinear", fn, *args)
+
+
+# ---------------------------------------------------------------------------
+# convolution
+# ---------------------------------------------------------------------------
+def _norm_tuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(e) for e in v)
+
+
+def _conv_padding(padding, n, stride=None):
+    """Normalize paddle padding spec to lax padding."""
+    if isinstance(padding, str):
+        return padding.upper()  # 'SAME' / 'VALID'
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding),) * 2] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, (int, np.integer)) for p in padding):
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    # list of pairs
+    return [tuple(int(q) for q in p) for p in padding]
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    """reference kernel: paddle/phi/kernels/gpu(dnn)/conv_kernel — here a
+    direct lax.conv_general_dilated lowering onto the MXU."""
+    n = 2
+    strides = _norm_tuple(stride, n)
+    dil = _norm_tuple(dilation, n)
+    pad = _conv_padding(padding, n)
+    dn = (data_format, "OIHW", data_format)
+
+    def fn(v, w, *b):
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=strides, padding=pad, rhs_dilation=dil,
+            dimension_numbers=dn, feature_group_count=groups,
+            preferred_element_type=None,
+        )
+        if b:
+            bias_shape = [1] * out.ndim
+            bias_shape[1 if data_format == "NCHW" else -1] = b[0].shape[0]
+            out = out + b[0].reshape(bias_shape)
+        return out
+
+    args = [_t(x), _t(weight)] + ([_t(bias)] if bias is not None else [])
+    return apply("conv2d", fn, *args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    strides = _norm_tuple(stride, 1)
+    dil = _norm_tuple(dilation, 1)
+    pad = _conv_padding(padding, 1)
+    dn = ("NCH" if data_format == "NCL" else "NHC", "OIH",
+          "NCH" if data_format == "NCL" else "NHC")
+
+    def fn(v, w, *b):
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=strides, padding=pad, rhs_dilation=dil,
+            dimension_numbers=dn, feature_group_count=groups)
+        if b:
+            shape = [1] * out.ndim
+            shape[1 if data_format == "NCL" else -1] = b[0].shape[0]
+            out = out + b[0].reshape(shape)
+        return out
+
+    args = [_t(x), _t(weight)] + ([_t(bias)] if bias is not None else [])
+    return apply("conv1d", fn, *args)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    strides = _norm_tuple(stride, 3)
+    dil = _norm_tuple(dilation, 3)
+    pad = _conv_padding(padding, 3)
+    dn = (data_format, "OIDHW", data_format)
+
+    def fn(v, w, *b):
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=strides, padding=pad, rhs_dilation=dil,
+            dimension_numbers=dn, feature_group_count=groups)
+        if b:
+            shape = [1] * out.ndim
+            shape[1 if data_format == "NCDHW" else -1] = b[0].shape[0]
+            out = out + b[0].reshape(shape)
+        return out
+
+    args = [_t(x), _t(weight)] + ([_t(bias)] if bias is not None else [])
+    return apply("conv3d", fn, *args)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     output_size=None, data_format="NCHW", name=None):
+    n = 2
+    strides = _norm_tuple(stride, n)
+    dil = _norm_tuple(dilation, n)
+    opad = _norm_tuple(output_padding, n)
+    padding_n = _conv_padding(padding, n)
+
+    def fn(v, w, *b):
+        # weight layout [in_c, out_c/groups, kh, kw] (paddle transpose-conv)
+        if isinstance(padding_n, str):
+            pads = padding_n
+        else:
+            pads = []
+            for i in range(n):
+                k = (w.shape[2 + i] - 1) * dil[i] + 1
+                lo = k - 1 - padding_n[i][0]
+                hi = k - 1 - padding_n[i][1] + opad[i]
+                pads.append((lo, hi))
+        w_flip = jnp.flip(w, axis=(2, 3))
+        if groups > 1:
+            ic, ocg = w.shape[0], w.shape[1]
+            w_flip = w_flip.reshape(groups, ic // groups, ocg, *w.shape[2:])
+            w_flip = jnp.moveaxis(w_flip, 2, 1).reshape(
+                groups * ocg, ic // groups, *w.shape[2:])
+        else:
+            w_flip = jnp.swapaxes(w_flip, 0, 1)
+        out = jax.lax.conv_general_dilated(
+            v, w_flip, window_strides=(1, 1), padding=pads, lhs_dilation=strides,
+            rhs_dilation=dil, dimension_numbers=(data_format, "OIHW", data_format),
+            feature_group_count=groups)
+        if b:
+            shape = [1] * out.ndim
+            shape[1 if data_format == "NCHW" else -1] = b[0].shape[0]
+            out = out + b[0].reshape(shape)
+        return out
+
+    args = [_t(x), _t(weight)] + ([_t(bias)] if bias is not None else [])
+    return apply("conv2d_transpose", fn, *args)
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+def _pool(x, kind, kernel, stride, padding, data_format, ceil_mode=False,
+          exclusive=True, nd=2):
+    kernel = _norm_tuple(kernel, nd)
+    stride = _norm_tuple(stride if stride is not None else kernel, nd)
+    pad = _conv_padding(padding, nd)
+    channel_last = data_format[-1] == "C"
+
+    def fn(v):
+        if channel_last:
+            window = (1,) + kernel + (1,)
+            strides_ = (1,) + stride + (1,)
+            pads = [(0, 0)] + (pad if isinstance(pad, list) else pad) + [(0, 0)] \
+                if not isinstance(pad, str) else pad
+        else:
+            window = (1, 1) + kernel
+            strides_ = (1, 1) + stride
+            pads = [(0, 0), (0, 0)] + pad if not isinstance(pad, str) else pad
+        if kind == "max":
+            init = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else \
+                jnp.iinfo(v.dtype).min
+            return jax.lax.reduce_window(v, init, jax.lax.max, window, strides_,
+                                         pads if not isinstance(pads, str) else pads)
+        # avg
+        ones = jnp.ones_like(v)
+        s = jax.lax.reduce_window(v, 0.0, jax.lax.add, window, strides_,
+                                  pads if not isinstance(pads, str) else pads)
+        if exclusive:
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides_,
+                                        pads if not isinstance(pads, str) else pads)
+        else:
+            cnt = float(np.prod(kernel))
+        return s / cnt
+
+    return apply(kind + "_pool", fn, _t(x))
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    return _pool(x, "max", kernel_size, stride, padding, data_format, ceil_mode)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, "avg", kernel_size, stride, padding, data_format, ceil_mode,
+                 exclusive)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    return _pool(x, "max", kernel_size, stride, padding, "NCL", ceil_mode, nd=1)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _pool(x, "avg", kernel_size, stride, padding, "NCL", ceil_mode,
+                 exclusive, nd=1)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW", name=None):
+    return _pool(x, "max", kernel_size, stride, padding, data_format, ceil_mode, nd=3)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, "avg", kernel_size, stride, padding, data_format, ceil_mode,
+                 exclusive, nd=3)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    out_hw = _norm_tuple(output_size, 2)
+
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v4 = v
+        else:
+            v4 = jnp.moveaxis(v, -1, 1)
+            n, c, h, w = v4.shape
+        oh, ow = out_hw
+        # split into oh x ow regions (exact when divisible; general via mean of
+        # variable windows using cumulative sums)
+        if h % oh == 0 and w % ow == 0:
+            out = v4.reshape(n, c, oh, h // oh, ow, w // ow).mean((3, 5))
+        else:
+            hs = np.floor(np.arange(oh) * h / oh).astype(int)
+            he = np.ceil((np.arange(oh) + 1) * h / oh).astype(int)
+            ws = np.floor(np.arange(ow) * w / ow).astype(int)
+            we = np.ceil((np.arange(ow) + 1) * w / ow).astype(int)
+            rows = []
+            for i in range(oh):
+                cols = []
+                for j in range(ow):
+                    cols.append(v4[:, :, hs[i]:he[i], ws[j]:we[j]].mean((2, 3)))
+                rows.append(jnp.stack(cols, -1))
+            out = jnp.stack(rows, -2)
+        if data_format != "NCHW":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return apply("adaptive_avg_pool2d", fn, _t(x))
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out_hw = _norm_tuple(output_size, 2)
+
+    def fn(v):
+        n, c, h, w = v.shape
+        oh, ow = out_hw
+        if h % oh == 0 and w % ow == 0:
+            return v.reshape(n, c, oh, h // oh, ow, w // ow).max((3, 5))
+        hs = np.floor(np.arange(oh) * h / oh).astype(int)
+        he = np.ceil((np.arange(oh) + 1) * h / oh).astype(int)
+        ws = np.floor(np.arange(ow) * w / ow).astype(int)
+        we = np.ceil((np.arange(ow) + 1) * w / ow).astype(int)
+        rows = []
+        for i in range(oh):
+            cols = []
+            for j in range(ow):
+                cols.append(v[:, :, hs[i]:he[i], ws[j]:we[j]].max((2, 3)))
+            rows.append(jnp.stack(cols, -1))
+        return jnp.stack(rows, -2)
+
+    return apply("adaptive_max_pool2d", fn, _t(x))
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    def fn(v):
+        n, c, l = v.shape
+        o = int(output_size) if not isinstance(output_size, (list, tuple)) else int(output_size[0])
+        if l % o == 0:
+            return v.reshape(n, c, o, l // o).mean(-1)
+        ss = np.floor(np.arange(o) * l / o).astype(int)
+        ee = np.ceil((np.arange(o) + 1) * l / o).astype(int)
+        return jnp.stack([v[:, :, s:e].mean(-1) for s, e in zip(ss, ee)], -1)
+
+    return apply("adaptive_avg_pool1d", fn, _t(x))
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    nd = len(normalized_shape)
+
+    def fn(v, *wb):
+        axes = tuple(range(v.ndim - nd, v.ndim))
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]
+            i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+
+    args = [_t(x)]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply("layer_norm", fn, *args)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, axis=-1, name=None):
+    """TPU-native fused rms_norm surface
+    (reference: paddle/incubate/nn/functional/fused_rms_norm)."""
+    def fn(v, *w):
+        var = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=axis, keepdims=True)
+        out = (v.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)).astype(v.dtype)
+        if w:
+            out = out * w[0]
+        return out
+
+    args = [_t(x)] + ([_t(weight)] if weight is not None else [])
+    return apply("rms_norm", fn, *args)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    ch_axis = 1 if data_format.startswith("NC") else -1
+
+    if training and not use_global_stats:
+        # compute batch stats eagerly, update running buffers (stateful parity
+        # with the reference's batch_norm kernel)
+        axes = tuple(i for i in range(len(x.shape)) if i != (ch_axis % len(x.shape)))
+
+        def stat_fn(v):
+            m = jnp.mean(v, axis=axes)
+            var = jnp.var(v, axis=axes)
+            return m, var
+
+        bmean, bvar = apply("batch_norm_stats", stat_fn, _t(x))
+        if isinstance(running_mean, Tensor) and not isinstance(
+            bmean._value, jax.core.Tracer
+        ):
+            from ...autograd import no_grad
+
+            with no_grad():
+                running_mean._replace_value(
+                    momentum * running_mean._value + (1 - momentum) * bmean._value)
+                running_var._replace_value(
+                    momentum * running_var._value + (1 - momentum) * bvar._value)
+        mean_t, var_t = bmean, bvar
+    else:
+        mean_t, var_t = _t(running_mean), _t(running_var)
+
+    def fn(v, m, var, *wb):
+        shape = [1] * v.ndim
+        shape[ch_axis] = v.shape[ch_axis]
+        out = (v - m.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [_t(x), mean_t, var_t]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply("batch_norm", fn, *args)
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
+               data_format="NCHW", name=None):
+    def fn(v, *wb):
+        if data_format != "NCHW" and v.ndim >= 3:
+            v_ = jnp.moveaxis(v, -1, 1)
+        else:
+            v_ = v
+        n, c = v_.shape[0], v_.shape[1]
+        rest = v_.shape[2:]
+        g = v_.reshape(n, num_groups, c // num_groups, *rest)
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(v_.shape)
+        shape = [1, c] + [1] * len(rest)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        if data_format != "NCHW" and v.ndim >= 3:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    args = [_t(x)]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply("group_norm", fn, *args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    def fn(v, *wb):
+        axes = tuple(range(2, v.ndim))
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) * jax.lax.rsqrt(var + eps)
+        shape = [1, v.shape[1]] + [1] * (v.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [_t(x)]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply("instance_norm", fn, *args)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def fn(v):
+        n = jnp.linalg.norm(v, ord=p, axis=axis, keepdims=True)
+        return v / jnp.maximum(n, epsilon)
+
+    return apply("normalize", fn, _t(x))
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def fn(v):
+        sq = jnp.square(v)
+        half = size // 2
+        c = v.shape[1]
+        pads = [(0, 0)] * v.ndim
+        pads[1] = (half, size - half - 1)
+        sq_p = jnp.pad(sq, pads)
+        acc = sum(sq_p[:, i:i + c] for i in range(size))
+        return v / jnp.power(k + alpha * acc / size, beta)
+
+    return apply("local_response_norm", fn, _t(x))
+
+
+# ---------------------------------------------------------------------------
+# dropout & masking
+# ---------------------------------------------------------------------------
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        return _t(x)
+    if p == 1.0:
+        from ...ops.creation import zeros_like
+
+        return zeros_like(x)
+    shape = tuple(x.shape)
+    if axis is not None:
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        mshape = tuple(s if i in axes else 1 for i, s in enumerate(shape))
+    else:
+        mshape = shape
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(next_key(), keep, mshape)
+
+    def fn(v, m):
+        if mode == "upscale_in_train":
+            return jnp.where(m, v / keep, 0.0)
+        return jnp.where(m, v, 0.0)
+
+    return apply("dropout", fn, _t(x), Tensor(mask))
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axes = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axes, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axes = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axes, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return _t(x)
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = 1.0 - p
+    a = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
+    b = -a * alpha_p * (1 - keep)
+    mask = jax.random.bernoulli(next_key(), keep, tuple(x.shape))
+
+    def fn(v, m):
+        return a * jnp.where(m, v, alpha_p) + b
+
+    return apply("alpha_dropout", fn, _t(x), Tensor(mask))
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",  # noqa: A002
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    """parity: python/paddle/nn/functional/loss.py cross_entropy."""
+    def fn(logits, lbl, *w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.clip(logits, 1e-30, None))
+        nclass = logits.shape[axis]
+        if soft_label:
+            soft = lbl
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / nclass
+            loss = -(soft * logp).sum(axis=axis)
+            valid = None
+        else:
+            lbl_ = lbl.astype(jnp.int32)
+            if lbl_.ndim == logp.ndim:
+                lbl_ = jnp.squeeze(lbl_, axis=axis)
+            valid = lbl_ != ignore_index
+            safe = jnp.where(valid, lbl_, 0)
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(safe, axis), axis=axis)
+            picked = jnp.squeeze(picked, axis=axis)
+            if label_smoothing > 0:
+                smooth = jnp.mean(logp, axis=axis)
+                picked = (1 - label_smoothing) * picked + label_smoothing * smooth
+            loss = jnp.where(valid, -picked, 0.0)
+            if w:
+                loss = loss * jnp.where(valid, jnp.take(w[0], safe), 0.0)
+        if reduction == "mean":
+            if not soft_label:
+                if w:
+                    denom = jnp.sum(jnp.where(valid, jnp.take(w[0], safe), 0.0))
+                else:
+                    denom = jnp.sum(valid)
+                return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+            return jnp.mean(loss)
+        return _reduce(loss, reduction)
+
+    args = [_t(input), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    return apply("cross_entropy", fn, *args)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False,
+                               axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    from ...ops.manipulation import unsqueeze
+
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):  # noqa: A002
+    def fn(logp, lbl, *w):
+        valid = lbl != ignore_index
+        safe = jnp.where(valid, lbl, 0)
+        picked = jnp.take_along_axis(logp, safe[:, None], axis=1)[:, 0]
+        loss = jnp.where(valid, -picked, 0.0)
+        if w:
+            wl = jnp.take(w[0], safe)
+            loss = loss * jnp.where(valid, wl, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.sum(jnp.where(valid, wl, 0.0))
+        return _reduce(loss, reduction)
+
+    args = [_t(input), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    return apply("nll_loss", fn, *args)
+
+
+def mse_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return apply(
+        "mse_loss", lambda a, b: _reduce(jnp.square(a - b), reduction),
+        _t(input), _t(label),
+    )
+
+
+def l1_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return apply(
+        "l1_loss", lambda a, b: _reduce(jnp.abs(a - b), reduction),
+        _t(input), _t(label),
+    )
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):  # noqa: A002
+    def fn(a, b):
+        d = a - b
+        loss = jnp.where(jnp.abs(d) < delta, 0.5 * d * d / delta,
+                         jnp.abs(d) - 0.5 * delta)
+        return _reduce(loss, reduction)
+
+    return apply("smooth_l1_loss", fn, _t(input), _t(label))
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):  # noqa: A002
+    def fn(a, b):
+        d = a - b
+        loss = jnp.where(jnp.abs(d) <= delta, 0.5 * d * d,
+                         delta * (jnp.abs(d) - 0.5 * delta))
+        return _reduce(loss, reduction)
+
+    return apply("huber_loss", fn, _t(input), _t(label))
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):  # noqa: A002
+    def fn(p, y, *w):
+        eps = 1e-12
+        loss = -(y * jnp.log(jnp.clip(p, eps, None)) +
+                 (1 - y) * jnp.log(jnp.clip(1 - p, eps, None)))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+
+    args = [_t(input), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    return apply("binary_cross_entropy", fn, *args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def fn(z, y, *rest):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = rest[i]
+            i += 1
+        if pos_weight is not None:
+            pw = rest[i]
+        # numerically-stable BCE-with-logits
+        neg_abs = -jnp.abs(z)
+        if pw is not None:
+            log_w = (pw - 1) * y + 1
+            loss = (1 - y) * z + log_w * (jnp.log1p(jnp.exp(neg_abs)) +
+                                          jnp.maximum(-z, 0.0))
+        else:
+            loss = jnp.maximum(z, 0.0) - z * y + jnp.log1p(jnp.exp(neg_abs))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    args = [_t(logit), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    if pos_weight is not None:
+        args.append(_t(pos_weight))
+    return apply("bce_with_logits", fn, *args)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):  # noqa: A002
+    def fn(logp, y):
+        if log_target:
+            loss = jnp.exp(y) * (y - logp)
+        else:
+            loss = y * (jnp.log(jnp.clip(y, 1e-12, None)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+
+    return apply("kl_div", fn, _t(input), _t(label))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):  # noqa: A002
+    def fn(a, b, y):
+        loss = jnp.maximum(0.0, -y * (a - b) + margin)
+        return _reduce(loss, reduction)
+
+    return apply("margin_ranking_loss", fn, _t(input), _t(other), _t(label))
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):  # noqa: A002
+    def fn(x, y):
+        loss = jnp.where(y == 1, x, jnp.maximum(0.0, margin - x))
+        return _reduce(loss, reduction)
+
+    return apply("hinge_embedding_loss", fn, _t(input), _t(label))
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def fn(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis)
+        return num / jnp.maximum(den, eps)
+
+    return apply("cosine_similarity", fn, _t(x1), _t(x2))
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def fn(a, b, y):
+        cos = jnp.sum(a * b, axis=1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=1) * jnp.linalg.norm(b, axis=1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+
+    return apply("cosine_embedding_loss", fn, _t(input1), _t(input2), _t(label))
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, eps=1e-6,  # noqa: A002
+                        swap=False, reduction="mean", name=None):
+    def fn(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + eps, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + eps, ord=p, axis=-1)
+        if swap:
+            dn2 = jnp.linalg.norm(pos - neg + eps, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return apply("triplet_margin_loss", fn, _t(input), _t(positive), _t(negative))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def fn(z, y, *n):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0.0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if n:
+            loss = loss / n[0]
+        return _reduce(loss, reduction)
+
+    args = [_t(logit), _t(label)]
+    if normalizer is not None:
+        args.append(_t(normalizer))
+    return apply("sigmoid_focal_loss", fn, *args)
+
+
+def square_error_cost(input, label):  # noqa: A002
+    return apply("square_error_cost", lambda a, b: jnp.square(a - b), _t(input), _t(label))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):  # noqa: A002
+    return apply(
+        "log_loss",
+        lambda p, y: -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon),
+        _t(input), _t(label),
+    )
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    raise NotImplementedError("ctc_loss lands with the audio model family")
+
+
+# ---------------------------------------------------------------------------
+# attention
+# ---------------------------------------------------------------------------
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True,
+                                 name=None):
+    """Inputs [batch, seq, heads, head_dim] (paddle convention,
+    reference: python/paddle/nn/functional/flash_attention.py:358).
+    Routes to the Pallas flash-attention kernel on TPU when enabled."""
+    from ...framework import flags as _flags
+
+    if _flags.get_flag("use_pallas_kernels") and attn_mask is None and dropout_p == 0.0:
+        try:
+            from ...kernels.flash_attention import flash_attention as _fa
+
+            return _fa(query, key, value, causal=is_causal)
+        except Exception:
+            pass
+
+    def fn(q, k, v, *m):
+        scale = 1.0 / _math.sqrt(q.shape[-1])
+        qt = jnp.swapaxes(q, 1, 2)  # [b, h, s, d]
+        kt = jnp.swapaxes(k, 1, 2)
+        vt = jnp.swapaxes(v, 1, 2)
+        scores = jnp.einsum("bhsd,bhtd->bhst", qt, kt) * scale
+        if is_causal:
+            s, t_ = scores.shape[-2], scores.shape[-1]
+            mask = jnp.tril(jnp.ones((s, t_), bool))
+            scores = jnp.where(mask, scores, -jnp.inf)
+        if m:
+            mm = m[0]
+            if mm.dtype == jnp.bool_:
+                scores = jnp.where(mm, scores, -jnp.inf)
+            else:
+                scores = scores + mm
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhst,bhtd->bhsd", probs, vt)
+        return jnp.swapaxes(out, 1, 2)
+
+    args = [_t(query), _t(key), _t(value)]
+    if attn_mask is not None:
+        args.append(_t(attn_mask))
+    out = apply("sdpa", fn, *args)
+    if dropout_p > 0.0 and training:
+        out = dropout(out, dropout_p, training=training)
+    return out
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, training=True,
+                    name=None):
+    out = scaled_dot_product_attention(query, key, value, dropout_p=dropout,
+                                       is_causal=causal, training=training)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+# ---------------------------------------------------------------------------
+# shape/common helpers re-exported (paddle parity)
+# ---------------------------------------------------------------------------
+from ...ops.manipulation import pad  # noqa: E402,F401
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    k = _norm_tuple(kernel_sizes, 2)
+    s = _norm_tuple(strides, 2)
+    p = _norm_tuple(paddings, 2)
+    d = _norm_tuple(dilations, 2)
+
+    def fn(v):
+        n, c, h, w = v.shape
+        vp = jnp.pad(v, [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])])
+        oh = (h + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+        ow = (w + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+        cols = []
+        for i in range(k[0]):
+            for j in range(k[1]):
+                patch = vp[:, :, i * d[0]:i * d[0] + oh * s[0]:s[0],
+                           j * d[1]:j * d[1] + ow * s[1]:s[1]]
+                cols.append(patch)
+        out = jnp.stack(cols, 2)  # n, c, k*k, oh, ow
+        return out.reshape(n, c * k[0] * k[1], oh * ow)
+
+    return apply("unfold", fn, _t(x))
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    def fn(v):
+        channel_last = data_format[-1] == "C"
+        v_ = v if channel_last else jnp.moveaxis(v, 1, -1)
+        spatial = v_.shape[1:-1]
+        if size is not None:
+            out_sp = _norm_tuple(size, len(spatial))
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else \
+                [scale_factor] * len(spatial)
+            out_sp = tuple(int(s * f) for s, f in zip(spatial, sf))
+        method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic",
+                  "trilinear": "linear", "linear": "linear", "area": "linear"}[mode]
+        out = jax.image.resize(v_, (v_.shape[0],) + out_sp + (v_.shape[-1],),
+                               method=method)
+        return out if channel_last else jnp.moveaxis(out, -1, 1)
+
+    return apply("interpolate", fn, _t(x))
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def fn(v):
+        n, c, h, w = v.shape
+        out = v.reshape(n, c // (r * r), r, r, h, w)
+        out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
+        return out.reshape(n, c // (r * r), h * r, w * r)
+
+    return apply("pixel_shuffle", fn, _t(x))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def fn(y):
+        n = y.shape[-1]
+        return (1 - epsilon) * y + epsilon / n
+
+    return apply("label_smooth", fn, _t(label))
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    def fn(v):
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        v5 = v.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate([v5[:, 1:, :fold], jnp.zeros_like(v5[:, :1, :fold])], 1)
+        right = jnp.concatenate([jnp.zeros_like(v5[:, :1, fold:2 * fold]),
+                                 v5[:, :-1, fold:2 * fold]], 1)
+        keep = v5[:, :, 2 * fold:]
+        return jnp.concatenate([left, right, keep], 2).reshape(nt, c, h, w)
+
+    return apply("temporal_shift", fn, _t(x))
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
+    def fn(l):
+        m = maxlen or int(jnp.max(l))
+        ar = jnp.arange(m)
+        return (ar[None, :] < l[:, None]).astype(
+            dtypes.convert_dtype(dtype).np_dtype)
+
+    return apply("sequence_mask", fn, _t(lengths))

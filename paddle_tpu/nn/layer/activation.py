@@ -1,0 +1,86 @@
+"""Activation layers (parity: python/paddle/nn/layer/activation.py)."""
+from __future__ import annotations
+
+from .. import functional as F
+from .layers import Layer
+
+
+def _make(name, fname=None, **fixed):
+    fname = fname or name.lower()
+
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            self._kwargs = {**fixed, **kwargs}
+            self._args = args
+
+        def forward(self, x):
+            return getattr(F, fname)(x, *self._args, **self._kwargs)
+
+    _Act.__name__ = name
+    _Act.__qualname__ = name
+    return _Act
+
+
+ReLU = _make("ReLU", "relu")
+ReLU6 = _make("ReLU6", "relu6")
+Sigmoid = _make("Sigmoid", "sigmoid")
+Tanh = _make("Tanh", "tanh")
+Silu = _make("Silu", "silu")
+Swish = _make("Swish", "swish")
+Mish = _make("Mish", "mish")
+Softsign = _make("Softsign", "softsign")
+Tanhshrink = _make("Tanhshrink", "tanhshrink")
+Hardswish = _make("Hardswish", "hardswish")
+Hardsigmoid = _make("Hardsigmoid", "hardsigmoid")
+GELU = _make("GELU", "gelu")
+LeakyReLU = _make("LeakyReLU", "leaky_relu")
+ELU = _make("ELU", "elu")
+CELU = _make("CELU", "celu")
+SELU = _make("SELU", "selu")
+Hardtanh = _make("Hardtanh", "hardtanh")
+Hardshrink = _make("Hardshrink", "hardshrink")
+Softshrink = _make("Softshrink", "softshrink")
+Softplus = _make("Softplus", "softplus")
+LogSigmoid = _make("LogSigmoid", "log_sigmoid")
+Softmax = _make("Softmax", "softmax")
+LogSoftmax = _make("LogSoftmax", "log_softmax")
+GLU = _make("GLU", "glu")
+RReLU = _make("RReLU", "rrelu")
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        from .. import initializer as I
+
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            [num_parameters], attr=weight_attr,
+            default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, data_format=self._data_format)
+
+
+class Maxout(Layer):
+    def __init__(self, groups, axis=1, name=None):
+        super().__init__()
+        self.groups = groups
+        self.axis = axis
+
+    def forward(self, x):
+        import jax.numpy as jnp
+
+        from ...ops.dispatch import apply
+
+        def fn(v):
+            ax = self.axis % v.ndim
+            c = v.shape[ax]
+            shape = list(v.shape)
+            shape[ax] = c // self.groups
+            shape.insert(ax + 1, self.groups)
+            return jnp.max(v.reshape(shape), axis=ax + 1)
+
+        return apply("maxout", fn, x)

@@ -1,0 +1,401 @@
+"""Layer base class.
+
+Parity surface: python/paddle/nn/layer/layers.py:353 (paddle.nn.Layer) —
+parameter/buffer/sublayer registration, hooks, state_dict machinery, train/eval
+mode, apply/to. The functional-capture helpers at the bottom
+(``functional_state``/``bind_state``) are the TPU-native addition that lets any
+Layer be jitted/pjit-ed as a pure function over its parameter pytree.
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ...core.tensor import Parameter, Tensor
+from ...framework import dtype as dtypes
+from ...framework.param_attr import ParamAttr
+
+
+class HookRemoveHelper:
+    _next = 0
+
+    def __init__(self, hooks):
+        self._hooks = hooks
+        self._id = HookRemoveHelper._next
+        HookRemoveHelper._next += 1
+
+    def remove(self):
+        self._hooks.pop(self._id, None)
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
+        self.training = True
+        self._dtype = dtypes.convert_dtype(dtype) if dtype else dtypes.get_default_dtype()
+        self._parameters: "OrderedDict[str, Parameter]" = OrderedDict()
+        self._sub_layers: "OrderedDict[str, Layer]" = OrderedDict()
+        self._buffers: "OrderedDict[str, Tensor]" = OrderedDict()
+        self._non_persistable_buffer_names_set = set()
+        self._forward_pre_hooks: "OrderedDict[int, Callable]" = OrderedDict()
+        self._forward_post_hooks: "OrderedDict[int, Callable]" = OrderedDict()
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+        self._init_in_dynamic_mode = True
+
+    # -- registration ------------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call super().__init__() before assigning parameters")
+            params.pop(name, None)
+            if buffers is not None:
+                buffers.pop(name, None)
+            params[name] = value
+            self.__dict__.pop(name, None)
+            return
+        if isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call super().__init__() before assigning sublayers")
+            layers[name] = value
+            self.__dict__.pop(name, None)
+            return
+        if params is not None and name in params:
+            if value is None:
+                params.pop(name)
+                object.__setattr__(self, name, None)
+                return
+            if isinstance(value, Tensor):
+                params[name] = value if isinstance(value, Parameter) else Parameter(
+                    value._value, trainable=not value.stop_gradient)
+                return
+        if layers is not None and name in layers and value is None:
+            layers.pop(name)
+            object.__setattr__(self, name, None)
+            return
+        if buffers is not None and name in buffers:
+            if value is None:
+                buffers.pop(name)
+                object.__setattr__(self, name, None)
+            else:
+                buffers[name] = value if isinstance(value, Tensor) else Tensor(value)
+            return
+        object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'"
+        )
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        extra = []
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d:
+                extra += list(d)
+        return list(super().__dir__()) + extra
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        if parameter is None:
+            self._parameters[name] = None
+        else:
+            self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Optional[Tensor], persistable: bool = True):
+        if tensor is not None and not isinstance(tensor, Tensor):
+            tensor = Tensor(tensor)
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names_set.add(name)
+        return tensor
+
+    def create_parameter(
+        self,
+        shape,
+        attr=None,
+        dtype=None,
+        is_bias: bool = False,
+        default_initializer=None,
+    ) -> Parameter:
+        """parity: layers.py create_parameter via LayerHelper
+        (reference: python/paddle/base/layer_helper.py)."""
+        from .. import initializer as I
+
+        attr = ParamAttr._to_attr(attr)
+        dtype = dtypes.convert_dtype(dtype) if dtype else self._dtype
+        init = default_initializer
+        if attr is not None and attr.initializer is not None:
+            init = attr.initializer
+        if init is None:
+            init = I.Constant(0.0) if is_bias else I.XavierNormal()
+        shape = [int(s) for s in shape]
+        value = init._generate(shape, dtype)
+        trainable = attr.trainable if attr is not None else True
+        p = Parameter(value, trainable=trainable,
+                      name=(attr.name if attr is not None else None))
+        if attr is not None:
+            p.optimize_attr["learning_rate"] = attr.learning_rate
+            p.regularizer = attr.regularizer
+            p.need_clip = attr.need_clip
+        return p
+
+    def create_tensor(self, name=None, persistable=None, dtype=None):
+        return Tensor(np.zeros([0], dtype=(dtypes.convert_dtype(dtype).np_dtype
+                                           if dtype else np.float32)))
+
+    # -- traversal ---------------------------------------------------------
+    def parameters(self, include_sublayers: bool = True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_parameters(
+        self, prefix: str = "", include_sublayers: bool = True
+    ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for name, layer, lp in self._walk(prefix, include_sublayers):
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f"{lp}.{pname}" if lp else pname), p
+
+    def _walk(self, prefix="", include_sublayers=True):
+        yield prefix, self, prefix
+        if include_sublayers:
+            for name, sub in self._sub_layers.items():
+                if sub is None:
+                    continue
+                sp = f"{prefix}.{name}" if prefix else name
+                yield from sub._walk(sp, True)
+
+    def sublayers(self, include_self: bool = False) -> List["Layer"]:
+        out = []
+        for _, layer, _ in self._walk():
+            out.append(layer)
+        return out if include_self else out[1:]
+
+    def named_sublayers(self, prefix="", include_self=False):
+        for p, layer, _ in self._walk(prefix):
+            if not include_self and layer is self:
+                continue
+            yield p, layer
+
+    def children(self) -> Iterator["Layer"]:
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self):
+        for name, sub in self._sub_layers.items():
+            if sub is not None:
+                yield name, sub
+
+    def buffers(self, include_sublayers: bool = True) -> List[Tensor]:
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        for _, layer, lp in self._walk(prefix, include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (f"{lp}.{bname}" if lp else bname), b
+
+    # -- mode --------------------------------------------------------------
+    def train(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = True
+        return self
+
+    def eval(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = False
+        return self
+
+    def apply(self, fn):
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    # -- state dict --------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        out = destination if destination is not None else OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix,
+                                             include_sublayers=include_sublayers):
+            out[name] = p
+        for _, layer, lp in self._walk(structured_name_prefix, include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is None or bname in layer._non_persistable_buffer_names_set:
+                    continue
+                out[f"{lp}.{bname}" if lp else bname] = b
+        return out
+
+    def set_state_dict(self, state_dict, use_structured_name: bool = True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        matched = {}
+        for k, v in state_dict.items():
+            if k in own:
+                matched[k] = v
+            else:
+                unexpected.append(k)
+        for k in own:
+            if k not in matched:
+                missing.append(k)
+        for k, v in matched.items():
+            target = own[k]
+            val = v._value if isinstance(v, Tensor) else np.asarray(v)
+            if tuple(np.shape(val)) != tuple(target.shape):
+                raise ValueError(
+                    f"shape mismatch for {k}: loaded {np.shape(val)} vs "
+                    f"expected {tuple(target.shape)}"
+                )
+            import jax.numpy as jnp
+
+            target._replace_value(jnp.asarray(val, dtype=target._value.dtype))
+        return missing, unexpected
+
+    load_dict = set_state_dict
+    set_dict = set_state_dict
+
+    # -- conversion --------------------------------------------------------
+    def to(self, device=None, dtype=None, blocking=None):
+        import jax.numpy as jnp
+
+        if dtype is not None:
+            npd = dtypes.convert_dtype(dtype).np_dtype
+            for t in list(self.parameters()) + list(self.buffers()):
+                d = np.dtype(t._value.dtype)
+                if np.issubdtype(d, np.floating):
+                    t._replace_value(jnp.asarray(t._value, dtype=npd))
+        if device is not None:
+            from ...device import jax_device
+
+            dev = jax_device(device)
+            for t in list(self.parameters()) + list(self.buffers()):
+                t._replace_value(jax.device_put(t._value, dev))
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    # -- hooks -------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        h = HookRemoveHelper(self._forward_pre_hooks)
+        self._forward_pre_hooks[h._id] = hook
+        return h
+
+    def register_forward_post_hook(self, hook):
+        h = HookRemoveHelper(self._forward_post_hooks)
+        self._forward_post_hooks[h._id] = hook
+        return h
+
+    # -- call --------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            res = hook(self, inputs, outputs)
+            if res is not None:
+                outputs = res
+        return outputs
+
+    def full_name(self):
+        return self._name_scope
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            mod_str = repr(sub)
+            mod_str = _addindent(mod_str, 2)
+            lines.append(f"({name}): {mod_str}")
+        main = self.__class__.__name__ + "("
+        if extra:
+            main += extra
+        if lines:
+            main += "\n  " + "\n  ".join(lines) + "\n"
+        return main + ")"
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    # -- functional capture (TPU-native) -----------------------------------
+    def functional_state(self):
+        """Return (params, buffers) as name→raw-array pytrees for jit/pjit."""
+        params = {k: p._value for k, p in self.named_parameters()}
+        bufs = {k: b._value for k, b in self.named_buffers()}
+        return params, bufs
+
+    @contextlib.contextmanager
+    def bind_state(self, params: Dict[str, object], buffers: Optional[Dict] = None):
+        """Temporarily swap (possibly traced) values into the layer's
+        parameters/buffers — the bridge from stateful Layers to pure
+        functions for jax.jit / pjit / shard_map."""
+        saved = {}
+        named_p = dict(self.named_parameters())
+        named_b = dict(self.named_buffers())
+        try:
+            for k, v in params.items():
+                if k in named_p:
+                    saved[k] = named_p[k]._value
+                    named_p[k]._value = v
+            if buffers:
+                for k, v in buffers.items():
+                    if k in named_b:
+                        saved["buf:" + k] = named_b[k]._value
+                        named_b[k]._value = v
+            yield self
+        finally:
+            for k, v in saved.items():
+                if k.startswith("buf:"):
+                    named_b[k[4:]]._value = v
+                else:
+                    named_p[k]._value = v
+
+
+def _addindent(s, n):
+    pad = " " * n
+    lines = s.split("\n")
+    return lines[0] + "".join("\n" + pad + l for l in lines[1:])

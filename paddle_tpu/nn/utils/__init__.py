@@ -1,0 +1,117 @@
+"""nn.utils (parity: python/paddle/nn/utils/)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from ..clip import clip_grad_norm_, clip_grad_value_  # noqa: F401
+
+
+def parameters_to_vector(parameters, name=None):
+    vals = [p._value.reshape(-1) for p in parameters]
+    return Tensor(jnp.concatenate(vals))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    v = vec._value
+    for p in parameters:
+        n = p.size
+        p._replace_value(v[offset:offset + n].reshape(tuple(p.shape)).astype(
+            p._value.dtype))
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize weight = g * v / ||v|| (parity:
+    python/paddle/nn/utils/weight_norm_hook.py)."""
+    import jax
+
+    weight = getattr(layer, name)
+    w = weight._value
+    if dim is None:
+        norm = jnp.sqrt(jnp.sum(jnp.square(w)))
+        g0 = norm.reshape((1,))
+    else:
+        axes = tuple(i for i in range(w.ndim) if i != dim)
+        g0 = jnp.sqrt(jnp.sum(jnp.square(w), axis=axes))
+    from ...core.tensor import Parameter
+
+    layer.add_parameter(name + "_g", Parameter(g0))
+    layer.add_parameter(name + "_v", Parameter(w))
+    del layer._parameters[name]
+
+    def hook(lyr, inputs):
+        g = lyr._parameters[name + "_g"]
+        v = lyr._parameters[name + "_v"]
+        from ...ops import dispatch
+
+        def fn(gv, vv):
+            if dim is None:
+                nrm = jnp.sqrt(jnp.sum(jnp.square(vv)))
+                return vv * (gv.reshape(()) / nrm)
+            axes = tuple(i for i in range(vv.ndim) if i != dim)
+            nrm = jnp.sqrt(jnp.sum(jnp.square(vv), axis=axes, keepdims=True))
+            shape = [1] * vv.ndim
+            shape[dim] = -1
+            return vv * (gv.reshape(shape) / nrm)
+
+        w_t = dispatch.apply("weight_norm", fn, g, v)
+        object.__setattr__(lyr, "_wn_cache", w_t)
+        lyr._parameters[name] = w_t  # transient; recomputed every forward
+        return None
+
+    # stash as forward pre-hook
+    h = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_hook = h
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    if hasattr(layer, "_weight_norm_hook"):
+        layer._weight_norm_hook.remove()
+        g = layer._parameters.pop(name + "_g")
+        v = layer._parameters.pop(name + "_v")
+        from ...core.tensor import Parameter
+
+        w = v._value * (g._value.reshape([-1] + [1] * (v._value.ndim - 1)) /
+                        jnp.sqrt(jnp.sum(jnp.square(v._value),
+                                         axis=tuple(range(1, v._value.ndim)),
+                                         keepdims=True)))
+        layer._parameters[name] = Parameter(w)
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=None):
+    weight = getattr(layer, name)
+    w = weight._value
+    if dim is None:
+        dim = 0
+    w_mat = np.asarray(jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1))
+    u = np.random.randn(w_mat.shape[0]).astype(np.float32)
+    v = np.random.randn(w_mat.shape[1]).astype(np.float32)
+
+    def hook(lyr, inputs):
+        nonlocal u, v
+        wv = lyr._parameters[name + "_orig"]._value
+        mat = jnp.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
+        uu, vv = u, v
+        for _ in range(n_power_iterations):
+            vv = np.asarray(mat.T @ uu)
+            vv = vv / (np.linalg.norm(vv) + eps)
+            uu = np.asarray(mat @ vv)
+            uu = uu / (np.linalg.norm(uu) + eps)
+        u, v = uu, vv
+        sigma = jnp.dot(uu, mat @ vv)
+        from ...core.tensor import Tensor as _T
+
+        lyr._parameters[name] = _T(wv / sigma)
+        return None
+
+    from ...core.tensor import Parameter
+
+    layer.add_parameter(name + "_orig", Parameter(w))
+    h = layer.register_forward_pre_hook(hook)
+    layer._spectral_norm_hook = h
+    return layer

@@ -1,0 +1,152 @@
+"""paddle_tpu.ops — the functional operator surface.
+
+Aggregates every op domain (parity: python/paddle/tensor/__init__.py) and
+attaches the Tensor method / operator surface
+(parity: paddle/fluid/pybind/eager_method.cc + tensor patch methods).
+"""
+from __future__ import annotations
+
+from . import creation, indexing, linalg, logic, manipulation, math, random, search, stat
+from .creation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+from . import registry
+from ..core.tensor import Tensor
+
+for _mod, _cat in [
+    (creation, "creation"), (math, "math"), (manipulation, "manipulation"),
+    (linalg, "linalg"), (logic, "logic"), (search, "search"),
+    (random, "random"), (stat, "stat"),
+]:
+    registry.register_module(_mod, _cat)
+
+
+# ---------------------------------------------------------------------------
+# Tensor operator protocol
+# ---------------------------------------------------------------------------
+def _rsub(x, y):
+    return math.subtract(y, x)
+
+
+def _rdiv(x, y):
+    return math.divide(y, x)
+
+
+def _rpow(x, y):
+    return math.pow(y, x)
+
+
+def _rmod(x, y):
+    return math.mod(y, x)
+
+
+def _rmatmul(x, y):
+    return linalg.matmul(y, x)
+
+
+def _rfloordiv(x, y):
+    return math.floor_divide(y, x)
+
+
+Tensor.__add__ = math.add
+Tensor.__radd__ = math.add
+Tensor.__sub__ = math.subtract
+Tensor.__rsub__ = _rsub
+Tensor.__mul__ = math.multiply
+Tensor.__rmul__ = math.multiply
+Tensor.__truediv__ = math.divide
+Tensor.__rtruediv__ = _rdiv
+Tensor.__div__ = math.divide
+Tensor.__floordiv__ = math.floor_divide
+Tensor.__rfloordiv__ = _rfloordiv
+Tensor.__mod__ = math.mod
+Tensor.__rmod__ = _rmod
+Tensor.__pow__ = math.pow
+Tensor.__rpow__ = _rpow
+Tensor.__matmul__ = linalg.matmul
+Tensor.__rmatmul__ = _rmatmul
+Tensor.__neg__ = math.negative
+Tensor.__abs__ = math.abs
+Tensor.__eq__ = logic.equal
+Tensor.__ne__ = logic.not_equal
+Tensor.__lt__ = logic.less_than
+Tensor.__le__ = logic.less_equal
+Tensor.__gt__ = logic.greater_than
+Tensor.__ge__ = logic.greater_equal
+Tensor.__invert__ = logic.logical_not
+Tensor.__and__ = logic.bitwise_and
+Tensor.__or__ = logic.bitwise_or
+Tensor.__xor__ = logic.bitwise_xor
+Tensor.__getitem__ = indexing.getitem
+Tensor.__setitem__ = indexing.setitem
+Tensor.__hash__ = lambda self: id(self)
+
+_METHODS = [
+    # math
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod", "remainder",
+    "pow", "exp", "expm1", "log", "log2", "log10", "log1p", "sqrt", "rsqrt",
+    "square", "reciprocal", "abs", "sign", "sin", "cos", "tan", "asin", "acos",
+    "atan", "atan2", "sinh", "cosh", "tanh", "sigmoid", "erf", "erfinv",
+    "floor", "ceil", "round", "trunc", "frac", "clip", "maximum", "minimum",
+    "scale", "lerp", "nan_to_num", "digamma", "lgamma", "deg2rad", "rad2deg",
+    "conj", "real", "imag", "angle", "heaviside", "fmax", "fmin", "trace",
+    "neg", "logit", "increment", "divide_no_nan",
+    # reductions
+    "sum", "nansum", "mean", "nanmean", "prod", "max", "min", "amax", "amin",
+    "logsumexp", "cumsum", "cumprod", "cummax", "cummin", "logcumsumexp",
+    "all", "any", "count_nonzero",
+    # stat
+    "std", "var", "median", "nanmedian", "quantile", "nanquantile",
+    # manipulation
+    "cast", "reshape", "reshape_", "transpose", "moveaxis", "swapaxes",
+    "split", "chunk", "unbind", "squeeze", "squeeze_", "unsqueeze",
+    "unsqueeze_", "flatten", "flip", "rot90", "roll", "tile", "expand",
+    "expand_as", "broadcast_to", "gather", "gather_nd", "take_along_axis",
+    "put_along_axis", "scatter", "scatter_", "scatter_nd_add", "index_select",
+    "index_sample", "index_add", "index_put", "masked_select", "masked_fill",
+    "masked_fill_", "masked_scatter", "where", "strided_slice", "pad",
+    "repeat_interleave", "unique", "unique_consecutive", "view",
+    # linalg
+    "matmul", "mm", "bmm", "dot", "mv", "cross", "norm", "dist", "det",
+    "inv", "pinv", "matrix_power", "cholesky", "qr", "svd", "eigvals",
+    "solve", "lstsq", "tensordot", "multi_dot",
+    # logic
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "equal_all", "allclose", "isclose", "isnan", "isinf",
+    "isfinite", "logical_and", "logical_or", "logical_xor", "logical_not",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not", "is_empty",
+    # search
+    "argmax", "argmin", "argsort", "sort", "topk", "nonzero", "kthvalue",
+    "mode", "bucketize",
+    # creation-ish
+    "tril", "triu", "diagonal", "one_hot",
+]
+
+_ns = globals()
+for _m in _METHODS:
+    if _m in _ns and not hasattr(Tensor, _m):
+        setattr(Tensor, _m, _ns[_m])
+
+# inplace arithmetic variants (reference: inplace api surface x.add_(y) etc.)
+def _make_inplace(fname):
+    fn = _ns[fname]
+
+    def method(self, *args, **kwargs):
+        return self._adopt(fn(self, *args, **kwargs))
+
+    method.__name__ = fname + "_"
+    return method
+
+
+for _m in ["add", "subtract", "multiply", "divide", "clip", "scale", "exp",
+           "sqrt", "rsqrt", "reciprocal", "floor", "ceil", "round", "tanh",
+           "cast", "pow", "lerp", "remainder", "mod"]:
+    if not hasattr(Tensor, _m + "_"):
+        setattr(Tensor, _m + "_", _make_inplace(_m))
+
+_C_ops = registry.build_c_ops_namespace()

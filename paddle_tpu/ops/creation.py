@@ -1,0 +1,243 @@
+"""Tensor creation ops.
+
+Parity surface: python/paddle/tensor/creation.py (to_tensor, zeros, ones, full,
+arange, linspace, eye, ...). Kernels are jax.numpy; shape/dtype inference is
+implicit in XLA (the reference routes these through InferMeta —
+paddle/phi/infermeta/nullary.cc).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..device import jax_device
+from ..framework import dtype as dtypes
+from .dispatch import apply
+
+
+def _dt(dtype, default_float=True):
+    if dtype is None:
+        return dtypes.get_default_dtype().np_dtype if default_float else None
+    return dtypes.convert_dtype(dtype).np_dtype
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """paddle.to_tensor parity."""
+    if isinstance(data, Tensor):
+        v = data._value
+        if dtype is not None:
+            v = jnp.asarray(v, dtype=_dt(dtype))
+        t = Tensor(v, stop_gradient=stop_gradient)
+        return t
+    if isinstance(data, (jax.Array,)):
+        v = data
+    else:
+        keep_dtype = isinstance(data, np.ndarray)
+        arr = np.asarray(data)
+        if dtype is None and not keep_dtype and arr.dtype == np.float64:
+            # python floats default to the framework float dtype (paddle parity)
+            arr = arr.astype(dtypes.get_default_dtype().np_dtype)
+        v = jnp.asarray(arr)
+    if dtype is not None:
+        v = jnp.asarray(v, dtype=_dt(dtype))
+    if place is not None:
+        v = jax.device_put(v, jax_device(place))
+    return Tensor(v, stop_gradient=stop_gradient)
+
+
+def tensor(data, **kw):
+    return to_tensor(data, **kw)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if dtype is None and isinstance(fill_value, int):
+        return Tensor(jnp.full(_shape(shape), fill_value, _dt("int64")))
+    return Tensor(jnp.full(_shape(shape), _value_of(fill_value), _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def zeros_like(x, dtype=None, name=None):
+    return apply("zeros_like", lambda v: jnp.zeros_like(v, dtype=_dt(dtype, False)), _t(x))
+
+
+def ones_like(x, dtype=None, name=None):
+    return apply("ones_like", lambda v: jnp.ones_like(v, dtype=_dt(dtype, False)), _t(x))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return apply(
+        "full_like",
+        lambda v: jnp.full_like(v, _value_of(fill_value), dtype=_dt(dtype, False)),
+        _t(x),
+    )
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start = _value_of(start)
+    end = _value_of(end)
+    step = _value_of(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if any(isinstance(v, float) for v in (start, end, step)):
+            dtype = dtypes.get_default_dtype()
+        else:
+            dtype = "int64"
+    return Tensor(jnp.arange(start, end, step, dtype=_dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(
+        jnp.linspace(_value_of(start), _value_of(stop), int(_value_of(num)),
+                     dtype=_dt(dtype))
+    )
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(
+        jnp.logspace(_value_of(start), _value_of(stop), int(_value_of(num)),
+                     base=base, dtype=_dt(dtype))
+    )
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    ts = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    outs = apply("meshgrid", lambda vs: tuple(jnp.meshgrid(*vs, indexing="ij")), list(ts))
+    return list(outs)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def fn(v):
+        if v.ndim == 1 and padding_value != 0:
+            d = jnp.diag(v, k=offset)
+            mask = jnp.eye(d.shape[0], d.shape[1], k=offset, dtype=bool)
+            return jnp.where(mask, d, jnp.asarray(padding_value, d.dtype))
+        return jnp.diag(v, k=offset)
+
+    return apply("diag", fn, _t(x))
+
+
+def diagflat(x, offset=0, name=None):
+    return apply("diagflat", lambda v: jnp.diagflat(v, k=offset), _t(x))
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def fn(v):
+        out = jnp.zeros(v.shape + (v.shape[-1] + abs(offset),), v.dtype)
+        out = jnp.moveaxis(
+            jax.vmap(lambda row: jnp.diag(row, k=offset), in_axes=0, out_axes=0)(
+                v.reshape(-1, v.shape[-1])
+            ).reshape(v.shape[:-1] + (v.shape[-1] + abs(offset), v.shape[-1] + abs(offset))),
+            (-2, -1), (dim1, dim2),
+        )
+        return out
+
+    return apply("diag_embed", fn, _t(x))
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(
+        "diagonal", lambda v: jnp.diagonal(v, offset=offset, axis1=axis1, axis2=axis2),
+        _t(x),
+    )
+
+
+def tril(x, diagonal=0, name=None):
+    return apply("tril", lambda v: jnp.tril(v, k=diagonal), _t(x))
+
+
+def triu(x, diagonal=0, name=None):
+    return apply("triu", lambda v: jnp.triu(v, k=diagonal), _t(x))
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    r = jnp.tril_indices(row, k=offset, m=col)
+    return Tensor(jnp.stack([r[0], r[1]]).astype(_dt(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r = jnp.triu_indices(row, k=offset, m=col)
+    return Tensor(jnp.stack([r[0], r[1]]).astype(_dt(dtype)))
+
+
+def assign(x, output=None):
+    """paddle.assign parity: identity copy, recorded for autograd."""
+    out = apply("assign", lambda v: v + 0 if _is_float(v) else jnp.array(v, copy=True), _t(x))
+    if output is not None:
+        output._adopt(out)
+        return output
+    return out
+
+
+def clone(x):
+    return assign(x)
+
+
+def one_hot(x, num_classes, name=None):
+    return apply(
+        "one_hot",
+        lambda v: jax.nn.one_hot(v, num_classes, dtype=dtypes.get_default_dtype().np_dtype),
+        _t(x),
+    )
+
+
+def numel(x):
+    return Tensor(jnp.asarray(int(np.prod(x.shape)) if x.shape else 1, dtype=jnp.int64))
+
+
+def polar(abs_t, angle, name=None):
+    return apply(
+        "polar", lambda a, b: a * jnp.exp(1j * b.astype(jnp.complex64)).astype(jnp.complex64),
+        _t(abs_t), _t(angle),
+    )
+
+
+def complex(real, imag, name=None):
+    return apply("complex", lambda r, i: jax.lax.complex(r, i), _t(real), _t(imag))
+
+
+# -- helpers -----------------------------------------------------------------
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._value))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(_value_of(s)) for s in shape)
+
+
+def _value_of(v):
+    if isinstance(v, Tensor):
+        x = v.item() if v.size == 1 else v._value
+        return x
+    return v
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _is_float(v):
+    return np.issubdtype(np.dtype(v.dtype), np.floating) or np.issubdtype(
+        np.dtype(v.dtype), np.complexfloating
+    )

@@ -112,8 +112,10 @@ def _apply_impl(name, fn, args, kwargs):
     _maybe_check_nan_inf(name, out_vals)
 
     out_metas = [(tuple(v.shape), v.dtype) for v in out_vals]
-    node = GradNode(name, vjp_fn, out_metas)
-    node.edges = [_edge_for(tensors[i]) for i in primal_idx]
+    primal_tensors = [tensors[i] for i in primal_idx]
+    node = GradNode(name, vjp_fn, out_metas, pure_fn=pure,
+                    primal_tensors=primal_tensors)
+    node.edges = [_edge_for(t) for t in primal_tensors]
 
     outs = []
     for i, v in enumerate(out_vals):

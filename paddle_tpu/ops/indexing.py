@@ -1,0 +1,95 @@
+"""Tensor __getitem__ / __setitem__.
+
+Parity surface: the pybind indexing methods
+(reference: paddle/fluid/pybind/eager_method.cc __getitem__/__setitem__ and
+python/paddle/base/variable_index.py). Indexing is recorded through dispatch so
+gradients flow; __setitem__ is an out-of-place ``.at[...].set`` buffer swap.
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dispatch import apply
+
+
+def _normalize_index(key, tensor_args):
+    """Replace Tensors inside the index expression with placeholders; returns
+    a rebuild function operating on raw values."""
+    if not isinstance(key, tuple):
+        key = (key,)
+
+    spec = []
+    for k in key:
+        if isinstance(k, Tensor):
+            tensor_args.append(k)
+            spec.append(("t", len(tensor_args) - 1))
+        elif isinstance(k, builtins.slice):
+            parts = []
+            for comp in (k.start, k.stop, k.step):
+                if isinstance(comp, Tensor):
+                    parts.append(int(comp.item()))
+                else:
+                    parts.append(comp)
+            spec.append(("s", tuple(parts)))
+        elif k is None or k is Ellipsis or isinstance(k, (int, np.integer)):
+            spec.append(("c", k))
+        elif isinstance(k, (list, np.ndarray)):
+            arr = np.asarray(k)
+            spec.append(("c", arr))
+        elif isinstance(k, (bool, np.bool_)):
+            spec.append(("c", bool(k)))
+        else:
+            spec.append(("c", k))
+
+    def rebuild(vals):
+        out = []
+        for kind, payload in spec:
+            if kind == "t":
+                out.append(vals[payload])
+            elif kind == "s":
+                out.append(builtins.slice(*payload))
+            else:
+                out.append(payload)
+        return tuple(out)
+
+    return rebuild
+
+
+def getitem(x, key):
+    tensor_args = []
+    rebuild = _normalize_index(key, tensor_args)
+
+    def fn(v, *idx_vals):
+        idx = rebuild(idx_vals)
+        return v[idx]
+
+    return apply("getitem", fn, x, *tensor_args)
+
+
+def setitem(x, key, value):
+    tensor_args = []
+    rebuild = _normalize_index(key, tensor_args)
+    has_value_tensor = isinstance(value, Tensor)
+
+    def fn(v, *args):
+        if has_value_tensor:
+            val = args[0]
+            idx_vals = args[1:]
+        else:
+            val = value
+            idx_vals = args
+        idx = rebuild(idx_vals)
+        if not isinstance(val, (int, float, bool, complex)):
+            val = jnp.asarray(val, dtype=v.dtype)
+        return v.at[idx].set(val)
+
+    if has_value_tensor:
+        out = apply("setitem", fn, x, value, *tensor_args)
+    else:
+        out = apply("setitem", fn, x, *tensor_args)
+    x._adopt(out)
+    return x

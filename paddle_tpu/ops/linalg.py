@@ -1,0 +1,294 @@
+"""Linear algebra ops.
+
+Parity surface: python/paddle/tensor/linalg.py (matmul at :220) and
+paddle.linalg.*. matmul/einsum lower to XLA dot_general — the MXU path
+(the reference's cuBLAS funcs/blas layer has no analogue here; XLA owns it).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .creation import _t
+from .dispatch import apply
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return apply("matmul", fn, _t(x), _t(y))
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return apply("bmm", jnp.matmul, _t(x), _t(y))
+
+
+def dot(x, y, name=None):
+    return apply("dot", lambda a, b: jnp.sum(a * b, axis=-1), _t(x), _t(y))
+
+
+def mv(x, vec, name=None):
+    return apply("mv", jnp.matmul, _t(x), _t(vec))
+
+
+def t(x, name=None):
+    def fn(v):
+        if v.ndim < 2:
+            return v
+        return v.T
+
+    return apply("t", fn, _t(x))
+
+
+def cross(x, y, axis=9, name=None):
+    def fn(a, b):
+        ax = axis
+        if ax == 9:
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+
+    return apply("cross", fn, _t(x), _t(y))
+
+
+def einsum(equation, *operands):
+    ts = [_t(o) for o in operands]
+    return apply("einsum", lambda vs: jnp.einsum(equation, *vs), list(ts))
+
+
+def tensordot(x, y, axes=2, name=None):
+    def _norm(a):
+        if isinstance(a, Tensor):
+            return [int(i) for i in np.asarray(a._value).reshape(-1)]
+        return a
+
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(_norm(a) for a in axes)
+    return apply("tensordot", lambda a, b: jnp.tensordot(a, b, axes=axes), _t(x), _t(y))
+
+
+def multi_dot(x, name=None):
+    ts = [_t(e) for e in x]
+    return apply("multi_dot", lambda vs: jnp.linalg.multi_dot(vs), ts)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def fn(v):
+        pp = p
+        if pp is None:
+            pp = "fro" if axis is None or isinstance(axis, (list, tuple)) else 2
+        if axis is None:
+            flat = v.reshape(-1)
+            if pp == "fro" or pp == 2:
+                return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(flat))))
+            if pp == np.inf:
+                return jnp.max(jnp.abs(flat))
+            if pp == -np.inf:
+                return jnp.min(jnp.abs(flat))
+            if pp == 0:
+                return jnp.sum((flat != 0).astype(v.dtype))
+            if pp == 1:
+                return jnp.sum(jnp.abs(flat))
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(flat), pp)), 1.0 / pp)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if pp == "fro":
+            return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(v)), axis=ax, keepdims=keepdim))
+        if pp == "nuc":
+            return jnp.linalg.norm(v, ord="nuc", axis=ax, keepdims=keepdim)
+        return jnp.linalg.norm(v, ord=pp, axis=ax, keepdims=keepdim)
+
+    return apply("norm", fn, _t(x))
+
+
+def p_norm(x, p=2, axis=-1, keepdim=False):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def vector_norm(x, p=2, axis=None, keepdim=False, name=None):
+    return apply(
+        "vector_norm",
+        lambda v: jnp.linalg.vector_norm(v, ord=p, axis=axis, keepdims=keepdim),
+        _t(x),
+    )
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return apply(
+        "matrix_norm",
+        lambda v: jnp.linalg.matrix_norm(v, ord=p, keepdims=keepdim),
+        _t(x),
+    )
+
+
+def dist(x, y, p=2, name=None):
+    return norm(x - y if isinstance(x, Tensor) else _t(x) - _t(y), p=p)
+
+
+def det(x, name=None):
+    return apply("det", jnp.linalg.det, _t(x))
+
+
+def slogdet(x, name=None):
+    outs = apply("slogdet", lambda v: tuple(jnp.linalg.slogdet(v)), _t(x))
+    from .manipulation import stack
+
+    return stack(list(outs), 0)
+
+
+def inv(x, name=None):
+    return apply("inv", jnp.linalg.inv, _t(x))
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply("pinv", lambda v: jnp.linalg.pinv(v, rcond=rcond, hermitian=hermitian), _t(x))
+
+
+def matrix_power(x, n, name=None):
+    return apply("matrix_power", lambda v: jnp.linalg.matrix_power(v, n), _t(x))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply("matrix_rank", lambda v: jnp.linalg.matrix_rank(v, tol=tol), _t(x))
+
+
+def cholesky(x, upper=False, name=None):
+    def fn(v):
+        L = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+
+    return apply("cholesky", fn, _t(x))
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def fn(b, L):
+        lower = not upper
+        y1 = jax.scipy.linalg.solve_triangular(L, b, lower=lower, trans=0 if lower else 1)
+        return jax.scipy.linalg.solve_triangular(L, y1, lower=lower, trans=1 if lower else 0)
+
+    return apply("cholesky_solve", fn, _t(x), _t(y))
+
+
+def qr(x, mode="reduced", name=None):
+    outs = apply("qr", lambda v: tuple(jnp.linalg.qr(v, mode=mode)), _t(x))
+    return outs
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply("svd", lambda v: tuple(jnp.linalg.svd(v, full_matrices=full_matrices)), _t(x))
+
+
+def svdvals(x, name=None):
+    return apply("svdvals", lambda v: jnp.linalg.svd(v, compute_uv=False), _t(x))
+
+
+def eig(x, name=None):
+    # CPU-only in jax; evaluated on host
+    vals, vecs = np.linalg.eig(np.asarray(x._value))
+    return Tensor(jnp.asarray(vals)), Tensor(jnp.asarray(vecs))
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply("eigh", lambda v: tuple(jnp.linalg.eigh(v, UPLO=UPLO)), _t(x))
+
+
+def eigvals(x, name=None):
+    vals = np.linalg.eigvals(np.asarray(x._value))
+    return Tensor(jnp.asarray(vals))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply("eigvalsh", lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO), _t(x))
+
+
+def solve(x, y, name=None):
+    def fn(a, b):
+        if b.ndim == a.ndim - 1:
+            return jnp.linalg.solve(a, b[..., None])[..., 0]
+        return jnp.linalg.solve(a, b)
+
+    return apply("solve", fn, _t(x), _t(y))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    return apply(
+        "triangular_solve",
+        lambda a, b: jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular,
+        ),
+        _t(x), _t(y),
+    )
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def fn(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+
+    return apply("lstsq", fn, _t(x), _t(y))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_mat, piv = jax.scipy.linalg.lu_factor(np.asarray(x._value))
+    outs = (Tensor(lu_mat), Tensor(jnp.asarray(piv, jnp.int32) + 1))
+    if get_infos:
+        return outs + (Tensor(jnp.zeros((), jnp.int32)),)
+    return outs
+
+
+def cond(x, p=None, name=None):
+    return apply("cond", lambda v: jnp.linalg.cond(v, p=p), _t(x))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply("corrcoef", lambda v: jnp.corrcoef(v, rowvar=rowvar), _t(x))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply(
+        "cov", lambda v: jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0), _t(x)
+    )
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):  # noqa: A002
+    def fn(v):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (v.min(), v.max())
+        hist, _ = jnp.histogram(v, bins=bins, range=(lo, hi))
+        return hist.astype(jnp.int64)
+
+    return apply("histogram", fn, _t(input))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    arr = np.asarray(x._value)
+    w = np.asarray(weights._value) if isinstance(weights, Tensor) else weights
+    return Tensor(jnp.asarray(np.bincount(arr, weights=w, minlength=minlength)))
+
+
+def householder_product(x, tau, name=None):
+    def fn(a, t_):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(eye, a.shape[:-2] + (m, m)).copy() if a.ndim > 2 else eye
+
+        def body(i, q):
+            v = jnp.where(jnp.arange(m) < i, 0.0, a[..., :, i])
+            v = v.at[..., i].set(1.0)
+            h = jnp.eye(m, dtype=a.dtype) - t_[..., i] * jnp.outer(v, v)
+            return q @ h
+
+        for i in range(n):
+            q = body(i, q)
+        return q[..., :, :n]
+
+    return apply("householder_product", fn, _t(x), _t(tau))

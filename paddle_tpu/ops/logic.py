@@ -1,0 +1,107 @@
+"""Comparison / logical / bitwise ops (parity: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .creation import _t
+from .dispatch import apply
+
+
+def _cmp(opname, jfn):
+    def op(x, y, name=None):
+        yv = y if isinstance(y, Tensor) else jnp.asarray(y)
+        return apply(opname, jfn, _t(x), yv)
+
+    op.__name__ = opname
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+bitwise_and = _cmp("bitwise_and", jnp.bitwise_and)
+bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
+bitwise_left_shift = _cmp("bitwise_left_shift", jnp.left_shift)
+bitwise_right_shift = _cmp("bitwise_right_shift", jnp.right_shift)
+
+
+def logical_not(x, name=None):
+    return apply("logical_not", jnp.logical_not, _t(x))
+
+
+def bitwise_not(x, name=None):
+    return apply("bitwise_not", jnp.bitwise_not, _t(x))
+
+
+def equal_all(x, y, name=None):
+    return apply("equal_all", lambda a, b: jnp.array_equal(a, b), _t(x), _t(y))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(
+        "allclose",
+        lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        _t(x), _t(y),
+    )
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(
+        "isclose",
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        _t(x), _t(y),
+    )
+
+
+def isnan(x, name=None):
+    return apply("isnan", jnp.isnan, _t(x))
+
+
+def isinf(x, name=None):
+    return apply("isinf", jnp.isinf, _t(x))
+
+
+def isfinite(x, name=None):
+    return apply("isfinite", jnp.isfinite, _t(x))
+
+
+def isposinf(x, name=None):
+    return apply("isposinf", jnp.isposinf, _t(x))
+
+
+def isneginf(x, name=None):
+    return apply("isneginf", jnp.isneginf, _t(x))
+
+
+def isreal(x, name=None):
+    return apply("isreal", jnp.isreal, _t(x))
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_floating_point(x):
+    return np.issubdtype(np.dtype(x._value.dtype), np.floating)
+
+
+def is_integer(x):
+    return np.issubdtype(np.dtype(x._value.dtype), np.integer)
+
+
+def is_complex(x):
+    return np.issubdtype(np.dtype(x._value.dtype), np.complexfloating)

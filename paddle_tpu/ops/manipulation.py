@@ -1,0 +1,533 @@
+"""Shape / layout / indexing manipulation ops.
+
+Parity surface: python/paddle/tensor/manipulation.py. All static-shape
+transforms lower to XLA reshape/transpose/gather/scatter; the data-dependent
+ones (masked_select, nonzero, unique) work eagerly and document their jit
+constraints.
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .creation import _t
+from .dispatch import apply
+
+
+def cast(x, dtype):
+    from ..framework import dtype as dtypes
+
+    npd = dtypes.convert_dtype(dtype).np_dtype
+    return apply("cast", lambda v: jnp.asarray(v, dtype=npd), _t(x))
+
+
+def reshape(x, shape, name=None):
+    if isinstance(shape, Tensor):
+        shape = [int(s) for s in np.asarray(shape._value)]
+    shape = tuple(int(s) if not isinstance(s, Tensor) else int(s.item()) for s in shape)
+    return apply("reshape", lambda v: jnp.reshape(v, shape), _t(x))
+
+
+def reshape_(x, shape, name=None):
+    return x._adopt(reshape(x, shape))
+
+
+def view(x, shape_or_dtype, name=None):
+    return reshape(x, shape_or_dtype)
+
+
+def transpose(x, perm, name=None):
+    perm = [int(p) for p in perm]
+    return apply("transpose", lambda v: jnp.transpose(v, perm), _t(x))
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply("moveaxis", lambda v: jnp.moveaxis(v, source, destination), _t(x))
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply("swapaxes", lambda v: jnp.swapaxes(v, axis0, axis1), _t(x))
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    ts = [_t(e) for e in x]
+    return apply("concat", lambda vs: jnp.concatenate(vs, axis=axis), ts)
+
+
+def stack(x, axis=0, name=None):
+    ts = [_t(e) for e in x]
+    return apply("stack", lambda vs: jnp.stack(vs, axis=axis), ts)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+
+    def fn(v):
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(v, num_or_sections, axis=axis))
+        secs = [int(s) for s in num_or_sections]
+        total = v.shape[axis]
+        # paddle allows one -1 section
+        neg = [i for i, s in enumerate(secs) if s == -1]
+        if neg:
+            known = sum(s for s in secs if s != -1)
+            secs[neg[0]] = total - known
+        points = np.cumsum(secs)[:-1].tolist()
+        return tuple(jnp.split(v, points, axis=axis))
+
+    return list(apply("split", fn, _t(x)))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    n = x.shape[axis]
+
+    def fn(v):
+        return tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(v, n, axis=axis))
+
+    return list(apply("unbind", fn, _t(x)))
+
+
+def squeeze(x, axis=None, name=None):
+    def fn(v):
+        if axis is None:
+            return jnp.squeeze(v)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(int(a) % v.ndim for a in axes if v.shape[int(a) % v.ndim] == 1)
+        return jnp.squeeze(v, axis=axes) if axes else v
+
+    return apply("squeeze", fn, _t(x))
+
+
+def squeeze_(x, axis=None, name=None):
+    return x._adopt(squeeze(x, axis))
+
+
+def unsqueeze(x, axis, name=None):
+    def fn(v):
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        out = v
+        for a in sorted(int(ax) for ax in axes):
+            out = jnp.expand_dims(out, a)
+        return out
+
+    return apply("unsqueeze", fn, _t(x))
+
+
+def unsqueeze_(x, axis, name=None):
+    return x._adopt(unsqueeze(x, axis))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def fn(v):
+        nd = v.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = v.shape[:s] + (-1,) + v.shape[e + 1:]
+        return jnp.reshape(v, new_shape)
+
+    return apply("flatten", fn, _t(x))
+
+
+def flip(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return apply("flip", lambda v: jnp.flip(v, axis=tuple(axes)), _t(x))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply("rot90", lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), _t(x))
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply("roll", lambda v: jnp.roll(v, shifts, axis=axis), _t(x))
+
+
+def tile(x, repeat_times, name=None):
+    if isinstance(repeat_times, Tensor):
+        repeat_times = [int(r) for r in np.asarray(repeat_times._value)]
+    return apply("tile", lambda v: jnp.tile(v, tuple(repeat_times)), _t(x))
+
+
+def expand(x, shape, name=None):
+    if isinstance(shape, Tensor):
+        shape = [int(s) for s in np.asarray(shape._value)]
+
+    def fn(v):
+        tgt = list(shape)
+        # -1 keeps the source dim (paddle semantics)
+        vshape = (1,) * (len(tgt) - v.ndim) + tuple(v.shape)
+        tgt = [vs if t == -1 else t for t, vs in zip(tgt, vshape)]
+        return jnp.broadcast_to(v.reshape(vshape), tuple(tgt))
+
+    return apply("expand", fn, _t(x))
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    ts = [_t(e) for e in inputs]
+    return list(apply("broadcast_tensors", lambda vs: tuple(jnp.broadcast_arrays(*vs)), ts))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+
+    def fn(v, idx):
+        return jnp.take(v, idx.reshape(-1) if idx.ndim > 1 else idx, axis=int(axis))
+
+    return apply("gather", fn, _t(x), _t(index))
+
+
+def gather_nd(x, index, name=None):
+    def fn(v, idx):
+        if idx.shape[-1] == 0:
+            return jnp.broadcast_to(v, idx.shape[:-1] + v.shape)
+        comps = tuple(jnp.moveaxis(idx, -1, 0))
+        return v[comps]
+
+    return apply("gather_nd", fn, _t(x), _t(index))
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return apply(
+        "take_along_axis",
+        lambda v, idx: jnp.take_along_axis(v, idx, axis=axis),
+        _t(arr), _t(indices),
+    )
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True,
+                   broadcast=True, name=None):
+    def fn(v, idx, val):
+        val = jnp.broadcast_to(jnp.asarray(val, v.dtype), idx.shape)
+        if reduce == "assign":
+            return _scatter_along_axis(v, idx, val, axis, "set")
+        if reduce in ("add", "sum"):
+            return _scatter_along_axis(v, idx, val, axis, "add")
+        if reduce in ("mul", "multiply"):
+            return _scatter_along_axis(v, idx, val, axis, "mul")
+        raise ValueError(f"unsupported reduce: {reduce}")
+
+    vals = values if isinstance(values, Tensor) else jnp.asarray(values)
+    return apply("put_along_axis", fn, _t(arr), _t(indices), vals)
+
+
+def _scatter_along_axis(v, idx, val, axis, mode):
+    axis = axis % v.ndim
+    idx_full = [jnp.arange(s).reshape([-1 if i == d else 1 for i in range(idx.ndim)])
+                for d, s in enumerate(idx.shape)]
+    idx_full[axis] = idx
+    loc = tuple(jnp.broadcast_arrays(*idx_full))
+    ref = v.at[loc]
+    return {"set": ref.set, "add": ref.add, "mul": ref.multiply}[mode](val)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def fn(v, idx, upd):
+        idx = idx.reshape(-1)
+        if overwrite:
+            return v.at[idx].set(upd)
+        base = v.at[idx].set(jnp.zeros_like(upd))
+        return base.at[idx].add(upd)
+
+    return apply("scatter", fn, _t(x), _t(index), _t(updates))
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return x._adopt(scatter(x, index, updates, overwrite))
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def fn(v, idx, upd):
+        comps = tuple(jnp.moveaxis(idx, -1, 0))
+        return v.at[comps].add(upd)
+
+    return apply("scatter_nd_add", fn, _t(x), _t(index), _t(updates))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+
+    zero = zeros(shape, dtype=updates.dtype)
+    return scatter_nd_add(zero, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply("index_select", lambda v, idx: jnp.take(v, idx, axis=axis), _t(x), _t(index))
+
+
+def index_sample(x, index):
+    return apply(
+        "index_sample", lambda v, idx: jnp.take_along_axis(v, idx, axis=1), _t(x), _t(index)
+    )
+
+
+def index_add(x, index, axis, value, name=None):
+    def fn(v, idx, val):
+        sl = [slice(None)] * v.ndim
+        perm_axis = axis % v.ndim
+        moved = jnp.moveaxis(v, perm_axis, 0)
+        movedv = jnp.moveaxis(val, perm_axis, 0)
+        out = moved.at[idx].add(movedv)
+        return jnp.moveaxis(out, 0, perm_axis)
+
+    return apply("index_add", fn, _t(x), _t(index), _t(value))
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx_ts = [_t(i) for i in indices]
+
+    def fn(v, idxs, val):
+        key = tuple(idxs)
+        return v.at[key].add(val) if accumulate else v.at[key].set(val)
+
+    return apply("index_put", fn, _t(x), idx_ts, _t(value))
+
+
+def masked_select(x, mask, name=None):
+    # data-dependent output shape: eager-only (under jit use where/gather)
+    def fn(v, m):
+        return v[m]
+
+    return apply("masked_select", fn, _t(x), _t(mask))
+
+
+def masked_fill(x, mask, value, name=None):
+    v = value if isinstance(value, Tensor) else jnp.asarray(value)
+    return apply("masked_fill", lambda a, m, val: jnp.where(m, jnp.asarray(val, a.dtype), a),
+                 _t(x), _t(mask), v)
+
+
+def masked_fill_(x, mask, value, name=None):
+    return x._adopt(masked_fill(x, mask, value))
+
+
+def masked_scatter(x, mask, value, name=None):
+    def fn(v, m, val):
+        flat_m = m.reshape(-1)
+        cnt = jnp.cumsum(flat_m) - 1
+        src = val.reshape(-1)[jnp.clip(cnt, 0, val.size - 1)]
+        return jnp.where(flat_m, src, v.reshape(-1)).reshape(v.shape)
+
+    return apply("masked_scatter", fn, _t(x), _t(mask), _t(value))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        from .search import nonzero
+
+        return nonzero(condition, as_tuple=True)
+    xt = x if isinstance(x, Tensor) else jnp.asarray(x)
+    yt = y if isinstance(y, Tensor) else jnp.asarray(y)
+    return apply("where", lambda c, a, b: jnp.where(c, a, b), _t(condition), xt, yt)
+
+
+def slice(x, axes, starts, ends):  # noqa: A001
+    def fn(v):
+        idx = [builtins_slice(None)] * v.ndim
+        for ax, st, en in zip(axes, starts, ends):
+            st = int(st.item()) if isinstance(st, Tensor) else int(st)
+            en = int(en.item()) if isinstance(en, Tensor) else int(en)
+            idx[ax] = builtins_slice(st, en)
+        return v[tuple(idx)]
+
+    return apply("slice", fn, _t(x))
+
+
+builtins_slice = builtins.slice
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def fn(v):
+        idx = [builtins_slice(None)] * v.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[ax] = builtins_slice(int(st), int(en), int(sd))
+        return v[tuple(idx)]
+
+    return apply("strided_slice", fn, _t(x))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    if isinstance(pad, Tensor):
+        pad = [int(p) for p in np.asarray(pad._value)]
+    pad = [int(p) for p in pad]
+
+    def fn(v):
+        nd = v.ndim
+        if len(pad) == 2 * nd:
+            width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle nn.functional.pad convention: pad applies to last dims,
+            # ordered (last_dim_lo, last_dim_hi, second_last_lo, ...)
+            npairs = len(pad) // 2
+            width = [(0, 0)] * nd
+            if data_format in ("NCHW", "NCL", "NCDHW") and npairs == nd - 2:
+                # spatial dims only, reversed pair order
+                for i in range(npairs):
+                    dim = nd - 1 - i
+                    width[dim] = (pad[2 * i], pad[2 * i + 1])
+            elif data_format in ("NHWC", "NLC", "NDHWC") and npairs == nd - 2:
+                for i in range(npairs):
+                    dim = nd - 2 - i
+                    width[dim] = (pad[2 * i], pad[2 * i + 1])
+            else:
+                for i in range(npairs):
+                    dim = nd - 1 - i
+                    width[dim] = (pad[2 * i], pad[2 * i + 1])
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+                 "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(v, width, mode="constant", constant_values=value)
+        return jnp.pad(v, width, mode=jmode)
+
+    return apply("pad", fn, _t(x))
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    def fn(v, *r):
+        rep = r[0] if r else repeats
+        return jnp.repeat(v, rep, axis=axis)
+
+    if isinstance(repeats, Tensor):
+        return apply("repeat_interleave", fn, _t(x), repeats)
+    return apply("repeat_interleave", fn, _t(x))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    # data-dependent shape: eager-only
+    vals = np.unique(
+        np.asarray(x._value), return_index=return_index,
+        return_inverse=return_inverse, return_counts=return_counts, axis=axis,
+    )
+    if not isinstance(vals, tuple):
+        return Tensor(jnp.asarray(vals))
+    outs = [Tensor(jnp.asarray(v)) for v in vals]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    arr = np.asarray(x._value)
+    if axis is None:
+        arr = arr.reshape(-1)
+        change = np.concatenate([[True], arr[1:] != arr[:-1]])
+    else:
+        raise NotImplementedError("unique_consecutive with axis")
+    vals = arr[change]
+    outs = [Tensor(jnp.asarray(vals))]
+    if return_inverse:
+        inv = np.cumsum(change) - 1
+        outs.append(Tensor(jnp.asarray(inv)))
+    if return_counts:
+        idx = np.nonzero(change)[0]
+        counts = np.diff(np.concatenate([idx, [arr.size]]))
+        outs.append(Tensor(jnp.asarray(counts)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def as_real(x, name=None):
+    return apply("as_real", lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], -1), _t(x))
+
+
+def as_complex(x, name=None):
+    return apply("as_complex", lambda v: jax.lax.complex(v[..., 0], v[..., 1]), _t(x))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    def fn(v):
+        offs = offsets or [0] * v.ndim
+        shp = shape or v.shape
+        idx = tuple(builtins_slice(int(o), int(o) + int(s)) for o, s in zip(offs, shp))
+        return v[idx]
+
+    return apply("crop", fn, _t(x))
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply("atleast_1d", jnp.atleast_1d, _t(i)) for i in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply("atleast_2d", jnp.atleast_2d, _t(i)) for i in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply("atleast_3d", jnp.atleast_3d, _t(i)) for i in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    def fn(v):
+        return tuple(jnp.array_split(v, num_or_indices, axis=axis))
+
+    return list(apply("tensor_split", fn, _t(x)))
+
+
+def hsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=1 if x.ndim > 1 else 0)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def hstack(x, name=None):
+    ts = [_t(e) for e in x]
+    return apply("hstack", lambda vs: jnp.hstack(vs), ts)
+
+
+def vstack(x, name=None):
+    ts = [_t(e) for e in x]
+    return apply("vstack", lambda vs: jnp.vstack(vs), ts)
+
+
+def dstack(x, name=None):
+    ts = [_t(e) for e in x]
+    return apply("dstack", lambda vs: jnp.dstack(vs), ts)
+
+
+def column_stack(x, name=None):
+    ts = [_t(e) for e in x]
+    return apply("column_stack", lambda vs: jnp.column_stack(vs), ts)
+
+
+def row_stack(x, name=None):
+    return vstack(x)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):  # noqa: A002
+    def fn(v):
+        shard_size = (index_num + nshards - 1) // nshards
+        lo = shard_id * shard_size
+        in_shard = (v >= lo) & (v < lo + shard_size)
+        return jnp.where(in_shard, v - lo, ignore_value)
+
+    return apply("shard_index", fn, _t(input))

@@ -1,0 +1,330 @@
+"""Elementwise & reduction math ops.
+
+Parity surface: python/paddle/tensor/math.py (and ops.yaml entries, reference:
+paddle/phi/ops/yaml/ops.yaml). Every op routes through dispatch.apply so
+autograd records a node; kernels are jax.numpy/lax and fuse in XLA.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..framework import dtype as dtypes
+from .creation import _t, to_tensor
+from .dispatch import apply
+
+
+def _unary(opname, jfn):
+    def op(x, name=None):
+        return apply(opname, jfn, _t(x))
+
+    op.__name__ = opname
+    return op
+
+
+def _binary(opname, jfn):
+    def op(x, y, name=None):
+        xt = x if isinstance(x, Tensor) else None
+        yt = y if isinstance(y, Tensor) else None
+        if xt is None and yt is None:
+            return Tensor(jfn(jnp.asarray(x), jnp.asarray(y)))
+        a = xt if xt is not None else x
+        b = yt if yt is not None else y
+        return apply(opname, jfn, a, b)
+
+    op.__name__ = opname
+    return op
+
+
+# -- unary -------------------------------------------------------------------
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", jax.lax.rsqrt)
+square = _unary("square", jnp.square)
+reciprocal = _unary("reciprocal", lambda v: 1.0 / v)
+abs = _unary("abs", jnp.abs)  # noqa: A001
+sign = _unary("sign", jnp.sign)
+negative = _unary("negative", jnp.negative)
+neg = negative
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+tanh = _unary("tanh", jnp.tanh)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+floor = _unary("floor", jnp.floor)
+ceil = _unary("ceil", jnp.ceil)
+round = _unary("round", jnp.round)  # noqa: A001
+trunc = _unary("trunc", jnp.trunc)
+frac = _unary("frac", lambda v: v - jnp.trunc(v))
+digamma = _unary("digamma", jax.scipy.special.digamma)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+gamma = _unary("gamma", lambda v: jnp.exp(jax.scipy.special.gammaln(v)) * jnp.sign(v) ** 0)
+i0 = _unary("i0", jax.scipy.special.i0)
+i0e = _unary("i0e", jax.scipy.special.i0e)
+i1 = _unary("i1", jax.scipy.special.i1)
+i1e = _unary("i1e", jax.scipy.special.i1e)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+angle = _unary("angle", jnp.angle)
+conj = _unary("conj", jnp.conj)
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+softplus_raw = _unary("softplus", jax.nn.softplus)
+logit = _unary("logit", jax.scipy.special.logit)
+
+
+# -- binary ------------------------------------------------------------------
+add = _binary("add", jnp.add)
+subtract = _binary("subtract", jnp.subtract)
+multiply = _binary("multiply", jnp.multiply)
+divide = _binary("divide", jnp.true_divide)
+floor_divide = _binary("floor_divide", jnp.floor_divide)
+mod = _binary("mod", jnp.mod)
+remainder = mod
+floor_mod = mod
+fmod = _binary("fmod", jnp.fmod)
+pow = _binary("pow", jnp.power)  # noqa: A001
+maximum = _binary("maximum", jnp.maximum)
+minimum = _binary("minimum", jnp.minimum)
+fmax = _binary("fmax", jnp.fmax)
+fmin = _binary("fmin", jnp.fmin)
+atan2 = _binary("atan2", jnp.arctan2)
+heaviside = _binary("heaviside", jnp.heaviside)
+gcd = _binary("gcd", jnp.gcd)
+lcm = _binary("lcm", jnp.lcm)
+hypot = _binary("hypot", jnp.hypot)
+copysign = _binary("copysign", jnp.copysign)
+nextafter = _binary("nextafter", jnp.nextafter)
+logaddexp = _binary("logaddexp", jnp.logaddexp)
+ldexp = _binary("ldexp", jnp.ldexp)
+inner = _binary("inner", jnp.inner)
+outer = _binary("outer", jnp.outer)
+kron = _binary("kron", jnp.kron)
+
+
+def divide_no_nan(x, y):
+    return apply("divide_no_nan", lambda a, b: jnp.where(b == 0, 0.0, a / jnp.where(b == 0, 1.0, b)), _t(x), _t(y))
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def fn(v, s):
+        if bias_after_scale:
+            return v * s + jnp.asarray(bias, _result_float(v))
+        return (v + jnp.asarray(bias, _result_float(v))) * s
+
+    s = scale if isinstance(scale, Tensor) else jnp.asarray(scale)
+    out = apply("scale", fn, _t(x), s)
+    if act is not None:
+        from ..nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+def _result_float(v):
+    d = np.dtype(v.dtype)
+    return d if np.issubdtype(d, np.floating) else np.float32
+
+
+def increment(x, value=1.0, name=None):
+    out = apply("increment", lambda v: v + jnp.asarray(value, v.dtype), x)
+    x._adopt(out)
+    return x
+
+
+def clip(x, min=None, max=None, name=None):  # noqa: A002
+    def fn(v, *mm):
+        lo = mm[0] if isinstance(min, Tensor) else min
+        hi_idx = 1 if isinstance(min, Tensor) else 0
+        hi = mm[hi_idx] if isinstance(max, Tensor) else max
+        return jnp.clip(v, lo, hi)
+
+    extra = [m for m in (min, max) if isinstance(m, Tensor)]
+    return apply("clip", fn, _t(x), *extra)
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply("lerp", lambda a, b, w: a + w * (b - a), _t(x), _t(y), weight)
+    return apply("lerp", lambda a, b: a + weight * (b - a), _t(x), _t(y))
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply(
+        "nan_to_num",
+        lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf, neginf=neginf),
+        _t(x),
+    )
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply("stanh", lambda v: scale_b * jnp.tanh(scale_a * v), _t(x))
+
+
+def multiplex(inputs, index, name=None):
+    return apply(
+        "multiplex",
+        lambda vs, idx: jnp.stack(vs, 0)[idx.reshape(-1), jnp.arange(vs[0].shape[0])],
+        [_t(i) for i in inputs], _t(index),
+    )
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    return apply(
+        "addmm", lambda i, a, b: beta * i + alpha * (a @ b), _t(input), _t(x), _t(y)
+    )
+
+
+# -- reductions ---------------------------------------------------------------
+def _axes(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    d = dtypes.convert_dtype(dtype).np_dtype if dtype else None
+
+    def fn(v):
+        dd = d
+        if dd is None and np.issubdtype(np.dtype(v.dtype), np.bool_):
+            dd = np.int64
+        return jnp.sum(v, axis=_axes(axis), keepdims=keepdim, dtype=dd)
+
+    return apply("sum", fn, _t(x))
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return apply("nansum", lambda v: jnp.nansum(v, axis=_axes(axis), keepdims=keepdim), _t(x))
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply("mean", lambda v: jnp.mean(v, axis=_axes(axis), keepdims=keepdim), _t(x))
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return apply("nanmean", lambda v: jnp.nanmean(v, axis=_axes(axis), keepdims=keepdim), _t(x))
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    return apply("prod", lambda v: jnp.prod(v, axis=_axes(axis), keepdims=keepdim), _t(x))
+
+
+def max(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply("max", lambda v: jnp.max(v, axis=_axes(axis), keepdims=keepdim), _t(x))
+
+
+def min(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply("min", lambda v: jnp.min(v, axis=_axes(axis), keepdims=keepdim), _t(x))
+
+
+amax = max
+amin = min
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply(
+        "logsumexp",
+        lambda v: jax.scipy.special.logsumexp(v, axis=_axes(axis), keepdims=keepdim),
+        _t(x),
+    )
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    def fn(v):
+        if axis is None:
+            return jnp.cumsum(v.reshape(-1))
+        return jnp.cumsum(v, axis=int(axis))
+
+    return apply("cumsum", fn, _t(x))
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    def fn(v):
+        if dim is None:
+            return jnp.cumprod(v.reshape(-1))
+        return jnp.cumprod(v, axis=int(dim))
+
+    return apply("cumprod", fn, _t(x))
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def full_fn(v):
+        ax = 0 if axis is None else int(axis)
+        vv = v.reshape(-1) if axis is None else v
+        vals = jax.lax.associative_scan(jnp.maximum, vv, axis=ax)
+        n = vv.shape[ax]
+        ar = jnp.arange(n).reshape([-1 if i == ax % vv.ndim else 1 for i in range(vv.ndim)])
+        eq = vv == vals
+        idx = jax.lax.associative_scan(jnp.maximum, jnp.where(eq, ar, -1), axis=ax)
+        return vals, idx.astype(jnp.int64)
+
+    vals, idx = apply("cummax", full_fn, _t(x))
+    return vals, idx
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def full_fn(v):
+        ax = 0 if axis is None else int(axis)
+        vv = v.reshape(-1) if axis is None else v
+        vals = jax.lax.associative_scan(jnp.minimum, vv, axis=ax)
+        n = vv.shape[ax]
+        ar = jnp.arange(n).reshape([-1 if i == ax % vv.ndim else 1 for i in range(vv.ndim)])
+        eq = vv == vals
+        idx = jax.lax.associative_scan(jnp.maximum, jnp.where(eq, ar, -1), axis=ax)
+        return vals, idx.astype(jnp.int64)
+
+    vals, idx = apply("cummin", full_fn, _t(x))
+    return vals, idx
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def fn(v):
+        vv = v.reshape(-1) if axis is None else v
+        ax = 0 if axis is None else int(axis)
+        return jax.lax.associative_scan(jnp.logaddexp, vv, axis=ax)
+
+    return apply("logcumsumexp", fn, _t(x))
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(
+        "trace", lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2), _t(x)
+    )
+
+
+def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply("all", lambda v: jnp.all(v, axis=_axes(axis), keepdims=keepdim), _t(x))
+
+
+def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply("any", lambda v: jnp.any(v, axis=_axes(axis), keepdims=keepdim), _t(x))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply(
+        "count_nonzero",
+        lambda v: jnp.count_nonzero(v, axis=_axes(axis), keepdims=keepdim).astype(jnp.int64),
+        _t(x),
+    )

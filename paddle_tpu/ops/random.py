@@ -1,0 +1,152 @@
+"""Random ops over the framework RNG.
+
+Parity surface: python/paddle/tensor/random.py. Eager calls draw keys from the
+global stateful generator (paddle.seed parity); under jit capture the key comes
+from the bound rng_context (see framework/random.py) so traced programs stay
+pure. Outputs are non-differentiable constants (paddle parity).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..framework import dtype as dtypes
+from ..framework.random import next_key
+from .creation import _shape, _t
+
+
+def _dt(dtype):
+    if dtype is None:
+        return dtypes.get_default_dtype().np_dtype
+    return dtypes.convert_dtype(dtype).np_dtype
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    key = jax.random.key(seed) if seed else next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype), min, max))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    return x._replace_value(
+        jax.random.uniform(next_key(), tuple(x.shape), x._value.dtype, min, max)
+    )
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(next_key(), _shape(shape), _dt(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        shp = np.broadcast_shapes(
+            np.shape(m) if not isinstance(m, (int, float)) else (),
+            np.shape(s) if not isinstance(s, (int, float)) else (),
+        )
+        return Tensor(jax.random.normal(next_key(), shp, _dt(None)) * s + m)
+    if shape is None:
+        shape = []
+    return Tensor(jax.random.normal(next_key(), _shape(shape), _dt(None)) * std + mean)
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    return x._replace_value(
+        jax.random.normal(next_key(), tuple(x.shape), x._value.dtype) * std + mean
+    )
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    key = jax.random.key(seed) if seed else next_key()
+    return Tensor(jax.random.normal(key, _shape(shape), _dt(dtype)) * std + mean)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(next_key(), _shape(shape), low, high,
+                                     dtype=_dtint(dtype)))
+
+
+def _dtint(dtype):
+    return dtypes.convert_dtype(dtype or "int64").np_dtype
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    d = dtype or x.dtype
+    return Tensor(jax.random.randint(next_key(), tuple(x.shape), low, high,
+                                     dtype=_dtint(d)))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(next_key(), n).astype(_dtint(dtype)))
+
+
+def shuffle(x, axis=0):
+    return Tensor(jax.random.permutation(next_key(), x._value, axis=axis,
+                                         independent=False))
+
+
+def bernoulli(x, name=None):
+    return Tensor(jax.random.bernoulli(next_key(), x._value).astype(x._value.dtype))
+
+
+def bernoulli_(x, p=0.5, name=None):
+    return x._replace_value(
+        jax.random.bernoulli(next_key(), p, tuple(x.shape)).astype(x._value.dtype)
+    )
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    logits = jnp.log(jnp.clip(x._value, 1e-30, None))
+    if logits.ndim == 1:
+        out = jax.random.categorical(next_key(), logits, shape=(num_samples,)) \
+            if replacement else jax.random.choice(
+                next_key(), logits.shape[0], (num_samples,), replace=False,
+                p=x._value / x._value.sum())
+    else:
+        if replacement:
+            out = jax.random.categorical(
+                next_key(), logits[:, None, :], axis=-1,
+                shape=(logits.shape[0], num_samples))
+        else:
+            keys = jax.random.split(next_key(), logits.shape[0])
+            out = jnp.stack([
+                jax.random.choice(k, logits.shape[-1], (num_samples,), replace=False,
+                                  p=row / row.sum())
+                for k, row in zip(keys, x._value)
+            ])
+    return Tensor(out.astype(jnp.int64))
+
+
+def poisson(x, name=None):
+    return Tensor(jax.random.poisson(next_key(), x._value).astype(x._value.dtype))
+
+
+def exponential_(x, lam=1.0, name=None):
+    return x._replace_value(
+        jax.random.exponential(next_key(), tuple(x.shape), x._value.dtype) / lam
+    )
+
+
+def binomial(count, prob, name=None):
+    c = count._value if isinstance(count, Tensor) else count
+    p = prob._value if isinstance(prob, Tensor) else prob
+    return Tensor(jax.random.binomial(next_key(), c, p).astype(jnp.int64))
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    return Tensor(jnp.exp(
+        jax.random.normal(next_key(), _shape(shape or []), _dt(None)) * std + mean))

@@ -1,0 +1,58 @@
+"""Operator registry.
+
+TPU-native counterpart of the reference's yaml op registry + kernel factory
+(reference: paddle/phi/ops/yaml/ops.yaml; paddle/phi/core/kernel_factory.h:316
+KernelFactory; registration macro kernel_registry.h:196 PD_REGISTER_KERNEL).
+
+Here there is exactly one "backend" (XLA), so a registration is just
+(name, python functional entry, category). The registry exists for
+introspection, op-inventory tests, and the generated ``_C_ops`` namespace
+(parity: python/paddle/_C_ops.py:20-27).
+"""
+from __future__ import annotations
+
+import dataclasses
+import types
+from typing import Callable, Dict, Optional
+
+
+@dataclasses.dataclass
+class OpDef:
+    name: str
+    fn: Callable
+    category: str
+    inplace: Optional[str] = None  # name of the inplace variant, if any
+
+
+REGISTRY: Dict[str, OpDef] = {}
+
+
+def register_op(name: str, fn: Callable, category: str, inplace: Optional[str] = None):
+    REGISTRY[name] = OpDef(name, fn, category, inplace)
+    return fn
+
+
+def register_module(mod: types.ModuleType, category: str):
+    """Register every public callable of a module as an op."""
+    for attr in dir(mod):
+        if attr.startswith("_"):
+            continue
+        fn = getattr(mod, attr)
+        if callable(fn) and getattr(fn, "__module__", "").startswith("paddle_tpu"):
+            register_op(attr, fn, category)
+
+
+def get_op(name: str) -> OpDef:
+    return REGISTRY[name]
+
+
+def op_names():
+    return sorted(REGISTRY)
+
+
+def build_c_ops_namespace():
+    """The `_C_ops`-style flat namespace of raw functional ops."""
+    ns = types.SimpleNamespace()
+    for name, od in REGISTRY.items():
+        setattr(ns, name, od.fn)
+    return ns

@@ -1,0 +1,144 @@
+"""Search / sort / index ops (parity: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .creation import _t
+from .dispatch import apply
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def fn(v):
+        out = jnp.argmax(v.reshape(-1) if axis is None else v,
+                         axis=None if axis is None else int(axis),
+                         keepdims=keepdim if axis is not None else False)
+        return out.astype(jnp.int64)
+
+    return apply("argmax", fn, _t(x))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def fn(v):
+        out = jnp.argmin(v.reshape(-1) if axis is None else v,
+                         axis=None if axis is None else int(axis),
+                         keepdims=keepdim if axis is not None else False)
+        return out.astype(jnp.int64)
+
+    return apply("argmin", fn, _t(x))
+
+
+def argsort(x, axis=-1, descending=False, stable=True, name=None):
+    def fn(v):
+        idx = jnp.argsort(v, axis=axis, stable=stable, descending=descending)
+        return idx.astype(jnp.int64)
+
+    return apply("argsort", fn, _t(x))
+
+
+def sort(x, axis=-1, descending=False, stable=True, name=None):
+    def fn(v):
+        return jnp.sort(v, axis=axis, stable=stable, descending=descending)
+
+    return apply("sort", fn, _t(x))
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):  # noqa: A002
+    if isinstance(k, Tensor):
+        k = int(k.item())
+
+    def fn(v):
+        ax = axis % v.ndim
+        moved = jnp.moveaxis(v, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(moved, k)
+        else:
+            vals, idx = jax.lax.top_k(-moved, k)
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int64), -1, ax))
+
+    return apply("topk", fn, _t(x))
+
+
+def nonzero(x, as_tuple=False):
+    # data-dependent output shape: eager-only
+    arr = np.asarray(x._value)
+    idx = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i[:, None].astype(np.int64))) for i in idx)
+    return Tensor(jnp.asarray(np.stack(idx, -1).astype(np.int64)))
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def fn(v):
+        ax = axis % v.ndim
+        sorted_v = jnp.sort(v, axis=ax)
+        sorted_i = jnp.argsort(v, axis=ax)
+        vals = jnp.take(sorted_v, k - 1, axis=ax)
+        idx = jnp.take(sorted_i, k - 1, axis=ax)
+        if keepdim:
+            vals = jnp.expand_dims(vals, ax)
+            idx = jnp.expand_dims(idx, ax)
+        return vals, idx.astype(jnp.int64)
+
+    return apply("kthvalue", fn, _t(x))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    def fn(v):
+        ax = axis % v.ndim
+        moved = jnp.moveaxis(v, ax, -1)
+        sorted_v = jnp.sort(moved, axis=-1)
+        n = sorted_v.shape[-1]
+        # run-length: count of equal neighbors
+        eq = sorted_v[..., 1:] == sorted_v[..., :-1]
+        runs = jnp.concatenate(
+            [jnp.zeros(eq.shape[:-1] + (1,), jnp.int32),
+             jnp.cumsum(eq.astype(jnp.int32), axis=-1)], axis=-1)
+        # reset counter at run boundaries
+        start = jnp.where(
+            jnp.concatenate([jnp.ones(eq.shape[:-1] + (1,), bool), ~eq], axis=-1),
+            runs, 0)
+        run_id = jax.lax.associative_scan(jnp.maximum, start, axis=-1)
+        length = runs - run_id
+        best = jnp.argmax(length, axis=-1)
+        vals = jnp.take_along_axis(sorted_v, best[..., None], axis=-1)[..., 0]
+        # index of last occurrence of the modal value in the original layout
+        idx = jnp.argmax(
+            (moved == vals[..., None]) * jnp.arange(n), axis=-1)
+        if keepdim:
+            vals, idx = vals[..., None], idx[..., None]
+            vals = jnp.moveaxis(vals, -1, ax)
+            idx = jnp.moveaxis(idx, -1, ax)
+        return vals, idx.astype(jnp.int64)
+
+    return apply("mode", fn, _t(x))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def fn(seq, vals):
+        side = "right" if right else "left"
+        if seq.ndim == 1:
+            out = jnp.searchsorted(seq, vals, side=side)
+        else:
+            out = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(
+                seq.reshape(-1, seq.shape[-1]), vals.reshape(-1, vals.shape[-1])
+            ).reshape(vals.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+    return apply("searchsorted", fn, _t(sorted_sequence), _t(values))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def index_fill(x, index, axis, value, name=None):
+    def fn(v, idx):
+        moved = jnp.moveaxis(v, axis, 0)
+        out = moved.at[idx].set(jnp.asarray(value, v.dtype))
+        return jnp.moveaxis(out, 0, axis)
+
+    return apply("index_fill", fn, _t(x), _t(index))

@@ -1,0 +1,68 @@
+"""Statistics ops (parity: python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .creation import _t
+from .dispatch import apply
+from .math import _axes
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(
+        "std",
+        lambda v: jnp.std(v, axis=_axes(axis), ddof=1 if unbiased else 0,
+                          keepdims=keepdim),
+        _t(x),
+    )
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(
+        "var",
+        lambda v: jnp.var(v, axis=_axes(axis), ddof=1 if unbiased else 0,
+                          keepdims=keepdim),
+        _t(x),
+    )
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def fn(v):
+        if mode == "avg":
+            return jnp.median(v, axis=_axes(axis), keepdims=keepdim)
+        # 'min' mode: lower of the two middle values
+        vv = v.reshape(-1) if axis is None else v
+        ax = 0 if axis is None else int(axis)
+        n = vv.shape[ax]
+        s = jnp.sort(vv, axis=ax)
+        out = jnp.take(s, (n - 1) // 2, axis=ax)
+        if keepdim:
+            out = jnp.expand_dims(out, ax)
+        return out
+
+    return apply("median", fn, _t(x))
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    return apply(
+        "nanmedian", lambda v: jnp.nanmedian(v, axis=_axes(axis), keepdims=keepdim),
+        _t(x),
+    )
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    def fn(v):
+        qq = jnp.asarray(q)
+        return jnp.quantile(v, qq, axis=_axes(axis), keepdims=keepdim,
+                            method=interpolation)
+
+    return apply("quantile", fn, _t(x))
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    def fn(v):
+        qq = jnp.asarray(q)
+        return jnp.nanquantile(v, qq, axis=_axes(axis), keepdims=keepdim,
+                               method=interpolation)
+
+    return apply("nanquantile", fn, _t(x))

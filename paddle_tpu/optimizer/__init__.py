@@ -1,0 +1,21 @@
+"""paddle_tpu.optimizer (parity: python/paddle/optimizer/__init__.py)."""
+from __future__ import annotations
+
+from . import lr  # noqa: F401
+from .optimizer import Optimizer  # noqa: F401
+from .optimizers import (  # noqa: F401
+    ASGD, LBFGS, SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum,
+    NAdam, RAdam, RMSProp, Rprop,
+)
+
+
+class L2Decay:
+    """paddle.regularizer.L2Decay parity."""
+
+    def __init__(self, coeff=0.0):
+        self._coeff = coeff
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = coeff

