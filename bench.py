@@ -1,0 +1,108 @@
+"""Round benchmark: Llama pretrain train-step throughput on the local chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+value = tokens/sec/chip on a ~1.2B-param Llama train step (fwd+bwd+AdamW,
+bf16 compute / f32 master, remat on). vs_baseline = achieved MFU / 0.40
+(the BASELINE.json north-star: >=40% MFU — no reference-published numbers
+exist, see BASELINE.md).
+"""
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+# bf16 peak FLOPs per chip by device kind (public spec sheets)
+_PEAK = {
+    "v4": 275e12,
+    "v5p": 459e12,
+    "v5e": 197e12,
+    "v5 lite": 197e12,
+    "v6e": 918e12,
+    "trillium": 918e12,
+}
+
+
+def _peak_flops(dev) -> float:
+    kind = getattr(dev, "device_kind", "").lower()
+    for key, val in _PEAK.items():
+        if key in kind:
+            return val
+    if dev.platform == "cpu":
+        return 1e12  # nominal, so MFU is defined everywhere
+    return 459e12  # assume v5p-class
+
+
+def _configs():
+    from paddle_tpu.models import llama
+    # largest first; fall back if the chip is small (v5e has 16GB HBM and
+    # f32 master params + two Adam moments cost 12 bytes/param)
+    yield "llama-740m", llama.LlamaConfig(
+        vocab_size=32768, hidden_size=2048, intermediate_size=6144,
+        num_layers=12, num_heads=16, num_kv_heads=8, head_dim=128,
+        max_seq_len=2048, remat=True), 8, 2048
+    yield "llama-510m", llama.LlamaConfig(
+        vocab_size=32768, hidden_size=1536, intermediate_size=6144,
+        num_layers=12, num_heads=12, num_kv_heads=4, head_dim=128,
+        max_seq_len=2048, remat=True), 8, 2048
+    yield "llama-350m", llama.LlamaConfig(
+        vocab_size=32768, hidden_size=1024, intermediate_size=4096,
+        num_layers=12, num_heads=8, num_kv_heads=8, head_dim=128,
+        max_seq_len=1024, remat=True), 8, 1024
+    yield "llama-tiny", llama.tiny_llama(), 4, 128
+
+
+def _sync(x):
+    """Device-to-host readback: the only reliable full sync on every backend
+    (block_until_ready returns early through the remote-device tunnel)."""
+    import numpy as np
+    v = float(np.asarray(x))
+    if not jnp.isfinite(v):
+        raise FloatingPointError(f"non-finite loss {v}")
+    return v
+
+
+def main():
+    from paddle_tpu.models import llama
+
+    dev = jax.devices()[0]
+    last_err = None
+    for name, cfg, batch, seq in _configs():
+        try:
+            state = llama.init_train_state(cfg, jax.random.PRNGKey(0))
+            tokens = jax.random.randint(
+                jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size)
+            step = jax.jit(
+                lambda s, t: llama.train_step(s, t, cfg), donate_argnums=0)
+            for _ in range(2):  # compile + warmup
+                state, loss = step(state, tokens)
+            _sync(loss)
+            n_steps = 5
+            t0 = time.perf_counter()
+            for _ in range(n_steps):
+                state, loss = step(state, tokens)
+            _sync(loss)
+            dt = time.perf_counter() - t0
+            tokens_per_sec = batch * seq * n_steps / dt
+            mfu = (llama.flops_per_token(cfg, seq) * tokens_per_sec
+                   / _peak_flops(dev))
+            print(json.dumps({
+                "metric": f"{name}_pretrain_tokens_per_sec_per_chip",
+                "value": round(tokens_per_sec, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(mfu / 0.40, 4),
+            }))
+            return 0
+        except Exception as e:  # OOM etc. — try the next smaller config
+            last_err = e
+            continue
+    print(json.dumps({
+        "metric": "bench_failed", "value": 0.0, "unit": "tokens/s",
+        "vs_baseline": 0.0, "error": str(last_err)[:200]}))
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

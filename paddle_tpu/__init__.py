@@ -11,11 +11,17 @@ Brand-new framework with the capability surface of PaddlePaddle
 """
 from __future__ import annotations
 
+import os as _os
+
 import jax as _jax
 
-# 64-bit types first-class (paddle defaults int64 indices; float64 available
-# on CPU; models use f32/bf16 explicitly on TPU).
-_jax.config.update("jax_enable_x64", True)
+# TPU-first numerics: stay in JAX's 32-bit mode. The reference defaults
+# integer tensors to int64, but on TPU 64-bit index math costs throughput,
+# doubles index memory, and Mosaic (Pallas) rejects i64 scalars — so int32 is
+# the default here (documented divergence). Set PADDLE_TPU_X64=1 to restore
+# first-class int64/float64 (CPU workflows, numeric-grad checking).
+if _os.environ.get("PADDLE_TPU_X64", "0") == "1":
+    _jax.config.update("jax_enable_x64", True)
 
 from .framework import dtype as _dtype_mod
 from .framework.dtype import (  # noqa: F401

@@ -7,6 +7,8 @@ import math
 
 import jax
 import jax.numpy as jnp
+
+from ..framework import dtype as _dtypes
 import numpy as np
 
 from ..core.tensor import Tensor
@@ -136,7 +138,7 @@ class Categorical(Distribution):
     def sample(self, shape=()):
         shape = tuple(shape) + self.batch_shape
         return Tensor(jax.random.categorical(next_key(), self.logits,
-                                             shape=shape).astype(jnp.int64))
+                                             shape=shape).astype(_dtypes.index_dtype()))
 
     def log_prob(self, value):
         v = _v(value).astype(jnp.int32)

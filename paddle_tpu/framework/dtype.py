@@ -116,8 +116,35 @@ def convert_dtype(dtype) -> DType:
     raise ValueError(f"unsupported dtype: {dtype!r}")
 
 
+_NARROW_64 = {"int64": "int32", "float64": "float32",
+              "complex128": "complex64"}
+
+
+def _x64_enabled() -> bool:
+    import jax
+    return bool(jax.config.jax_enable_x64)
+
+
+def canonicalize(dtype) -> DType:
+    """Resolve a *requested* dtype to the runtime dtype for the current
+    numerics mode: 64-bit requests narrow to 32-bit unless PADDLE_TPU_X64=1
+    (the package-level TPU-first policy — see paddle_tpu/__init__.py).
+    Use this for the request→storage direction only; reporting an existing
+    array's dtype goes through convert_dtype untouched."""
+    d = convert_dtype(dtype)
+    if d.name in _NARROW_64 and not _x64_enabled():
+        return _BY_NAME[_NARROW_64[d.name]]
+    return d
+
+
+def index_dtype() -> np.dtype:
+    """The integer dtype for indices/counts (argmax, arange, numel, ...):
+    int64 in x64 mode (reference parity), int32 otherwise (TPU-native)."""
+    return np.dtype(np.int64) if _x64_enabled() else np.dtype(np.int32)
+
+
 def to_np(dtype) -> np.dtype:
-    return convert_dtype(dtype).np_dtype
+    return canonicalize(dtype).np_dtype
 
 
 def is_floating(dtype_like) -> bool:

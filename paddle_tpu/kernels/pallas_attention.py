@@ -1,0 +1,304 @@
+"""FlashAttention-2 as a Pallas TPU kernel (forward + backward).
+
+Replaces the reference's vendored FlashAttention-2 CUDA library
+(reference: third_party/flashattn backing
+paddle/phi/kernels/gpu/flash_attn_kernel.cu, python surface
+python/paddle/nn/functional/flash_attention.py:358).
+
+TPU-native design: online-softmax tiles sized for the MXU (128-multiple
+blocks), f32 accumulators in VMEM scratch carried across the innermost
+(kv) grid dimension, log-sum-exp saved as the residual so the backward
+recomputes probabilities tile-by-tile (two kernels: dQ over kv tiles, dK/dV
+over q tiles) — never materializing the [S, S] score matrix in HBM.
+
+Layout contract: q, k, v are [batch, seq, heads, head_dim] (the framework's
+public flash_attention layout); kernels run on [batch*heads, seq, head_dim].
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+STATS = 128  # lane width used to store per-row softmax stats
+
+
+def _interpret() -> bool:
+    # off-TPU (CPU tests) the kernels run in the Pallas interpreter
+    return jax.default_backend() != "tpu"
+
+
+def _pick_block(seq: int, want: int) -> int:
+    b = min(want, seq)
+    while seq % b:
+        b //= 2
+    return max(b, 128) if seq % max(b, 128) == 0 else b
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_kv):
+    i, j = pl.program_id(1), pl.program_id(2)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    run = True
+    if causal:
+        # tile fully above the diagonal contributes nothing
+        run = (j * block_kv) <= (i * block_q + block_q - 1)
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + i * block_q
+            col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * block_kv
+            s = jnp.where(row >= col, s, jnp.float32(NEG_INF))
+
+        m_prev = m_ref[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_ref[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == nj - 1)
+    def _():
+        l = l_ref[:, :1]
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:, :1] + jnp.log(l)   # [block_q, 1]
+
+
+def _fwd(q, k, v, causal, block_q, block_kv, scale):
+    BH, S, D = q.shape
+    bq = _pick_block(S, block_q)
+    bkv = _pick_block(S, block_kv)
+    grid = (BH, S // bq, S // bkv)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_kv=bkv)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bkv, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bkv, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, S, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),
+            pltpu.VMEM((bq, STATS), jnp.float32),
+            pltpu.VMEM((bq, STATS), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               acc_ref, *, scale, causal, block_q, block_kv):
+    i, j = pl.program_id(1), pl.program_id(2)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    run = True
+    if causal:
+        run = (j * block_kv) <= (i * block_q + block_q - 1)
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + i * block_q
+            col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * block_kv
+            s = jnp.where(row >= col, s, jnp.float32(NEG_INF))
+        p = jnp.exp(s - lse_ref[0])
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0]) * scale
+        acc_ref[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == nj - 1)
+    def _():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc,
+                *, scale, causal, block_q, block_kv):
+    j, i = pl.program_id(1), pl.program_id(2)  # kv tile outer, q tile inner
+    ni = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    run = True
+    if causal:
+        run = (j * block_kv) <= (i * block_q + block_q - 1)
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + i * block_q
+            col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * block_kv
+            s = jnp.where(row >= col, s, jnp.float32(NEG_INF))
+        p = jnp.exp(s - lse_ref[0])                              # [bq, bkv]
+        do = do_ref[0]
+        dv_acc[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0]) * scale                     # [bq, bkv]
+        dk_acc[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == ni - 1)
+    def _():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd(causal, block_q, block_kv, scale, res, do):
+    q, k, v, out, lse = res
+    BH, S, D = q.shape
+    bq = _pick_block(S, block_q)
+    bkv = _pick_block(S, block_kv)
+    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
+                    axis=-1, keepdims=True)                      # [BH, S, 1]
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_kv=bkv),
+        grid=(BH, S // bq, S // bkv),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bkv, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bkv, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_kv=bkv),
+        grid=(BH, S // bkv, S // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bkv, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bkv, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bkv, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bkv, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, S, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bkv, D), jnp.float32),
+            pltpu.VMEM((bkv, D), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_kv, scale):
+    out, _ = _fwd(q, k, v, causal, block_q, block_kv, scale)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_kv, scale):
+    out, lse = _fwd(q, k, v, causal, block_q, block_kv, scale)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, block_q, block_kv, scale, res, do):
+    return _bwd(causal, block_q, block_kv, scale, res, do)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention_fwd(q, k, v, causal: bool = False,
+                        block_q: int = 512, block_kv: int = 512):
+    """q/k/v: [batch, seq, heads, head_dim] (same-heads; expand GQA outside).
+    Differentiable (custom FA2 backward)."""
+    B, S, H, D = q.shape
+    scale = 1.0 / math.sqrt(D)
+
+    def to_bh(x):
+        return jnp.swapaxes(x, 1, 2).reshape(B * H, S, D)
+
+    out = _flash(to_bh(q), to_bh(k), to_bh(v), causal, block_q, block_kv,
+                 scale)
+    return jnp.swapaxes(out.reshape(B, H, S, D), 1, 2)

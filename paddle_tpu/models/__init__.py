@@ -1,0 +1,8 @@
+"""Model families (functional training path).
+
+The reference keeps model zoos in paddle.vision.models + PaddleNLP; this
+package holds the TPU-first functional implementations used for pretraining
+benchmarks (paddle_tpu.vision.models keeps the eager Layer zoo for parity).
+"""
+from . import llama  # noqa: F401
+from .llama import LlamaConfig, llama3_8b, tiny_llama  # noqa: F401

@@ -22,7 +22,7 @@ class Initializer:
 
 
 def _npd(dtype):
-    return dtypes.convert_dtype(dtype).np_dtype
+    return dtypes.canonicalize(dtype).np_dtype
 
 
 def _fans(shape):

@@ -285,7 +285,7 @@ class Layer:
         import jax.numpy as jnp
 
         if dtype is not None:
-            npd = dtypes.convert_dtype(dtype).np_dtype
+            npd = dtypes.canonicalize(dtype).np_dtype
             for t in list(self.parameters()) + list(self.buffers()):
                 d = np.dtype(t._value.dtype)
                 if np.issubdtype(d, np.floating):

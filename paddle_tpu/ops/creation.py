@@ -20,7 +20,7 @@ from .dispatch import apply
 def _dt(dtype, default_float=True):
     if dtype is None:
         return dtypes.get_default_dtype().np_dtype if default_float else None
-    return dtypes.convert_dtype(dtype).np_dtype
+    return dtypes.canonicalize(dtype).np_dtype
 
 
 def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
@@ -39,7 +39,24 @@ def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tenso
         if dtype is None and not keep_dtype and arr.dtype == np.float64:
             # python floats default to the framework float dtype (paddle parity)
             arr = arr.astype(dtypes.get_default_dtype().np_dtype)
+        if dtype is not None:
+            # cast numpy-side so int64 values a wider dtype can hold exactly
+            # are not first wrapped through int32 by jnp canonicalization
+            arr = arr.astype(_dt(dtype))
+        elif (arr.dtype in (np.int64, np.uint64) and arr.size
+                and not dtypes._x64_enabled()):
+            info = (np.iinfo(np.uint32) if arr.dtype == np.uint64
+                    else np.iinfo(np.int32))
+            if arr.max() > info.max or arr.min() < info.min:
+                import warnings
+
+                warnings.warn(
+                    f"to_tensor: {arr.dtype.name} input exceeds "
+                    f"{np.dtype(info.dtype).name} range and will wrap under "
+                    "the 32-bit default numerics mode; set PADDLE_TPU_X64=1 "
+                    "to keep 64-bit integers.", stacklevel=2)
         v = jnp.asarray(arr)
+        dtype = None  # handled
     if dtype is not None:
         v = jnp.asarray(v, dtype=_dt(dtype))
     if place is not None:
@@ -60,8 +77,9 @@ def ones(shape, dtype=None, name=None):
 
 
 def full(shape, fill_value, dtype=None, name=None):
-    if dtype is None and isinstance(fill_value, int):
-        return Tensor(jnp.full(_shape(shape), fill_value, _dt("int64")))
+    if dtype is None and isinstance(fill_value, int) \
+            and not isinstance(fill_value, bool):
+        return Tensor(jnp.full(_shape(shape), fill_value, dtypes.index_dtype()))
     return Tensor(jnp.full(_shape(shape), _value_of(fill_value), _dt(dtype)))
 
 
@@ -203,7 +221,7 @@ def one_hot(x, num_classes, name=None):
 
 
 def numel(x):
-    return Tensor(jnp.asarray(int(np.prod(x.shape)) if x.shape else 1, dtype=jnp.int64))
+    return Tensor(jnp.asarray(int(np.prod(x.shape)) if x.shape else 1, dtype=dtypes.index_dtype()))
 
 
 def polar(abs_t, angle, name=None):

@@ -12,6 +12,7 @@ import numpy as np
 
 from ..core.tensor import Tensor
 from .creation import _t
+from ..framework import dtype as dtypes
 from .dispatch import apply
 
 
@@ -264,7 +265,7 @@ def histogram(input, bins=100, min=0, max=0, name=None):  # noqa: A002
     def fn(v):
         lo, hi = (min, max) if (min != 0 or max != 0) else (v.min(), v.max())
         hist, _ = jnp.histogram(v, bins=bins, range=(lo, hi))
-        return hist.astype(jnp.int64)
+        return hist.astype(dtypes.index_dtype())
 
     return apply("histogram", fn, _t(input))
 

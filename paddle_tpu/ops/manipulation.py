@@ -21,7 +21,7 @@ from .dispatch import apply
 def cast(x, dtype):
     from ..framework import dtype as dtypes
 
-    npd = dtypes.convert_dtype(dtype).np_dtype
+    npd = dtypes.canonicalize(dtype).np_dtype
     return apply("cast", lambda v: jnp.asarray(v, dtype=npd), _t(x))
 
 

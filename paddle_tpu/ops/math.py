@@ -204,12 +204,12 @@ def _axes(axis):
 
 
 def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
-    d = dtypes.convert_dtype(dtype).np_dtype if dtype else None
+    d = dtypes.canonicalize(dtype).np_dtype if dtype else None
 
     def fn(v):
         dd = d
         if dd is None and np.issubdtype(np.dtype(v.dtype), np.bool_):
-            dd = np.int64
+            dd = dtypes.index_dtype()
         return jnp.sum(v, axis=_axes(axis), keepdims=keepdim, dtype=dd)
 
     return apply("sum", fn, _t(x))
@@ -278,7 +278,7 @@ def cummax(x, axis=None, dtype="int64", name=None):
         ar = jnp.arange(n).reshape([-1 if i == ax % vv.ndim else 1 for i in range(vv.ndim)])
         eq = vv == vals
         idx = jax.lax.associative_scan(jnp.maximum, jnp.where(eq, ar, -1), axis=ax)
-        return vals, idx.astype(jnp.int64)
+        return vals, idx.astype(dtypes.index_dtype())
 
     vals, idx = apply("cummax", full_fn, _t(x))
     return vals, idx
@@ -293,7 +293,7 @@ def cummin(x, axis=None, dtype="int64", name=None):
         ar = jnp.arange(n).reshape([-1 if i == ax % vv.ndim else 1 for i in range(vv.ndim)])
         eq = vv == vals
         idx = jax.lax.associative_scan(jnp.maximum, jnp.where(eq, ar, -1), axis=ax)
-        return vals, idx.astype(jnp.int64)
+        return vals, idx.astype(dtypes.index_dtype())
 
     vals, idx = apply("cummin", full_fn, _t(x))
     return vals, idx
@@ -325,6 +325,6 @@ def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
 def count_nonzero(x, axis=None, keepdim=False, name=None):
     return apply(
         "count_nonzero",
-        lambda v: jnp.count_nonzero(v, axis=_axes(axis), keepdims=keepdim).astype(jnp.int64),
+        lambda v: jnp.count_nonzero(v, axis=_axes(axis), keepdims=keepdim).astype(dtypes.index_dtype()),
         _t(x),
     )

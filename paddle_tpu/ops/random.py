@@ -20,7 +20,7 @@ from .creation import _shape, _t
 def _dt(dtype):
     if dtype is None:
         return dtypes.get_default_dtype().np_dtype
-    return dtypes.convert_dtype(dtype).np_dtype
+    return dtypes.canonicalize(dtype).np_dtype
 
 
 def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
@@ -79,7 +79,7 @@ def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
 
 
 def _dtint(dtype):
-    return dtypes.convert_dtype(dtype or "int64").np_dtype
+    return dtypes.canonicalize(dtype or "int64").np_dtype
 
 
 def randint_like(x, low=0, high=None, dtype=None, name=None):
@@ -128,7 +128,7 @@ def multinomial(x, num_samples=1, replacement=False, name=None):
                                   p=row / row.sum())
                 for k, row in zip(keys, x._value)
             ])
-    return Tensor(out.astype(jnp.int64))
+    return Tensor(out.astype(dtypes.index_dtype()))
 
 
 def poisson(x, name=None):
@@ -144,7 +144,7 @@ def exponential_(x, lam=1.0, name=None):
 def binomial(count, prob, name=None):
     c = count._value if isinstance(count, Tensor) else count
     p = prob._value if isinstance(prob, Tensor) else prob
-    return Tensor(jax.random.binomial(next_key(), c, p).astype(jnp.int64))
+    return Tensor(jax.random.binomial(next_key(), c, p).astype(dtypes.index_dtype()))
 
 
 def log_normal(mean=1.0, std=2.0, shape=None, name=None):

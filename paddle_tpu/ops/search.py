@@ -6,6 +6,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..framework import dtype as dtypes
 from .creation import _t
 from .dispatch import apply
 
@@ -15,7 +16,7 @@ def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
         out = jnp.argmax(v.reshape(-1) if axis is None else v,
                          axis=None if axis is None else int(axis),
                          keepdims=keepdim if axis is not None else False)
-        return out.astype(jnp.int64)
+        return out.astype(dtypes.index_dtype())
 
     return apply("argmax", fn, _t(x))
 
@@ -25,7 +26,7 @@ def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
         out = jnp.argmin(v.reshape(-1) if axis is None else v,
                          axis=None if axis is None else int(axis),
                          keepdims=keepdim if axis is not None else False)
-        return out.astype(jnp.int64)
+        return out.astype(dtypes.index_dtype())
 
     return apply("argmin", fn, _t(x))
 
@@ -33,7 +34,7 @@ def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
 def argsort(x, axis=-1, descending=False, stable=True, name=None):
     def fn(v):
         idx = jnp.argsort(v, axis=axis, stable=stable, descending=descending)
-        return idx.astype(jnp.int64)
+        return idx.astype(dtypes.index_dtype())
 
     return apply("argsort", fn, _t(x))
 
@@ -57,7 +58,7 @@ def topk(x, k, axis=-1, largest=True, sorted=True, name=None):  # noqa: A002
         else:
             vals, idx = jax.lax.top_k(-moved, k)
             vals = -vals
-        return (jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int64), -1, ax))
+        return (jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(dtypes.index_dtype()), -1, ax))
 
     return apply("topk", fn, _t(x))
 
@@ -67,8 +68,8 @@ def nonzero(x, as_tuple=False):
     arr = np.asarray(x._value)
     idx = np.nonzero(arr)
     if as_tuple:
-        return tuple(Tensor(jnp.asarray(i[:, None].astype(np.int64))) for i in idx)
-    return Tensor(jnp.asarray(np.stack(idx, -1).astype(np.int64)))
+        return tuple(Tensor(jnp.asarray(i[:, None].astype(dtypes.index_dtype()))) for i in idx)
+    return Tensor(jnp.asarray(np.stack(idx, -1).astype(dtypes.index_dtype())))
 
 
 def kthvalue(x, k, axis=-1, keepdim=False, name=None):
@@ -81,7 +82,7 @@ def kthvalue(x, k, axis=-1, keepdim=False, name=None):
         if keepdim:
             vals = jnp.expand_dims(vals, ax)
             idx = jnp.expand_dims(idx, ax)
-        return vals, idx.astype(jnp.int64)
+        return vals, idx.astype(dtypes.index_dtype())
 
     return apply("kthvalue", fn, _t(x))
 
@@ -112,7 +113,7 @@ def mode(x, axis=-1, keepdim=False, name=None):
             vals, idx = vals[..., None], idx[..., None]
             vals = jnp.moveaxis(vals, -1, ax)
             idx = jnp.moveaxis(idx, -1, ax)
-        return vals, idx.astype(jnp.int64)
+        return vals, idx.astype(dtypes.index_dtype())
 
     return apply("mode", fn, _t(x))
 
@@ -126,7 +127,7 @@ def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=Non
             out = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(
                 seq.reshape(-1, seq.shape[-1]), vals.reshape(-1, vals.shape[-1])
             ).reshape(vals.shape)
-        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+        return out.astype(jnp.int32 if out_int32 else dtypes.index_dtype())
 
     return apply("searchsorted", fn, _t(sorted_sequence), _t(values))
 

@@ -4,10 +4,22 @@ distributed/sharding tests can exercise an 8-chip mesh on any host
 test/legacy_test/test_parallel_dygraph_dataparallel.py:30)."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # override axon/tpu: tests want 8 virtual devices
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# jax may have been imported (and its config snapshotted from env) before this
+# conftest runs — force the values through the config API as well. If some
+# plugin already initialized backends, num_cpu_devices can no longer change;
+# fall back to whatever the env provided rather than aborting the session.
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except RuntimeError:
+    pass
 
 import paddle_tpu  # noqa: E402,F401

@@ -1,20 +1,40 @@
 """Tensor basics (parity model: the pybind tensor-method surface,
 reference: paddle/fluid/pybind/eager_method.cc)."""
+import os
+
 import numpy as np
 import pytest
 
 import paddle_tpu as paddle
 
 
+X64 = os.environ.get("PADDLE_TPU_X64") == "1"
+
+
 def test_to_tensor_dtypes():
+    # TPU-first 32-bit default (documented divergence: the reference defaults
+    # python ints to int64; int32 here unless PADDLE_TPU_X64=1)
     t = paddle.to_tensor([1, 2, 3])
-    assert t.dtype == paddle.int64
+    assert t.dtype == (paddle.int64 if X64 else paddle.int32)
     t = paddle.to_tensor([1.0, 2.0])
     assert t.dtype == paddle.float32
     t = paddle.to_tensor(np.array([1, 2], dtype=np.int32))
     assert t.dtype == paddle.int32
-    t = paddle.to_tensor([1.0], dtype="float64")
-    assert t.dtype == paddle.float64
+    if X64:
+        t = paddle.to_tensor([1.0], dtype="float64")
+        assert t.dtype == paddle.float64
+
+
+def test_to_tensor_int64_overflow_warns():
+    import warnings
+    big = np.array([2**40], dtype=np.int64)
+    if X64:
+        assert int(paddle.to_tensor(big).item()) == 2**40
+    else:
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            paddle.to_tensor(big)
+        assert any("int32 range" in str(x.message) for x in w)
 
 
 def test_shape_props():
@@ -82,10 +102,12 @@ def test_inplace_and_version():
 
 def test_astype_cast():
     x = paddle.to_tensor([1.5, 2.5])
-    y = x.astype("int64")
-    assert y.dtype == paddle.int64
+    y = x.astype("int64")  # request canonicalizes per numerics mode
+    assert y.dtype == (paddle.int64 if X64 else paddle.int32)
     z = x.cast(paddle.float64)
-    assert z.dtype == paddle.float64
+    assert z.dtype == (paddle.float64 if X64 else paddle.float32)
+    w = x.cast(paddle.float16)
+    assert w.dtype == paddle.float16
 
 
 def test_detach_clone():
