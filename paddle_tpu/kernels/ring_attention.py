@@ -1,0 +1,106 @@
+"""Ring attention — context parallelism over the sequence axis.
+
+The reference has NO in-core ring attention (SURVEY.md §5.7: its SEP axis
+splits the sequence and leaves full-sequence attention to downstream model
+code via alltoall — fleet/meta_parallel/segment_parallel.py:26,
+hybrid_parallel_util.py:278-311). This module supplies the long-context
+capability TPU-natively: blockwise attention where each device holds one
+sequence shard of Q/K/V and K/V blocks rotate around the ring via
+``jax.lax.ppermute`` over ICI, with online-softmax (m, l, acc) accumulation —
+activation memory O(S_local), full-sequence exact attention.
+
+Used under ``jax.shard_map`` over the mesh axis that shards the sequence
+('sp'/'cp'). Causal masking is block-triangular: a device's Q block attends
+fully to earlier K/V blocks, causally to its own, not at all to later ones
+(those ring steps are masked, not skipped, to keep the loop shape static for
+XLA).
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ring_attention", "ring_attention_sharded"]
+
+
+def _block_attend(q, k, v, m, l, acc, mask):
+    """One online-softmax accumulation step.
+    q: [B,Sq,H,D]; k,v: [B,Skv,H,D]; m,l: [B,H,Sq,1]; acc: [B,H,Sq,D];
+    mask: [Sq,Skv] bool or None (True = attend)."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, -1e30)
+    m_cur = jnp.max(s, axis=-1, keepdims=True)            # [B,H,Sq,1]
+    m_new = jnp.maximum(m, m_cur)
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jnp.einsum("bhqk,bkhd->bhqd", p.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32)
+    acc_new = acc * alpha + pv
+    return m_new, l_new, acc_new
+
+
+def ring_attention(q, k, v, axis_name: str, axis_size: int,
+                   causal: bool = True):
+    """Per-shard body (call inside shard_map). q/k/v: [B, S_local, H, D],
+    the sequence axis sharded over ``axis_name`` (static size ``axis_size``).
+    Returns [B, S_local, H, D]. Differentiable (lax.scan ring).
+
+    GQA: expand K/V heads to Q heads before calling.
+    """
+    n = axis_size
+    my = jax.lax.axis_index(axis_name)
+    B, S, H, D = q.shape
+
+    m0 = jnp.full((B, H, S, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((B, H, S, 1), jnp.float32)
+    a0 = jnp.zeros((B, H, S, D), jnp.float32)
+
+    row = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
+    tri = row >= col
+
+    def step(carry, t):
+        k_t, v_t, m, l, acc = carry
+        src = (my - t) % n  # which sequence block we hold this step
+        if causal:
+            # full attend if src < my; causal if src == my; masked out if >
+            full = jnp.ones((S, S), bool)
+            mask = jnp.where(src == my, tri,
+                             jnp.where(src < my, full, jnp.zeros((S, S), bool)))
+        else:
+            mask = None
+        m, l, acc = _block_attend(q, k_t, v_t, m, l, acc, mask)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_n = jax.lax.ppermute(k_t, axis_name, perm)
+        v_n = jax.lax.ppermute(v_t, axis_name, perm)
+        return (k_n, v_n, m, l, acc), None
+
+    (k_f, v_f, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, m0, l0, a0), jnp.arange(n))
+    out = acc / jnp.maximum(l, 1e-30)
+    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = "sp",
+                           causal: bool = True,
+                           batch_axis: Optional[str] = "dp"):
+    """Convenience wrapper: runs ring_attention under shard_map over ``mesh``.
+    q/k/v are GLOBAL [B, S, H, D] arrays (sequence logically sharded over
+    axis_name, batch over batch_axis if present)."""
+    ba = batch_axis if (batch_axis and batch_axis in mesh.axis_names) else None
+    spec = P(ba, axis_name, None, None)
+    fn = jax.shard_map(
+        functools.partial(ring_attention, axis_name=axis_name,
+                          axis_size=mesh.shape[axis_name], causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)

@@ -64,6 +64,9 @@ class LlamaConfig:
     # gradient checkpointing of the layer body (reference: fleet/recompute)
     remat: bool = True
     use_flash: bool = True
+    # exact blockwise ring attention over the 'sp' mesh axis (long-context;
+    # capability the reference's SEP axis delegates to model code — §5.7)
+    context_parallel: bool = False
 
 
 def llama3_8b() -> LlamaConfig:
@@ -190,7 +193,7 @@ def _fit_spec(spec: P, shape, mesh: Mesh) -> P:
         names = entry if isinstance(entry, tuple) else (entry,)
         keep, size = [], shape[d]
         for nm in names:
-            ax = mesh.shape[nm]
+            ax = dict(mesh.shape).get(nm, 1)  # absent mesh axis → replicate
             if ax > 1 and size % ax == 0:
                 keep.append(nm)
                 size //= ax
@@ -244,6 +247,11 @@ def _attention(q, k, v, config: LlamaConfig):
     if groups > 1:
         k = jnp.repeat(k, groups, axis=2)
         v = jnp.repeat(v, groups, axis=2)
+    mesh = _ACT_MESH
+    if (config.context_parallel and mesh is not None
+            and dict(mesh.shape).get("sp", 1) > 1):
+        from ..kernels.ring_attention import ring_attention_sharded
+        return ring_attention_sharded(q, k, v, mesh, "sp", causal=True)
     if config.use_flash and S >= 128 and D % 128 == 0:
         try:
             from ..kernels.pallas_attention import flash_attention_fwd
@@ -373,14 +381,17 @@ def init_train_state(config: LlamaConfig, key: jax.Array) -> TrainState:
                       jnp.zeros((), jnp.int32))
 
 
-def train_step(state: TrainState, tokens, config: LlamaConfig,
+def train_step(state: TrainState, tokens, config,
                lr=3e-4, beta1=0.9, beta2=0.95, eps=1e-8, wd=0.1,
-               clip_norm=1.0):
+               clip_norm=1.0, loss_function=None):
     """One fused pretrain step: fwd+bwd, global-norm clip, AdamW.
     The reference splits this across EagerReducer buckets +
     HybridParallelOptimizer (hybrid_parallel_optimizer.py:540); here the whole
-    thing is one traced program and GSPMD/XLA overlap the collectives."""
-    loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens, config)
+    thing is one traced program and GSPMD/XLA overlap the collectives.
+    ``loss_function(params, tokens, config)`` defaults to the llama loss —
+    MoE passes its own (models/moe.py)."""
+    lf = loss_function or loss_fn
+    loss, grads = jax.value_and_grad(lf)(state.params, tokens, config)
 
     gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
                          for g in jax.tree_util.tree_leaves(grads)))

@@ -1,0 +1,306 @@
+"""Mixture-of-Experts with expert parallelism — the DeepSeekMoE-class path.
+
+Capability parity: the reference's MoE stack is
+incubate/distributed/models/moe/moe_layer.py:261 (MoELayer with
+global_scatter/global_gather all-to-all dispatch), gates under moe/gate/
+(gshard/switch/naive), cutlass grouped-GEMM fused kernels
+(phi/kernels/fusion/cutlass/fused_moe_kernel.cu, moe_gemm/), and SPMD rules
+moe_combine.cc / moe_gate_dispatch.cc (phi/infermeta/spmd_rules/).
+
+TPU-native re-design: fixed-capacity GShard-style dispatch expressed as
+einsums over a one-hot dispatch tensor — entirely MXU-shaped, so the whole
+layer is three (grouped) matmuls XLA can tile. Experts live on a stacked
+leading axis sharded over the 'ep' mesh axis; GSPMD turns the dispatch/combine
+einsums into the ragged all-to-alls the reference issues by hand through
+ProcessGroup (SURVEY.md §2.4 item: capacity-less ragged alltoall is
+reformulated as fixed-capacity — the documented-hard-part trade).
+
+DeepSeekMoE specifics (fine-grained experts + shared experts) are config
+knobs: many small experts (num_experts), top_k routing, n_shared_experts
+always-on FFNs added to the routed output.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "MoEConfig", "deepseek_moe_16b", "tiny_moe", "init_params", "forward",
+    "loss_fn", "param_specs", "make_shardings", "moe_ffn", "top_k_gating",
+    "TrainState", "init_train_state", "train_step", "num_params",
+]
+
+from .llama import (  # reuse the dense-transformer scaffolding
+    TrainState, _apply_rope, _attention, _constrain, _rms_norm, _rope_tables,
+    activation_mesh,
+)
+from . import llama as _llama
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    vocab_size: int = 102400
+    hidden_size: int = 2048
+    intermediate_size: int = 10944       # dense-layer FFN
+    moe_intermediate_size: int = 1408    # per-expert FFN (fine-grained)
+    num_layers: int = 28
+    num_heads: int = 16
+    num_kv_heads: int = 16
+    head_dim: int = 128
+    num_experts: int = 64
+    top_k: int = 6
+    n_shared_experts: int = 2
+    first_dense_layers: int = 1          # DeepSeekMoE: layer 0 stays dense
+    capacity_factor: float = 1.25
+    router_aux_coef: float = 0.01
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    use_flash: bool = True
+    context_parallel: bool = False
+
+
+def deepseek_moe_16b() -> MoEConfig:
+    return MoEConfig()
+
+
+def tiny_moe(vocab=256, hidden=64, layers=2, heads=4, experts=8, top_k=2,
+             seq=128) -> MoEConfig:
+    return MoEConfig(
+        vocab_size=vocab, hidden_size=hidden, intermediate_size=hidden * 2,
+        moe_intermediate_size=hidden, num_layers=layers, num_heads=heads,
+        num_kv_heads=heads, head_dim=hidden // heads, num_experts=experts,
+        top_k=top_k, n_shared_experts=1, first_dense_layers=0,
+        max_seq_len=seq, remat=False, use_flash=False)
+
+
+# ---------------------------------------------------------------------------
+# params  (experts stacked on a leading E axis — the 'ep' sharding target)
+# ---------------------------------------------------------------------------
+
+def _init(key, shape, scale):
+    return jax.random.normal(key, shape, jnp.float32) * scale
+
+
+def init_params(config: MoEConfig, key: jax.Array) -> Dict[str, Any]:
+    c = config
+    ks = jax.random.split(key, 16)
+    h, L, E = c.hidden_size, c.num_layers, c.num_experts
+    nq, nkv, d = c.num_heads, c.num_kv_heads, c.head_dim
+    fm, fs = c.moe_intermediate_size, c.n_shared_experts * c.moe_intermediate_size
+    s = 1.0 / math.sqrt(h)
+    o = s / math.sqrt(2 * L)
+    params = {
+        "embed": _init(ks[0], (c.vocab_size, h), s),
+        "layers": {
+            "attn_norm": jnp.ones((L, h), jnp.float32),
+            "wq": _init(ks[1], (L, h, nq * d), s),
+            "wk": _init(ks[2], (L, h, nkv * d), s),
+            "wv": _init(ks[3], (L, h, nkv * d), s),
+            "wo": _init(ks[4], (L, nq * d, h), o),
+            "mlp_norm": jnp.ones((L, h), jnp.float32),
+            "router": _init(ks[5], (L, h, E), s),
+            # routed experts: [L, E, h, f] / [L, E, f, h]
+            "e_gate": _init(ks[6], (L, E, h, fm), s),
+            "e_up": _init(ks[7], (L, E, h, fm), s),
+            "e_down": _init(ks[8], (L, E, fm, h), o / math.sqrt(fm / h)),
+            # shared experts: one fused FFN of width n_shared * f
+            "s_gate": _init(ks[9], (L, h, fs), s),
+            "s_up": _init(ks[10], (L, h, fs), s),
+            "s_down": _init(ks[11], (L, fs, h), o),
+        },
+        "final_norm": jnp.ones((h,), jnp.float32),
+        "lm_head": _init(ks[12], (h, c.vocab_size), s),
+    }
+    return params
+
+
+def num_params(params) -> int:
+    return int(sum(np.prod(p.shape) for p in jax.tree_util.tree_leaves(params)))
+
+
+def param_specs(config: MoEConfig, fsdp: bool = True) -> Dict[str, Any]:
+    """'ep' shards the expert axis; 'tp' the Megatron axis of each expert and
+    of the dense sublayers; fsdp ('dp') the remaining matrix axis."""
+    dp = "dp" if fsdp else None
+    return {
+        "embed": P("tp", dp),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, dp, "tp"),
+            "wk": P(None, dp, "tp"),
+            "wv": P(None, dp, "tp"),
+            "wo": P(None, "tp", dp),
+            "mlp_norm": P(None, None),
+            "router": P(None, dp, None),
+            "e_gate": P(None, "ep", dp, "tp"),
+            "e_up": P(None, "ep", dp, "tp"),
+            "e_down": P(None, "ep", "tp", dp),
+            "s_gate": P(None, dp, "tp"),
+            "s_up": P(None, dp, "tp"),
+            "s_down": P(None, "tp", dp),
+        },
+        "final_norm": P(None),
+        "lm_head": P(dp, "tp"),
+    }
+
+
+def make_shardings(config: MoEConfig, mesh: Mesh, fsdp: bool = True):
+    shapes = jax.eval_shape(functools.partial(init_params, config),
+                            jax.random.PRNGKey(0))
+    return jax.tree_util.tree_map(
+        lambda spec, arr: NamedSharding(
+            mesh, _llama._fit_spec(spec, arr.shape, mesh)),
+        param_specs(config, fsdp), shapes,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+# ---------------------------------------------------------------------------
+# routing + expert compute
+# ---------------------------------------------------------------------------
+
+def top_k_gating(logits, top_k: int):
+    """Top-k softmax router (parity: gshard/switch gates under
+    incubate/.../moe/gate/). Returns (weights [T,k], indices [T,k],
+    aux_loss scalar) with load-balance aux loss (GShard eq. (4))."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)   # [T,E]
+    weights, idx = jax.lax.top_k(probs, top_k)                    # [T,k]
+    weights = weights / jnp.sum(weights, -1, keepdims=True)
+    E = logits.shape[-1]
+    me = jnp.mean(probs, axis=0)                                  # [E]
+    one_hot_top1 = jax.nn.one_hot(idx[:, 0], E, dtype=jnp.float32)
+    ce = jnp.mean(one_hot_top1, axis=0)
+    aux = E * jnp.sum(me * ce)
+    return weights, idx, aux
+
+
+def moe_ffn(x, router_w, e_gate, e_up, e_down, config: MoEConfig):
+    """Routed-expert FFN over flattened tokens.
+    x: [T, h]; experts [E, h, f]/[E, f, h]. Fixed-capacity one-hot dispatch:
+      dispatch [T, E, C] (bool-ish f32), combine = dispatch * gate weight.
+    All compute is einsum → MXU; 'ep' sharding of the E axis makes XLA emit
+    the all-to-alls (reference: global_scatter/global_gather —
+    moe_layer.py:105-188)."""
+    c = config
+    T, h = x.shape
+    E, k = c.num_experts, c.top_k
+    C = max(1, int(c.capacity_factor * T * k / E))
+
+    weights, idx, aux = top_k_gating(x.astype(jnp.float32) @ router_w.astype(jnp.float32), k)
+
+    # position of each (token, slot) within its expert's capacity buffer
+    onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)              # [T,k,E]
+    flat = onehot.reshape(T * k, E)
+    pos = (jnp.cumsum(flat, axis=0) - flat).reshape(T, k, E)      # rank per expert
+    pos = jnp.sum(pos * onehot, axis=-1)                          # [T,k]
+    keep = pos < C                                                # overflow drop
+    w = weights * keep.astype(weights.dtype)
+
+    disp = jnp.einsum("tke,tkc->tec",
+                      onehot.astype(x.dtype) * keep[..., None].astype(x.dtype),
+                      jax.nn.one_hot(pos, C, dtype=x.dtype))      # [T,E,C]
+    comb = jnp.einsum("tke,tkc,tk->tec", onehot.astype(jnp.float32),
+                      jax.nn.one_hot(pos, C, dtype=jnp.float32),
+                      w.astype(jnp.float32)).astype(x.dtype)
+
+    xe = jnp.einsum("tec,th->ech", disp, x)                       # [E,C,h]
+    gate = jax.nn.silu(jnp.einsum("ech,ehf->ecf", xe, e_gate.astype(x.dtype)))
+    up = jnp.einsum("ech,ehf->ecf", xe, e_up.astype(x.dtype))
+    ye = jnp.einsum("ecf,efh->ech", gate * up, e_down.astype(x.dtype))
+    y = jnp.einsum("tec,ech->th", comb, ye)                       # [T,h]
+    return y, aux
+
+
+def _layer_body(carry, layer_params, cos, sin, config: MoEConfig,
+                layer_idx, dense: bool):
+    c = config
+    x, aux_sum = carry
+    B, S, h = x.shape
+    p = layer_params
+    dt = c.dtype
+
+    hn = _rms_norm(x, p["attn_norm"], c.rms_eps)
+    q = (hn @ p["wq"].astype(dt)).reshape(B, S, c.num_heads, c.head_dim)
+    k = (hn @ p["wk"].astype(dt)).reshape(B, S, c.num_kv_heads, c.head_dim)
+    v = (hn @ p["wv"].astype(dt)).reshape(B, S, c.num_kv_heads, c.head_dim)
+    q = _apply_rope(q, cos, sin)
+    k = _apply_rope(k, cos, sin)
+    att = _attention(q, k, v, c).reshape(B, S, c.num_heads * c.head_dim)
+    x = x + att @ p["wo"].astype(dt)
+    x = _constrain(x)
+
+    hn = _rms_norm(x, p["mlp_norm"], c.rms_eps)
+    # shared experts (always-on FFN)
+    sg = jax.nn.silu(hn @ p["s_gate"].astype(dt))
+    y = (sg * (hn @ p["s_up"].astype(dt))) @ p["s_down"].astype(dt)
+    if not dense:
+        routed, aux = moe_ffn(hn.reshape(B * S, h), p["router"],
+                              p["e_gate"], p["e_up"], p["e_down"], c)
+        y = y + routed.reshape(B, S, h)
+        aux_sum = aux_sum + aux
+    x = x + y
+    return (_constrain(x), aux_sum)
+
+
+def forward(params, tokens, config: MoEConfig, return_aux=False):
+    c = config
+    dt = c.dtype
+    S = tokens.shape[1]
+    x = params["embed"].astype(dt)[tokens]
+    x = _constrain(x)
+    cos, sin = _rope_tables(S, c.head_dim, c.rope_theta)
+
+    # first_dense_layers use the shared-expert FFN only (DeepSeekMoE layer 0)
+    aux = jnp.zeros((), jnp.float32)
+    n_dense = c.first_dense_layers
+
+    def make_body(dense):
+        def body(carry, lp):
+            return _layer_body(carry, lp, cos, sin, c, 0, dense), None
+        if c.remat:
+            inner = jax.checkpoint(lambda carry, lp: _layer_body(
+                carry, lp, cos, sin, c, 0, dense))
+            return lambda carry, lp: (inner(carry, lp), None)
+        return body
+
+    tree = params["layers"]
+    if n_dense > 0:
+        head_p = jax.tree_util.tree_map(lambda a: a[:n_dense], tree)
+        (x, aux), _ = jax.lax.scan(make_body(True), (x, aux), head_p)
+    tail_p = jax.tree_util.tree_map(lambda a: a[n_dense:], tree)
+    (x, aux), _ = jax.lax.scan(make_body(False), (x, aux), tail_p)
+
+    x = _rms_norm(x, params["final_norm"], c.rms_eps)
+    logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    return (logits, aux) if return_aux else logits
+
+
+def loss_fn(params, tokens, config: MoEConfig):
+    logits, aux = forward(params, tokens[:, :-1], config, return_aux=True)
+    targets = tokens[:, 1:]
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    ce = jnp.mean(logz - gold)
+    return ce + config.router_aux_coef * aux
+
+
+def init_train_state(config: MoEConfig, key: jax.Array) -> TrainState:
+    params = init_params(config, key)
+    z = jax.tree_util.tree_map(jnp.zeros_like, params)
+    z2 = jax.tree_util.tree_map(jnp.zeros_like, params)
+    return TrainState(params, z, z2, jnp.zeros((), jnp.int32))
+
+
+def train_step(state: TrainState, tokens, config: MoEConfig, **kw):
+    """llama's fused AdamW step with the MoE (CE + router aux) loss."""
+    return _llama.train_step(state, tokens, config,
+                             loss_function=loss_fn, **kw)

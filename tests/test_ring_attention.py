@@ -1,0 +1,74 @@
+"""Ring attention (context parallelism) vs dense reference, and the llama
+context_parallel path. Capability beyond the reference (SURVEY.md §5.7: SEP
+groups only; no in-core ring attention)."""
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from paddle_tpu.kernels.ring_attention import ring_attention_sharded
+from paddle_tpu.models import llama
+
+
+def dense(q, k, v, causal):
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        S = s.shape[-1]
+        s = jnp.where(jnp.tril(jnp.ones((S, S), bool))[None, None], s, -1e30)
+    p = jax.nn.softmax(s.astype(jnp.float32), -1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dp", "sp"))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_matches_dense(mesh, causal):
+    key = jax.random.PRNGKey(0)
+    B, S, H, D = 2, 256, 4, 64
+    q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.float32)
+               for kk in jax.random.split(key, 3))
+    o1 = ring_attention_sharded(q, k, v, mesh, "sp", causal=causal)
+    o2 = dense(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), atol=2e-5)
+
+
+def test_ring_gradients(mesh):
+    key = jax.random.PRNGKey(1)
+    B, S, H, D = 2, 128, 2, 32
+    q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.float32)
+               for kk in jax.random.split(key, 3))
+    f1 = lambda q, k, v: jnp.sum(
+        ring_attention_sharded(q, k, v, mesh, "sp", causal=True) * v)
+    f2 = lambda q, k, v: jnp.sum(dense(q, k, v, True) * v)
+    g1 = jax.grad(f1, (0, 1, 2))(q, k, v)
+    g2 = jax.grad(f2, (0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_llama_context_parallel_loss_matches(mesh):
+    cfg = llama.tiny_llama()
+    cfg_cp = dataclasses.replace(cfg, context_parallel=True)
+    state = llama.init_train_state(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 65), 0,
+                                cfg.vocab_size)  # loss_fn trims to S=64 = sp*16
+    loss_ref = float(jax.jit(
+        lambda p, t: llama.loss_fn(p, t, cfg))(state.params, tokens))
+    shardings = llama.make_shardings(cfg_cp, mesh, fsdp=False)
+    sp = jax.device_put(state.params, shardings)
+    # tokens carry the odd +1 label column — batch-shard only; activations
+    # get sequence-sharded by the in-model constraints after the trim
+    tok = jax.device_put(tokens, jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec("dp", None)))
+    with llama.activation_mesh(mesh):
+        loss_cp = float(jax.jit(
+            lambda p, t: llama.loss_fn(p, t, cfg_cp))(sp, tok))
+    np.testing.assert_allclose(loss_ref, loss_cp, rtol=1e-3)
