@@ -1,0 +1,112 @@
+"""Compiled pipeline parallelism over a 'pp' mesh axis.
+
+Parity target: fleet/meta_parallel/pipeline_parallel.py (1F1B :242,684,
+interleave :1308) and the static Plan/Job schedules
+(passes/pipeline_scheduler_pass/ — FThenB/1F1B/ZeroBubble), whose stage
+hand-offs are NCCL p2p sends (pp_utils/p2p_communication.py:193-222).
+
+TPU-native re-design: one SPMD program. Layer stacks are sharded over the
+'pp' mesh axis; inside ``jax.shard_map`` each device runs its stage on a
+rotating microbatch while activations move stage-to-stage with
+``jax.lax.ppermute`` over ICI. The schedule is GPipe-shaped (fill + steady
+state + drain in a single ``lax.scan``); the backward program XLA derives by
+reverse-mode autodiff is the mirrored drain (reverse ppermute), so the whole
+fwd+bwd pipeline compiles to one collective-permute loop — no host p2p, no
+process groups.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["pipeline_apply"]
+
+
+def _pipeline_body(stage_params, microbatches, stage_fn: Callable,
+                   axis_name: str, n_stages: int, out_like):
+    """Per-device body under shard_map.
+    stage_params: this stage's slice of the stacked layer params (leading
+    local-layer axis). microbatches: [M, ...] (replicated across 'pp').
+    Returns [M, ...] outputs of the LAST stage (other stages return zeros;
+    caller selects)."""
+    stage = jax.lax.axis_index(axis_name)
+    # boundary dtype is f32 (see pipeline_apply); compute in the model dtype
+    microbatches = microbatches.astype(out_like.dtype)
+    M = microbatches.shape[0]
+    steps = M + n_stages - 1
+
+    perm_fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def step(carry, t):
+        recv, outs = carry
+        mb_idx = jnp.clip(t, 0, M - 1)
+        x0 = microbatches[mb_idx]
+        x_in = jnp.where(stage == 0, x0, recv)
+        y = stage_fn(stage_params, x_in)
+        # last stage writes its result for microbatch t-(n_stages-1)
+        out_idx = jnp.clip(t - (n_stages - 1), 0, M - 1)
+        valid = jnp.logical_and(stage == n_stages - 1, t >= n_stages - 1)
+        outs = jax.lax.dynamic_update_index_in_dim(
+            outs, jnp.where(valid, y, outs[out_idx]), out_idx, 0)
+        nxt = jax.lax.ppermute(y, axis_name, perm_fwd)
+        return (nxt, outs), None
+
+    recv0 = jnp.zeros_like(out_like)
+    outs0 = jnp.zeros((M,) + out_like.shape, out_like.dtype)
+    (recv, outs), _ = jax.lax.scan(step, (recv0, outs0), jnp.arange(steps))
+    # broadcast final outputs from the last stage to every stage so the
+    # result is replicated over 'pp' (head/loss run replicated after).
+    # psum in f32: XLA's AllReducePromotion pass miscompiles (checks-fails)
+    # on bf16 all-reduces emitted from partial-manual regions.
+    sel = jnp.where(stage == n_stages - 1, outs.astype(jnp.float32),
+                    jnp.zeros(outs.shape, jnp.float32))
+    return jax.lax.psum(sel, axis_name).astype(outs.dtype)
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh,
+                   num_microbatches: int, axis_name: str = "pp"):
+    """Run a layer stack as a pipeline over ``axis_name``.
+
+    stage_fn(local_layer_params, x_micro) -> y_micro — applies a stage's
+    local layers (e.g. an inner lax.scan over them); shapes of x and y match.
+    stacked_params: pytree with leading axis L (total layers), L divisible by
+    the pp axis size; x: [B, ...] with B divisible by num_microbatches.
+    Returns y: [B, ...].
+    """
+    n_stages = dict(mesh.shape)[axis_name]
+    B = x.shape[0]
+    assert B % num_microbatches == 0, (B, num_microbatches)
+    mb = x.reshape((num_microbatches, B // num_microbatches) + x.shape[1:])
+    out_like = jax.eval_shape(lambda m: m[0], mb)
+    out_like = jnp.zeros(out_like.shape, out_like.dtype)
+
+    # leading layer axis L -> [n_stages, L/n_stages, ...], sharded over pp
+    def split_stages(a):
+        L = a.shape[0]
+        assert L % n_stages == 0, (L, n_stages)
+        return a.reshape((n_stages, L // n_stages) + a.shape[1:])
+
+    staged = jax.tree_util.tree_map(split_stages, stacked_params)
+
+    pspec = jax.tree_util.tree_map(
+        lambda a: P(axis_name, *([None] * (a.ndim - 1))), staged)
+
+    body = functools.partial(
+        _pipeline_body, stage_fn=lambda p, xx: stage_fn(
+            jax.tree_util.tree_map(lambda a: a[0], p), xx),
+        axis_name=axis_name, n_stages=n_stages, out_like=out_like)
+
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(pspec, P()), out_specs=P(),
+        axis_names={axis_name},  # other mesh axes stay auto → GSPMD inside
+        check_vma=False)
+    # f32 at the replicated-input boundary: the transpose rule psums the
+    # microbatch cotangent over 'pp', and XLA's AllReducePromotion pass
+    # check-fails on bf16 all-reduces from partial-manual regions
+    outs = fn(staged, mb.astype(jnp.float32))
+    return outs.reshape((B,) + x.shape[1:])

@@ -67,6 +67,9 @@ class LlamaConfig:
     # exact blockwise ring attention over the 'sp' mesh axis (long-context;
     # capability the reference's SEP axis delegates to model code — §5.7)
     context_parallel: bool = False
+    # >0 enables the compiled GPipe schedule over the 'pp' mesh axis
+    # (distributed/pipeline.py); value = microbatches per step
+    pipeline_microbatches: int = 0
 
 
 def llama3_8b() -> LlamaConfig:
@@ -131,18 +134,20 @@ def param_specs(config: LlamaConfig, fsdp: bool = True) -> Dict[str, Any]:
     shards the other matrix axis over 'dp' (ZeRO-3 — reference:
     DygraphShardingOptimizer V2, dygraph_sharding_optimizer.py:592)."""
     dp = "dp" if fsdp else None
+    # leading (layer) axis shards over 'pp' when the mesh has one — the
+    # pipeline schedule slices stages from it (dropped on pp-less meshes)
     specs = {
         "embed": P("tp", dp),
         "layers": {
-            "attn_norm": P(None, None),
-            "wq": P(None, dp, "tp"),
-            "wk": P(None, dp, "tp"),
-            "wv": P(None, dp, "tp"),
-            "wo": P(None, "tp", dp),
-            "mlp_norm": P(None, None),
-            "w_gate": P(None, dp, "tp"),
-            "w_up": P(None, dp, "tp"),
-            "w_down": P(None, "tp", dp),
+            "attn_norm": P("pp", None),
+            "wq": P("pp", dp, "tp"),
+            "wk": P("pp", dp, "tp"),
+            "wv": P("pp", dp, "tp"),
+            "wo": P("pp", "tp", dp),
+            "mlp_norm": P("pp", None),
+            "w_gate": P("pp", dp, "tp"),
+            "w_up": P("pp", dp, "tp"),
+            "w_down": P("pp", "tp", dp),
         },
         "final_norm": P(None),
     }
@@ -338,7 +343,22 @@ def forward(params, tokens, config: LlamaConfig):
     def scan_fn(carry, layer_params):
         return body(carry, layer_params), None
 
-    x, _ = jax.lax.scan(scan_fn, x, params["layers"])
+    mesh = _ACT_MESH
+    pp = dict(mesh.shape).get("pp", 1) if mesh is not None else 1
+    if pp > 1 and c.pipeline_microbatches > 0:
+        from ..distributed.pipeline import pipeline_apply
+
+        def stage_fn(local_layers, xx):
+            # inside the manual-'pp' shard_map region full-mesh sharding
+            # constraints are illegal — let GSPMD place the stage body
+            with activation_mesh(None):
+                out, _ = jax.lax.scan(scan_fn, xx, local_layers)
+            return out
+
+        x = pipeline_apply(stage_fn, params["layers"], x, mesh,
+                           c.pipeline_microbatches, "pp")
+    else:
+        x, _ = jax.lax.scan(scan_fn, x, params["layers"])
     x = _rms_norm(x, params["final_norm"], c.rms_eps)
     head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
     logits = x @ head.astype(dt)
