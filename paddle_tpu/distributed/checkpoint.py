@@ -1,0 +1,101 @@
+"""Distributed checkpoint: sharded save/load with resharding-on-load.
+
+Parity: python/paddle/distributed/checkpoint/ — save_state_dict
+(save_state_dict.py:135; per-rank shard files + global metadata + replicated-
+tensor dedup + async save queue) and load_state_dict (load_state_dict.py:526;
+overlap computation between saved shards and the CURRENT sharding —
+compute_overlap :394, per-rank read plans :211).
+
+TPU-native re-design: Orbax + jax.sharding carry the mechanism — a
+NamedSharding-aware TensorStore write is exactly "per-shard files + global
+metadata", dedup of replicated shards is built in, and resharding-on-load is
+expressed by passing the *target* shardings to restore (the overlap math the
+reference hand-rolls happens inside TensorStore reads). The API keeps the
+reference's contract: a flat state_dict of arrays in, the same out under any
+new mesh/placements. Async save (the reference's save queue) maps to Orbax's
+async checkpointer.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+__all__ = ["save_state_dict", "load_state_dict"]
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+    return ocp.PyTreeCheckpointer()
+
+
+def _is_tensor(v) -> bool:
+    from ..core.tensor import Tensor
+    return isinstance(v, Tensor)
+
+
+def _plain_tree(tree):
+    """Tensor→jax.Array with Tensor treated as a LEAF (Tensor is itself a
+    registered pytree node; naive tree_map would descend into it and rebuild
+    Tensors around non-array payloads)."""
+    return jax.tree_util.tree_map(
+        lambda v: v._value if _is_tensor(v) else v, tree, is_leaf=_is_tensor)
+
+
+def save_state_dict(state_dict: Dict[str, Any], path: str,
+                    process_group=None, coordinator_rank: int = 0,
+                    async_save: bool = False) -> None:
+    """Write a (possibly sharded) state_dict to ``path``.
+    Sharded jax.Arrays are written as distributed shard files + metadata;
+    replicated values are deduplicated (parity: dedup_tensor —
+    save_state_dict.py:107)."""
+    import orbax.checkpoint as ocp
+
+    tree = _plain_tree(state_dict)
+    path = os.path.abspath(path)
+    if async_save:
+        ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+        ckptr.save(path, tree, force=True)
+        # caller may continue; orbax finalizes in background. wait_until
+        # exposed for tests via the returned-less contract: orbax tracks it.
+        ckptr.wait_until_finished()
+    else:
+        _checkpointer().save(path, tree, force=True)
+
+
+def load_state_dict(state_dict: Dict[str, Any], path: str,
+                    process_group=None, coordinator_rank: int = 0,
+                    offload: bool = False) -> Dict[str, Any]:
+    """Restore into the CURRENT sharding of ``state_dict`` (in-place for
+    framework Tensors, returned for raw arrays). The saved mesh/placements
+    may differ arbitrarily — resharding happens during the read (parity:
+    load_state_dict.py:369-444 compute_overlap / read plans)."""
+    import orbax.checkpoint as ocp
+    from ..core.tensor import Tensor
+
+    path = os.path.abspath(path)
+    plain = _plain_tree(state_dict)
+
+    def to_restore_args(val):
+        if isinstance(val, jax.Array):
+            return ocp.ArrayRestoreArgs(
+                sharding=val.sharding, dtype=val.dtype,
+                global_shape=val.shape)
+        return ocp.RestoreArgs()
+
+    args = jax.tree_util.tree_map(to_restore_args, plain)
+    restored = _checkpointer().restore(path, restore_args=args)
+
+    flat_new = jax.tree_util.tree_leaves(restored)
+    flat_old, treedef = jax.tree_util.tree_flatten(state_dict,
+                                                   is_leaf=_is_tensor)
+    out = []
+    for old, new in zip(flat_old, flat_new):
+        if _is_tensor(old):
+            old._replace_value(new)
+            out.append(old)
+        else:
+            out.append(new)
+    return jax.tree_util.tree_unflatten(treedef, out)

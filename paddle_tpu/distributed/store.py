@@ -1,0 +1,96 @@
+"""TCPStore — rendezvous key/value store for multi-host bootstrap.
+
+Parity: paddle.distributed.TCPStore over the C++ store
+(reference: paddle/phi/core/distributed/store/tcp_store.h:121, socket.cpp;
+created by init_parallel_env at parallel.py:1134). The server/client are the
+native C++ implementation in csrc/ptpu_runtime.cpp (length-prefixed frames,
+blocking wait, atomic add) bound via ctypes.
+
+On TPU pods the heavy coordination is jax.distributed.initialize / GCS; this
+store covers the reference's explicit-rendezvous API surface (barriers,
+elastic membership, user code that calls store.set/get/wait/add).
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+from ..lib import native_lib
+
+__all__ = ["TCPStore"]
+
+_MAX_VAL = 1 << 20
+
+
+class TCPStore:
+    """parity: paddle.distributed.TCPStore(host, port, is_master, world_size,
+    timeout)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 is_master: bool = False, world_size: int = 1,
+                 timeout: float = 30.0):
+        lib = native_lib()
+        self._lib = lib
+        self._server = None
+        self.host = host
+        if is_master:
+            self._server = lib.ptpu_store_server_start(port)
+            if not self._server:
+                raise RuntimeError(f"TCPStore: cannot bind port {port}")
+            port = lib.ptpu_store_server_port(self._server)
+        self.port = port
+        self._client = lib.ptpu_store_client_connect(
+            host.encode(), port, float(timeout))
+        if not self._client:
+            raise RuntimeError(f"TCPStore: cannot connect {host}:{port}")
+
+    def set(self, key: str, value) -> None:
+        data = value if isinstance(value, bytes) else str(value).encode()
+        rc = self._lib.ptpu_store_set(self._client, key.encode(), data,
+                                      len(data))
+        if rc != 0:
+            raise RuntimeError("TCPStore.set failed")
+
+    def get(self, key: str) -> Optional[bytes]:
+        buf = ctypes.create_string_buffer(_MAX_VAL)
+        n = self._lib.ptpu_store_get(self._client, key.encode(), buf, _MAX_VAL)
+        if n == -1:
+            return None
+        if n < 0:
+            raise RuntimeError("TCPStore.get failed")
+        return buf.raw[:n]
+
+    def wait(self, key: str) -> bytes:
+        buf = ctypes.create_string_buffer(_MAX_VAL)
+        n = self._lib.ptpu_store_wait(self._client, key.encode(), buf, _MAX_VAL)
+        if n < 0:
+            raise RuntimeError("TCPStore.wait failed")
+        return buf.raw[:n]
+
+    def add(self, key: str, amount: int = 1) -> int:
+        out = self._lib.ptpu_store_add(self._client, key.encode(), amount)
+        if out == -(1 << 63):
+            raise RuntimeError("TCPStore.add failed")
+        return int(out)
+
+    def barrier(self, key: str, world_size: int) -> None:
+        """All participants call with the same key; returns when world_size
+        have arrived."""
+        n = self.add(key + "/count", 1)
+        if n >= world_size:
+            self.set(key + "/done", b"1")
+        self.wait(key + "/done")
+
+    def close(self) -> None:
+        if self._client:
+            self._lib.ptpu_store_client_close(self._client)
+            self._client = None
+        if self._server:
+            self._lib.ptpu_store_server_stop(self._server)
+            self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -18,7 +18,7 @@ import numpy as np
 from ..core.tensor import Tensor
 
 __all__ = [
-    "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
+    "Dataset", "IterableDataset", "TensorDataset", "ArrayDataset", "ComposeDataset",
     "ChainDataset", "Subset", "ConcatDataset", "random_split",
     "Sampler", "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
     "BatchSampler", "DistributedBatchSampler", "DataLoader", "default_collate_fn",
@@ -54,6 +54,47 @@ class TensorDataset(Dataset):
 
     def __len__(self):
         return self.tensors[0].shape[0]
+
+
+class ArrayDataset(Dataset):
+    """Contiguous numpy-backed map-style dataset with NATIVE batch collation:
+    DataLoader gathers whole batches through the C++ runtime
+    (csrc/ptpu_runtime.cpp ptpu_gather_rows — parallel row memcpy outside the
+    GIL), playing the role of the reference's C++ DataFeed/shared-memory
+    worker transport (fluid/framework/data_feed.h:1144,
+    io/dataloader/worker.py)."""
+
+    def __init__(self, *arrays):
+        assert arrays and all(len(a) == len(arrays[0]) for a in arrays)
+        self.arrays = [np.ascontiguousarray(a) for a in arrays]
+
+    def __getitem__(self, idx):
+        out = tuple(a[idx] for a in self.arrays)
+        return out if len(out) > 1 else out[0]
+
+    def __len__(self):
+        return len(self.arrays[0])
+
+
+def _native_gather(arr: np.ndarray, indices, nthreads: int = 4) -> np.ndarray:
+    """Batch-gather rows via the native runtime; numpy fallback."""
+    import ctypes
+
+    idx = np.ascontiguousarray(indices, np.int64)
+    out = np.empty((len(idx),) + arr.shape[1:], arr.dtype)
+    try:
+        from ..lib import native_lib
+        lib = native_lib()
+    except RuntimeError:
+        np.take(arr, idx, axis=0, out=out)
+        return out
+    row_bytes = int(arr.dtype.itemsize * np.prod(arr.shape[1:], dtype=np.int64))
+    lib.ptpu_gather_rows(
+        arr.ctypes.data_as(ctypes.c_char_p),
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+        len(idx), row_bytes,
+        out.ctypes.data_as(ctypes.c_char_p), nthreads)
+    return out
 
 
 class ComposeDataset(Dataset):
@@ -326,6 +367,11 @@ class DataLoader:
         return len(self.batch_sampler)
 
     def _fetch(self, indices):
+        if (isinstance(self.dataset, ArrayDataset)
+                and self.collate_fn is default_collate_fn):
+            cols = tuple(Tensor(_native_gather(a, indices))
+                         for a in self.dataset.arrays)
+            return cols if len(cols) > 1 else cols[0]
         samples = [self.dataset[i] for i in indices]
         return self.collate_fn(samples)
 

@@ -1,0 +1,79 @@
+"""Native runtime loader (C++ pieces, ctypes-bound).
+
+The reference's runtime is C++ end-to-end; on TPU the device path is XLA, and
+the host-side pieces that stay native live in csrc/ptpu_runtime.cpp
+(TCPStore rendezvous, GIL-free batch collation). Built on first use with g++
+and cached next to the source.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO, "csrc", "ptpu_runtime.cpp")
+_SO = os.path.join(_REPO, "csrc", "libptpu_runtime.so")
+
+_lock = threading.Lock()
+_lib = None
+_build_error: str | None = None
+
+
+def _build() -> None:
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-pthread", _SRC, "-o", _SO]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+
+
+def native_lib():
+    """Load (building if needed) the native runtime; returns the ctypes CDLL
+    or raises RuntimeError with the build error."""
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_error is not None:
+            raise RuntimeError(_build_error)
+        try:
+            if (not os.path.exists(_SO)
+                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                _build()
+            lib = ctypes.CDLL(_SO)
+        except Exception as e:  # keep the framework importable without g++
+            _build_error = f"native runtime unavailable: {e}"
+            raise RuntimeError(_build_error) from e
+        lib.ptpu_store_server_start.restype = ctypes.c_void_p
+        lib.ptpu_store_server_start.argtypes = [ctypes.c_int]
+        lib.ptpu_store_server_port.restype = ctypes.c_int
+        lib.ptpu_store_server_port.argtypes = [ctypes.c_void_p]
+        lib.ptpu_store_server_stop.argtypes = [ctypes.c_void_p]
+        lib.ptpu_store_client_connect.restype = ctypes.c_void_p
+        lib.ptpu_store_client_connect.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_double]
+        lib.ptpu_store_client_close.argtypes = [ctypes.c_void_p]
+        lib.ptpu_store_set.restype = ctypes.c_int
+        lib.ptpu_store_set.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+        lib.ptpu_store_get.restype = ctypes.c_int
+        lib.ptpu_store_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+        lib.ptpu_store_wait.restype = ctypes.c_int
+        lib.ptpu_store_wait.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+        lib.ptpu_store_add.restype = ctypes.c_longlong
+        lib.ptpu_store_add.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
+        lib.ptpu_gather_rows.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
+            ctypes.c_longlong, ctypes.c_char_p, ctypes.c_int]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    try:
+        native_lib()
+        return True
+    except RuntimeError:
+        return False

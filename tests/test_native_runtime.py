@@ -1,0 +1,95 @@
+"""Native C++ runtime: TCPStore rendezvous + GIL-free batch collation
+(parity: phi/core/distributed/store/tcp_store.h; fluid data_feed /
+io/dataloader worker transport)."""
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.lib import native_available
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="g++ native runtime unavailable")
+
+
+def test_store_set_get_add():
+    from paddle_tpu.distributed.store import TCPStore
+
+    master = TCPStore(is_master=True)
+    client = TCPStore(port=master.port)
+    master.set("k", b"hello")
+    assert client.get("k") == b"hello"
+    assert client.get("missing") is None
+    assert client.add("ctr", 5) == 5
+    assert master.add("ctr", 2) == 7
+    client.close()
+    master.close()
+
+
+def test_store_wait_blocks_until_set():
+    from paddle_tpu.distributed.store import TCPStore
+
+    master = TCPStore(is_master=True)
+    client = TCPStore(port=master.port)
+    got = {}
+
+    def waiter():
+        got["v"] = client.wait("late")
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    t.join(0.2)
+    assert t.is_alive()  # still blocked
+    master.set("late", b"now")
+    t.join(5)
+    assert got["v"] == b"now"
+    client.close()
+    master.close()
+
+
+def test_store_barrier():
+    from paddle_tpu.distributed.store import TCPStore
+
+    master = TCPStore(is_master=True)
+    clients = [TCPStore(port=master.port) for _ in range(3)]
+    done = []
+
+    def arrive(c):
+        c.barrier("b1", 3)
+        done.append(1)
+
+    ts = [threading.Thread(target=arrive, args=(c,)) for c in clients]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(10)
+    assert len(done) == 3
+    for c in clients:
+        c.close()
+    master.close()
+
+
+def test_native_gather_rows():
+    from paddle_tpu.io import _native_gather
+
+    arr = np.arange(1000 * 16, dtype=np.float32).reshape(1000, 16)
+    idx = np.random.default_rng(0).integers(0, 1000, size=256)
+    out = _native_gather(arr, idx, nthreads=4)
+    np.testing.assert_array_equal(out, arr[idx])
+
+
+def test_array_dataset_loader():
+    import paddle_tpu as paddle
+    from paddle_tpu.io import ArrayDataset, DataLoader
+
+    x = np.random.default_rng(0).normal(size=(100, 8)).astype(np.float32)
+    y = np.arange(100, dtype=np.int32)
+    loader = DataLoader(ArrayDataset(x, y), batch_size=16, shuffle=False,
+                        drop_last=False)
+    seen = 0
+    for bx, by in loader:
+        assert bx.shape[1] == 8
+        np.testing.assert_array_equal(
+            bx.numpy(), x[seen:seen + bx.shape[0]])
+        seen += bx.shape[0]
+    assert seen == 100
