@@ -60,6 +60,12 @@ from . import distribution  # noqa: F401
 from . import sparse  # noqa: F401
 from . import static  # noqa: F401
 from . import hapi  # noqa: F401
+from . import fft  # noqa: F401
+from . import signal  # noqa: F401
+from . import geometric  # noqa: F401
+from . import audio  # noqa: F401
+from . import text  # noqa: F401
+from . import quantization  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 from .nn.layer.layers import Layer  # noqa: F401
 

@@ -1,0 +1,188 @@
+"""paddle.audio parity — window functions + spectrogram/mel/MFCC features.
+
+Reference: python/paddle/audio/ (features/layers.py Spectrogram/MelSpectrogram
+/LogMelSpectrogram/MFCC; functional/window.py get_window; functional.py
+hz_to_mel/mel_to_hz/compute_fbank_matrix/create_dct).
+TPU-native: everything is jnp over the framework stft (signal.py) — the
+feature layers are nn.Layers so they compose with models.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from .. import signal as _signal
+
+__all__ = [
+    "get_window", "hz_to_mel", "mel_to_hz", "compute_fbank_matrix",
+    "create_dct", "Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC",
+]
+
+
+def get_window(window, win_length, fftbins=True, dtype="float64"):
+    """parity: audio/functional/window.py get_window (hann/hamming/blackman/
+    bartlett/kaiser/gaussian/general_gaussian/exponential/taylor subset)."""
+    name, *args = window if isinstance(window, tuple) else (window,)
+    n = win_length
+    sym = not fftbins
+    denom = n - 1 if sym else n
+    k = np.arange(n)
+    if name == "hann":
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * k / denom)
+    elif name == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * k / denom)
+    elif name == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * k / denom)
+             + 0.08 * np.cos(4 * np.pi * k / denom))
+    elif name == "bartlett":
+        w = 1.0 - np.abs(2 * k / denom - 1.0)
+    elif name == "kaiser":
+        beta = args[0] if args else 12.0
+        w = np.i0(beta * np.sqrt(1 - (2 * k / denom - 1) ** 2)) / np.i0(beta)
+    elif name == "gaussian":
+        std = args[0] if args else 7.0
+        w = np.exp(-0.5 * ((k - denom / 2) / std) ** 2)
+    else:
+        raise ValueError(f"unsupported window: {window}")
+    return Tensor(jnp.asarray(w, jnp.float32))
+
+
+def hz_to_mel(freq, htk=False):
+    if htk:
+        return 2595.0 * np.log10(1.0 + np.asarray(freq) / 700.0)
+    f = np.asarray(freq, np.float64)
+    f_sp = 200.0 / 3
+    mels = f / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = min_log_hz / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(f >= min_log_hz,
+                    min_log_mel + np.log(np.maximum(f, 1e-10) / min_log_hz) / logstep,
+                    mels)
+
+
+def mel_to_hz(mel, htk=False):
+    if htk:
+        return 700.0 * (10.0 ** (np.asarray(mel) / 2595.0) - 1.0)
+    m = np.asarray(mel, np.float64)
+    f_sp = 200.0 / 3
+    freqs = m * f_sp
+    min_log_hz = 1000.0
+    min_log_mel = min_log_hz / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(m >= min_log_mel,
+                    min_log_hz * np.exp(logstep * (m - min_log_mel)), freqs)
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    f_max = f_max or sr / 2
+    fft_freqs = np.linspace(0, sr / 2, n_fft // 2 + 1)
+    mel_pts = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                          n_mels + 2)
+    hz_pts = mel_to_hz(mel_pts, htk)
+    fb = np.zeros((n_mels, len(fft_freqs)))
+    for i in range(n_mels):
+        lo, ctr, hi = hz_pts[i], hz_pts[i + 1], hz_pts[i + 2]
+        up = (fft_freqs - lo) / max(ctr - lo, 1e-10)
+        down = (hi - fft_freqs) / max(hi - ctr, 1e-10)
+        fb[i] = np.maximum(0, np.minimum(up, down))
+    if norm == "slaney":
+        enorm = 2.0 / (hz_pts[2:n_mels + 2] - hz_pts[:n_mels])
+        fb *= enorm[:, None]
+    return Tensor(jnp.asarray(fb, jnp.float32))
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    k = np.arange(n_mels)
+    dct = np.cos(np.pi / n_mels * (k + 0.5)[None, :] * np.arange(n_mfcc)[:, None])
+    if norm == "ortho":
+        dct[0] *= 1 / math.sqrt(2)
+        dct *= math.sqrt(2.0 / n_mels)
+    return Tensor(jnp.asarray(dct.T, jnp.float32))
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.register_buffer("window",
+                             get_window(window, self.win_length))
+
+    def forward(self, x):
+        spec = _signal.stft(x, self.n_fft, self.hop_length, self.win_length,
+                            window=self.window, center=self.center,
+                            pad_mode=self.pad_mode)
+        from ..ops.dispatch import apply
+        from ..ops.creation import _t
+        return apply("spec_power",
+                     lambda s: jnp.abs(s) ** self.power, _t(spec))
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                       power, center, pad_mode)
+        self.register_buffer(
+            "fbank", compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max,
+                                          htk, norm))
+
+    def forward(self, x):
+        spec = self.spectrogram(x)          # [..., freq, frames]
+        from ..ops.dispatch import apply
+        from ..ops.creation import _t
+        return apply("mel", lambda s, fb: jnp.einsum("mf,...ft->...mt", fb, s),
+                     _t(spec), _t(self.fbank))
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, *args, ref_value=1.0, amin=1e-10, top_db=None, **kw):
+        super().__init__()
+        self.mel = MelSpectrogram(*args, **kw)
+        self.ref_value, self.amin, self.top_db = ref_value, amin, top_db
+
+    def forward(self, x):
+        m = self.mel(x)
+        from ..ops.dispatch import apply
+        from ..ops.creation import _t
+
+        def fn(v):
+            db = 10.0 * jnp.log10(jnp.maximum(v, self.amin))
+            db -= 10.0 * math.log10(max(self.amin, self.ref_value))
+            if self.top_db is not None:
+                db = jnp.maximum(db, jnp.max(db) - self.top_db)
+            return db
+
+        return apply("logmel", fn, _t(m))
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 n_mels=64, f_min=50.0, f_max=None, **kw):
+        super().__init__()
+        self.logmel = LogMelSpectrogram(sr=sr, n_fft=n_fft,
+                                        hop_length=hop_length, n_mels=n_mels,
+                                        f_min=f_min, f_max=f_max, **kw)
+        self.register_buffer("dct", create_dct(n_mfcc, n_mels))
+
+    def forward(self, x):
+        lm = self.logmel(x)                 # [..., mels, frames]
+        from ..ops.dispatch import apply
+        from ..ops.creation import _t
+        return apply("mfcc", lambda v, d: jnp.einsum("md,...mt->...dt", d, v),
+                     _t(lm), _t(self.dct))

@@ -1,0 +1,106 @@
+"""paddle.distributed.launch parity — the process launcher CLI.
+
+Reference: python/paddle/distributed/launch/ — main.py:23 CLI,
+CollectiveController.build_pod (controllers/collective.py:37) spawning one
+process per device with the PADDLE_* env contract (collective.py:126-241),
+HTTPMaster rendezvous (controllers/master.py:73), watcher/restart.
+
+TPU-native: on a TPU pod each host runs ONE process (jax.distributed handles
+per-host coordination), so the launcher's job collapses to (a) the env
+contract, (b) multi-process CPU simulation for tests, (c) restart-on-failure.
+Usage:  python -m paddle_tpu.distributed.launch [--nproc_per_node N] train.py
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+__all__ = ["launch", "main"]
+
+
+def _env_for_rank(rank: int, nproc: int, master: str, port: int):
+    env = dict(os.environ)
+    env.update({
+        # the reference's env contract (collective.py:126-241)
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(nproc),
+        "PADDLE_MASTER": f"{master}:{port}",
+        "PADDLE_LOCAL_RANK": str(rank),
+        "PADDLE_RANK_IN_NODE": str(rank),
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(
+            f"{master}:{port + 1 + r}" for r in range(nproc)),
+        "PADDLE_CURRENT_ENDPOINT": f"{master}:{port + 1 + rank}",
+    })
+    return env
+
+
+def launch(script: str, script_args: Optional[List[str]] = None,
+           nproc_per_node: int = 1, master: str = "127.0.0.1",
+           port: int = 0, max_restarts: int = 0) -> int:
+    """Spawn nproc_per_node worker processes with the env contract; returns
+    the first nonzero exit code (0 on success). Restarts the pod on failure
+    up to max_restarts (parity: elastic fault-level restart —
+    fleet/elastic/manager.py)."""
+    script_args = script_args or []
+    if port == 0:
+        import socket
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+
+    for attempt in range(max_restarts + 1):
+        procs = []
+        for rank in range(nproc_per_node):
+            env = _env_for_rank(rank, nproc_per_node, master, port)
+            procs.append(subprocess.Popen(
+                [sys.executable, script, *script_args], env=env))
+        codes = []
+        failed = False
+        try:
+            while procs:
+                for p in list(procs):
+                    rc = p.poll()
+                    if rc is None:
+                        continue
+                    procs.remove(p)
+                    codes.append(rc)
+                    if rc != 0:
+                        failed = True
+                        for q in procs:
+                            q.send_signal(signal.SIGTERM)
+                time.sleep(0.05)
+        except KeyboardInterrupt:
+            for p in procs:
+                p.send_signal(signal.SIGTERM)
+            raise
+        if not failed:
+            return 0
+        if attempt < max_restarts:
+            time.sleep(1.0)
+    return next((c for c in codes if c != 0), 1)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="paddle.distributed.launch-compatible process launcher")
+    ap.add_argument("--nproc_per_node", "--nprocs", type=int, default=1)
+    ap.add_argument("--master", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--max_restarts", type=int, default=0)
+    ap.add_argument("script")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER)
+    ns = ap.parse_args(argv)
+    return launch(ns.script, ns.script_args, ns.nproc_per_node, ns.master,
+                  ns.port, ns.max_restarts)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,73 @@
+"""paddle.fft parity (reference: python/paddle/fft.py over pocketfft-backed
+kernels). TPU-native: jnp.fft lowers to XLA FFT HLO directly."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.tensor import Tensor
+from .ops.dispatch import apply
+from .ops.creation import _t
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
+    "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn", "fftfreq",
+    "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _wrap1(name, jfn):
+    def op(x, n=None, axis=-1, norm="backward", name_=None):
+        return apply(name, lambda v: jfn(v, n=n, axis=axis, norm=norm), _t(x))
+    op.__name__ = name
+    return op
+
+
+def _wrapn(name, jfn, s_name="s"):
+    def op(x, s=None, axes=None, norm="backward", name_=None):
+        return apply(name, lambda v: jfn(v, s=s, axes=axes, norm=norm), _t(x))
+    op.__name__ = name
+    return op
+
+
+fft = _wrap1("fft", jnp.fft.fft)
+ifft = _wrap1("ifft", jnp.fft.ifft)
+rfft = _wrap1("rfft", jnp.fft.rfft)
+irfft = _wrap1("irfft", jnp.fft.irfft)
+hfft = _wrap1("hfft", jnp.fft.hfft)
+ihfft = _wrap1("ihfft", jnp.fft.ihfft)
+fftn = _wrapn("fftn", jnp.fft.fftn)
+ifftn = _wrapn("ifftn", jnp.fft.ifftn)
+rfftn = _wrapn("rfftn", jnp.fft.rfftn)
+irfftn = _wrapn("irfftn", jnp.fft.irfftn)
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply("fft2", lambda v: jnp.fft.fft2(v, s=s, axes=axes, norm=norm), _t(x))
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply("ifft2", lambda v: jnp.fft.ifft2(v, s=s, axes=axes, norm=norm), _t(x))
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply("rfft2", lambda v: jnp.fft.rfft2(v, s=s, axes=axes, norm=norm), _t(x))
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply("irfft2", lambda v: jnp.fft.irfft2(v, s=s, axes=axes, norm=norm), _t(x))
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(n, d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply("fftshift", lambda v: jnp.fft.fftshift(v, axes=axes), _t(x))
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply("ifftshift", lambda v: jnp.fft.ifftshift(v, axes=axes), _t(x))

@@ -1,0 +1,162 @@
+"""paddle.geometric parity — graph message passing + segment ops.
+
+Reference: python/paddle/geometric/ (send_u_recv / send_ue_recv message
+passing, segment_{sum,mean,max,min}, sample_neighbors, reindex_graph).
+TPU-native: jax.ops.segment_* (XLA scatter-reduce — no atomics needed on
+TPU's deterministic scatter).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops.creation import _t
+from ..ops.dispatch import apply
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "send_u_recv", "send_ue_recv", "send_uv", "reindex_graph",
+    "sample_neighbors",
+]
+
+
+def _nseg(segment_ids, num_segments):
+    if num_segments is not None:
+        return int(num_segments)
+    return int(np.asarray(jnp.max(_t(segment_ids)._value)) + 1)
+
+
+def segment_sum(data, segment_ids, name=None, num_segments=None):
+    n = _nseg(segment_ids, num_segments)
+    return apply("segment_sum",
+                 lambda d, s: jax.ops.segment_sum(d, s, num_segments=n),
+                 _t(data), _t(segment_ids))
+
+
+def segment_mean(data, segment_ids, name=None, num_segments=None):
+    n = _nseg(segment_ids, num_segments)
+
+    def fn(d, s):
+        tot = jax.ops.segment_sum(d, s, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones_like(d), s, num_segments=n)
+        return tot / jnp.maximum(cnt, 1)
+
+    return apply("segment_mean", fn, _t(data), _t(segment_ids))
+
+
+def segment_max(data, segment_ids, name=None, num_segments=None):
+    n = _nseg(segment_ids, num_segments)
+    return apply("segment_max",
+                 lambda d, s: jax.ops.segment_max(d, s, num_segments=n),
+                 _t(data), _t(segment_ids))
+
+
+def segment_min(data, segment_ids, name=None, num_segments=None):
+    n = _nseg(segment_ids, num_segments)
+    return apply("segment_min",
+                 lambda d, s: jax.ops.segment_min(d, s, num_segments=n),
+                 _t(data), _t(segment_ids))
+
+
+_POOLS = {"sum": jax.ops.segment_sum, "mean": None, "max": jax.ops.segment_max,
+          "min": jax.ops.segment_min}
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src], scatter-reduce onto dst (reference:
+    geometric/message_passing/send_recv.py)."""
+    n = out_size or int(np.asarray(jnp.max(_t(dst_index)._value)) + 1)
+
+    def fn(xv, si, di):
+        msgs = xv[si]
+        if reduce_op == "mean":
+            tot = jax.ops.segment_sum(msgs, di, num_segments=n)
+            cnt = jax.ops.segment_sum(jnp.ones((msgs.shape[0],) + (1,) * (msgs.ndim - 1),
+                                               msgs.dtype), di, num_segments=n)
+            return tot / jnp.maximum(cnt, 1)
+        return _POOLS[reduce_op](msgs, di, num_segments=n)
+
+    return apply("send_u_recv", fn, _t(x), _t(src_index), _t(dst_index))
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Message = combine(x[src], edge_feature y), then scatter-reduce."""
+    n = out_size or int(np.asarray(jnp.max(_t(dst_index)._value)) + 1)
+
+    def fn(xv, yv, si, di):
+        m = xv[si]
+        if message_op == "add":
+            m = m + yv
+        elif message_op == "sub":
+            m = m - yv
+        elif message_op == "mul":
+            m = m * yv
+        elif message_op == "div":
+            m = m / yv
+        if reduce_op == "mean":
+            tot = jax.ops.segment_sum(m, di, num_segments=n)
+            cnt = jax.ops.segment_sum(
+                jnp.ones((m.shape[0],) + (1,) * (m.ndim - 1), m.dtype), di,
+                num_segments=n)
+            return tot / jnp.maximum(cnt, 1)
+        return _POOLS[reduce_op](m, di, num_segments=n)
+
+    return apply("send_ue_recv", fn, _t(x), _t(y), _t(src_index), _t(dst_index))
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message from both endpoints (no reduce)."""
+    def fn(xv, yv, si, di):
+        a, b = xv[si], yv[di]
+        return {"add": a + b, "sub": a - b, "mul": a * b,
+                "div": a / b}[message_op]
+
+    return apply("send_uv", fn, _t(x), _t(y), _t(src_index), _t(dst_index))
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact global node ids to local ids (host-side — graph prep is not a
+    jit path)."""
+    xv = np.asarray(_t(x)._value)
+    nb = np.asarray(_t(neighbors)._value)
+    uniq, inv = np.unique(np.concatenate([xv, nb]), return_inverse=True)
+    order = {int(v): i for i, v in enumerate(xv)}
+    remap = np.empty(len(uniq), np.int64)
+    nxt = len(xv)
+    out_nodes = list(xv)
+    for u in uniq:
+        if int(u) in order:
+            remap[np.searchsorted(uniq, u)] = order[int(u)]
+        else:
+            remap[np.searchsorted(uniq, u)] = nxt
+            out_nodes.append(u)
+            nxt += 1
+    reindexed = remap[inv[len(xv):]]
+    return (Tensor(jnp.asarray(reindexed)),
+            Tensor(jnp.asarray(np.asarray(out_nodes))),
+            Tensor(_t(count)._value))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None,
+                     name=None):
+    """Uniform neighbor sampling on a CSC graph (host-side)."""
+    rng = np.random.default_rng()
+    rowv = np.asarray(_t(row)._value)
+    cp = np.asarray(_t(colptr)._value)
+    nodes = np.asarray(_t(input_nodes)._value)
+    out, counts = [], []
+    for nmid in nodes:
+        lo, hi = int(cp[nmid]), int(cp[nmid + 1])
+        nbrs = rowv[lo:hi]
+        if 0 <= sample_size < len(nbrs):
+            nbrs = rng.choice(nbrs, size=sample_size, replace=False)
+        out.append(nbrs)
+        counts.append(len(nbrs))
+    cat = np.concatenate(out) if out else np.zeros((0,), rowv.dtype)
+    return Tensor(jnp.asarray(cat)), Tensor(jnp.asarray(np.asarray(counts)))

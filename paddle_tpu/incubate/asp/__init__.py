@@ -1,0 +1,94 @@
+"""Automatic SParsity (ASP) — n:m structured sparsity.
+
+Reference: python/paddle/incubate/asp/ — calculate_density, create_mask
+(n:m best-magnitude patterns — utils.py get_mask_1d/2d), prune_model,
+decorate (mask-preserving optimizer wrap).
+
+TPU-native note: 2:4 hardware sparse MXU is not a TPU feature; masks here
+deliver the *model* capability (train-with-mask, export sparse) with dense
+execution — masked weights stay exactly zero through optimizer steps.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...nn.layer.layers import Layer
+
+__all__ = ["calculate_density", "create_mask", "prune_model", "decorate",
+           "reset_excluded_layers", "set_excluded_layers"]
+
+_excluded: List[str] = []
+_masks: Dict[int, np.ndarray] = {}
+
+
+def calculate_density(x) -> float:
+    arr = np.asarray(x._value if isinstance(x, Tensor) else x)
+    return float(np.count_nonzero(arr)) / arr.size
+
+
+def _mask_1d(vec: np.ndarray, n: int, m: int) -> np.ndarray:
+    """Keep the n largest-magnitude entries of every m-block."""
+    pad = (-len(vec)) % m
+    v = np.pad(vec, (0, pad))
+    blocks = np.abs(v).reshape(-1, m)
+    keep = np.argsort(-blocks, axis=1)[:, :n]
+    mask = np.zeros_like(blocks, dtype=bool)
+    np.put_along_axis(mask, keep, True, axis=1)
+    return mask.reshape(-1)[:len(vec)]
+
+
+def create_mask(tensor, func_name: str = "mask_1d", n: int = 2, m: int = 4):
+    """n:m mask along the last axis (parity: asp/utils.py create_mask)."""
+    arr = np.asarray(tensor._value if isinstance(tensor, Tensor) else tensor)
+    flat = arr.reshape(-1, arr.shape[-1])
+    mask = np.stack([_mask_1d(row, n, m) for row in flat])
+    return mask.reshape(arr.shape)
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _excluded.extend(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def prune_model(model: Layer, n: int = 2, m: int = 4,
+                mask_algo: str = "mask_1d", with_mask: bool = True):
+    """Apply n:m masks to every >=2D parameter (conv/linear weights)."""
+    pruned = {}
+    for name, p in model.named_parameters():
+        if p is None or len(p.shape) < 2 or name in _excluded:
+            continue
+        mask = create_mask(p, mask_algo, n, m)
+        p._replace_value(p._value * jnp.asarray(mask, p._value.dtype))
+        if with_mask:
+            _masks[id(p)] = mask
+        pruned[name] = mask
+    return pruned
+
+
+class _ASPOptimizer:
+    """Mask-preserving optimizer wrapper (parity: asp decorate) — re-applies
+    masks after every step so pruned weights stay zero."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, k):
+        return getattr(self._inner, k)
+
+    def step(self):
+        self._inner.step()
+        for p in getattr(self._inner, "_parameter_list", []):
+            mask = _masks.get(id(p))
+            if mask is not None:
+                p._replace_value(p._value * jnp.asarray(mask, p._value.dtype))
+
+
+def decorate(optimizer):
+    return _ASPOptimizer(optimizer)

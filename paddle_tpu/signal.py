@@ -1,0 +1,105 @@
+"""paddle.signal parity: stft / istft (reference: python/paddle/signal.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ops.dispatch import apply
+from .ops.creation import _t
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice into overlapping frames along the last axis → [..., frames, frame_length]."""
+    def fn(v):
+        n = v.shape[-1]
+        num = 1 + (n - frame_length) // hop_length
+        idx = (jnp.arange(frame_length)[None, :]
+               + hop_length * jnp.arange(num)[:, None])
+        return v[..., idx]
+    return apply("frame", fn, _t(x))
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    def fn(v):
+        *lead, num, fl = v.shape
+        n = fl + hop_length * (num - 1)
+        out = jnp.zeros(tuple(lead) + (n,), v.dtype)
+        for i in range(num):  # static python loop (num is static)
+            out = out.at[..., i * hop_length:i * hop_length + fl].add(v[..., i, :])
+        return out
+    return apply("overlap_add", fn, _t(x))
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """parity: paddle.signal.stft — returns [..., n_fft//2+1, frames] complex."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    def fn(v, w=None):
+        if center:
+            pad = n_fft // 2
+            v = jnp.pad(v, [(0, 0)] * (v.ndim - 1) + [(pad, pad)],
+                        mode=pad_mode)
+        n = v.shape[-1]
+        num = 1 + (n - n_fft) // hop_length
+        idx = (jnp.arange(n_fft)[None, :]
+               + hop_length * jnp.arange(num)[:, None])
+        frames = v[..., idx]                       # [..., frames, n_fft]
+        if w is not None:
+            if win_length < n_fft:
+                lp = (n_fft - win_length) // 2
+                w = jnp.pad(w, (lp, n_fft - win_length - lp))
+            frames = frames * w
+        spec = jnp.fft.rfft(frames, axis=-1) if onesided \
+            else jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.swapaxes(spec, -1, -2)          # [..., freq, frames]
+
+    if window is not None:
+        return apply("stft", fn, _t(x), _t(window))
+    return apply("stft", fn, _t(x))
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    def fn(spec, w=None):
+        spec = jnp.swapaxes(spec, -1, -2)          # [..., frames, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        frames = (jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided
+                  else jnp.fft.ifft(spec, axis=-1).real)
+        if w is None:
+            wv = jnp.ones((n_fft,), frames.dtype)
+        else:
+            wv = w
+            if win_length < n_fft:
+                lp = (n_fft - win_length) // 2
+                wv = jnp.pad(wv, (lp, n_fft - win_length - lp))
+        frames = frames * wv
+        *lead, num, fl = frames.shape
+        n = fl + hop_length * (num - 1)
+        out = jnp.zeros(tuple(lead) + (n,), frames.dtype)
+        norm = jnp.zeros((n,), frames.dtype)
+        for i in range(num):
+            sl = slice(i * hop_length, i * hop_length + fl)
+            out = out.at[..., sl].add(frames[..., i, :])
+            norm = norm.at[sl].add(wv * wv)
+        out = out / jnp.maximum(norm, 1e-10)
+        if center:
+            pad = n_fft // 2
+            out = out[..., pad:out.shape[-1] - pad]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    if window is not None:
+        return apply("istft", fn, _t(x), _t(window))
+    return apply("istft", fn, _t(x))

@@ -1,0 +1,165 @@
+"""paddle.text parity — text dataset classes.
+
+Reference: python/paddle/text/datasets/ (Imdb, Conll05st, Movielens,
+UCIHousing, WMT14, WMT16). This environment has no network egress, so
+constructors accept ``data_file`` (pre-downloaded archives) and raise a
+clear error when asked to download.
+"""
+from __future__ import annotations
+
+import gzip
+import io
+import os
+import re
+import tarfile
+from typing import List, Optional
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Imdb", "UCIHousing", "Conll05st", "Movielens", "WMT14", "WMT16",
+           "ViterbiDecoder", "viterbi_decode"]
+
+
+def _need_file(data_file, name):
+    if data_file is None or not os.path.exists(data_file):
+        raise RuntimeError(
+            f"{name}: automatic download is unavailable in this environment; "
+            f"pass data_file= pointing at the pre-downloaded archive")
+    return data_file
+
+
+class Imdb(Dataset):
+    """parity: text/datasets/imdb.py — aclImdb sentiment dataset."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 cutoff: int = 150, download: bool = True):
+        data_file = _need_file(data_file, "Imdb")
+        self.mode = mode
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        docs, labels = [], []
+        freq: dict = {}
+        with tarfile.open(data_file) as tf:
+            for m in tf.getmembers():
+                if pat.match(m.name):
+                    text = tf.extractfile(m).read().decode("utf-8",
+                                                           "ignore").lower()
+                    toks = re.findall(r"[a-z']+", text)
+                    docs.append(toks)
+                    labels.append(0 if "/neg/" in m.name else 1)
+                    for t in toks:
+                        freq[t] = freq.get(t, 0) + 1
+        vocab = {w: i for i, (w, c) in enumerate(
+            sorted(freq.items(), key=lambda kv: -kv[1])) if c > cutoff}
+        self.word_idx = vocab
+        self.docs = [np.asarray([vocab[t] for t in d if t in vocab],
+                                np.int64) for d in docs]
+        self.labels = np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class UCIHousing(Dataset):
+    """parity: text/datasets/uci_housing.py (13 features → price)."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 download: bool = True):
+        data_file = _need_file(data_file, "UCIHousing")
+        raw = np.loadtxt(data_file)
+        split = int(len(raw) * 0.8)
+        data = raw[:split] if mode == "train" else raw[split:]
+        feats = data[:, :-1]
+        mx, mn = feats.max(0), feats.min(0)
+        self.data = ((feats - feats.mean(0)) / np.maximum(mx - mn, 1e-6)
+                     ).astype(np.float32)
+        self.label = data[:, -1:].astype(np.float32)
+
+    def __getitem__(self, idx):
+        return self.data[idx], self.label[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class _ArchiveBacked(Dataset):
+    def __init__(self, name, data_file):
+        _need_file(data_file, name)
+        self.data_file = data_file
+
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        return 0
+
+
+class Conll05st(_ArchiveBacked):
+    def __init__(self, data_file=None, **kw):
+        super().__init__("Conll05st", data_file)
+
+
+class Movielens(_ArchiveBacked):
+    def __init__(self, data_file=None, **kw):
+        super().__init__("Movielens", data_file)
+
+
+class WMT14(_ArchiveBacked):
+    def __init__(self, data_file=None, **kw):
+        super().__init__("WMT14", data_file)
+
+
+class WMT16(_ArchiveBacked):
+    def __init__(self, data_file=None, **kw):
+        super().__init__("WMT16", data_file)
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """parity: paddle.text.viterbi_decode — batched Viterbi over emission
+    potentials [B, T, N] with transitions [N, N]."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+    from ..ops.creation import _t
+    from ..ops.dispatch import apply
+
+    def fn(pot, trans):
+        B, T, N = pot.shape
+
+        def step(carry, emit):
+            score = carry                                  # [B, N]
+            cand = score[:, :, None] + trans[None]         # [B, N, N]
+            best = jnp.max(cand, axis=1) + emit            # [B, N]
+            back = jnp.argmax(cand, axis=1)                # [B, N]
+            return best, back
+
+        init = pot[:, 0]
+        score, backs = jax.lax.scan(step, init, jnp.swapaxes(pot[:, 1:], 0, 1))
+        last = jnp.argmax(score, -1)                       # [B]
+
+        def backtrace(carry, back):
+            tag = carry
+            prev = jnp.take_along_axis(back, tag[:, None], 1)[:, 0]
+            return prev, prev
+
+        _, path = jax.lax.scan(backtrace, last, backs, reverse=True)
+        path = jnp.concatenate([jnp.swapaxes(path, 0, 1), last[:, None]], 1)
+        return jnp.max(score, -1), path
+
+    return apply("viterbi_decode", fn, _t(potentials), _t(transition_params))
+
+
+class ViterbiDecoder:
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -14,3 +14,5 @@ def set_image_backend(backend):
 
 def get_image_backend():
     return "numpy"
+
+from . import ops  # noqa: F401,E402

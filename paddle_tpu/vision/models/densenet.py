@@ -1,0 +1,114 @@
+"""DenseNet (parity: python/paddle/vision/models/densenet.py)."""
+from __future__ import annotations
+
+from ... import nn
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, num_input, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.norm1 = nn.BatchNorm2D(num_input)
+        self.relu = nn.ReLU()
+        self.conv1 = nn.Conv2D(num_input, bn_size * growth_rate, 1,
+                               bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = nn.Conv2D(bn_size * growth_rate, growth_rate, 3,
+                               padding=1, bias_attr=False)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.norm1(x)))
+        out = self.conv2(self.relu(self.norm2(out)))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        from ...ops.manipulation import concat
+        return concat([x, out], axis=1)
+
+
+class _Transition(nn.Layer):
+    def __init__(self, num_input, num_output):
+        super().__init__()
+        self.norm = nn.BatchNorm2D(num_input)
+        self.relu = nn.ReLU()
+        self.conv = nn.Conv2D(num_input, num_output, 1, bias_attr=False)
+        self.pool = nn.AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.norm(x))))
+
+
+_CFG = {
+    121: (6, 12, 24, 16),
+    161: (6, 12, 36, 24),
+    169: (6, 12, 32, 32),
+    201: (6, 12, 48, 32),
+    264: (6, 12, 64, 48),
+}
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, growth_rate=32, bn_size=4, dropout=0.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        block_config = _CFG[layers]
+        if layers == 161:
+            growth_rate = 48
+        num_init = 2 * growth_rate
+        self.features = nn.Sequential(
+            nn.Conv2D(3, num_init, 7, stride=2, padding=3, bias_attr=False),
+            nn.BatchNorm2D(num_init), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        ch = num_init
+        self.blocks = nn.LayerList()
+        self.transitions = nn.LayerList()
+        for i, n in enumerate(block_config):
+            block = nn.Sequential(*[
+                _DenseLayer(ch + j * growth_rate, growth_rate, bn_size,
+                            dropout) for j in range(n)])
+            self.blocks.append(block)
+            ch += n * growth_rate
+            if i != len(block_config) - 1:
+                self.transitions.append(_Transition(ch, ch // 2))
+                ch //= 2
+        self.norm_final = nn.BatchNorm2D(ch)
+        self.relu = nn.ReLU()
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        for i, block in enumerate(self.blocks):
+            x = block(x)
+            if i < len(self.transitions):
+                x = self.transitions[i](x)
+        x = self.relu(self.norm_final(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ...ops.manipulation import flatten
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+def densenet121(pretrained=False, **kw):
+    return DenseNet(121, **kw)
+
+
+def densenet161(pretrained=False, **kw):
+    return DenseNet(161, **kw)
+
+
+def densenet169(pretrained=False, **kw):
+    return DenseNet(169, **kw)
+
+
+def densenet201(pretrained=False, **kw):
+    return DenseNet(201, **kw)
+
+
+def densenet264(pretrained=False, **kw):
+    return DenseNet(264, **kw)

@@ -1,0 +1,79 @@
+"""GoogLeNet / InceptionV1 (parity: python/paddle/vision/models/googlenet.py)."""
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import concat, flatten
+
+
+class _BasicConv(nn.Layer):
+    def __init__(self, inp, oup, k, **kw):
+        super().__init__()
+        self.conv = nn.Conv2D(inp, oup, k, bias_attr=False, **kw)
+        self.bn = nn.BatchNorm2D(oup)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class _Inception(nn.Layer):
+    def __init__(self, inp, c1, c3r, c3, c5r, c5, pp):
+        super().__init__()
+        self.b1 = _BasicConv(inp, c1, 1)
+        self.b2 = nn.Sequential(_BasicConv(inp, c3r, 1),
+                                _BasicConv(c3r, c3, 3, padding=1))
+        self.b3 = nn.Sequential(_BasicConv(inp, c5r, 1),
+                                _BasicConv(c5r, c5, 5, padding=2))
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, stride=1, padding=1),
+                                _BasicConv(inp, pp, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)],
+                      axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            _BasicConv(3, 64, 7, stride=2, padding=3),
+            nn.MaxPool2D(3, stride=2, ceil_mode=True),
+            _BasicConv(64, 64, 1),
+            _BasicConv(64, 192, 3, padding=1),
+            nn.MaxPool2D(3, stride=2, ceil_mode=True))
+        self.ince3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.ince3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, stride=2, ceil_mode=True)
+        self.ince4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.ince4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.ince4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.ince4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.ince4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, stride=2, ceil_mode=True)
+        self.ince5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.ince5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool5 = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.2)
+            self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.ince3b(self.ince3a(x)))
+        x = self.ince4e(self.ince4d(self.ince4c(self.ince4b(self.ince4a(x)))))
+        x = self.pool4(x)
+        x = self.ince5b(self.ince5a(x))
+        if self.with_pool:
+            x = self.pool5(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(flatten(x, 1)))
+        # reference returns (out, aux1, aux2); aux heads are train-only and
+        # omitted here (None placeholders keep the tuple contract)
+        return x, None, None
+
+
+def googlenet(pretrained=False, **kw):
+    return GoogLeNet(**kw)

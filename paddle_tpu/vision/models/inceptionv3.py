@@ -1,0 +1,133 @@
+"""InceptionV3 (parity: python/paddle/vision/models/inceptionv3.py)."""
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import concat, flatten
+
+
+class _Conv(nn.Layer):
+    def __init__(self, inp, oup, k, **kw):
+        super().__init__()
+        self.conv = nn.Conv2D(inp, oup, k, bias_attr=False, **kw)
+        self.bn = nn.BatchNorm2D(oup)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class _InceptionA(nn.Layer):
+    def __init__(self, inp, pool_features):
+        super().__init__()
+        self.b1 = _Conv(inp, 64, 1)
+        self.b5 = nn.Sequential(_Conv(inp, 48, 1), _Conv(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(_Conv(inp, 64, 1), _Conv(64, 96, 3, padding=1),
+                                _Conv(96, 96, 3, padding=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _Conv(inp, pool_features, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)], 1)
+
+
+class _InceptionB(nn.Layer):
+    def __init__(self, inp):
+        super().__init__()
+        self.b3 = _Conv(inp, 384, 3, stride=2)
+        self.b3d = nn.Sequential(_Conv(inp, 64, 1), _Conv(64, 96, 3, padding=1),
+                                 _Conv(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b3d(x), self.pool(x)], 1)
+
+
+class _InceptionC(nn.Layer):
+    def __init__(self, inp, c7):
+        super().__init__()
+        self.b1 = _Conv(inp, 192, 1)
+        self.b7 = nn.Sequential(
+            _Conv(inp, c7, 1), _Conv(c7, c7, (1, 7), padding=(0, 3)),
+            _Conv(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = nn.Sequential(
+            _Conv(inp, c7, 1), _Conv(c7, c7, (7, 1), padding=(3, 0)),
+            _Conv(c7, c7, (1, 7), padding=(0, 3)),
+            _Conv(c7, c7, (7, 1), padding=(3, 0)),
+            _Conv(c7, 192, (1, 7), padding=(0, 3)))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _Conv(inp, 192, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b7(x), self.b7d(x), self.bp(x)], 1)
+
+
+class _InceptionD(nn.Layer):
+    def __init__(self, inp):
+        super().__init__()
+        self.b3 = nn.Sequential(_Conv(inp, 192, 1), _Conv(192, 320, 3, stride=2))
+        self.b7 = nn.Sequential(
+            _Conv(inp, 192, 1), _Conv(192, 192, (1, 7), padding=(0, 3)),
+            _Conv(192, 192, (7, 1), padding=(3, 0)),
+            _Conv(192, 192, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b7(x), self.pool(x)], 1)
+
+
+class _InceptionE(nn.Layer):
+    def __init__(self, inp):
+        super().__init__()
+        self.b1 = _Conv(inp, 320, 1)
+        self.b3_stem = _Conv(inp, 384, 1)
+        self.b3_a = _Conv(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _Conv(384, 384, (3, 1), padding=(1, 0))
+        self.b3d_stem = nn.Sequential(_Conv(inp, 448, 1),
+                                      _Conv(448, 384, 3, padding=1))
+        self.b3d_a = _Conv(384, 384, (1, 3), padding=(0, 1))
+        self.b3d_b = _Conv(384, 384, (3, 1), padding=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _Conv(inp, 192, 1))
+
+    def forward(self, x):
+        s = self.b3_stem(x)
+        d = self.b3d_stem(x)
+        return concat([self.b1(x),
+                       concat([self.b3_a(s), self.b3_b(s)], 1),
+                       concat([self.b3d_a(d), self.b3d_b(d)], 1),
+                       self.bp(x)], 1)
+
+
+class InceptionV3(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            _Conv(3, 32, 3, stride=2), _Conv(32, 32, 3),
+            _Conv(32, 64, 3, padding=1), nn.MaxPool2D(3, stride=2),
+            _Conv(64, 80, 1), _Conv(80, 192, 3), nn.MaxPool2D(3, stride=2))
+        self.blocks = nn.Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64), _InceptionA(288, 64),
+            _InceptionB(288),
+            _InceptionC(768, 128), _InceptionC(768, 160),
+            _InceptionC(768, 160), _InceptionC(768, 192),
+            _InceptionD(768),
+            _InceptionE(1280), _InceptionE(2048))
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.5)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(flatten(x, 1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kw):
+    return InceptionV3(**kw)

@@ -1,0 +1,49 @@
+"""MobileNetV1 (parity: python/paddle/vision/models/mobilenetv1.py)."""
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import flatten
+
+
+def _dw_sep(inp, oup, stride):
+    return nn.Sequential(
+        nn.Conv2D(inp, inp, 3, stride=stride, padding=1, groups=inp,
+                  bias_attr=False),
+        nn.BatchNorm2D(inp), nn.ReLU(),
+        nn.Conv2D(inp, oup, 1, bias_attr=False),
+        nn.BatchNorm2D(oup), nn.ReLU())
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        s = lambda c: max(8, int(c * scale))
+        cfg = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+               (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+               (1024, 1)]
+        layers = [nn.Sequential(
+            nn.Conv2D(3, s(32), 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(s(32)), nn.ReLU())]
+        inp = s(32)
+        for c, st in cfg:
+            layers.append(_dw_sep(inp, s(c), st))
+            inp = s(c)
+        self.features = nn.Sequential(*layers)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(inp, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kw):
+    return MobileNetV1(scale=scale, **kw)

@@ -1,0 +1,139 @@
+"""MobileNetV3 (parity: python/paddle/vision/models/mobilenetv3.py)."""
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import flatten
+
+
+def _make_divisible(v, divisor=8):
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class _SE(nn.Layer):
+    def __init__(self, ch, squeeze=4):
+        super().__init__()
+        mid = _make_divisible(ch // squeeze)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(ch, mid, 1)
+        self.fc2 = nn.Conv2D(mid, ch, 1)
+        self.relu = nn.ReLU()
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, inp, exp, oup, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and inp == oup
+        Act = nn.Hardswish if act == "hardswish" else nn.ReLU
+        layers = []
+        if exp != inp:
+            layers += [nn.Conv2D(inp, exp, 1, bias_attr=False),
+                       nn.BatchNorm2D(exp), Act()]
+        layers += [nn.Conv2D(exp, exp, k, stride=stride, padding=k // 2,
+                             groups=exp, bias_attr=False),
+                   nn.BatchNorm2D(exp)]
+        if use_se:
+            layers.append(_SE(exp))
+        layers += [Act(), nn.Conv2D(exp, oup, 1, bias_attr=False),
+                   nn.BatchNorm2D(oup)]
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+_LARGE = [
+    # k, exp, out, se, act, stride
+    (3, 16, 16, False, "relu", 1),
+    (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1),
+    (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1),
+    (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2),
+    (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1),
+    (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2),
+    (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+
+_SMALL = [
+    (3, 16, 16, True, "relu", 2),
+    (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1),
+    (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1),
+    (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2),
+    (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+
+class MobileNetV3(nn.Layer):
+    def __init__(self, config, last_channel, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        sc = lambda c: _make_divisible(c * scale)
+        self.conv = nn.Sequential(
+            nn.Conv2D(3, sc(16), 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(sc(16)), nn.Hardswish())
+        blocks = []
+        inp = sc(16)
+        for k, exp, out, se, act, st in config:
+            blocks.append(_InvertedResidual(inp, sc(exp), sc(out), k, st, se,
+                                            act))
+            inp = sc(out)
+        self.blocks = nn.Sequential(*blocks)
+        lastconv = sc(config[-1][1])
+        self.conv_last = nn.Sequential(
+            nn.Conv2D(inp, lastconv, 1, bias_attr=False),
+            nn.BatchNorm2D(lastconv), nn.Hardswish())
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(lastconv, last_channel), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.conv_last(self.blocks(self.conv(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+class MobileNetV3Large(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_LARGE, 1280, scale, num_classes, with_pool)
+
+
+class MobileNetV3Small(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_SMALL, 1024, scale, num_classes, with_pool)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kw):
+    return MobileNetV3Large(scale=scale, **kw)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kw):
+    return MobileNetV3Small(scale=scale, **kw)

@@ -1,0 +1,117 @@
+"""ShuffleNetV2 (parity: python/paddle/vision/models/shufflenetv2.py)."""
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import concat, flatten, reshape, transpose
+
+
+def channel_shuffle(x, groups):
+    B, C, H, W = x.shape
+    x = reshape(x, [B, groups, C // groups, H, W])
+    x = transpose(x, [0, 2, 1, 3, 4])
+    return reshape(x, [B, C, H, W])
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, inp, oup, stride):
+        super().__init__()
+        self.stride = stride
+        branch = oup // 2
+        if stride > 1:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(inp, inp, 3, stride=stride, padding=1, groups=inp,
+                          bias_attr=False),
+                nn.BatchNorm2D(inp),
+                nn.Conv2D(inp, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), nn.ReLU())
+        inp2 = inp if stride > 1 else branch
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(inp2, branch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch), nn.ReLU(),
+            nn.Conv2D(branch, branch, 3, stride=stride, padding=1,
+                      groups=branch, bias_attr=False),
+            nn.BatchNorm2D(branch),
+            nn.Conv2D(branch, branch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch), nn.ReLU())
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+_CFG = {
+    "x0.25": ([4, 8, 4], [24, 24, 48, 96, 512]),
+    "x0.33": ([4, 8, 4], [24, 32, 64, 128, 512]),
+    "x0.5": ([4, 8, 4], [24, 48, 96, 192, 1024]),
+    "x1.0": ([4, 8, 4], [24, 116, 232, 464, 1024]),
+    "x1.5": ([4, 8, 4], [24, 176, 352, 704, 1024]),
+    "x2.0": ([4, 8, 4], [24, 244, 488, 976, 2048]),
+}
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale="x1.0", act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        repeats, channels = _CFG[scale]
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, channels[0], 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(channels[0]), nn.ReLU())
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        inp = channels[0]
+        for i, (r, c) in enumerate(zip(repeats, channels[1:4])):
+            blocks = [_InvertedResidual(inp, c, 2)]
+            blocks += [_InvertedResidual(c, c, 1) for _ in range(r - 1)]
+            stages.append(nn.Sequential(*blocks))
+            inp = c
+        self.stages = nn.LayerList(stages)
+        self.conv_last = nn.Sequential(
+            nn.Conv2D(inp, channels[-1], 1, bias_attr=False),
+            nn.BatchNorm2D(channels[-1]), nn.ReLU())
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(channels[-1], num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        for s in self.stages:
+            x = s(x)
+        x = self.conv_last(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return ShuffleNetV2("x0.25", **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    return ShuffleNetV2("x0.33", **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return ShuffleNetV2("x0.5", **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return ShuffleNetV2("x1.0", **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return ShuffleNetV2("x1.5", **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return ShuffleNetV2("x2.0", **kw)

@@ -1,0 +1,222 @@
+"""paddle.vision.ops parity — detection ops.
+
+Reference: python/paddle/vision/ops.py (nms, roi_align, roi_pool, box_coder,
+deform_conv2d, distribute_fpn_proposals, PSRoIPool...).
+TPU-native: roi_align/roi_pool are gather+interpolate einsums (jit-able,
+static shapes); nms is host-side (dynamic output size — not a jit path, same
+as the reference's eager usage).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops.creation import _t
+from ..ops.dispatch import apply
+
+__all__ = ["nms", "roi_align", "roi_pool", "box_coder", "box_area", "box_iou",
+           "distribute_fpn_proposals"]
+
+
+def box_area(boxes):
+    def fn(b):
+        return (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return apply("box_area", fn, _t(boxes))
+
+
+def box_iou(boxes1, boxes2):
+    def fn(a, b):
+        area1 = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+        area2 = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+        lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+        rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / (area1[:, None] + area2[None, :] - inter)
+    return apply("box_iou", fn, _t(boxes1), _t(boxes2))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy NMS (host-side; parity: vision/ops.py nms)."""
+    b = np.asarray(_t(boxes)._value, np.float32)
+    n = len(b)
+    s = (np.asarray(_t(scores)._value, np.float32) if scores is not None
+         else np.arange(n, 0, -1, dtype=np.float32))
+    cats = (np.asarray(_t(category_idxs)._value) if category_idxs is not None
+            else np.zeros(n, np.int64))
+
+    keep_all = []
+    for c in np.unique(cats):
+        idx = np.where(cats == c)[0]
+        order = idx[np.argsort(-s[idx])]
+        kept = []
+        while len(order):
+            i = order[0]
+            kept.append(i)
+            if len(order) == 1:
+                break
+            rest = order[1:]
+            xx1 = np.maximum(b[i, 0], b[rest, 0])
+            yy1 = np.maximum(b[i, 1], b[rest, 1])
+            xx2 = np.minimum(b[i, 2], b[rest, 2])
+            yy2 = np.minimum(b[i, 3], b[rest, 3])
+            inter = np.clip(xx2 - xx1, 0, None) * np.clip(yy2 - yy1, 0, None)
+            a_i = (b[i, 2] - b[i, 0]) * (b[i, 3] - b[i, 1])
+            a_r = (b[rest, 2] - b[rest, 0]) * (b[rest, 3] - b[rest, 1])
+            iou = inter / (a_i + a_r - inter + 1e-10)
+            order = rest[iou <= iou_threshold]
+        keep_all.extend(kept)
+    keep = np.asarray(sorted(keep_all, key=lambda i: -s[i]), np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep))
+
+
+def _bilinear_sample(feat, y, x):
+    """feat [C,H,W]; y/x arbitrary same-shaped grids → [C, *grid]."""
+    C, H, W = feat.shape
+    y0 = jnp.clip(jnp.floor(y), 0, H - 1)
+    x0 = jnp.clip(jnp.floor(x), 0, W - 1)
+    y1 = jnp.clip(y0 + 1, 0, H - 1)
+    x1 = jnp.clip(x0 + 1, 0, W - 1)
+    ly, lx = y - y0, x - x0
+    y0i, y1i, x0i, x1i = (v.astype(jnp.int32) for v in (y0, y1, x0, x1))
+
+    def g(yi, xi):
+        return feat[:, yi, xi]
+
+    v = (g(y0i, x0i) * (1 - ly) * (1 - lx) + g(y0i, x1i) * (1 - ly) * lx
+         + g(y1i, x0i) * ly * (1 - lx) + g(y1i, x1i) * ly * lx)
+    return v
+
+
+def roi_align(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """parity: vision/ops.py roi_align. x [N,C,H,W], boxes [R,4] (x1y1x2y2),
+    boxes_num [N] → [R, C, out, out]."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    ratio = sampling_ratio if sampling_ratio > 0 else 2
+
+    bn = (np.asarray(_t(boxes_num)._value) if boxes_num is not None
+          else np.asarray([_t(boxes).shape[0]]))
+    batch_of_box = np.repeat(np.arange(len(bn)), bn)
+
+    def fn(xv, bv):
+        off = 0.5 if aligned else 0.0
+
+        def one(args):
+            bidx, box = args
+            feat = xv[bidx]
+            x1, y1, x2, y2 = box * spatial_scale - off
+            rw = jnp.maximum(x2 - x1, 1e-3)
+            rh = jnp.maximum(y2 - y1, 1e-3)
+            bh, bw = rh / oh, rw / ow
+            ys = (y1 + bh * (jnp.arange(oh)[:, None, None, None] +
+                             (jnp.arange(ratio)[None, :, None, None] + 0.5) / ratio))
+            xs = (x1 + bw * (jnp.arange(ow)[None, None, :, None] +
+                             (jnp.arange(ratio)[None, None, None, :] + 0.5) / ratio))
+            yg = jnp.broadcast_to(ys, (oh, ratio, ow, ratio))
+            xg = jnp.broadcast_to(xs, (oh, ratio, ow, ratio))
+            v = _bilinear_sample(feat, yg, xg)         # [C, oh, r, ow, r]
+            return jnp.mean(v, axis=(2, 4))            # [C, oh, ow]
+
+        bidx_arr = jnp.asarray(batch_of_box)
+        return jax.vmap(lambda i, b: one((i, b)))(bidx_arr, bv)
+
+    return apply("roi_align", fn, _t(x), _t(boxes))
+
+
+def roi_pool(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
+             name=None):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    bn = (np.asarray(_t(boxes_num)._value) if boxes_num is not None
+          else np.asarray([_t(boxes).shape[0]]))
+    batch_of_box = np.repeat(np.arange(len(bn)), bn)
+
+    def fn(xv, bv):
+        N, C, H, W = xv.shape
+
+        def one(bidx, box):
+            feat = xv[bidx]
+            x1 = jnp.floor(box[0] * spatial_scale)
+            y1 = jnp.floor(box[1] * spatial_scale)
+            x2 = jnp.ceil(box[2] * spatial_scale)
+            y2 = jnp.ceil(box[3] * spatial_scale)
+            rh = jnp.maximum(y2 - y1, 1.0) / oh
+            rw = jnp.maximum(x2 - x1, 1.0) / ow
+            # dense grid max-pool approximation with 4 samples per bin
+            ys = y1 + rh * (jnp.arange(oh)[:, None, None, None]
+                            + jnp.asarray([0.25, 0.75])[None, :, None, None])
+            xs = x1 + rw * (jnp.arange(ow)[None, None, :, None]
+                            + jnp.asarray([0.25, 0.75])[None, None, None, :])
+            yg = jnp.broadcast_to(ys, (oh, 2, ow, 2))
+            xg = jnp.broadcast_to(xs, (oh, 2, ow, 2))
+            v = _bilinear_sample(feat, yg, xg)
+            return jnp.max(v, axis=(2, 4))
+
+        return jax.vmap(one)(jnp.asarray(batch_of_box), bv)
+
+    return apply("roi_pool", fn, _t(x), _t(boxes))
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    """parity: vision/ops.py box_coder (SSD-style box encode/decode)."""
+    def fn(pb, tb, pbv=None):
+        norm = 0.0 if box_normalized else 1.0
+        pw = pb[:, 2] - pb[:, 0] + norm
+        ph = pb[:, 3] - pb[:, 1] + norm
+        pcx = pb[:, 0] + pw * 0.5
+        pcy = pb[:, 1] + ph * 0.5
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + norm
+            th = tb[:, 3] - tb[:, 1] + norm
+            tcx = tb[:, 0] + tw * 0.5
+            tcy = tb[:, 1] + th * 0.5
+            out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
+                             jnp.log(tw / pw), jnp.log(th / ph)], -1)
+            return out / pbv if pbv is not None else out
+        # decode
+        d = tb * pbv if pbv is not None else tb
+        cx = d[..., 0] * pw + pcx
+        cy = d[..., 1] * ph + pcy
+        w = jnp.exp(d[..., 2]) * pw
+        h = jnp.exp(d[..., 3]) * ph
+        return jnp.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - norm, cy + h / 2 - norm], -1)
+
+    if prior_box_var is None:
+        return apply("box_coder", fn, _t(prior_box), _t(target_box))
+    return apply("box_coder", fn, _t(prior_box), _t(target_box),
+                 _t(prior_box_var))
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign RoIs to FPN levels by scale (host-side split)."""
+    rois = np.asarray(_t(fpn_rois)._value, np.float32)
+    w = rois[:, 2] - rois[:, 0]
+    h = rois[:, 3] - rois[:, 1]
+    scale = np.sqrt(np.clip(w * h, 1e-6, None))
+    lvl = np.floor(refer_level + np.log2(scale / refer_scale + 1e-8))
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, idxs = [], []
+    for l in range(min_level, max_level + 1):
+        sel = np.where(lvl == l)[0]
+        outs.append(Tensor(jnp.asarray(rois[sel])))
+        idxs.append(sel)
+    order = np.argsort(np.concatenate(idxs)) if idxs else np.zeros(0)
+    restore = Tensor(jnp.asarray(order.astype(np.int32)[:, None]))
+    nums = [Tensor(jnp.asarray(np.asarray([len(i)], np.int32))) for i in idxs]
+    return outs, restore, nums

@@ -1,0 +1,71 @@
+"""OpTest harness — numeric-gradient checking for framework ops.
+
+Parity: test/legacy_test/op_test.py:418 (check_output vs numpy reference
+:2881; check_grad vs central-difference numeric gradients :3075, tolerances
+via white lists). TPU note: checks run in f32 on the CPU test backend; the
+production bf16 path is covered by model-level tests.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def check_output(op: Callable, inputs: Sequence[np.ndarray],
+                 reference: Callable, atol=1e-5, rtol=1e-5, **op_kwargs):
+    """op(*Tensors, **kwargs) vs reference(*numpy arrays)."""
+    ts = [paddle.to_tensor(x) for x in inputs]
+    out = op(*ts, **op_kwargs)
+    ref = reference(*inputs)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    refs = ref if isinstance(ref, (tuple, list)) else [ref]
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(o.numpy(), r, atol=atol, rtol=rtol)
+
+
+def check_grad(op: Callable, inputs: Sequence[np.ndarray],
+               grad_input_idx: Sequence[int] = (0,), eps=1e-3, atol=1e-2,
+               rtol=1e-2, reduce_fn=None, **op_kwargs):
+    """Analytic grads (tape backward) vs central-difference numeric grads.
+
+    reduce_fn maps the op output to a scalar (default: sum of all outputs).
+    """
+    def scalar(*arrs):
+        ts = [paddle.to_tensor(a, stop_gradient=(i not in grad_input_idx))
+              for i, a in enumerate(arrs)]
+        out = op(*ts, **op_kwargs)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        if reduce_fn is not None:
+            return reduce_fn(*outs), ts
+        total = None
+        for o in outs:
+            s = o.sum()
+            total = s if total is None else total + s
+        return total, ts
+
+    loss, ts = scalar(*inputs)
+    loss.backward()
+
+    for idx in grad_input_idx:
+        analytic = ts[idx].grad.numpy()
+        x = inputs[idx]
+        numeric = np.zeros_like(x, dtype=np.float64)
+        flat = x.reshape(-1)
+        num_flat = numeric.reshape(-1)
+        for i in range(flat.size):
+            xp = x.copy().reshape(-1)
+            xm = x.copy().reshape(-1)
+            xp[i] += eps
+            xm[i] -= eps
+            args_p = list(inputs)
+            args_m = list(inputs)
+            args_p[idx] = xp.reshape(x.shape)
+            args_m[idx] = xm.reshape(x.shape)
+            lp, _ = scalar(*args_p)
+            lm, _ = scalar(*args_m)
+            num_flat[i] = (float(lp.item()) - float(lm.item())) / (2 * eps)
+        np.testing.assert_allclose(analytic, numeric, atol=atol, rtol=rtol,
+                                   err_msg=f"grad mismatch on input {idx}")

@@ -1,0 +1,132 @@
+"""Domain long-tail: fft, signal, geometric, audio, quantization, asp,
+launch CLI (SURVEY.md §2.5 package inventory parity)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_fft_roundtrip():
+    x = paddle.to_tensor(np.random.default_rng(0).normal(size=(4, 32)).astype(np.float32))
+    X = paddle.fft.fft(x)
+    back = paddle.fft.ifft(X)
+    np.testing.assert_allclose(np.asarray(back._value).real, x.numpy(),
+                               atol=1e-5)
+    Xr = paddle.fft.rfft(x)
+    assert Xr.shape == [4, 17]
+    np.testing.assert_allclose(np.asarray(paddle.fft.irfft(Xr)._value),
+                               x.numpy(), atol=1e-5)
+
+
+def test_stft_istft_roundtrip():
+    x = paddle.to_tensor(
+        np.sin(np.linspace(0, 80 * np.pi, 2048)).astype(np.float32)[None])
+    spec = paddle.signal.stft(x, n_fft=256, hop_length=64)
+    assert spec.shape[1] == 129
+    back = paddle.signal.istft(spec, n_fft=256, hop_length=64,
+                               length=2048)
+    np.testing.assert_allclose(back.numpy()[0, 200:1800],
+                               x.numpy()[0, 200:1800], atol=1e-3)
+
+
+def test_geometric_segment_and_message_passing():
+    data = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+    seg = paddle.to_tensor(np.array([0, 0, 1], np.int32))
+    s = paddle.geometric.segment_sum(data, seg)
+    np.testing.assert_allclose(s.numpy(), [[4.0, 6.0], [5.0, 6.0]])
+    m = paddle.geometric.segment_mean(data, seg)
+    np.testing.assert_allclose(m.numpy(), [[2.0, 3.0], [5.0, 6.0]])
+
+    x = paddle.to_tensor([[1.0], [2.0], [3.0]])
+    src = paddle.to_tensor(np.array([0, 1, 2], np.int32))
+    dst = paddle.to_tensor(np.array([1, 2, 1], np.int32))
+    out = paddle.geometric.send_u_recv(x, src, dst, reduce_op="sum",
+                                       out_size=3)
+    np.testing.assert_allclose(out.numpy(), [[0.0], [4.0], [2.0]])
+
+
+def test_audio_features():
+    sr = 16000
+    t = np.linspace(0, 1, sr, dtype=np.float32)
+    wave = paddle.to_tensor(np.sin(2 * np.pi * 440 * t)[None])
+    mel = paddle.audio.MelSpectrogram(sr=sr, n_fft=512, n_mels=32)(wave)
+    assert mel.shape[1] == 32
+    mfcc = paddle.audio.MFCC(sr=sr, n_mfcc=13, n_fft=512, n_mels=32)(wave)
+    assert mfcc.shape[1] == 13
+    # 440 Hz should dominate the right mel bin region
+    m = mel.numpy()[0].mean(-1)
+    assert np.isfinite(m).all() and m.max() > 0
+
+
+def test_quantization_fake_quant_ste():
+    from paddle_tpu.quantization import fake_quant
+
+    x = paddle.to_tensor(np.linspace(-1, 1, 11).astype(np.float32),
+                         stop_gradient=False)
+    scale = paddle.to_tensor(1.0)
+    y = fake_quant(x, scale, bits=8)
+    err = np.abs(y.numpy() - x.numpy()).max()
+    assert err <= 1.0 / 127 + 1e-6  # quantization error bound
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones(11), atol=1e-6)  # STE
+
+
+def test_quantization_qat_wrap():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.quantization import (FakeQuanterWithAbsMax, QAT,
+                                         QuantConfig)
+
+    model = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 2))
+    cfg = QuantConfig()
+    cfg.add_type_config(nn.Linear, activation=FakeQuanterWithAbsMax,
+                        weight=FakeQuanterWithAbsMax)
+    q = QAT(cfg).quantize(model)
+    out = q(paddle.to_tensor(np.random.default_rng(0).normal(
+        size=(4, 8)).astype(np.float32)))
+    assert out.shape == [4, 2]
+
+
+def test_asp_prune_and_decorate():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.incubate import asp
+
+    lin = nn.Linear(16, 16)
+    masks = asp.prune_model(lin, n=2, m=4)
+    assert masks
+    d = asp.calculate_density(lin.weight)
+    assert abs(d - 0.5) < 0.01
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+    opt = asp.decorate(opt)
+    x = paddle.to_tensor(np.random.default_rng(0).normal(
+        size=(4, 16)).astype(np.float32))
+    loss = (lin(x) ** 2).sum()
+    loss.backward()
+    opt.step()
+    assert abs(asp.calculate_density(lin.weight) - 0.5) < 0.01  # still 2:4
+
+
+def test_launch_cli(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, sys\n"
+        "rank = os.environ['PADDLE_TRAINER_ID']\n"
+        "n = os.environ['PADDLE_TRAINERS_NUM']\n"
+        "eps = os.environ['PADDLE_TRAINER_ENDPOINTS'].split(',')\n"
+        "assert len(eps) == int(n)\n"
+        "open(os.path.join(os.path.dirname(__file__), f'out{rank}'), 'w').write(n)\n")
+    from paddle_tpu.distributed.launch import launch
+
+    rc = launch(str(script), nproc_per_node=3)
+    assert rc == 0
+    for r in range(3):
+        assert (tmp_path / f"out{r}").read_text() == "3"
+
+
+def test_viterbi_decode():
+    pot = paddle.to_tensor(np.array(
+        [[[1.0, 0.0], [0.0, 2.0], [1.5, 0.0]]], np.float32))
+    trans = paddle.to_tensor(np.zeros((2, 2), np.float32))
+    scores, path = paddle.text.viterbi_decode(pot, trans)
+    assert path.shape == [1, 3]
+    np.testing.assert_array_equal(path.numpy()[0], [0, 1, 0])

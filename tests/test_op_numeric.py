@@ -1,0 +1,95 @@
+"""Sample op corpus checks through the OpTest harness (parity shape:
+test/legacy_test op tests — numpy reference + numeric gradients)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from op_test import check_grad, check_output
+
+rng = np.random.default_rng(0)
+
+
+def test_matmul():
+    a = rng.normal(size=(3, 4)).astype(np.float32)
+    b = rng.normal(size=(4, 5)).astype(np.float32)
+    check_output(paddle.matmul, [a, b], lambda x, y: x @ y)
+    check_grad(paddle.matmul, [a, b], grad_input_idx=(0, 1))
+
+
+def test_tanh_exp_log():
+    x = rng.uniform(0.5, 2.0, size=(8,)).astype(np.float32)
+    check_output(paddle.tanh, [x], np.tanh)
+    check_grad(paddle.tanh, [x])
+    check_output(paddle.exp, [x], np.exp)
+    check_grad(paddle.exp, [x])
+    check_output(paddle.log, [x], np.log)
+    check_grad(paddle.log, [x])
+
+
+def test_softmax():
+    x = rng.normal(size=(4, 7)).astype(np.float32)
+
+    def ref(v):
+        e = np.exp(v - v.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+
+    check_output(F.softmax, [x], ref)
+    check_grad(F.softmax, [x])
+
+
+def test_mean_sum_reductions():
+    x = rng.normal(size=(3, 5)).astype(np.float32)
+    check_output(paddle.mean, [x], lambda v: v.mean())
+    check_output(lambda t: paddle.sum(t, axis=1), [x],
+                 lambda v: v.sum(1))
+    check_grad(lambda t: paddle.mean(t), [x])
+
+
+def test_layer_norm():
+    x = rng.normal(size=(4, 8)).astype(np.float32)
+    w = rng.normal(size=(8,)).astype(np.float32)
+    b = rng.normal(size=(8,)).astype(np.float32)
+
+    def ref(xv, wv, bv):
+        mu = xv.mean(-1, keepdims=True)
+        var = xv.var(-1, keepdims=True)
+        return (xv - mu) / np.sqrt(var + 1e-5) * wv + bv
+
+    check_output(lambda xt, wt, bt: F.layer_norm(xt, [8], wt, bt),
+                 [x, w, b], ref, atol=1e-4)
+    check_grad(lambda xt, wt, bt: F.layer_norm(xt, [8], wt, bt),
+               [x, w, b], grad_input_idx=(0, 1, 2))
+
+
+def test_conv2d():
+    x = rng.normal(size=(1, 2, 8, 8)).astype(np.float32)
+    w = rng.normal(size=(3, 2, 3, 3)).astype(np.float32)
+
+    def ref(xv, wv):
+        out = np.zeros((1, 3, 6, 6), np.float32)
+        for o in range(3):
+            for i in range(6):
+                for j in range(6):
+                    out[0, o, i, j] = np.sum(
+                        xv[0, :, i:i + 3, j:j + 3] * wv[o])
+        return out
+
+    check_output(F.conv2d, [x, w], ref, atol=1e-4)
+    check_grad(F.conv2d, [x, w], grad_input_idx=(0, 1), atol=5e-2, rtol=5e-2)
+
+
+def test_sigmoid_gelu():
+    x = rng.normal(size=(10,)).astype(np.float32)
+    check_output(F.sigmoid, [x], lambda v: 1 / (1 + np.exp(-v)))
+    check_grad(F.sigmoid, [x])
+    check_grad(F.gelu, [x])
+
+
+def test_broadcast_add_mul():
+    a = rng.normal(size=(3, 1, 5)).astype(np.float32)
+    b = rng.normal(size=(4, 1)).astype(np.float32)
+    check_output(paddle.add, [a, b], lambda x, y: x + y)
+    check_grad(paddle.add, [a, b], grad_input_idx=(0, 1))
+    check_output(paddle.multiply, [a, b], lambda x, y: x * y)
+    check_grad(paddle.multiply, [a, b], grad_input_idx=(0, 1))

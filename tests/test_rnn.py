@@ -1,0 +1,81 @@
+"""RNN layers (parity: python/paddle/nn/layer/rnn.py, test/rnn/).
+LSTM/GRU numerics are checked against torch's CPU reference implementation —
+the same gate equations the reference's cudnn kernels implement."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_lstm_matches_torch():
+    torch = pytest.importorskip("torch")
+    B, T, I, H = 2, 5, 4, 8
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(B, T, I)).astype(np.float32)
+
+    tm = torch.nn.LSTM(I, H, num_layers=1, batch_first=True)
+    m = nn.LSTM(I, H, num_layers=1)
+    sd = {k: v.detach().numpy() for k, v in tm.state_dict().items()}
+    m.weight_ih_l0_d0._replace_value(np.asarray(sd["weight_ih_l0"]))
+    m.weight_hh_l0_d0._replace_value(np.asarray(sd["weight_hh_l0"]))
+    m.bias_ih_l0_d0._replace_value(np.asarray(sd["bias_ih_l0"]))
+    m.bias_hh_l0_d0._replace_value(np.asarray(sd["bias_hh_l0"]))
+
+    # gate-order note: torch packs [i, f, g, o] — ours matches
+    y, (h, c) = m(paddle.to_tensor(x))
+    ty, (th, tc) = tm(torch.from_numpy(x))
+    np.testing.assert_allclose(y.numpy(), ty.detach().numpy(), atol=1e-5)
+    np.testing.assert_allclose(h.numpy(), th.detach().numpy(), atol=1e-5)
+    np.testing.assert_allclose(c.numpy(), tc.detach().numpy(), atol=1e-5)
+
+
+def test_gru_matches_torch():
+    torch = pytest.importorskip("torch")
+    B, T, I, H = 2, 5, 4, 8
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(B, T, I)).astype(np.float32)
+    tm = torch.nn.GRU(I, H, num_layers=1, batch_first=True)
+    m = nn.GRU(I, H, num_layers=1)
+    sd = {k: v.detach().numpy() for k, v in tm.state_dict().items()}
+    m.weight_ih_l0_d0._replace_value(np.asarray(sd["weight_ih_l0"]))
+    m.weight_hh_l0_d0._replace_value(np.asarray(sd["weight_hh_l0"]))
+    m.bias_ih_l0_d0._replace_value(np.asarray(sd["bias_ih_l0"]))
+    m.bias_hh_l0_d0._replace_value(np.asarray(sd["bias_hh_l0"]))
+    y, h = m(paddle.to_tensor(x))
+    ty, th = tm(torch.from_numpy(x))
+    np.testing.assert_allclose(y.numpy(), ty.detach().numpy(), atol=1e-5)
+
+
+def test_bidirectional_multilayer_shapes_and_grads():
+    x = paddle.to_tensor(
+        np.random.default_rng(0).normal(size=(3, 7, 8)).astype(np.float32),
+        stop_gradient=False)
+    for cls, nstate in ((nn.SimpleRNN, 1), (nn.LSTM, 2), (nn.GRU, 1)):
+        m = cls(8, 16, num_layers=2, direction="bidirect")
+        y, state = m(x)
+        assert y.shape == [3, 7, 32]
+        hs = state[0] if nstate == 2 else state
+        assert hs.shape == [4, 3, 16]  # layers * directions
+        y.mean().backward()
+        assert m.weight_ih_l0_d0.grad is not None
+        x.clear_grad() if hasattr(x, "clear_grad") else None
+
+
+def test_rnn_cell_wrappers():
+    x = paddle.to_tensor(
+        np.random.default_rng(2).normal(size=(2, 5, 8)).astype(np.float32))
+    rnn = nn.RNN(nn.LSTMCell(8, 16))
+    y, (h, c) = rnn(x)
+    assert y.shape == [2, 5, 16] and h.shape == [2, 16]
+    bi = nn.BiRNN(nn.GRUCell(8, 16), nn.GRUCell(8, 16))
+    y2, _ = bi(x)
+    assert y2.shape == [2, 5, 32]
+
+
+def test_time_major():
+    x = paddle.to_tensor(
+        np.random.default_rng(3).normal(size=(5, 2, 8)).astype(np.float32))
+    m = nn.GRU(8, 16, time_major=True)
+    y, h = m(x)
+    assert y.shape == [5, 2, 16]

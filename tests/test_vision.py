@@ -1,0 +1,71 @@
+"""Vision model zoo + detection ops (parity: python/paddle/vision/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models, ops
+
+
+def _img(n=1, s=64):
+    return paddle.to_tensor(
+        np.random.default_rng(0).normal(size=(n, 3, s, s)).astype(np.float32))
+
+
+@pytest.mark.parametrize("ctor,classes", [
+    (lambda: models.mobilenet_v1(num_classes=10), 10),
+    (lambda: models.mobilenet_v3_small(num_classes=10), 10),
+    (lambda: models.densenet121(num_classes=10), 10),
+    (lambda: models.squeezenet1_1(num_classes=10), 10),
+    (lambda: models.shufflenet_v2_x0_25(num_classes=10), 10),
+])
+def test_model_forward(ctor, classes):
+    m = ctor()
+    m.eval()
+    out = m(_img(1, 64))
+    assert out.shape == [1, classes]
+
+
+def test_googlenet_and_inception():
+    g = models.googlenet(num_classes=10)
+    g.eval()
+    out, aux1, aux2 = g(_img(1, 96))
+    assert out.shape == [1, 10]
+    iv = models.inception_v3(num_classes=10)
+    iv.eval()
+    out = iv(_img(1, 299))
+    assert out.shape == [1, 10]
+
+
+def test_nms():
+    boxes = paddle.to_tensor(np.array([
+        [0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]], np.float32))
+    scores = paddle.to_tensor(np.array([0.9, 0.8, 0.7], np.float32))
+    keep = ops.nms(boxes, iou_threshold=0.5, scores=scores)
+    np.testing.assert_array_equal(np.sort(keep.numpy()), [0, 2])
+
+
+def test_box_iou_and_area():
+    a = paddle.to_tensor(np.array([[0, 0, 10, 10]], np.float32))
+    b = paddle.to_tensor(np.array([[0, 0, 10, 10], [5, 5, 15, 15]],
+                                  np.float32))
+    iou = ops.box_iou(a, b)
+    np.testing.assert_allclose(iou.numpy()[0, 0], 1.0)
+    np.testing.assert_allclose(iou.numpy()[0, 1], 25.0 / 175.0, rtol=1e-5)
+    np.testing.assert_allclose(ops.box_area(b).numpy(), [100.0, 100.0])
+
+
+def test_roi_align_uniform_feature():
+    # constant feature map → every aligned cell equals the constant
+    x = paddle.to_tensor(np.full((1, 2, 16, 16), 3.0, np.float32))
+    boxes = paddle.to_tensor(np.array([[2.0, 2.0, 10.0, 10.0]], np.float32))
+    out = ops.roi_align(x, boxes, output_size=4)
+    assert out.shape == [1, 2, 4, 4]
+    np.testing.assert_allclose(out.numpy(), 3.0, rtol=1e-5)
+
+
+def test_box_coder_roundtrip():
+    prior = paddle.to_tensor(np.array([[0, 0, 10, 10]], np.float32))
+    target = paddle.to_tensor(np.array([[2, 2, 8, 9]], np.float32))
+    enc = ops.box_coder(prior, None, target, code_type="encode_center_size")
+    dec = ops.box_coder(prior, None, enc, code_type="decode_center_size")
+    np.testing.assert_allclose(dec.numpy(), target.numpy(), atol=1e-4)
