@@ -78,6 +78,8 @@ struct StoreServer {
   int port = 0;
   std::thread accept_thread;
   std::vector<std::thread> client_threads;
+  std::vector<int> client_fds;
+  std::mutex fds_mu;
   std::mutex mu;
   std::condition_variable cv;
   std::map<std::string, std::string> kv;
@@ -163,6 +165,10 @@ struct StoreServer {
           ::close(fd);
           break;
         }
+        {
+          std::lock_guard<std::mutex> g(fds_mu);
+          client_fds.push_back(fd);
+        }
         client_threads.emplace_back(&StoreServer::handle_client, this, fd);
       }
     });
@@ -174,6 +180,11 @@ struct StoreServer {
     cv.notify_all();
     ::shutdown(listen_fd, SHUT_RDWR);
     ::close(listen_fd);
+    {
+      // unblock handler threads parked in recv on live connections
+      std::lock_guard<std::mutex> g(fds_mu);
+      for (int fd : client_fds) ::shutdown(fd, SHUT_RDWR);
+    }
     if (accept_thread.joinable()) accept_thread.join();
     for (auto& t : client_threads)
       if (t.joinable()) t.join();

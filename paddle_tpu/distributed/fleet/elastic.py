@@ -1,0 +1,121 @@
+"""Elastic training manager.
+
+Parity: python/paddle/distributed/fleet/elastic/manager.py:125 ElasticManager
+— pods register in etcd, membership watches (:248-313), fault-level restart,
+np range scale-up/down; plus the launcher watcher/heartbeat
+(launch/controllers/master.py:253).
+
+TPU-native: the rendezvous substrate is the native TCPStore
+(csrc/ptpu_runtime.cpp) instead of etcd — pods heartbeat a key, the manager
+scans for missing/new pods and reports membership changes so the launcher can
+restart the job (the reference's pod-level restart policy). On real pods this
+sits next to jax.distributed's own failure detection.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable, List, Optional
+
+from ..store import TCPStore
+
+__all__ = ["ElasticManager", "ElasticStatus"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """Membership tracking over a TCPStore.
+
+    Each pod calls ``register`` + periodic ``heartbeat``; one pod (the
+    master) runs ``watch`` which detects joins/leaves and invokes
+    ``on_change(alive_pods)`` — the reference's scale-up/down hook."""
+
+    def __init__(self, store: Optional[TCPStore] = None, host="127.0.0.1",
+                 port: int = 0, is_master=False, np_range=(1, 64),
+                 heartbeat_interval: float = 1.0, timeout: float = 5.0):
+        self.store = store or TCPStore(host, port, is_master=is_master)
+        self.min_np, self.max_np = np_range
+        self.heartbeat_interval = heartbeat_interval
+        self.timeout = timeout
+        self._stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._watch_thread: Optional[threading.Thread] = None
+        self.pod_id: Optional[str] = None
+
+    # -- pod side --------------------------------------------------------
+    def register(self, pod_id: str, endpoint: str = "") -> None:
+        self.pod_id = pod_id
+        ids = self._pods()
+        if pod_id not in ids:
+            ids.append(pod_id)
+            self.store.set("elastic/pods", json.dumps(sorted(ids)))
+        self.heartbeat()
+
+    def heartbeat(self) -> None:
+        assert self.pod_id is not None
+        self.store.set(f"elastic/hb/{self.pod_id}", str(time.time()))
+
+    def start_heartbeat(self) -> None:
+        def loop():
+            while not self._stop.is_set():
+                self.heartbeat()
+                self._stop.wait(self.heartbeat_interval)
+
+        self._hb_thread = threading.Thread(target=loop, daemon=True)
+        self._hb_thread.start()
+
+    def deregister(self) -> None:
+        if self.pod_id:
+            ids = [i for i in self._pods() if i != self.pod_id]
+            self.store.set("elastic/pods", json.dumps(sorted(ids)))
+
+    # -- master side -----------------------------------------------------
+    def _pods(self) -> List[str]:
+        raw = self.store.get("elastic/pods")
+        return json.loads(raw) if raw else []
+
+    def alive_pods(self) -> List[str]:
+        now = time.time()
+        alive = []
+        for pid in self._pods():
+            hb = self.store.get(f"elastic/hb/{pid}")
+            if hb is not None and now - float(hb) <= self.timeout:
+                alive.append(pid)
+        return alive
+
+    def watch(self, on_change: Callable[[List[str]], None],
+              poll: float = 0.5) -> None:
+        """Blocking watch loop (run in a thread): calls on_change whenever
+        the alive-set changes; returns when stop() is called."""
+        prev = set(self.alive_pods())
+        while not self._stop.is_set():
+            cur = set(self.alive_pods())
+            if cur != prev:
+                on_change(sorted(cur))
+                prev = cur
+            self._stop.wait(poll)
+
+    def start_watch(self, on_change) -> None:
+        self._watch_thread = threading.Thread(
+            target=self.watch, args=(on_change,), daemon=True)
+        self._watch_thread.start()
+
+    def should_scale(self) -> Optional[str]:
+        n = len(self.alive_pods())
+        if n < self.min_np:
+            return ElasticStatus.HOLD
+        return None
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in (self._hb_thread, self._watch_thread):
+            if t is not None:
+                t.join(2)

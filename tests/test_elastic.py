@@ -1,0 +1,43 @@
+"""Elastic manager over the native TCPStore (parity:
+fleet/elastic/manager.py membership watch + heartbeat)."""
+import time
+
+import pytest
+
+from paddle_tpu.lib import native_available
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="native runtime unavailable")
+
+
+def test_membership_and_failure_detection():
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager
+    from paddle_tpu.distributed.store import TCPStore
+
+    master_store = TCPStore(is_master=True)
+    mgr = ElasticManager(store=master_store, timeout=1.0)
+
+    pods = []
+    for i in range(3):
+        p = ElasticManager(store=TCPStore(port=master_store.port),
+                           heartbeat_interval=0.2, timeout=1.0)
+        p.register(f"pod{i}")
+        p.start_heartbeat()
+        pods.append(p)
+
+    time.sleep(0.5)
+    assert sorted(mgr.alive_pods()) == ["pod0", "pod1", "pod2"]
+
+    changes = []
+    mgr.start_watch(lambda alive: changes.append(alive))
+    pods[1].stop()  # pod1 dies (heartbeat stops)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if any("pod1" not in c for c in changes):
+            break
+        time.sleep(0.2)
+    assert any("pod1" not in c for c in changes), changes
+
+    for p in pods:
+        p.stop()
+    mgr.stop()
