@@ -1,0 +1,135 @@
+"""paddle.distributed.rpc parity — simple RPC between workers.
+
+Reference: python/paddle/distributed/rpc/rpc.py (init_rpc, rpc_sync,
+rpc_async, shutdown — over the brpc agent in fluid/distributed/rpc).
+
+TPU-native/host-side: a lightweight pickle-over-TCP RPC using the native
+TCPStore for service discovery. Suitable for control-plane coordination
+(the data plane is XLA collectives); functions must be importable at the
+callee (module-level), mirroring the reference's requirement.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Dict, Optional
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown", "get_worker_info",
+           "get_all_worker_infos", "WorkerInfo"]
+
+
+class WorkerInfo:
+    def __init__(self, name, rank, ip, port):
+        self.name, self.rank, self.ip, self.port = name, rank, ip, port
+
+    def __repr__(self):
+        return f"WorkerInfo(name={self.name}, rank={self.rank}, " \
+               f"ip={self.ip}, port={self.port})"
+
+
+_state: Dict[str, Any] = {}
+
+
+def _send_msg(sock, obj):
+    data = pickle.dumps(obj)
+    sock.sendall(struct.pack("<I", len(data)) + data)
+
+
+def _recv_msg(sock):
+    hdr = b""
+    while len(hdr) < 4:
+        c = sock.recv(4 - len(hdr))
+        if not c:
+            raise ConnectionError("closed")
+        hdr += c
+    (n,) = struct.unpack("<I", hdr)
+    buf = b""
+    while len(buf) < n:
+        c = sock.recv(min(65536, n - len(buf)))
+        if not c:
+            raise ConnectionError("closed")
+        buf += c
+    return pickle.loads(buf)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            fn, args, kwargs = _recv_msg(self.request)
+            try:
+                result = (True, fn(*args, **kwargs))
+            except Exception as e:  # deliver remote exceptions
+                result = (False, e)
+            _send_msg(self.request, result)
+        except ConnectionError:
+            pass
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None) -> None:
+    """parity: dist.rpc.init_rpc. master_endpoint 'host:port' hosts the
+    discovery store (rank 0 serves it)."""
+    from .store import TCPStore
+
+    host, port = (master_endpoint.split(":") if master_endpoint
+                  else ("127.0.0.1", "0"))
+    is_master = (rank or 0) == 0
+    store = TCPStore(host, int(port), is_master=is_master,
+                     world_size=world_size or 1)
+
+    server = _Server(("127.0.0.1", 0), _Handler)
+    sport = server.server_address[1]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+
+    store.set(f"rpc/worker/{name}",
+              pickle.dumps(WorkerInfo(name, rank or 0, "127.0.0.1", sport)))
+    store.add("rpc/registered", 1)
+
+    _state.update(dict(name=name, rank=rank or 0,
+                       world_size=world_size or 1, store=store,
+                       server=server, thread=thread,
+                       pool=concurrent.futures.ThreadPoolExecutor(8)))
+
+
+def get_worker_info(name: str) -> WorkerInfo:
+    raw = _state["store"].wait(f"rpc/worker/{name}")
+    return pickle.loads(raw)
+
+
+def get_all_worker_infos():
+    # best effort: workers register under known names only
+    return [get_worker_info(_state["name"])]
+
+
+def rpc_sync(to: str, fn, args=(), kwargs=None, timeout=30.0):
+    info = get_worker_info(to)
+    with socket.create_connection((info.ip, info.port), timeout) as s:
+        _send_msg(s, (fn, tuple(args), kwargs or {}))
+        ok, payload = _recv_msg(s)
+    if not ok:
+        raise payload
+    return payload
+
+
+def rpc_async(to: str, fn, args=(), kwargs=None, timeout=30.0):
+    return _state["pool"].submit(rpc_sync, to, fn, args, kwargs, timeout)
+
+
+def shutdown() -> None:
+    if not _state:
+        return
+    _state["server"].shutdown()
+    _state["pool"].shutdown(wait=False)
+    _state["store"].close()
+    _state.clear()

@@ -1,2 +1,5 @@
 """paddle_tpu.incubate (parity: python/paddle/incubate/)."""
 from . import nn  # noqa: F401
+
+from . import asp  # noqa: F401,E402
+from . import autograd  # noqa: F401,E402

@@ -1,0 +1,77 @@
+"""paddle.incubate.autograd parity — forward-mode AD + functional transforms.
+
+Reference: python/paddle/incubate/autograd/ (primapi — jvp/forward_grad,
+transpose rules; functional jvp/vjp). TPU-native: jax.jvp/jax.linearize ARE
+the forward-mode engine; these wrappers keep the Tensor API surface.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+__all__ = ["jvp", "vjp", "forward_grad", "enable_prim", "disable_prim",
+           "prim_enabled"]
+
+_prim = False
+
+
+def enable_prim():
+    """parity: paddle.incubate.autograd.enable_prim — in the reference this
+    switches autodiff to composite primitives; here jax always differentiates
+    through primitives, so this is a recorded no-op."""
+    global _prim
+    _prim = True
+
+
+def disable_prim():
+    global _prim
+    _prim = False
+
+
+def prim_enabled() -> bool:
+    return _prim
+
+
+def _to_vals(xs):
+    seq = xs if isinstance(xs, (list, tuple)) else [xs]
+    return [x._value if isinstance(x, Tensor) else jnp.asarray(x) for x in seq]
+
+
+def _wrap(fn: Callable):
+    def pure(*vals):
+        outs = fn(*[Tensor(v, stop_gradient=False) for v in vals])
+        seq = outs if isinstance(outs, (list, tuple)) else [outs]
+        return tuple(o._value if isinstance(o, Tensor) else o for o in seq)
+    return pure
+
+
+def jvp(func: Callable, xs, v=None):
+    """Forward-mode: returns (outputs, jvp-products)
+    (parity: incubate/autograd/functional.py jvp)."""
+    vals = _to_vals(xs)
+    tangents = (_to_vals(v) if v is not None
+                else [jnp.ones_like(x) for x in vals])
+    outs, tangent_out = jax.jvp(_wrap(func), tuple(vals), tuple(tangents))
+    mk = lambda t: tuple(Tensor(o) for o in t) if len(t) > 1 else Tensor(t[0])
+    return mk(outs), mk(tangent_out)
+
+
+def vjp(func: Callable, xs, v=None):
+    """Reverse-mode pullback (parity: functional.py vjp)."""
+    vals = _to_vals(xs)
+    outs, pullback = jax.vjp(_wrap(func), *vals)
+    cots = (_to_vals(v) if v is not None
+            else [jnp.ones_like(o) for o in outs])
+    grads = pullback(tuple(cots))
+    mk = lambda t: tuple(Tensor(o) for o in t) if len(t) > 1 else Tensor(t[0])
+    return mk(outs), mk(grads)
+
+
+def forward_grad(func: Callable, xs, v=None):
+    """Alias of jvp's tangent output (parity: primapi.forward_grad)."""
+    _, tang = jvp(func, xs, v)
+    return tang

@@ -452,3 +452,111 @@ def flops_per_token(config: LlamaConfig, seq_len: int) -> float:
 def _abstract_params(config: LlamaConfig):
     return jax.eval_shape(
         functools.partial(init_params, config), jax.random.PRNGKey(0))
+
+
+# ---------------------------------------------------------------------------
+# inference: KV-cache decode + generation
+# (the reference's decode path: fused block_multihead_attention decode
+#  kernels — phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu,
+#  incubate/nn/functional/block_multihead_attention; here: static-shape KV
+#  cache ring with masked attention — jit compiles one decode step)
+# ---------------------------------------------------------------------------
+
+def init_kv_cache(config: LlamaConfig, batch: int, max_len: int):
+    c = config
+    shape = (c.num_layers, batch, max_len, c.num_kv_heads, c.head_dim)
+    return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype),
+            "pos": jnp.zeros((), jnp.int32)}
+
+
+def _cached_attention(q, k_cache, v_cache, pos, config: LlamaConfig):
+    """q: [B, S_new, Hq, D]; caches: [B, max_len, Hkv, D]; valid keys < pos +
+    S_new with causality inside the new block."""
+    c = config
+    B, S, Hq, D = q.shape
+    groups = Hq // c.num_kv_heads
+    k = jnp.repeat(k_cache, groups, axis=2)
+    v = jnp.repeat(v_cache, groups, axis=2)
+    scale = 1.0 / math.sqrt(D)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    max_len = k.shape[1]
+    key_idx = jnp.arange(max_len)[None, :]
+    qry_idx = pos + jnp.arange(S)[:, None]
+    mask = key_idx <= qry_idx                        # [S, max_len]
+    s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def forward_with_cache(params, tokens, cache, config: LlamaConfig):
+    """Append `tokens` [B, S_new] to the cache, return (logits_last, cache).
+    Works for prefill (S_new = prompt len) and decode (S_new = 1)."""
+    c = config
+    dt = c.dtype
+    B, S = tokens.shape
+    pos = cache["pos"]
+    x = params["embed"].astype(dt)[tokens]
+    max_len = cache["k"].shape[2]
+    # rope tables over absolute positions pos..pos+S
+    ang_pos = (pos + jnp.arange(S)).astype(jnp.float32)
+    freq = c.rope_theta ** (-jnp.arange(0, c.head_dim, 2, jnp.float32)
+                            / c.head_dim)
+    ang = ang_pos[:, None] * freq[None, :]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+
+    # python loop over layers (decode is matmul-small; L is static and the
+    # cache-threading stays explicit)
+    new_k, new_v = [], []
+    for l in range(c.num_layers):
+        p = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
+        hn = _rms_norm(x, p["attn_norm"], c.rms_eps)
+        q = (hn @ p["wq"].astype(dt)).reshape(B, S, c.num_heads, c.head_dim)
+        k = (hn @ p["wk"].astype(dt)).reshape(B, S, c.num_kv_heads, c.head_dim)
+        v = (hn @ p["wv"].astype(dt)).reshape(B, S, c.num_kv_heads, c.head_dim)
+        q = _apply_rope(q, cos, sin)
+        k = _apply_rope(k, cos, sin)
+        kc = jax.lax.dynamic_update_slice(
+            cache["k"][l], k, (0, pos, 0, 0))
+        vc = jax.lax.dynamic_update_slice(
+            cache["v"][l], v, (0, pos, 0, 0))
+        new_k.append(kc)
+        new_v.append(vc)
+        att = _cached_attention(q, kc, vc, pos, c)
+        x = x + att.reshape(B, S, c.num_heads * c.head_dim) @ p["wo"].astype(dt)
+        hn = _rms_norm(x, p["mlp_norm"], c.rms_eps)
+        gate = jax.nn.silu(hn @ p["w_gate"].astype(dt))
+        x = x + (gate * (hn @ p["w_up"].astype(dt))) @ p["w_down"].astype(dt)
+
+    x = _rms_norm(x, params["final_norm"], c.rms_eps)
+    head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
+    logits = (x[:, -1] @ head.astype(dt)).astype(jnp.float32)
+    cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v), "pos": pos + S}
+    return logits, cache
+
+
+def generate(params, prompt_tokens, config: LlamaConfig, max_new_tokens: int,
+             temperature: float = 0.0, key=None, eos_token_id=None):
+    """Greedy (temperature=0) or sampled generation with a jitted decode
+    step. prompt_tokens: [B, S_prompt] → [B, S_prompt + max_new_tokens]."""
+    B, S0 = prompt_tokens.shape
+    max_len = S0 + max_new_tokens
+    cache = init_kv_cache(config, B, max_len)
+
+    prefill = jax.jit(functools.partial(forward_with_cache, config=config))
+    logits, cache = prefill(params, prompt_tokens, cache)
+
+    decode = jax.jit(functools.partial(forward_with_cache, config=config))
+    out = [prompt_tokens]
+    key = key if key is not None else jax.random.PRNGKey(0)
+    for i in range(max_new_tokens):
+        if temperature > 0:
+            key, sub = jax.random.split(key)
+            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        nxt = nxt[:, None].astype(prompt_tokens.dtype)
+        out.append(nxt)
+        if i + 1 < max_new_tokens:
+            logits, cache = decode(params, nxt, cache)
+    return jnp.concatenate(out, axis=1)

@@ -1,0 +1,75 @@
+"""Inference predictor (jit.save → StableHLO → Predictor), forward-mode AD,
+RPC (parity: paddle.inference, incubate.autograd, distributed.rpc)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_predictor_roundtrip(tmp_path):
+    from paddle_tpu import inference
+    from paddle_tpu.jit import InputSpec
+
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    model.eval()
+    x = np.random.default_rng(0).normal(size=(3, 4)).astype(np.float32)
+    want = model(paddle.to_tensor(x)).numpy()
+
+    path = str(tmp_path / "model")
+    paddle.jit.save(model, path, input_spec=[InputSpec([3, 4], "float32")])
+
+    cfg = inference.Config(path)
+    pred = inference.create_predictor(cfg)
+    out = pred.run([x])
+    np.testing.assert_allclose(out[0], want, rtol=1e-5)
+
+    # zero-copy handle path
+    h = pred.get_input_handle(pred.get_input_names()[0])
+    h.copy_from_cpu(x)
+    pred.run()
+    np.testing.assert_allclose(
+        pred.get_output_handle("out0").copy_to_cpu(), want, rtol=1e-5)
+
+
+def test_forward_mode_jvp():
+    from paddle_tpu.incubate import autograd as iag
+
+    def f(x):
+        return paddle.tanh(x * 2)
+
+    x = paddle.to_tensor(np.array([0.3, -0.5], np.float32))
+    v = paddle.to_tensor(np.array([1.0, 0.5], np.float32))
+    out, tang = iag.jvp(f, x, v)
+    expect = (1 - np.tanh(np.array([0.6, -1.0])) ** 2) * 2 * np.array([1.0, 0.5])
+    np.testing.assert_allclose(tang.numpy(), expect, rtol=1e-5)
+
+    out, grads = iag.vjp(f, x)
+    np.testing.assert_allclose(
+        grads.numpy(), (1 - np.tanh(np.array([0.6, -1.0])) ** 2) * 2,
+        rtol=1e-5)
+
+
+def _double(x):
+    return x * 2
+
+
+def _boom():
+    raise ValueError("remote boom")
+
+
+def test_rpc_roundtrip():
+    from paddle_tpu.lib import native_available
+    if not native_available():
+        pytest.skip("native runtime unavailable")
+    from paddle_tpu.distributed import rpc
+
+    rpc.init_rpc("worker0", rank=0, world_size=1)
+    try:
+        assert rpc.rpc_sync("worker0", _double, args=(21,)) == 42
+        fut = rpc.rpc_async("worker0", _double, args=(5,))
+        assert fut.result(10) == 10
+        with pytest.raises(ValueError, match="remote boom"):
+            rpc.rpc_sync("worker0", _boom)
+    finally:
+        rpc.shutdown()
