@@ -67,6 +67,7 @@ from . import audio  # noqa: F401
 from . import text  # noqa: F401
 from . import quantization  # noqa: F401
 from . import inference  # noqa: F401
+from . import utils  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 from .nn.layer.layers import Layer  # noqa: F401
 

@@ -153,3 +153,113 @@ def fused_dropout_add(x, y, p=0.0, training=True, mode="upscale_in_train",
     from ....nn import functional as F
 
     return F.dropout(x, p, training=training, mode=mode) + y
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               sequence_lengths=None, rotary_tensor=None,
+                               beam_cache_offset=None, qkv_out_scale=None,
+                               out_shift=None, out_smooth=None, seq_len=1,
+                               rotary_emb_dims=0, use_neox_rotary_style=False,
+                               compute_dtype="default", **kw):
+    """Single-token decode attention over a KV cache (parity:
+    incubate/nn/functional/masked_multihead_attention — the reference's
+    fused decode kernel). x: [B, 3*H*D] packed qkv for ONE step;
+    cache_kv: [2, B, H, max_len, D]; sequence_lengths: [B] current lengths.
+    Returns (out [B, H*D], updated cache_kv)."""
+    import jax
+    import jax.numpy as jnp
+    import math as _math
+
+    from ....core.tensor import Tensor
+    from ....ops.creation import _t
+    from ....ops.dispatch import apply
+
+    def fn(xv, cache, seqlens):
+        B = xv.shape[0]
+        _, _, H, max_len, D = cache.shape
+        qkv = xv.reshape(B, 3, H, D)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        pos = seqlens.astype(jnp.int32)                      # [B]
+        bidx = jnp.arange(B)
+        kc = cache[0].at[bidx, :, pos].set(k)                # [B,H,max,D]
+        vc = cache[1].at[bidx, :, pos].set(v)
+        s = jnp.einsum("bhd,bhkd->bhk", q, kc,
+                       preferred_element_type=jnp.float32)
+        s = s / _math.sqrt(D)
+        mask = jnp.arange(max_len)[None, None, :] <= pos[:, None, None]
+        s = jnp.where(mask, s, -1e30)
+        p = jax.nn.softmax(s, -1).astype(vc.dtype)
+        out = jnp.einsum("bhk,bhkd->bhd", p, vc)
+        return out.reshape(B, H * D), jnp.stack([kc, vc])
+
+    seqlens = sequence_lengths if sequence_lengths is not None else None
+    out, new_cache = apply("masked_multihead_attention", fn, _t(x),
+                           _t(cache_kv), _t(seqlens))
+    return out, new_cache
+
+
+def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
+                              seq_lens_decoder, seq_lens_this_time,
+                              padding_offsets=None, cum_offsets=None,
+                              cu_seqlens_q=None, cu_seqlens_k=None,
+                              block_tables=None, max_seq_len=None, **kw):
+    """Blocked KV-cache attention for batched decode (parity:
+    incubate/nn/functional/block_multihead_attention — the reference's paged
+    decode kernel over cutlass). Simplified contract: qkv [B, 3, H, D] one
+    step per sequence; caches [B, H, max_len, D]; seq_lens_decoder [B]."""
+    import jax
+    import jax.numpy as jnp
+    import math as _math
+
+    from ....ops.creation import _t
+    from ....ops.dispatch import apply
+
+    def fn(qkvv, kc, vc, lens):
+        B, _, H, D = qkvv.shape
+        q, k, v = qkvv[:, 0], qkvv[:, 1], qkvv[:, 2]
+        pos = lens.astype(jnp.int32)
+        bidx = jnp.arange(B)
+        kc = kc.at[bidx, :, pos].set(k)
+        vc = vc.at[bidx, :, pos].set(v)
+        s = jnp.einsum("bhd,bhkd->bhk", q, kc,
+                       preferred_element_type=jnp.float32) / _math.sqrt(D)
+        mask = jnp.arange(kc.shape[2])[None, None, :] <= pos[:, None, None]
+        s = jnp.where(mask, s, -1e30)
+        p = jax.nn.softmax(s, -1).astype(vc.dtype)
+        out = jnp.einsum("bhk,bhkd->bhd", p, vc)
+        return out, kc, vc
+
+    return apply("block_multihead_attention", fn, _t(qkv), _t(key_cache),
+                 _t(value_cache), _t(seq_lens_decoder))
+
+
+def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
+              ffn2_bias=None, quant_method="None", moe_topk=2, norm_topk_prob=True,
+              **kw):
+    """Fused MoE FFN (parity: incubate/nn/functional/fused_moe.py:75 over the
+    cutlass grouped-GEMM kernels). x: [T, h]; gate_weight [h, E];
+    ffn1_weight [E, h, 2f] (gate+up packed) or [E, h, f]; ffn2 [E, f, h]."""
+    import jax
+    import jax.numpy as jnp
+
+    from ....core.tensor import Tensor
+    from ....models.moe import MoEConfig, moe_ffn
+    from ....ops.creation import _t
+    from ....ops.dispatch import apply
+
+    def fn(xv, gw, w1, w2):
+        E = gw.shape[-1]
+        f2 = w1.shape[-1]
+        if f2 % 2 == 0:
+            gate_w, up_w = w1[..., :f2 // 2], w1[..., f2 // 2:]
+        else:
+            gate_w = up_w = w1
+        cfg = MoEConfig(num_experts=E, top_k=moe_topk,
+                        hidden_size=xv.shape[-1],
+                        moe_intermediate_size=w2.shape[1],
+                        capacity_factor=float(E))
+        y, _aux = moe_ffn(xv, gw, gate_w, up_w, w2, cfg)
+        return y
+
+    return apply("fused_moe", fn, _t(x), _t(gate_weight), _t(ffn1_weight),
+                 _t(ffn2_weight))

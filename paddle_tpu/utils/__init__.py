@@ -1,0 +1,61 @@
+"""paddle.utils parity — dlpack interchange, import/download helpers,
+deprecation, unique names.
+
+Reference: python/paddle/utils/ (dlpack.py, lazy_import/try_import,
+deprecated decorator, unique_name, download; cpp_extension JIT-builds custom
+C++ ops — here the native extension story is csrc/ + ctypes, see
+paddle_tpu/lib, so cpp_extension exposes load() over the same g++ path).
+"""
+from __future__ import annotations
+
+import functools
+import itertools
+import warnings
+
+from . import dlpack  # noqa: F401
+from . import unique_name  # noqa: F401
+from . import cpp_extension  # noqa: F401
+
+__all__ = ["deprecated", "try_import", "run_check", "dlpack", "unique_name",
+           "cpp_extension"]
+
+
+def deprecated(update_to="", since="", reason="", level=1):
+    """parity: paddle.utils.deprecated decorator."""
+    def wrap(fn):
+        @functools.wraps(fn)
+        def inner(*a, **kw):
+            msg = f"API {fn.__module__}.{fn.__name__} is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f", use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*a, **kw)
+        return inner
+    return wrap
+
+
+def try_import(module_name, err_msg=None):
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or f"optional dependency {module_name!r} is not installed")
+
+
+def run_check():
+    """parity: paddle.utils.run_check — verifies the device works."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((128, 128))
+    y = jax.jit(lambda a: a @ a)(x)
+    dev = jax.devices()[0]
+    print(f"paddle_tpu works on {dev.platform}:{dev.id} "
+          f"({getattr(dev, 'device_kind', '?')}); matmul checksum "
+          f"{float(y.sum()):.0f}")

@@ -1,0 +1,37 @@
+"""DLPack interchange (parity: python/paddle/utils/dlpack.py).
+
+Modern protocol: ``to_dlpack`` returns a carrier exposing
+``__dlpack__``/``__dlpack_device__`` (consumable by jax, torch, numpy, cupy);
+``from_dlpack`` accepts any such object (or a framework Tensor/array).
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+class _DLPackCarrier:
+    """Single-use carrier implementing the DLPack exchange protocol."""
+
+    def __init__(self, arr):
+        self._arr = arr
+
+    def __dlpack__(self, *args, **kwargs):
+        return self._arr.__dlpack__(*args, **kwargs)
+
+    def __dlpack_device__(self):
+        return self._arr.__dlpack_device__()
+
+
+def to_dlpack(x):
+    val = x._value if isinstance(x, Tensor) else x
+    return _DLPackCarrier(val)
+
+
+def from_dlpack(obj) -> Tensor:
+    if isinstance(obj, Tensor):
+        return obj
+    return Tensor(jax.dlpack.from_dlpack(obj))

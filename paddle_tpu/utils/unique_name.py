@@ -1,0 +1,30 @@
+"""Unique-name generator (parity: python/paddle/utils/unique_name.py)."""
+from __future__ import annotations
+
+import contextlib
+import itertools
+from collections import defaultdict
+
+__all__ = ["generate", "guard", "switch"]
+
+_counters = defaultdict(itertools.count)
+
+
+def generate(key: str) -> str:
+    return f"{key}_{next(_counters[key])}"
+
+
+def switch(new_scope=None):
+    global _counters
+    old = _counters
+    _counters = new_scope if new_scope is not None else defaultdict(itertools.count)
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_scope=None):
+    old = switch(new_scope)
+    try:
+        yield
+    finally:
+        switch(old)

@@ -130,3 +130,58 @@ def test_viterbi_decode():
     scores, path = paddle.text.viterbi_decode(pot, trans)
     assert path.shape == [1, 3]
     np.testing.assert_array_equal(path.numpy()[0], [0, 1, 0])
+
+
+def test_utils_dlpack_roundtrip():
+    x = paddle.to_tensor(np.array([[1.0, 2.0]], np.float32))
+    cap = paddle.utils.dlpack.to_dlpack(x)
+    y = paddle.utils.dlpack.from_dlpack(cap)
+    np.testing.assert_array_equal(y.numpy(), x.numpy())
+
+
+def test_utils_unique_and_deprecated():
+    a = paddle.utils.unique_name.generate("fc")
+    b = paddle.utils.unique_name.generate("fc")
+    assert a != b
+
+    @paddle.utils.deprecated(update_to="new_fn", since="2.0")
+    def old_fn():
+        return 7
+
+    import warnings as W
+    with W.catch_warnings(record=True) as rec:
+        W.simplefilter("always")
+        assert old_fn() == 7
+    assert any(issubclass(r.category, DeprecationWarning) for r in rec)
+
+
+def test_cpp_extension_load(tmp_path):
+    src = tmp_path / "myop.cpp"
+    src.write_text('extern "C" int triple(int v) { return 3 * v; }\n')
+    lib = paddle.utils.cpp_extension.load(
+        "myop", [str(src)], build_directory=str(tmp_path))
+    assert lib.triple(14) == 42
+
+
+def test_masked_multihead_attention_matches_dense():
+    from paddle_tpu.incubate.nn import functional as IF
+    rng = np.random.default_rng(0)
+    B, H, D, M = 1, 2, 8, 4
+    kcache = rng.normal(size=(B, H, M, D)).astype(np.float32)
+    vcache = rng.normal(size=(B, H, M, D)).astype(np.float32)
+    x = rng.normal(size=(B, 3 * H * D)).astype(np.float32)
+    lens = np.array([2], np.int32)  # two cached tokens, writing slot 2
+    cache = paddle.to_tensor(np.stack([kcache, vcache]))
+    out, nc = IF.masked_multihead_attention(
+        paddle.to_tensor(x), cache,
+        sequence_lengths=paddle.to_tensor(lens))
+    qkv = x.reshape(B, 3, H, D)
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+    kc = kcache.copy(); kc[0, :, 2] = k[0]
+    vc = vcache.copy(); vc[0, :, 2] = v[0]
+    s = np.einsum("bhd,bhkd->bhk", q, kc) / np.sqrt(D)
+    s[..., 3:] = -1e30  # only slots 0..2 valid
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    want = np.einsum("bhk,bhkd->bhd", p, vc).reshape(B, H * D)
+    np.testing.assert_allclose(out.numpy(), want, atol=1e-5)
