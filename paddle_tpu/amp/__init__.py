@@ -134,3 +134,5 @@ def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16"
 from .grad_scaler import GradScaler  # noqa: E402,F401
 
 __all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler"]
+
+from . import debugging  # noqa: F401,E402

@@ -1,0 +1,123 @@
+"""AMP debugging utilities.
+
+Parity: python/paddle/amp/debugging.py — check_numerics, operator stats
+collection (enable/disable_operator_stats_collection, collect_operator_stats)
+and the accuracy-compare workflow. TPU-native: hooks ride the op-dispatch
+path (ops/dispatch.py) — the same place the reference instruments its
+ad_funcs.
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import defaultdict
+from enum import Enum
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..framework import flags as _flags
+
+__all__ = [
+    "DebugMode", "check_numerics", "enable_operator_stats_collection",
+    "disable_operator_stats_collection", "collect_operator_stats",
+    "enable_tensor_checker", "disable_tensor_checker", "TensorCheckerConfig",
+]
+
+
+class DebugMode(Enum):
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 4
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable=True, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None,
+                 skipped_op_list=None, debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = checked_op_list
+        self.skipped_op_list = skipped_op_list
+
+
+def check_numerics(tensor, op_type: str = "", var_name: str = "",
+                   debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT):
+    """Count nan/inf in a tensor; abort per debug_mode (parity:
+    amp/debugging.py check_numerics). Returns (num_nan, num_inf, num_zero)."""
+    v = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    vf = v.astype(jnp.float32) if np.issubdtype(np.dtype(v.dtype), np.floating) else None
+    if vf is None:
+        z = jnp.asarray(0)
+        return Tensor(z), Tensor(z), Tensor(z)
+    n_nan = jnp.sum(jnp.isnan(vf)).astype(jnp.int32)
+    n_inf = jnp.sum(jnp.isinf(vf)).astype(jnp.int32)
+    n_zero = jnp.sum(vf == 0).astype(jnp.int32)
+    if debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT and \
+            (int(n_nan) or int(n_inf)):
+        raise FloatingPointError(
+            f"[check_numerics] op={op_type or '?'} var={var_name or '?'}: "
+            f"{int(n_nan)} nan, {int(n_inf)} inf")
+    return Tensor(n_nan), Tensor(n_inf), Tensor(n_zero)
+
+
+# -- operator stats ---------------------------------------------------------
+
+_collecting = False
+_stats: Dict[str, Dict[str, int]] = defaultdict(lambda: defaultdict(int))
+
+
+def _record_op(name: str, out_vals) -> None:
+    if not _collecting:
+        return
+    for v in out_vals:
+        d = np.dtype(v.dtype)
+        _stats[name][d.name] += 1
+
+
+def enable_operator_stats_collection() -> None:
+    """Start counting per-op dtype calls (parity: the reference's low/high
+    precision op lists report)."""
+    global _collecting
+    _stats.clear()
+    _collecting = True
+
+
+def disable_operator_stats_collection() -> None:
+    """Stop collecting and print the per-dtype op table."""
+    global _collecting
+    _collecting = False
+    print("<" + "-" * 60 + ">")
+    print(f"{'op':<30}{'calls by dtype'}")
+    for op, per in sorted(_stats.items()):
+        row = ", ".join(f"{k}:{v}" for k, v in sorted(per.items()))
+        print(f"{op:<30}{row}")
+    print("<" + "-" * 60 + ">")
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def operator_stats() -> Dict[str, Dict[str, int]]:
+    return {k: dict(v) for k, v in _stats.items()}
+
+
+# -- tensor checker (global nan/inf scan switch) ----------------------------
+
+def enable_tensor_checker(config: TensorCheckerConfig) -> None:
+    """parity: amp/debugging.py enable_tensor_checker — turns on the
+    dispatch-path nan/inf scan (FLAGS_check_nan_inf analogue)."""
+    if config.enable:
+        _flags.set_flags({"check_nan_inf": True})
+
+
+def disable_tensor_checker() -> None:
+    _flags.set_flags({"check_nan_inf": False})

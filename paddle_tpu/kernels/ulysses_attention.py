@@ -1,0 +1,80 @@
+"""Ulysses attention — all-to-all sequence parallelism.
+
+The reference's SEP axis (SURVEY.md §5.7: topology.py:199-260 provides the
+groups; the alltoall-based Ulysses attention itself lives in downstream
+PaddleNLP model code over communication/all_to_all.py). Here it is in-core:
+inside shard_map, an all-to-all swaps the sharded axis from sequence to
+heads, each device computes FULL-sequence attention for its head slice, and
+a second all-to-all swaps back. Complements kernels/ring_attention:
+Ulysses moves activations twice (cheap when heads >= ring size), ring moves
+K/V n-1 times (better for very long sequences / few heads).
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["ulysses_attention", "ulysses_attention_sharded"]
+
+
+def _dense_causal(q, k, v, causal):
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        S = s.shape[-1]
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def ulysses_attention(q, k, v, axis_name: str, axis_size: int,
+                      causal: bool = True):
+    """Per-shard body under shard_map. q/k/v: [B, S_local, H, D] with the
+    sequence axis sharded over axis_name; H must be divisible by axis_size.
+    all_to_all #1: gather sequence, scatter heads → [B, S_full, H_local, D];
+    attention; all_to_all #2: the reverse."""
+    B, S, H, D = q.shape
+    n = axis_size
+    assert H % n == 0, (H, n)
+
+    def seq2head(x):
+        # [B, S, H, D] -> [B, S, n, h, D]: head groups; all-to-all sends each
+        # group to its device while gathering the full sequence
+        x = x.reshape(B, S, n, H // n, D)
+        out = jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                 tiled=True)
+        # tiled all_to_all keeps the split axis (now size 1): [B, S*n, 1, h, D]
+        return out.reshape(B, S * n, H // n, D)
+
+    def head2seq(x):
+        # inverse: [B, S*n, h, D] -> regroup sequence shards then swap back
+        x = x.reshape(B, n, S, H // n, D)
+        out = jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=3,
+                                 tiled=True)
+        return out.reshape(B, S, H, D)
+
+    qf, kf, vf = seq2head(q), seq2head(k), seq2head(v)
+    of = _dense_causal(qf, kf, vf, causal)
+    return head2seq(of)
+
+
+def ulysses_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = "sp",
+                              causal: bool = True,
+                              batch_axis: Optional[str] = "dp"):
+    """Global-array wrapper (q/k/v: [B, S, H, D])."""
+    ba = batch_axis if (batch_axis and batch_axis in mesh.axis_names) else None
+    spec = P(ba, axis_name, None, None)
+    fn = jax.shard_map(
+        functools.partial(ulysses_attention, axis_name=axis_name,
+                          axis_size=dict(mesh.shape)[axis_name],
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)

@@ -98,6 +98,7 @@ def _apply_impl(name, fn, args, kwargs):
         out_vals = run_with(raw_vals)
         outs = tuple(Tensor(v, stop_gradient=True) for v in out_vals)
         _maybe_check_nan_inf(name, out_vals)
+        _maybe_record_stats(name, out_vals)
         return outs, multi_box["multi"]
 
     primal_idx = [i for i, t in enumerate(tensors) if _requires_grad(t)]
@@ -110,6 +111,7 @@ def _apply_impl(name, fn, args, kwargs):
 
     out_vals, vjp_fn = jax.vjp(pure, *[raw_vals[i] for i in primal_idx])
     _maybe_check_nan_inf(name, out_vals)
+    _maybe_record_stats(name, out_vals)
 
     out_metas = [(tuple(v.shape), v.dtype) for v in out_vals]
     primal_tensors = [tensors[i] for i in primal_idx]
@@ -138,6 +140,14 @@ def _edge_for(t):
         accum = AccumulateGrad(t)
         t._accumulate_node = accum
     return (accum, 0)
+
+
+def _maybe_record_stats(name, out_vals):
+    # amp.debugging operator-stats hook (zero-cost when collection is off)
+    from ..amp import debugging as _dbg
+
+    if _dbg._collecting:
+        _dbg._record_op(name, out_vals)
 
 
 def _maybe_check_nan_inf(name, out_vals):
