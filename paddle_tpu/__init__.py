@@ -23,6 +23,20 @@ import jax as _jax
 if _os.environ.get("PADDLE_TPU_X64", "0") == "1":
     _jax.config.update("jax_enable_x64", True)
 
+# Multi-process bootstrap (the PADDLE_* env contract from
+# distributed.launch) must run BEFORE anything touches the XLA backend —
+# importing this package initializes devices, so it happens here rather
+# than in init_parallel_env (which becomes a no-op confirmation).
+if int(_os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1 and \
+        _os.environ.get("PADDLE_MASTER"):
+    try:
+        _jax.distributed.initialize(
+            coordinator_address=_os.environ["PADDLE_MASTER"],
+            num_processes=int(_os.environ["PADDLE_TRAINERS_NUM"]),
+            process_id=int(_os.environ.get("PADDLE_TRAINER_ID", "0")))
+    except RuntimeError:
+        pass  # already initialized (re-import or user-managed)
+
 from .framework import dtype as _dtype_mod
 from .framework.dtype import (  # noqa: F401
     bfloat16, bool_, complex64, complex128, float16, float32, float64,

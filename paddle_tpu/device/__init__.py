@@ -169,3 +169,96 @@ def place_of_array(arr) -> Place:
         return Place(_platform_of(dev), dev.id)
     except Exception:
         return CPUPlace()
+
+
+# -- streams & events -------------------------------------------------------
+# parity: paddle.device.Stream/Event + stream_guard (python/paddle/device/
+# __init__.py, device/cuda/streams.py). XLA owns real stream scheduling on
+# TPU (one compute stream + DMA; the latency-hiding scheduler interleaves
+# collectives), so these objects provide ORDERING semantics only: record/
+# wait/synchronize map to effects barriers, and the "current stream" is a
+# thread-local tag user code can branch on.
+
+import threading as _threading
+import time as _time
+
+
+class Event:
+    """parity: paddle.device.Event — records a point in the issue order."""
+
+    def __init__(self, device=None, enable_timing=False, blocking=False,
+                 interprocess=False):
+        self._recorded = None
+        self._enable_timing = enable_timing
+
+    def record(self, stream=None):
+        jax.effects_barrier()
+        self._recorded = _time.perf_counter()
+
+    def query(self) -> bool:
+        return self._recorded is not None
+
+    def synchronize(self):
+        jax.effects_barrier()
+
+    def elapsed_time(self, end_event) -> float:
+        if self._recorded is None or end_event._recorded is None:
+            raise RuntimeError("both events must be recorded")
+        return (end_event._recorded - self._recorded) * 1000.0
+
+
+class Stream:
+    """parity: paddle.device.Stream — on TPU all work issues onto XLA's
+    stream; wait_event/wait_stream/synchronize provide the ordering API."""
+
+    def __init__(self, device=None, priority=2, blocking=False):
+        self.device = device
+
+    def wait_event(self, event: "Event"):
+        event.synchronize()
+
+    def wait_stream(self, stream: "Stream"):
+        jax.effects_barrier()
+
+    def record_event(self, event: "Event" = None) -> "Event":
+        ev = event or Event()
+        ev.record(self)
+        return ev
+
+    def synchronize(self):
+        jax.effects_barrier()
+
+    def query(self) -> bool:
+        return True
+
+
+_stream_tls = _threading.local()
+
+
+def current_stream(device=None) -> Stream:
+    cur = getattr(_stream_tls, "stream", None)
+    if cur is None:
+        cur = Stream(device)
+        _stream_tls.stream = cur
+    return cur
+
+
+def set_stream(stream: Stream) -> Stream:
+    prev = current_stream()
+    _stream_tls.stream = stream
+    return prev
+
+
+class stream_guard:
+    """parity: paddle.device.stream_guard context manager."""
+
+    def __init__(self, stream: Stream):
+        self._stream = stream
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = set_stream(self._stream)
+        return self._stream
+
+    def __exit__(self, *exc):
+        set_stream(self._prev)

@@ -18,6 +18,7 @@ from __future__ import annotations
 from typing import List, Optional, Sequence, Union
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
@@ -26,7 +27,7 @@ from ...core.tensor import Tensor
 __all__ = [
     "ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
     "shard_tensor", "reshard", "shard_layer", "dtensor_from_fn",
-    "get_mesh", "set_mesh", "DistAttr",
+    "get_mesh", "set_mesh", "DistAttr", "shard_dataloader", "ShardDataloader",
 ]
 
 
@@ -287,3 +288,50 @@ def shard_layer(layer, process_mesh: ProcessMesh, shard_fn=None,
 def get_placements(x: Tensor):
     attr = getattr(x, "_dist_attr", None)
     return attr.placements if attr else None
+
+
+class ShardDataloader:
+    """Wraps a DataLoader so each batch lands sharded on the mesh
+    (parity: dist.shard_dataloader — auto_parallel/api.py:3475: per-rank
+    loaders feeding DistTensors; here one global loader whose batches are
+    device_put with batch-dim sharding over the data axes)."""
+
+    def __init__(self, dataloader, meshes, input_keys=None, shard_dims=None,
+                 is_dataset_splitted=False):
+        self._loader = dataloader
+        self._mesh = meshes[0] if isinstance(meshes, (list, tuple)) else meshes
+        self._shard_dims = shard_dims
+
+    def _place(self, t):
+        mesh = self._mesh
+        axis = self._shard_dims
+        if axis is None:
+            axis = "dp" if "dp" in mesh.dim_names else mesh.dim_names[0]
+        val = t._value if isinstance(t, Tensor) else jnp.asarray(t)
+        n = mesh.get_dim_size(axis) if axis in mesh.dim_names else 1
+        if val.ndim == 0 or n <= 1 or val.shape[0] % n:
+            return t if isinstance(t, Tensor) else Tensor(val)
+        spec = PartitionSpec(axis, *([None] * (val.ndim - 1)))
+        out = Tensor(jax.device_put(
+            val, NamedSharding(mesh.jax_mesh(), spec)))
+        out.stop_gradient = getattr(t, "stop_gradient", True)
+        return out
+
+    def __iter__(self):
+        import jax.numpy as jnp  # noqa: F811
+        for batch in self._loader:
+            if isinstance(batch, (list, tuple)):
+                yield type(batch)(self._place(b) for b in batch)
+            elif isinstance(batch, dict):
+                yield {k: self._place(v) for k, v in batch.items()}
+            else:
+                yield self._place(batch)
+
+    def __len__(self):
+        return len(self._loader)
+
+
+def shard_dataloader(dataloader, meshes, input_keys=None, shard_dims=None,
+                     is_dataset_splitted=False):
+    return ShardDataloader(dataloader, meshes, input_keys, shard_dims,
+                           is_dataset_splitted)

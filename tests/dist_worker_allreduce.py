@@ -1,0 +1,43 @@
+"""Worker for the multi-process DIST test (spawned by launch()):
+init_parallel_env over the env contract, then all_reduce across processes.
+Mirrors the reference's test pattern (SURVEY.md §4: programmatic
+multi-process cluster, e.g. test/collective/collective_allreduce_api.py)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+
+
+def main():
+    env = dist.init_parallel_env()
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+    assert world == int(os.environ["PADDLE_TRAINERS_NUM"]), world
+
+    t = paddle.to_tensor(np.full((4,), float(rank + 1), np.float32))
+    dist.all_reduce(t)
+    want = sum(range(1, world + 1))
+    np.testing.assert_allclose(t.numpy(), np.full((4,), want, np.float32))
+
+    out = []
+    dist.all_gather(out, paddle.to_tensor(
+        np.asarray([rank], np.float32)))
+    got = sorted(float(x.numpy()[0]) for x in out)
+    assert got == [float(r) for r in range(world)], got
+
+    outdir = os.environ["DIST_TEST_OUT"]
+    with open(os.path.join(outdir, f"ok{rank}"), "w") as f:
+        f.write(str(want))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,21 @@
+"""Real multi-process collective test driven through the launch CLI
+(parity: the reference's DIST-labeled tests — multi-process on one host,
+SURVEY.md §4)."""
+import os
+
+import pytest
+
+
+def test_two_process_allreduce(tmp_path):
+    from paddle_tpu.distributed.launch import launch
+
+    worker = os.path.join(os.path.dirname(__file__),
+                          "dist_worker_allreduce.py")
+    os.environ["DIST_TEST_OUT"] = str(tmp_path)
+    try:
+        rc = launch(worker, nproc_per_node=2)
+    finally:
+        os.environ.pop("DIST_TEST_OUT", None)
+    assert rc == 0
+    assert (tmp_path / "ok0").read_text() == "3"
+    assert (tmp_path / "ok1").read_text() == "3"
