@@ -148,3 +148,5 @@ worker_index = fleet.worker_index
 worker_num = fleet.worker_num
 is_first_worker = fleet.is_first_worker
 barrier_worker = fleet.barrier_worker
+
+from .recompute import recompute, recompute_sequential  # noqa: F401,E402

@@ -1,0 +1,88 @@
+"""Activation recomputation (gradient checkpointing).
+
+Parity: python/paddle/distributed/fleet/recompute/recompute.py:128,463 —
+RecomputeFunction (saves inputs, recomputes activations in backward),
+recompute_sequential, recompute_hybrid.
+
+TPU-native: ``jax.checkpoint`` (remat) IS the mechanism — the forward is
+functionalized (Layer.bind_state turns a stateful Layer into a pure fn over
+its params/buffers), wrapped in jax.checkpoint, and routed through the eager
+tape's dispatch so ``loss.backward()`` re-runs the region's forward during
+the backward pass, trading FLOPs for activation HBM exactly like the
+reference's RecomputeFunction. RNG state is captured and replayed (parity
+with preserve_rng_state).
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+
+from ...autograd import no_grad
+from ...core.tensor import Tensor
+from ...framework.random import next_key, rng_context
+from ...jit import _rebuild, _split_tensors
+from ...nn.layer.layers import Layer
+from ...ops.dispatch import apply
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function: Callable, *args, preserve_rng_state: bool = True,
+              use_reentrant: bool = True, **kwargs):
+    """Run ``function(*args, **kwargs)`` so its activations are REcomputed
+    during backward instead of stored (parity: fleet recompute)."""
+    acc = []
+    skel_args = _split_tensors(args, acc)
+    skel_kwargs = _split_tensors(kwargs, acc)
+
+    layer = function if isinstance(function, Layer) else None
+    params = dict(layer.named_parameters()) if layer is not None else {}
+    bufs = dict(layer.named_buffers()) if layer is not None else {}
+    key_data = jax.random.key_data(next_key())
+
+    def fn(pvals, bvals, kdata, *avals):
+        key = jax.random.wrap_key_data(kdata)
+        wrap = lambda v: Tensor(v, stop_gradient=True)
+        a = _rebuild(skel_args, list(avals), wrap)
+        kw = _rebuild(skel_kwargs, list(avals), wrap)
+        with rng_context(key), no_grad():
+            if layer is not None:
+                with layer.bind_state(pvals, bvals):
+                    out = layer(*a, **kw)
+            else:
+                out = function(*a, **kw)
+        seq = out if isinstance(out, (tuple, list)) else (out,)
+        res = tuple(o._value if isinstance(o, Tensor) else o for o in seq)
+        return res if len(res) > 1 else res[0]
+
+    ck = jax.checkpoint(fn)
+    return apply("recompute", ck, params, bufs, Tensor(key_data),
+                 *[t for t in acc])
+
+
+def recompute_sequential(ctx: dict, functions, *args, **kwargs):
+    """parity: recompute_sequential — chunk a Sequential into segments and
+    recompute each. ctx: {'segments': N, 'preserve_rng_state': bool}."""
+    segments = int(ctx.get("segments", 1))
+    preserve = ctx.get("preserve_rng_state", True)
+    layers = list(functions) if not isinstance(functions, Layer) else \
+        list(functions.children())
+    if not layers:
+        return functions(*args, **kwargs)
+    per = max(1, len(layers) // segments)
+    out = args
+    for i in range(0, len(layers), per):
+        seg = layers[i:i + per]
+
+        def seg_fn(*xs, _seg=seg):
+            cur = xs
+            for lyr in _seg:
+                cur = lyr(*cur) if isinstance(cur, tuple) else lyr(cur)
+                if not isinstance(cur, tuple):
+                    cur = (cur,)
+            return cur if len(cur) > 1 else cur[0]
+
+        res = recompute(seg_fn, *out, preserve_rng_state=preserve)
+        out = res if isinstance(res, tuple) else (res,)
+    return out if len(out) > 1 else out[0]

@@ -1,0 +1,133 @@
+"""jit / to_static capture layer (parity: python/paddle/jit — SOT guard
+cache semantics, backward through captured programs, save/load)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_to_static_matches_eager_and_caches():
+    model = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    x = paddle.to_tensor(
+        np.random.default_rng(0).normal(size=(3, 4)).astype(np.float32))
+    eager = model(x).numpy()
+    st = paddle.jit.to_static(model)
+    out = st(x)
+    np.testing.assert_allclose(out.numpy(), eager, rtol=1e-5)
+    # second call with same signature hits the compile cache (one entry)
+    st(x)
+    # new shape → guard miss → retrace (still correct)
+    x2 = paddle.to_tensor(
+        np.random.default_rng(1).normal(size=(5, 4)).astype(np.float32))
+    np.testing.assert_allclose(st(x2).numpy(), model(x2).numpy(), rtol=1e-5)
+
+
+def test_backward_through_captured_program():
+    model = nn.Linear(4, 4)
+    st = paddle.jit.to_static(model)
+    x = paddle.to_tensor(
+        np.random.default_rng(0).normal(size=(2, 4)).astype(np.float32),
+        stop_gradient=False)
+    loss = (st(x) ** 2).sum()
+    loss.backward()
+    assert model.weight.grad is not None
+    g_static = np.asarray(model.weight.grad.numpy())
+
+    model.clear_gradients() if hasattr(model, "clear_gradients") else None
+    for p in model.parameters():
+        p.clear_grad()
+    loss2 = (model(x) ** 2).sum()
+    loss2.backward()
+    np.testing.assert_allclose(g_static, model.weight.grad.numpy(),
+                               rtol=1e-4)
+
+
+def test_to_static_train_step_optimizer():
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    st = paddle.jit.to_static(model)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    x = paddle.to_tensor(
+        np.random.default_rng(0).normal(size=(16, 8)).astype(np.float32))
+    y = paddle.to_tensor(
+        np.random.default_rng(1).normal(size=(16, 1)).astype(np.float32))
+    losses = []
+    for _ in range(5):
+        loss = ((st(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.item()))
+    assert losses[-1] < losses[0]
+
+
+def test_not_to_static_and_enable_flag():
+    calls = {"n": 0}
+
+    def fn(x):
+        calls["n"] += 1
+        return x * 2
+
+    paddle.jit.enable_to_static(False)
+    try:
+        st = paddle.jit.to_static(fn)
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        out = st(x)
+        np.testing.assert_allclose(out.numpy(), [2.0, 2.0])
+    finally:
+        paddle.jit.enable_to_static(True)
+
+
+def test_sparse_surface():
+    import paddle_tpu.sparse as sparse
+
+    dense = np.array([[0.0, 1.0], [2.0, 0.0]], np.float32)
+    coo = sparse.sparse_from_dense(paddle.to_tensor(dense))
+    back = coo.to_dense()
+    np.testing.assert_allclose(back.numpy(), dense)
+    y = sparse.matmul(coo, paddle.to_tensor(np.eye(2, dtype=np.float32)))
+    val = y.to_dense() if hasattr(y, "to_dense") else y
+    np.testing.assert_allclose(val.numpy(), dense)
+
+
+def test_recompute_matches_plain():
+    """fleet.recompute: same values and grads, activations recomputed."""
+    from paddle_tpu.distributed.fleet import recompute
+
+    model = nn.Sequential(nn.Linear(8, 32), nn.Tanh(), nn.Linear(32, 8))
+    x = paddle.to_tensor(
+        np.random.default_rng(0).normal(size=(4, 8)).astype(np.float32),
+        stop_gradient=False)
+
+    out_rc = recompute(model, x)
+    loss_rc = (out_rc ** 2).sum()
+    loss_rc.backward()
+    g_rc = model.sublayers()[0].weight.grad.numpy().copy()
+    gx_rc = x.grad.numpy().copy()
+
+    for p in model.parameters():
+        p.clear_grad()
+    x2 = paddle.to_tensor(x.numpy(), stop_gradient=False)
+    loss = (model(x2) ** 2).sum()
+    loss.backward()
+    np.testing.assert_allclose(float(loss_rc.item()), float(loss.item()),
+                               rtol=1e-5)
+    np.testing.assert_allclose(g_rc, model.sublayers()[0].weight.grad.numpy(),
+                               rtol=1e-4)
+    np.testing.assert_allclose(gx_rc, x2.grad.numpy(), rtol=1e-4)
+
+
+def test_recompute_sequential_segments():
+    from paddle_tpu.distributed.fleet import recompute_sequential
+
+    model = nn.Sequential(nn.Linear(8, 8), nn.Tanh(), nn.Linear(8, 8),
+                          nn.Tanh())
+    x = paddle.to_tensor(
+        np.random.default_rng(1).normal(size=(2, 8)).astype(np.float32),
+        stop_gradient=False)
+    out = recompute_sequential({"segments": 2}, model, x)
+    ref = model(x)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+    (out ** 2).sum().backward()
+    assert x.grad is not None
