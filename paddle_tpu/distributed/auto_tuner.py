@@ -1,0 +1,131 @@
+"""Parallel-config auto-tuner.
+
+Parity: python/paddle/distributed/auto_tuner/ (tuner.py:21 AutoTuner over
+candidate dp/mp/pp/sharding configs with cost & memory models and pruning —
+the reference searches by launching trial jobs; prune rules live in
+auto_tuner/prune.py).
+
+TPU-native: the search space is mesh factorizations (dp, sp, tp, pp) of the
+chip count. Candidates are pruned by an analytic HBM model (params + Adam
+moments f32, bf16 activations w/ or w/o remat) and ranked by a communication
+cost model (tp all-reduce volume on ICI, pp bubble fraction, dp gradient
+reduce) — the same shape as the reference's cost model but closed-form, so
+tuning needs no trial launches. ``tune()`` returns ranked TuneResult rows;
+``best_mesh_shape()`` the winner.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import List, Optional, Tuple
+
+__all__ = ["ModelSpec", "ClusterSpec", "TuneResult", "tune",
+           "best_mesh_shape"]
+
+
+@dataclasses.dataclass
+class ModelSpec:
+    num_params: float                  # dense param count
+    hidden_size: int
+    num_layers: int
+    seq_len: int
+    global_batch: int
+    vocab_size: int = 32000
+    remat: bool = True
+
+
+@dataclasses.dataclass
+class ClusterSpec:
+    num_chips: int
+    hbm_bytes_per_chip: float = 95e9   # v5p default
+    peak_flops: float = 459e12
+    ici_bandwidth: float = 9e10        # bytes/s per link, order-of-magnitude
+
+
+@dataclasses.dataclass
+class TuneResult:
+    dp: int
+    sp: int
+    tp: int
+    pp: int
+    mem_bytes: float
+    comm_score: float
+    fits: bool
+
+    @property
+    def shape(self) -> Tuple[int, int, int, int]:
+        return (self.pp, self.dp, self.sp, self.tp)
+
+
+def _factorizations(n: int) -> List[Tuple[int, int, int, int]]:
+    out = []
+    def divs(x):
+        return [d for d in range(1, x + 1) if x % d == 0]
+    for pp in divs(n):
+        for tp in divs(n // pp):
+            rem = n // pp // tp
+            for sp in divs(rem):
+                dp = rem // sp
+                out.append((pp, dp, sp, tp))
+    return out
+
+
+def _memory(model: ModelSpec, pp, dp, sp, tp, remat) -> float:
+    # master params f32 + two Adam moments f32 + bf16 working copy,
+    # sharded over tp (always) and dp (fsdp) and pp (layer split)
+    param_shard = model.num_params / (tp * dp * pp)
+    state = param_shard * (4 + 4 + 4 + 2)
+    # activations: micro-batch per dp/sp shard; remat keeps ~2 residents
+    # per layer, otherwise ~20 intermediate tensors per layer
+    b_local = max(1, model.global_batch // dp)
+    s_local = max(1, model.seq_len // sp)
+    per_layer = b_local * s_local * model.hidden_size * 2  # bf16
+    layers_here = max(1, model.num_layers // pp)
+    act = per_layer * layers_here * (2 if remat else 20)
+    logits = b_local * s_local * model.vocab_size * 4 / max(tp, 1)
+    return state + act + logits
+
+
+def _comm_score(model: ModelSpec, pp, dp, sp, tp) -> float:
+    """Relative cost: lower is better. tp moves activations every layer,
+    dp reduces grads once per step, pp adds bubble."""
+    b = model.global_batch / dp
+    s = model.seq_len / sp
+    act_bytes = b * s * model.hidden_size * 2
+    tp_cost = (0.0 if tp == 1 else
+               2.0 * model.num_layers * act_bytes * (tp - 1) / tp)
+    dp_cost = 0.0 if dp == 1 else 2.0 * model.num_params * 2 * (dp - 1) / dp
+    sp_cost = 0.0 if sp == 1 else model.num_layers * act_bytes
+    bubble = 0.0 if pp == 1 else (pp - 1) / (pp + 8)  # ~microbatches=8
+    flops = 6 * model.num_params * model.global_batch * model.seq_len
+    return (tp_cost + dp_cost + sp_cost) + bubble * flops / 1e3
+
+
+def tune(model: ModelSpec, cluster: ClusterSpec,
+         max_candidates: Optional[int] = None) -> List[TuneResult]:
+    results = []
+    for pp, dp, sp, tp in _factorizations(cluster.num_chips):
+        # prune rules (parity: auto_tuner/prune.py): tp beyond 8 leaves the
+        # ICI domain; pp must divide layers; dp must divide batch
+        if tp > 8 or model.num_layers % pp or model.global_batch % dp:
+            continue
+        if sp > 1 and model.seq_len % sp:
+            continue
+        mem = _memory(model, pp, dp, sp, tp, model.remat)
+        fits = mem < 0.9 * cluster.hbm_bytes_per_chip
+        results.append(TuneResult(dp, sp, tp, pp, mem,
+                                  _comm_score(model, pp, dp, sp, tp), fits))
+    results.sort(key=lambda r: (not r.fits, r.comm_score))
+    return results[:max_candidates] if max_candidates else results
+
+
+def best_mesh_shape(model: ModelSpec, cluster: ClusterSpec):
+    """Winning (pp, dp, sp, tp) — raises if nothing fits."""
+    ranked = tune(model, cluster)
+    for r in ranked:
+        if r.fits:
+            return r.shape
+    raise RuntimeError(
+        f"no parallel config fits: smallest footprint "
+        f"{min(r.mem_bytes for r in ranked) / 1e9:.1f} GB > "
+        f"{cluster.hbm_bytes_per_chip / 1e9:.1f} GB HBM")

