@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 
 
-# bf16 peak FLOPs per chip by device kind (public spec sheets)
+# bf16 peak FLOPs / HBM bytes per chip by device kind (public spec sheets)
 _PEAK = {
     "v4": 275e12,
     "v5p": 459e12,
@@ -22,6 +22,14 @@ _PEAK = {
     "v5 lite": 197e12,
     "v6e": 918e12,
     "trillium": 918e12,
+}
+_HBM = {
+    "v4": 32e9,
+    "v5p": 95e9,
+    "v5e": 16e9,
+    "v5 lite": 16e9,
+    "v6e": 32e9,
+    "trillium": 32e9,
 }
 
 
@@ -35,10 +43,22 @@ def _peak_flops(dev) -> float:
     return 459e12  # assume v5p-class
 
 
+def _hbm_bytes(dev) -> float:
+    kind = getattr(dev, "device_kind", "").lower()
+    for key, val in _HBM.items():
+        if key in kind:
+            return val
+    return 95e9
+
+
 def _configs():
     from paddle_tpu.models import llama
     # largest first; fall back if the chip is small (v5e has 16GB HBM and
     # f32 master params + two Adam moments cost 12 bytes/param)
+    yield "llama-2.6b", llama.LlamaConfig(
+        vocab_size=32768, hidden_size=3072, intermediate_size=8192,
+        num_layers=24, num_heads=24, num_kv_heads=8, head_dim=128,
+        max_seq_len=2048, remat=True), 8, 2048
     yield "llama-740m", llama.LlamaConfig(
         vocab_size=32768, hidden_size=2048, intermediate_size=6144,
         num_layers=12, num_heads=16, num_kv_heads=8, head_dim=128,
@@ -70,6 +90,11 @@ def main():
     dev = jax.devices()[0]
     last_err = None
     for name, cfg, batch, seq in _configs():
+        # pre-check the 16-bytes/param optimizer footprint against HBM so an
+        # OOM attempt can't poison the allocator for the fallback configs
+        n_params = llama.num_params(llama._abstract_params(cfg))
+        if n_params * 16 > 0.8 * _hbm_bytes(dev) and dev.platform != "cpu":
+            continue
         try:
             state = llama.init_train_state(cfg, jax.random.PRNGKey(0))
             tokens = jax.random.randint(
@@ -97,6 +122,10 @@ def main():
             return 0
         except Exception as e:  # OOM etc. — try the next smaller config
             last_err = e
+            state = tokens = step = loss = None  # release device buffers
+            import gc
+            gc.collect()
+            jax.clear_caches()
             continue
     print(json.dumps({
         "metric": "bench_failed", "value": 0.0, "unit": "tokens/s",

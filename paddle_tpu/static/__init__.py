@@ -13,7 +13,7 @@ from ..jit import save as _jit_save
 
 __all__ = ["InputSpec", "save_inference_model", "load_inference_model",
            "default_main_program", "default_startup_program", "Program",
-           "program_guard", "name_scope"]
+           "program_guard", "name_scope", "data", "Executor"]
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
@@ -75,3 +75,47 @@ class name_scope:
 
     def __exit__(self, *exc):
         return False
+
+
+class _DataPlaceholder:
+    """Returned by static.data — a named InputSpec that eager/capture code
+    treats as an input slot."""
+
+    def __init__(self, name, shape, dtype):
+        self.name = name
+        self.spec = InputSpec(shape, dtype or "float32", name)
+        self.shape = list(shape)
+        self.dtype = self.spec.dtype
+
+
+def data(name, shape, dtype=None, lod_level=0):
+    """parity: paddle.static.data — declares a program input."""
+    return _DataPlaceholder(name, shape, dtype)
+
+
+class Executor:
+    """parity: paddle.base.executor.Executor (executor.py:1237) — in the
+    TPU-native design a 'program' is a python callable (usually a
+    to_static-captured function or a loaded TranslatedLayer); run() feeds a
+    dict keyed by static.data names and fetches outputs."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        import numpy as _np
+
+        from ..core.tensor import Tensor as _T
+        from ..ops.creation import to_tensor as _to
+
+        if program is None or isinstance(program, Program):
+            return []  # vestigial startup-program run
+        feed = feed or {}
+        args = [_to(v) for v in feed.values()]
+        outs = program(*args)
+        seq = outs if isinstance(outs, (list, tuple)) else [outs]
+        return [_np.asarray(o._value) if isinstance(o, _T) else _np.asarray(o)
+                for o in seq]
+
+    def close(self):
+        pass

@@ -93,3 +93,103 @@ def test_broadcast_add_mul():
     check_grad(paddle.add, [a, b], grad_input_idx=(0, 1))
     check_output(paddle.multiply, [a, b], lambda x, y: x * y)
     check_grad(paddle.multiply, [a, b], grad_input_idx=(0, 1))
+
+
+def test_trig_and_inverse():
+    x = rng.uniform(-0.9, 0.9, size=(6,)).astype(np.float32)
+    for op, ref in [(paddle.sin, np.sin), (paddle.cos, np.cos),
+                    (paddle.asin, np.arcsin), (paddle.atan, np.arctan),
+                    (paddle.sinh, np.sinh), (paddle.cosh, np.cosh)]:
+        check_output(op, [x], ref)
+        check_grad(op, [x])
+
+
+def test_pow_sqrt_rsqrt():
+    x = rng.uniform(0.5, 2.0, size=(6,)).astype(np.float32)
+    check_output(lambda t: paddle.pow(t, 3.0), [x], lambda v: v ** 3)
+    check_grad(lambda t: paddle.pow(t, 3.0), [x])
+    check_output(paddle.sqrt, [x], np.sqrt)
+    check_grad(paddle.sqrt, [x])
+    check_output(paddle.rsqrt, [x], lambda v: 1 / np.sqrt(v))
+
+
+def test_minimum_maximum_clip():
+    a = rng.normal(size=(5,)).astype(np.float32)
+    b = rng.normal(size=(5,)).astype(np.float32)
+    check_output(paddle.minimum, [a, b], np.minimum)
+    check_output(paddle.maximum, [a, b], np.maximum)
+    check_grad(paddle.maximum, [a, b], grad_input_idx=(0, 1))
+    check_output(lambda t: paddle.clip(t, -0.5, 0.5), [a],
+                 lambda v: np.clip(v, -0.5, 0.5))
+
+
+def test_concat_split_stack():
+    a = rng.normal(size=(2, 3)).astype(np.float32)
+    b = rng.normal(size=(2, 3)).astype(np.float32)
+    check_output(lambda x, y: paddle.concat([x, y], axis=0), [a, b],
+                 lambda x, y: np.concatenate([x, y], 0))
+    check_output(lambda x, y: paddle.stack([x, y], axis=0), [a, b],
+                 lambda x, y: np.stack([x, y], 0))
+    check_grad(lambda x, y: paddle.concat([x, y], axis=1), [a, b],
+               grad_input_idx=(0, 1))
+
+
+def test_transpose_reshape_squeeze():
+    x = rng.normal(size=(2, 3, 4)).astype(np.float32)
+    check_output(lambda t: paddle.transpose(t, [2, 0, 1]), [x],
+                 lambda v: v.transpose(2, 0, 1))
+    check_grad(lambda t: paddle.transpose(t, [2, 0, 1]), [x])
+    check_output(lambda t: paddle.reshape(t, [6, 4]), [x],
+                 lambda v: v.reshape(6, 4))
+    check_output(lambda t: paddle.unsqueeze(t, 0), [x],
+                 lambda v: v[None])
+
+
+def test_gather_index_select_where():
+    x = rng.normal(size=(5, 3)).astype(np.float32)
+    idx = np.array([0, 2, 4], np.int32)
+    check_output(lambda t: paddle.gather(t, paddle.to_tensor(idx)), [x],
+                 lambda v: v[idx])
+    check_grad(lambda t: paddle.gather(t, paddle.to_tensor(idx)), [x])
+    cond = x > 0
+    check_output(
+        lambda t: paddle.where(paddle.to_tensor(cond), t, t * 0.5), [x],
+        lambda v: np.where(cond, v, v * 0.5))
+
+
+def test_cumsum_cumprod():
+    x = rng.uniform(0.5, 1.5, size=(3, 4)).astype(np.float32)
+    check_output(lambda t: paddle.cumsum(t, axis=1), [x],
+                 lambda v: np.cumsum(v, 1))
+    check_grad(lambda t: paddle.cumsum(t, axis=1), [x])
+    check_output(lambda t: paddle.cumprod(t, dim=1), [x],
+                 lambda v: np.cumprod(v, 1))
+
+
+def test_norms_and_dist():
+    x = rng.normal(size=(4, 5)).astype(np.float32)
+    check_output(lambda t: paddle.linalg.norm(t), [x],
+                 lambda v: np.linalg.norm(v), atol=1e-4)
+    y = rng.normal(size=(4, 5)).astype(np.float32)
+    check_output(paddle.dist, [x, y],
+                 lambda a, b: np.linalg.norm((a - b).ravel()), atol=1e-4)
+
+
+def test_matmul_batched_and_transposes():
+    a = rng.normal(size=(2, 3, 4)).astype(np.float32)
+    b = rng.normal(size=(2, 4, 5)).astype(np.float32)
+    check_output(paddle.matmul, [a, b], lambda x, y: x @ y)
+    check_output(lambda x, y: paddle.matmul(x, y, transpose_y=True),
+                 [a, rng.normal(size=(2, 5, 4)).astype(np.float32)],
+                 lambda x, y: x @ y.transpose(0, 2, 1))
+
+
+def test_logsumexp_prod_amax():
+    x = rng.normal(size=(3, 4)).astype(np.float32)
+    check_output(lambda t: paddle.logsumexp(t, axis=1), [x],
+                 lambda v: np.log(np.exp(v).sum(1)), atol=1e-5)
+    check_grad(lambda t: paddle.logsumexp(t, axis=1), [x])
+    check_output(lambda t: paddle.amax(t, axis=0), [x],
+                 lambda v: v.max(0))
+    check_output(lambda t: paddle.prod(t, axis=1), [x],
+                 lambda v: v.prod(1))
