@@ -48,7 +48,8 @@ def check_numerics(tensor, op_type: str = "", var_name: str = "",
     """Count nan/inf in a tensor; abort per debug_mode (parity:
     amp/debugging.py check_numerics). Returns (num_nan, num_inf, num_zero)."""
     v = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
-    vf = v.astype(jnp.float32) if np.issubdtype(np.dtype(v.dtype), np.floating) else None
+    from ..framework.dtype import np_is_floating
+    vf = v.astype(jnp.float32) if np_is_floating(v.dtype) else None
     if vf is None:
         z = jnp.asarray(0)
         return Tensor(z), Tensor(z), Tensor(z)

@@ -142,7 +142,8 @@ class GradNode:
 
     def zero_cotangent(self, idx):
         shape, dtype = self.out_metas[idx]
-        if np.issubdtype(np.dtype(dtype), np.floating) or np.issubdtype(
+        from ..framework.dtype import np_is_floating
+        if np_is_floating(dtype) or np.issubdtype(
             np.dtype(dtype), np.complexfloating
         ):
             return jnp.zeros(shape, dtype)

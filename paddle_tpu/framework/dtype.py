@@ -147,6 +147,15 @@ def to_np(dtype) -> np.dtype:
     return canonicalize(dtype).np_dtype
 
 
+def np_is_floating(d) -> bool:
+    """True for ANY float dtype including bfloat16/float8 extension types
+    (np.issubdtype alone misses ml_dtypes — a silent trap: bf16 params would
+    look non-differentiable)."""
+    import jax.numpy as jnp
+
+    return bool(jnp.issubdtype(np.dtype(d), jnp.floating))
+
+
 def is_floating(dtype_like) -> bool:
     try:
         return convert_dtype(dtype_like).is_floating_point

@@ -288,7 +288,7 @@ class Layer:
             npd = dtypes.canonicalize(dtype).np_dtype
             for t in list(self.parameters()) + list(self.buffers()):
                 d = np.dtype(t._value.dtype)
-                if np.issubdtype(d, np.floating):
+                if dtypes.np_is_floating(d):
                     t._replace_value(jnp.asarray(t._value, dtype=npd))
         if device is not None:
             from ...device import jax_device

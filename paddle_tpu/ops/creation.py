@@ -256,6 +256,6 @@ def _t(x):
 
 
 def _is_float(v):
-    return np.issubdtype(np.dtype(v.dtype), np.floating) or np.issubdtype(
+    return dtypes.np_is_floating(v.dtype) or np.issubdtype(
         np.dtype(v.dtype), np.complexfloating
     )

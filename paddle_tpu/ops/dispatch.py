@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..autograd.tape import AccumulateGrad, GradNode, is_grad_enabled
+from ..framework import dtype as _dtypes
 from ..framework import flags as _flags
 
 
@@ -55,7 +56,7 @@ def _requires_grad(t) -> bool:
     if t.stop_gradient:
         return False
     d = np.dtype(t._value.dtype)
-    return np.issubdtype(d, np.floating) or np.issubdtype(d, np.complexfloating)
+    return _dtypes.np_is_floating(d) or np.issubdtype(d, np.complexfloating)
 
 
 def apply(name: str, fn: Callable, *args, **kwargs):
@@ -122,7 +123,8 @@ def _apply_impl(name, fn, args, kwargs):
     outs = []
     for i, v in enumerate(out_vals):
         d = np.dtype(v.dtype)
-        is_float = np.issubdtype(d, np.floating) or np.issubdtype(d, np.complexfloating)
+        is_float = (_dtypes.np_is_floating(d)
+                    or np.issubdtype(d, np.complexfloating))
         t = Tensor(v, stop_gradient=not is_float)
         if is_float:
             t._grad_node = node
@@ -156,7 +158,7 @@ def _maybe_check_nan_inf(name, out_vals):
         return
     for i, v in enumerate(out_vals):
         d = np.dtype(v.dtype)
-        if np.issubdtype(d, np.floating):
+        if _dtypes.np_is_floating(d):
             if not bool(jnp.all(jnp.isfinite(v))):
                 raise FloatingPointError(
                     f"nan/inf detected in output {i} of op '{name}'"

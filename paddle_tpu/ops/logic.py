@@ -96,7 +96,8 @@ def is_tensor(x):
 
 
 def is_floating_point(x):
-    return np.issubdtype(np.dtype(x._value.dtype), np.floating)
+    from ..framework.dtype import np_is_floating
+    return np_is_floating(x._value.dtype)
 
 
 def is_integer(x):

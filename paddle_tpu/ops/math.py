@@ -140,7 +140,7 @@ def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
 
 def _result_float(v):
     d = np.dtype(v.dtype)
-    return d if np.issubdtype(d, np.floating) else np.float32
+    return d if dtypes.np_is_floating(d) else np.float32
 
 
 def increment(x, value=1.0, name=None):

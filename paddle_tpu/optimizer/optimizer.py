@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..autograd import no_grad
+from ..framework import dtype as dtypes
 from ..core.tensor import Tensor
 from .lr import LRScheduler
 
@@ -79,7 +80,7 @@ class Optimizer:
             # multi-precision master weights for low-precision params
             master = None
             if self._multi_precision and np.dtype(pv.dtype).itemsize < 4 and \
-                    np.issubdtype(np.dtype(pv.dtype), np.floating):
+                    dtypes.np_is_floating(pv.dtype):
                 master = self._master_weights.get(pid)
                 if master is None:
                     master = pv.astype(jnp.float32)

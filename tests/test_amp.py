@@ -1,0 +1,80 @@
+"""AMP: auto_cast O1/O2, GradScaler dynamic loss scaling, decorate
+(parity: python/paddle/amp — auto_cast.py:1006, grad_scaler.py:657)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_auto_cast_o1_dtypes():
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    w = paddle.to_tensor(np.ones((4, 4), np.float32))
+    with paddle.amp.auto_cast(True, dtype="bfloat16"):
+        y = paddle.matmul(x, w)          # white-list op → bf16
+        s = paddle.sum(y)
+    assert y.dtype == paddle.bfloat16
+    out = paddle.matmul(x, w)            # outside: untouched
+    assert out.dtype == paddle.float32
+
+
+def test_auto_cast_black_list():
+    x = paddle.to_tensor(np.full((4,), 2.0, np.float32))
+    with paddle.amp.auto_cast(True, custom_black_list={"exp"},
+                              dtype="bfloat16"):
+        y = paddle.exp(x)
+    assert y.dtype == paddle.float32
+
+
+def test_grad_scaler_scales_and_steps():
+    model = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    loss = (model(x) ** 2).mean()
+    scaled = scaler.scale(loss)
+    np.testing.assert_allclose(float(scaled.item()),
+                               1024.0 * float(loss.item()), rtol=1e-6)
+    scaled.backward()
+    w_before = model.weight.numpy().copy()
+    scaler.step(opt)
+    scaler.update()
+    assert not np.allclose(model.weight.numpy(), w_before)  # stepped
+    # gradient applied UNscaled: magnitude sane
+    assert np.max(np.abs(model.weight.numpy() - w_before)) < 1.0
+
+
+def test_grad_scaler_skips_on_inf_and_backs_off():
+    model = nn.Linear(2, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=64.0)
+    w_before = model.weight.numpy().copy()
+    # poison a grad with inf
+    loss = (model(paddle.to_tensor(np.ones((1, 2), np.float32))) ** 2).sum()
+    scaler.scale(loss).backward()
+    g = model.weight.grad
+    g._replace_value(np.full(g.shape, np.inf, np.float32))
+    scale_before = float(scaler._scale)
+    scaler.step(opt)
+    scaler.update()
+    np.testing.assert_array_equal(model.weight.numpy(), w_before)  # skipped
+    assert float(scaler._scale) < scale_before  # backed off
+
+
+def test_decorate_o2_master_weights():
+    model = nn.Linear(4, 4)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=model.parameters())
+    model, opt = paddle.amp.decorate(models=model, optimizers=opt,
+                                     level="O2", dtype="bfloat16")
+    assert model.weight.dtype == paddle.bfloat16
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    with paddle.amp.auto_cast(True, dtype="bfloat16", level="O2"):
+        loss = (model(x) ** 2).mean()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    assert model.weight.dtype == paddle.bfloat16
+    assert np.isfinite(float(loss.item()))
