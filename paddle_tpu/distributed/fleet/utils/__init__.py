@@ -1,0 +1,4 @@
+"""Fleet utilities (parity: python/paddle/distributed/fleet/utils/)."""
+from . import sequence_parallel_utils  # noqa: F401
+from . import hybrid_parallel_util  # noqa: F401
+from ..recompute import recompute  # noqa: F401

