@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-__all__ = ["pipeline_apply"]
+__all__ = ["pipeline_apply", "pipeline_apply_interleaved"]
 
 
 def _pipeline_body(stage_params, microbatches, stage_fn: Callable,
@@ -110,3 +110,96 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh,
     # check-fails on bf16 all-reduces from partial-manual regions
     outs = fn(staged, mb.astype(jnp.float32))
     return outs.reshape((B,) + x.shape[1:])
+
+
+def _interleaved_body(stage_params, microbatches, stage_fn: Callable,
+                      axis_name: str, n_stages: int, n_chunks: int,
+                      out_like):
+    """Circular (interleaved / VPP) schedule, one wave of n_stages
+    microbatches: each item rides the ring n_chunks times, device s applying
+    its r-th layer chunk on an item's r-th pass. Bubble per wave is
+    (n_stages-1) steps vs GPipe's per-microbatch bubble — the reference's
+    PipelineParallelWithInterleave (pipeline_parallel.py:1308) effect in one
+    SPMD program."""
+    stage = jax.lax.axis_index(axis_name)
+    microbatches = microbatches.astype(out_like.dtype)
+    M = microbatches.shape[0]           # == n_stages per wave (caller splits)
+    steps = n_chunks * n_stages + n_stages - 1
+
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def step(carry, t):
+        recv, outs = carry
+        age = t - stage
+        e = jnp.mod(age, n_stages)      # item index riding through
+        r = (age - e) // n_stages       # which chunk round
+        active = jnp.logical_and(age >= 0, r < n_chunks)
+        fresh = jnp.logical_and(stage == 0, age == e)  # first touch: inject
+        mb_idx = jnp.clip(e, 0, M - 1)
+        x_in = jnp.where(fresh, microbatches[mb_idx], recv)
+
+        r_idx = jnp.clip(r, 0, n_chunks - 1)
+        # local params arrive as [1(pp-local), n_chunks, per, ...]: strip the
+        # pp axis, then select this round's chunk
+        chunk_params = jax.tree_util.tree_map(
+            lambda a: jax.lax.dynamic_index_in_dim(a[0], r_idx, 0,
+                                                   keepdims=False),
+            stage_params)
+        y = stage_fn(chunk_params, x_in)
+        y = jnp.where(active, y, x_in)
+
+        done = jnp.logical_and(stage == n_stages - 1,
+                               jnp.logical_and(r == n_chunks - 1, active))
+        outs = jax.lax.dynamic_update_index_in_dim(
+            outs, jnp.where(done, y, outs[mb_idx]), mb_idx, 0)
+        nxt = jax.lax.ppermute(y, axis_name, perm)
+        return (nxt, outs), None
+
+    recv0 = jnp.zeros_like(out_like)
+    outs0 = jnp.zeros((M,) + out_like.shape, out_like.dtype)
+    (_, outs), _ = jax.lax.scan(step, (recv0, outs0), jnp.arange(steps))
+    sel = jnp.where(stage == n_stages - 1, outs.astype(jnp.float32),
+                    jnp.zeros(outs.shape, jnp.float32))
+    return jax.lax.psum(sel, axis_name).astype(outs.dtype)
+
+
+def pipeline_apply_interleaved(stage_fn: Callable, stacked_params, x,
+                               mesh: Mesh, num_microbatches: int,
+                               num_chunks: int = 2, axis_name: str = "pp"):
+    """Interleaved pipeline: layer stack split into n_stages*num_chunks
+    chunks assigned round-robin (device s gets chunks s, s+n, ...). The
+    caller's num_microbatches must be a multiple of the pp size (waves)."""
+    n_stages = dict(mesh.shape)[axis_name]
+    B = x.shape[0]
+    assert B % num_microbatches == 0, (B, num_microbatches)
+    assert num_microbatches % n_stages == 0, (num_microbatches, n_stages)
+    mbs = x.reshape((num_microbatches, B // num_microbatches) + x.shape[1:])
+    out_like = jnp.zeros((B // num_microbatches,) + x.shape[1:], x.dtype)
+
+    def split_chunks(a):
+        L = a.shape[0]
+        assert L % (n_stages * num_chunks) == 0, (L, n_stages, num_chunks)
+        per = L // (n_stages * num_chunks)
+        # chunk c = layers [c*per:(c+1)*per]; device s gets c = r*n + s
+        a = a.reshape((num_chunks, n_stages, per) + a.shape[1:])
+        return jnp.swapaxes(a, 0, 1)   # [n_stages, num_chunks, per, ...]
+
+    staged = jax.tree_util.tree_map(split_chunks, stacked_params)
+    pspec = jax.tree_util.tree_map(
+        lambda a: P(axis_name, *([None] * (a.ndim - 1))), staged)
+
+    body = functools.partial(
+        _interleaved_body, stage_fn=stage_fn, axis_name=axis_name,
+        n_stages=n_stages, n_chunks=num_chunks, out_like=out_like)
+
+    fn = jax.shard_map(
+        body, mesh=mesh, in_specs=(pspec, P()), out_specs=P(),
+        axis_names={axis_name}, check_vma=False)
+
+    outs = []
+    waves = num_microbatches // n_stages
+    for w in range(waves):
+        wave_mb = mbs[w * n_stages:(w + 1) * n_stages]
+        outs.append(fn(staged, wave_mb.astype(jnp.float32)))
+    out = jnp.concatenate(outs, axis=0)
+    return out.reshape((B,) + x.shape[1:])
