@@ -70,6 +70,9 @@ class LlamaConfig:
     # >0 enables the compiled GPipe schedule over the 'pp' mesh axis
     # (distributed/pipeline.py); value = microbatches per step
     pipeline_microbatches: int = 0
+    # >1 switches to the circular interleaved (VPP) schedule with this many
+    # chunks per stage (requires num_layers % (pp * chunks) == 0)
+    pipeline_chunks: int = 1
 
 
 def llama3_8b() -> LlamaConfig:
@@ -346,7 +349,8 @@ def forward(params, tokens, config: LlamaConfig):
     mesh = _ACT_MESH
     pp = dict(mesh.shape).get("pp", 1) if mesh is not None else 1
     if pp > 1 and c.pipeline_microbatches > 0:
-        from ..distributed.pipeline import pipeline_apply
+        from ..distributed.pipeline import (pipeline_apply,
+                                            pipeline_apply_interleaved)
 
         def stage_fn(local_layers, xx):
             # inside the manual-'pp' shard_map region full-mesh sharding
@@ -355,8 +359,13 @@ def forward(params, tokens, config: LlamaConfig):
                 out, _ = jax.lax.scan(scan_fn, xx, local_layers)
             return out
 
-        x = pipeline_apply(stage_fn, params["layers"], x, mesh,
-                           c.pipeline_microbatches, "pp")
+        if c.pipeline_chunks > 1:
+            x = pipeline_apply_interleaved(
+                stage_fn, params["layers"], x, mesh,
+                c.pipeline_microbatches, c.pipeline_chunks, "pp")
+        else:
+            x = pipeline_apply(stage_fn, params["layers"], x, mesh,
+                               c.pipeline_microbatches, "pp")
     else:
         x, _ = jax.lax.scan(scan_fn, x, params["layers"])
     x = _rms_norm(x, params["final_norm"], c.rms_eps)
