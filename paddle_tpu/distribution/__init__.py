@@ -368,3 +368,9 @@ def _kl_cat(p, q):
     logp = jax.nn.log_softmax(p.logits, -1)
     logq = jax.nn.log_softmax(q.logits, -1)
     return Tensor(jnp.sum(jnp.exp(logp) * (logp - logq), -1))
+
+from .transform import (  # noqa: F401,E402
+    AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
+    TanhTransform, Transform, TransformedDistribution,
+)

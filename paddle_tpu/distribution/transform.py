@@ -1,0 +1,198 @@
+"""Probability transforms (parity: python/paddle/distribution/transform.py —
+Transform base with forward/inverse/log_det_jacobian, Affine/Exp/Sigmoid/
+Tanh/Power/Abs/Chain/Reshape/Independent transforms, and
+TransformedDistribution in distribution space)."""
+from __future__ import annotations
+
+import math
+from typing import List, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops.creation import _t
+from ..ops.dispatch import apply
+
+__all__ = [
+    "Transform", "AffineTransform", "ExpTransform", "SigmoidTransform",
+    "TanhTransform", "PowerTransform", "AbsTransform", "ChainTransform",
+    "ReshapeTransform", "IndependentTransform", "TransformedDistribution",
+]
+
+
+class Transform:
+    """Invertible map with tractable log|det J|."""
+
+    def forward(self, x):
+        return apply(f"{type(self).__name__}.fwd", self._forward, _t(x))
+
+    def inverse(self, y):
+        return apply(f"{type(self).__name__}.inv", self._inverse, _t(y))
+
+    def forward_log_det_jacobian(self, x):
+        return apply(f"{type(self).__name__}.fldj", self._fldj, _t(x))
+
+    def inverse_log_det_jacobian(self, y):
+        return apply(f"{type(self).__name__}.ildj",
+                     lambda v: -self._fldj(self._inverse(v)), _t(y))
+
+    # subclass hooks over raw jnp values
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _fldj(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)._value
+        self.scale = _t(scale)._value
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return 1 / (1 + jnp.exp(-x))
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return -jnp.logaddexp(0.0, -x) - jnp.logaddexp(0.0, x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _fldj(self, x):
+        return 2.0 * (math.log(2.0) - x - jnp.logaddexp(0.0, -2.0 * x))
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = float(_t(power)._value) if not isinstance(power, float) \
+            else power
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _fldj(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class AbsTransform(Transform):
+    """Non-bijective |x| (inverse returns the positive branch)."""
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms: Sequence[Transform]):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _fldj(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._fldj(x)
+            x = t._forward(x)
+        return total
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_shape = tuple(in_event_shape)
+        self.out_shape = tuple(out_event_shape)
+
+    def _forward(self, x):
+        lead = x.shape[:x.ndim - len(self.in_shape)]
+        return x.reshape(lead + self.out_shape)
+
+    def _inverse(self, y):
+        lead = y.shape[:y.ndim - len(self.out_shape)]
+        return y.reshape(lead + self.in_shape)
+
+    def _fldj(self, x):
+        lead = x.shape[:x.ndim - len(self.in_shape)]
+        return jnp.zeros(lead)
+
+
+class IndependentTransform(Transform):
+    """Sums the last n event dims out of the log-det."""
+
+    def __init__(self, base: Transform, reinterpreted_batch_rank: int):
+        self.base = base
+        self.rank = reinterpreted_batch_rank
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _fldj(self, x):
+        ldj = self.base._fldj(x)
+        return jnp.sum(ldj, axis=tuple(range(-self.rank, 0)))
+
+
+class TransformedDistribution:
+    """parity: paddle.distribution.TransformedDistribution."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transform = (transforms if isinstance(transforms, Transform)
+                          else ChainTransform(list(transforms)))
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        return self.transform.forward(x)
+
+    def log_prob(self, value):
+        x = self.transform.inverse(value)
+        base_lp = self.base.log_prob(x)
+        ldj = self.transform.forward_log_det_jacobian(x)
+        return base_lp - ldj

@@ -102,3 +102,33 @@ def test_run_check_and_flags():
     paddle.set_flags({"check_nan_inf": False})
     flags = paddle.get_flags(["check_nan_inf"])
     assert flags["FLAGS_check_nan_inf"] is False
+
+
+def test_distribution_transforms_lognormal():
+    """TransformedDistribution(Normal, Exp) == LogNormal log_prob."""
+    from paddle_tpu.distribution import (AffineTransform, ExpTransform,
+                                         Normal, SigmoidTransform,
+                                         TanhTransform,
+                                         TransformedDistribution)
+
+    base = Normal(loc=paddle.to_tensor(0.0), scale=paddle.to_tensor(1.0))
+    ln = TransformedDistribution(base, [ExpTransform()])
+    y = np.array([0.5, 1.0, 2.0], np.float32)
+    lp = ln.log_prob(paddle.to_tensor(y)).numpy()
+    # analytic lognormal(0,1) logpdf
+    want = -np.log(y) - 0.5 * np.log(2 * np.pi) - 0.5 * np.log(y) ** 2
+    np.testing.assert_allclose(lp, want, rtol=1e-5)
+
+    # transform roundtrips + log-det consistency
+    for t in (AffineTransform(1.0, 2.0), ExpTransform(), SigmoidTransform(),
+              TanhTransform()):
+        x = paddle.to_tensor(np.array([0.1, -0.3, 0.7], np.float32))
+        y2 = t.forward(x)
+        back = t.inverse(y2)
+        np.testing.assert_allclose(back.numpy(), x.numpy(), atol=1e-5)
+        fldj = t.forward_log_det_jacobian(x).numpy()
+        ildj = t.inverse_log_det_jacobian(y2).numpy()
+        np.testing.assert_allclose(fldj, -ildj, atol=1e-5)
+
+    s = ln.sample((1000,))
+    assert bool(np.all(s.numpy() > 0))
