@@ -66,9 +66,14 @@ bool send_bytes(int fd, const std::string& s) {
   return send_all(fd, &len, 4) && (len == 0 || send_all(fd, s.data(), len));
 }
 
+// Cap accepted frame length: a malformed/hostile length prefix must not
+// trigger a multi-GiB allocation (keys and rendezvous blobs are small).
+constexpr uint32_t kMaxFrameBytes = 64u << 20;
+
 bool recv_bytes(int fd, std::string* out) {
   uint32_t len = 0;
   if (!recv_all(fd, &len, 4)) return false;
+  if (len > kMaxFrameBytes) return false;
   out->resize(len);
   return len == 0 || recv_all(fd, &(*out)[0], len);
 }
@@ -126,6 +131,7 @@ struct StoreServer {
       } else if (cmd == kAdd) {
         std::string delta_s;
         if (!recv_bytes(fd, &delta_s)) break;
+        if (delta_s.size() != sizeof(int64_t)) break;
         int64_t delta = 0, cur = 0;
         std::memcpy(&delta, delta_s.data(), sizeof(int64_t));
         {
@@ -142,14 +148,19 @@ struct StoreServer {
     ::close(fd);
   }
 
-  bool start(int want_port) {
+  bool start(int want_port, const char* bind_addr) {
     listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
     if (listen_fd < 0) return false;
     int one = 1;
     ::setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
-    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    // Default: all interfaces, so other hosts can rendezvous (reference
+    // TCPStore listens on INADDR_ANY — tcp_utils.cc tcpListen).
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    if (bind_addr && bind_addr[0] &&
+        ::inet_pton(AF_INET, bind_addr, &addr.sin_addr) != 1)
+      return false;
     addr.sin_port = htons(static_cast<uint16_t>(want_port));
     if (::bind(listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
       return false;
@@ -220,9 +231,16 @@ struct StoreClient {
 
 extern "C" {
 
+void* ptpu_store_server_start2(int port, const char* bind_addr);
+
 void* ptpu_store_server_start(int port) {
+  return ptpu_store_server_start2(port, nullptr);
+}
+
+// bind_addr: dotted-quad interface to bind, NULL/"" = all interfaces.
+void* ptpu_store_server_start2(int port, const char* bind_addr) {
   auto* s = new StoreServer();
-  if (!s->start(port)) {
+  if (!s->start(port, bind_addr)) {
     delete s;
     return nullptr;
   }

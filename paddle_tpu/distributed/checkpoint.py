@@ -23,7 +23,37 @@ from typing import Any, Dict, Optional
 import jax
 import numpy as np
 
-__all__ = ["save_state_dict", "load_state_dict"]
+__all__ = ["save_state_dict", "load_state_dict", "wait_async_save",
+           "AsyncSaveHandle"]
+
+
+class AsyncSaveHandle:
+    """In-flight async save (parity: the reference's async save queue —
+    save_state_dict.py async_save path). ``wait()`` blocks until the
+    checkpoint is durable; until then the caller overlaps compute."""
+
+    def __init__(self, ckptr):
+        self._ckptr = ckptr
+        self._done = False
+
+    def wait(self) -> None:
+        if not self._done:
+            self._ckptr.wait_until_finished()
+            self._ckptr.close()
+            self._done = True
+        try:
+            _inflight_saves.remove(self)
+        except ValueError:
+            pass
+
+
+_inflight_saves: list = []
+
+
+def wait_async_save() -> None:
+    """Block until every outstanding async save is durable."""
+    for h in list(_inflight_saves):
+        h.wait()
 
 
 def _checkpointer():
@@ -46,7 +76,7 @@ def _plain_tree(tree):
 
 def save_state_dict(state_dict: Dict[str, Any], path: str,
                     process_group=None, coordinator_rank: int = 0,
-                    async_save: bool = False) -> None:
+                    async_save: bool = False) -> Optional["AsyncSaveHandle"]:
     """Write a (possibly sharded) state_dict to ``path``.
     Sharded jax.Arrays are written as distributed shard files + metadata;
     replicated values are deduplicated (parity: dedup_tensor —
@@ -58,11 +88,13 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
     if async_save:
         ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
         ckptr.save(path, tree, force=True)
-        # caller may continue; orbax finalizes in background. wait_until
-        # exposed for tests via the returned-less contract: orbax tracks it.
-        ckptr.wait_until_finished()
-    else:
-        _checkpointer().save(path, tree, force=True)
+        # Finalization runs in background; caller overlaps compute and calls
+        # handle.wait() / wait_async_save() before relying on the files.
+        handle = AsyncSaveHandle(ckptr)
+        _inflight_saves.append(handle)
+        return handle
+    _checkpointer().save(path, tree, force=True)
+    return None
 
 
 def load_state_dict(state_dict: Dict[str, Any], path: str,

@@ -52,11 +52,17 @@ class ElasticManager:
 
     # -- pod side --------------------------------------------------------
     def register(self, pod_id: str, endpoint: str = "") -> None:
+        """Registration is race-free under concurrent pod start (the normal
+        job-launch case): each pod claims a slot via the store's atomic add
+        and writes its id under its own key — no shared read-modify-write."""
         self.pod_id = pod_id
-        ids = self._pods()
-        if pod_id not in ids:
-            ids.append(pod_id)
-            self.store.set("elastic/pods", json.dumps(sorted(ids)))
+        if self.store.get(f"elastic/reg/{pod_id}") is None:
+            seq = self.store.add("elastic/seq", 1)
+            self.store.set(f"elastic/pod.{seq}", json.dumps(
+                {"id": pod_id, "endpoint": endpoint}))
+            self.store.set(f"elastic/reg/{pod_id}", str(seq))
+        # clear any tombstone so a pod can leave and rejoin under its id
+        self.store.set(f"elastic/dead/{pod_id}", "0")
         self.heartbeat()
 
     def heartbeat(self) -> None:
@@ -74,13 +80,20 @@ class ElasticManager:
 
     def deregister(self) -> None:
         if self.pod_id:
-            ids = [i for i in self._pods() if i != self.pod_id]
-            self.store.set("elastic/pods", json.dumps(sorted(ids)))
+            self.store.set(f"elastic/dead/{self.pod_id}", "1")
 
     # -- master side -----------------------------------------------------
     def _pods(self) -> List[str]:
-        raw = self.store.get("elastic/pods")
-        return json.loads(raw) if raw else []
+        n = self.store.add("elastic/seq", 0)  # atomic read of the counter
+        ids = []
+        for i in range(1, n + 1):
+            raw = self.store.get(f"elastic/pod.{i}")
+            if raw is None:
+                continue
+            pid = json.loads(raw)["id"]
+            if pid not in ids and self.store.get(f"elastic/dead/{pid}") != b"1":
+                ids.append(pid)
+        return sorted(ids)
 
     def alive_pods(self) -> List[str]:
         now = time.time()

@@ -28,13 +28,17 @@ class TCPStore:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  is_master: bool = False, world_size: int = 1,
-                 timeout: float = 30.0):
+                 timeout: float = 30.0, bind_addr: str = ""):
+        """``bind_addr``: interface the master listens on; default all
+        interfaces so other hosts can rendezvous (reference TCPStore
+        behavior). Pass "127.0.0.1" to restrict to loopback."""
         lib = native_lib()
         self._lib = lib
         self._server = None
         self.host = host
         if is_master:
-            self._server = lib.ptpu_store_server_start(port)
+            self._server = lib.ptpu_store_server_start2(
+                port, bind_addr.encode())
             if not self._server:
                 raise RuntimeError(f"TCPStore: cannot bind port {port}")
             port = lib.ptpu_store_server_port(self._server)

@@ -92,7 +92,10 @@ def _split_tensors(obj, acc):
     if isinstance(obj, (list, tuple)):
         return type(obj)(_split_tensors(e, acc) for e in obj)
     if isinstance(obj, dict):
-        return {k: _split_tensors(v, acc) for k, v in obj.items()}
+        # sorted-key order must match _guard_key, so two calls with the same
+        # keys in different insertion order share one compile cache entry
+        # with identical tensor slot assignment
+        return {k: _split_tensors(obj[k], acc) for k in sorted(obj)}
     return obj
 
 

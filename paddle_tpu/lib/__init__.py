@@ -45,6 +45,8 @@ def native_lib():
             raise RuntimeError(_build_error) from e
         lib.ptpu_store_server_start.restype = ctypes.c_void_p
         lib.ptpu_store_server_start.argtypes = [ctypes.c_int]
+        lib.ptpu_store_server_start2.restype = ctypes.c_void_p
+        lib.ptpu_store_server_start2.argtypes = [ctypes.c_int, ctypes.c_char_p]
         lib.ptpu_store_server_port.restype = ctypes.c_int
         lib.ptpu_store_server_port.argtypes = [ctypes.c_void_p]
         lib.ptpu_store_server_stop.argtypes = [ctypes.c_void_p]

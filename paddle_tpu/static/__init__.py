@@ -102,6 +102,29 @@ class Executor:
     def __init__(self, place=None):
         self.place = place
 
+    @staticmethod
+    def _input_names(program):
+        """Resolve the program's input-argument names: named InputSpecs if
+        the capture carries them, else the wrapped function's signature
+        (reference Executor matches feeds by name — executor.py _feed_data)."""
+        import inspect
+
+        sf = getattr(program, "_static_function", None)
+        if sf is None and hasattr(program, "_fn"):  # bare StaticFunction
+            sf = program
+        specs = getattr(sf, "_input_spec", None)
+        if specs and all(getattr(s, "name", None) for s in specs):
+            return [s.name for s in specs]
+        fn = getattr(sf, "_fn", None) or getattr(program, "forward", program)
+        try:
+            sig = inspect.signature(fn)
+        except (TypeError, ValueError):
+            return None
+        names = [p.name for p in sig.parameters.values()
+                 if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+                 and p.name != "self"]
+        return names or None
+
     def run(self, program=None, feed=None, fetch_list=None, **kwargs):
         import numpy as _np
 
@@ -110,9 +133,24 @@ class Executor:
 
         if program is None or isinstance(program, Program):
             return []  # vestigial startup-program run
-        feed = feed or {}
-        args = [_to(v) for v in feed.values()]
-        outs = program(*args)
+        feed = dict(feed or {})
+        names = self._input_names(program)
+        if names is not None and feed:
+            unknown = [k for k in feed if k not in names]
+            if unknown:
+                raise ValueError(
+                    f"Executor.run: feed names {unknown} do not match "
+                    f"program inputs {names}")
+            # bind by keyword: a missing required input raises the
+            # program's own clear TypeError instead of mis-binding
+            outs = program(**{n: _to(feed[n]) for n in feed})
+        else:
+            if len(feed) > 1:
+                raise ValueError(
+                    "Executor.run: cannot resolve feed order by name for "
+                    "this program; pass a single feed or a program captured "
+                    "with named InputSpecs")
+            outs = program(*[_to(v) for v in feed.values()])
         seq = outs if isinstance(outs, (list, tuple)) else [outs]
         return [_np.asarray(o._value) if isinstance(o, _T) else _np.asarray(o)
                 for o in seq]

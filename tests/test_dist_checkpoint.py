@@ -41,7 +41,11 @@ def test_save_load_llama_state(tmp_path):
     state = llama.init_train_state(cfg, jax.random.PRNGKey(0))
     sh = llama.make_shardings(cfg, mesh)
     params = jax.device_put(state.params, sh)
-    dc.save_state_dict(params, str(tmp_path / "llama"), async_save=True)
+    handle = dc.save_state_dict(params, str(tmp_path / "llama"),
+                                async_save=True)
+    assert handle is not None
+    handle.wait()  # overlap window ends here; files now durable
+    dc.wait_async_save()  # idempotent drain of the in-flight queue
 
     # reload replicated (single-chip serving layout)
     target = jax.tree_util.tree_map(jnp.zeros_like, state.params)

@@ -41,3 +41,22 @@ def test_membership_and_failure_detection():
     for p in pods:
         p.stop()
     mgr.stop()
+
+
+def test_deregister_then_rejoin_same_id():
+    """A pod that leaves and rejoins under the same id reappears in
+    membership (tombstone cleared on register)."""
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager
+
+    mgr = ElasticManager(is_master=True)
+    a = ElasticManager(store=mgr.store)
+    a.register("podA")
+    b = ElasticManager(store=mgr.store)
+    b.register("podB")
+    b.deregister()
+    assert mgr._pods() == ["podA"]
+    b.register("podB")
+    assert mgr._pods() == ["podA", "podB"]
+    for m in (a, b, mgr):
+        m.stop()
+    mgr.store.close()

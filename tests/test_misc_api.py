@@ -132,3 +132,27 @@ def test_distribution_transforms_lognormal():
 
     s = ln.sample((1000,))
     assert bool(np.all(s.numpy() > 0))
+
+
+def test_static_executor_feed_by_name_and_errors():
+    """Feeds resolve by name (insertion order irrelevant); unknown and
+    partial feeds raise instead of mis-binding positionally."""
+    class Two(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.l = nn.Linear(4, 2)
+
+        def forward(self, x, y):
+            return self.l(x) + y
+
+    st = paddle.jit.to_static(Two())
+    exe = paddle.static.Executor()
+    x = np.ones((3, 4), np.float32)
+    y = np.full((3, 2), 7, np.float32)
+    a = exe.run(st, feed={"y": y, "x": x})[0]
+    b = exe.run(st, feed={"x": x, "y": y})[0]
+    np.testing.assert_array_equal(a, b)
+    with pytest.raises(ValueError):
+        exe.run(st, feed={"bogus": x})
+    with pytest.raises(TypeError):
+        exe.run(st, feed={"y": y})  # missing required input x
