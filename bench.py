@@ -53,25 +53,33 @@ def _hbm_bytes(dev) -> float:
 
 def _configs():
     from paddle_tpu.models import llama
-    # largest first; fall back if the chip is small (v5e has 16GB HBM and
-    # f32 master params + two Adam moments cost 12 bytes/param)
+    # largest first; each entry carries its optimizer memory mode and a
+    # peak-bytes/param estimate for the HBM pre-check.
+    # 2.6B on a 16GB v5e: bf16 params + factored-second-moment adafactor
+    # (optimizer/functional.py) ≈ 2(p) + 2(g) + ~0(nu) + f32 update temps.
+    # peak ≈ 2 (bf16 params) + 2 (bf16 grads, transient) B/param; factored
+    # second moment and f32 update temps are noise at this scale (measured
+    # on v5e: 2.62B params trains in ~11GB)
+    adafactor_bf16 = {"optimizer": "adafactor",
+                      "param_dtype": jnp.bfloat16, "bpp": 4}
+    adamw_f32 = {"optimizer": "adamw", "param_dtype": jnp.float32, "bpp": 16}
     yield "llama-2.6b", llama.LlamaConfig(
         vocab_size=32768, hidden_size=3072, intermediate_size=8192,
         num_layers=24, num_heads=24, num_kv_heads=8, head_dim=128,
-        max_seq_len=2048, remat=True), 8, 2048
+        max_seq_len=2048, remat=True), 8, 2048, adafactor_bf16
     yield "llama-740m", llama.LlamaConfig(
         vocab_size=32768, hidden_size=2048, intermediate_size=6144,
         num_layers=12, num_heads=16, num_kv_heads=8, head_dim=128,
-        max_seq_len=2048, remat=True), 8, 2048
+        max_seq_len=2048, remat=True), 8, 2048, adamw_f32
     yield "llama-510m", llama.LlamaConfig(
         vocab_size=32768, hidden_size=1536, intermediate_size=6144,
         num_layers=12, num_heads=12, num_kv_heads=4, head_dim=128,
-        max_seq_len=2048, remat=True), 8, 2048
+        max_seq_len=2048, remat=True), 8, 2048, adamw_f32
     yield "llama-350m", llama.LlamaConfig(
         vocab_size=32768, hidden_size=1024, intermediate_size=4096,
         num_layers=12, num_heads=8, num_kv_heads=8, head_dim=128,
-        max_seq_len=1024, remat=True), 8, 1024
-    yield "llama-tiny", llama.tiny_llama(), 4, 128
+        max_seq_len=1024, remat=True), 8, 1024, adamw_f32
+    yield "llama-tiny", llama.tiny_llama(), 4, 128, adamw_f32
 
 
 def _sync(x):
@@ -89,18 +97,23 @@ def main():
 
     dev = jax.devices()[0]
     last_err = None
-    for name, cfg, batch, seq in _configs():
-        # pre-check the 16-bytes/param optimizer footprint against HBM so an
+    for name, cfg, batch, seq, opt in _configs():
+        # pre-check this config's optimizer-mode footprint against HBM so an
         # OOM attempt can't poison the allocator for the fallback configs
         n_params = llama.num_params(llama._abstract_params(cfg))
-        if n_params * 16 > 0.8 * _hbm_bytes(dev) and dev.platform != "cpu":
+        if n_params * opt["bpp"] > 0.8 * _hbm_bytes(dev) \
+                and dev.platform != "cpu":
             continue
         try:
-            state = llama.init_train_state(cfg, jax.random.PRNGKey(0))
+            state = llama.init_train_state(
+                cfg, jax.random.PRNGKey(0), optimizer=opt["optimizer"],
+                param_dtype=opt["param_dtype"])
             tokens = jax.random.randint(
                 jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size)
             step = jax.jit(
-                lambda s, t: llama.train_step(s, t, cfg), donate_argnums=0)
+                lambda s, t: llama.train_step(s, t, cfg,
+                                              optimizer=opt["optimizer"]),
+                donate_argnums=0)
             for _ in range(2):  # compile + warmup
                 state, loss = step(state, tokens)
             _sync(loss)

@@ -11,8 +11,10 @@ from __future__ import annotations
 
 from . import auto_parallel  # noqa: F401
 from .auto_parallel import (  # noqa: F401
-    Partial, Placement, ProcessMesh, Replicate, Shard, dtensor_from_fn,
-    get_mesh, reshard, set_mesh, shard_layer, shard_tensor,
+    DistModel, Partial, Placement, ProcessMesh, Replicate, Shard,
+    ShardingStage1, ShardingStage2, ShardingStage3, dtensor_from_fn,
+    get_mesh, reshard, set_mesh, shard_layer, shard_optimizer, shard_scaler,
+    shard_tensor, to_static,
 )
 from .collective import (  # noqa: F401
     Group, ReduceOp, all_gather, all_gather_object, all_reduce, all_to_all,

@@ -335,3 +335,11 @@ def shard_dataloader(dataloader, meshes, input_keys=None, shard_dims=None,
                      is_dataset_splitted=False):
     return ShardDataloader(dataloader, meshes, input_keys, shard_dims,
                            is_dataset_splitted)
+
+
+from .api import (  # noqa: E402,F401
+    DistModel, ShardingStage1, ShardingStage2, ShardingStage3,
+    shard_optimizer, shard_scaler, to_static,
+)
+__all__ += ["DistModel", "ShardingStage1", "ShardingStage2",
+            "ShardingStage3", "shard_optimizer", "shard_scaler", "to_static"]

@@ -23,7 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-__all__ = ["pipeline_apply", "pipeline_apply_interleaved"]
+__all__ = ["pipeline_apply", "pipeline_apply_interleaved",
+           "pipeline_train_1f1b", "make_1f1b_schedule"]
 
 
 def _pipeline_body(stage_params, microbatches, stage_fn: Callable,
@@ -203,3 +204,278 @@ def pipeline_apply_interleaved(stage_fn: Callable, stacked_params, x,
         outs.append(fn(staged, wave_mb.astype(jnp.float32)))
     out = jnp.concatenate(outs, axis=0)
     return out.reshape((B,) + x.shape[1:])
+
+
+# ---------------------------------------------------------------------------
+# 1F1B — memory-shaped pipeline training
+# ---------------------------------------------------------------------------
+#
+# Parity target: the reference's default hybrid-parallel schedule
+# (fleet/meta_parallel/pipeline_parallel.py:684 PipelineParallel 1F1B;
+# static-mode variants under passes/pipeline_scheduler_pass/). Its point is
+# the MEMORY profile: backward for microbatch m starts as soon as its forward
+# drains, so at most (pp - stage) microbatches are in flight per device —
+# O(pp), not O(M) like GPipe.
+#
+# TPU-native re-design (not a translation of the host-driven p2p loop):
+#   * The 1F1B timetable is SIMULATED ON THE HOST at trace time into a static
+#     [T, S] action table (idle/fwd/bwd + microbatch id + arrival tags).
+#     The reference derives the same order dynamically from queues + NCCL
+#     waits; here the schedule is data, and the program is one lax.scan.
+#   * Each step every device runs lax.switch on its action, then two
+#     lax.ppermute hops move activations (forward) and cotangents (backward)
+#     over ICI in lockstep.
+#   * Backward is an inline per-microbatch jax.vjp that RECOMPUTES the stage
+#     forward from the saved boundary input (recompute-1F1B): the only
+#     O(schedule) state is a ring of `pp` boundary activations. The outer
+#     scan is never differentiated — it PRODUCES grads, so XLA stores no
+#     scan residuals.
+#   * The loss head runs inside the last stage (lax.cond), so microbatch
+#     inputs are token ids (tiny) and nothing O(M * hidden) is ever
+#     replicated or broadcast — the two traffic problems of the GPipe path.
+
+_IDLE, _FWD, _BWD = 0, 1, 2
+
+
+def make_1f1b_schedule(num_microbatches: int, n_stages: int):
+    """Simulate the 1F1B timetable. Returns int32 numpy arrays, all [T, S]:
+    act (0 idle / 1 fwd / 2 bwd), mb (microbatch id of the action),
+    arr_f (microbatch id arriving on the forward wire this step, -1 if none),
+    arr_b (same for the backward wire).
+
+    Policy per stage s: (pp-1-s) warmup forwards, then strict 1F1B
+    alternation, then cooldown backwards — the reference's
+    PipelineParallel._forward_backward_pipeline order. Asserts the invariants
+    the compiled body relies on: in-flight <= pp - s, and both wires are
+    consumed before their 2-slot parity ring is overwritten."""
+    import numpy as np
+
+    M, S = num_microbatches, n_stages
+    next_f = [0] * S
+    next_b = [0] * S
+    f_time = [[None] * S for _ in range(M)]
+    b_time = [[None] * S for _ in range(M)]
+    act_rows, mb_rows = [], []
+    max_inflight = [0] * S
+    t = 0
+    while any(nb < M for nb in next_b):
+        assert t < 4 * (M + S) + 16, "1f1b schedule failed to converge"
+        ra, rm = [_IDLE] * S, [0] * S
+        for s in range(S):
+            warmup = min(S - 1 - s, M)
+            fm, bm = next_f[s], next_b[s]
+            can_f = fm < M and (
+                s == 0 or (f_time[fm][s - 1] is not None
+                           and f_time[fm][s - 1] < t))
+            can_b = bm < M and (
+                (s == S - 1 and f_time[bm][s] is not None
+                 and f_time[bm][s] < t)
+                or (s < S - 1 and b_time[bm][s + 1] is not None
+                    and b_time[bm][s + 1] < t))
+            f_turn = fm < M and (fm < warmup or fm - warmup == bm)
+            if f_turn and can_f:
+                ra[s], rm[s] = _FWD, fm
+                f_time[fm][s] = t
+                next_f[s] += 1
+            elif not f_turn and can_b:  # B only on its turn: caps in-flight
+                ra[s], rm[s] = _BWD, bm
+                b_time[bm][s] = t
+                next_b[s] += 1
+            max_inflight[s] = max(max_inflight[s], next_f[s] - next_b[s])
+        act_rows.append(ra)
+        mb_rows.append(rm)
+        t += 1
+
+    act = np.asarray(act_rows, np.int32)
+    mbt = np.asarray(mb_rows, np.int32)
+    T = act.shape[0]
+    for s in range(S):
+        assert max_inflight[s] <= S - s, (s, max_inflight[s])
+        assert int((act[:, s] == _FWD).sum()) == M
+        assert int((act[:, s] == _BWD).sum()) == M
+
+    arr_f = -np.ones((T, S), np.int32)
+    arr_b = -np.ones((T, S), np.int32)
+    for tt in range(1, T):
+        for s in range(S):
+            if s > 0 and act[tt - 1, s - 1] == _FWD:
+                arr_f[tt, s] = mbt[tt - 1, s - 1]
+            if s < S - 1 and act[tt - 1, s + 1] == _BWD:
+                arr_b[tt, s] = mbt[tt - 1, s + 1]
+
+    # parity-ring safety: payload m must be consumed strictly before payload
+    # m+2 (same ring slot) arrives
+    for s in range(S):
+        for wire, times in (
+                (arr_f, {m: f_time[m][s] for m in range(M)} if s else None),
+                (arr_b, {m: b_time[m][s] for m in range(M)} if s < S - 1
+                 else None)):
+            if times is None:
+                continue
+            arrive = {int(wire[tt, s]): tt for tt in range(T)
+                      if wire[tt, s] >= 0}
+            for m, tt in arrive.items():
+                if m + 2 in arrive:
+                    assert times[m] < arrive[m + 2], (s, m, times[m], arrive)
+    return act, mbt, arr_f, arr_b
+
+
+def pipeline_train_1f1b(first_fn: Callable, stage_fn: Callable,
+                        last_fn: Callable, first_params, stacked_params,
+                        last_params, inputs, targets, mesh: Mesh,
+                        num_microbatches: int, axis_name: str = "pp",
+                        hidden_dtype=jnp.bfloat16):
+    """Fused 1F1B pipeline train pass. Returns (mean_loss, (g_first,
+    g_stacked, g_last)) with grads in f32 and mean-over-microbatch scaling.
+
+    first_fn(first_params, in_mb) -> h          (stage 0 only; e.g. embed)
+    stage_fn(stage_layer_params, h) -> h        (every stage's layer chunk)
+    last_fn(last_params, h, tgt_mb) -> scalar   (last stage; norm+head+loss,
+                                                 mean over the microbatch)
+    inputs/targets: [B, ...] with B % num_microbatches == 0 (token ids —
+    small; only the boundary activation rides the ring).
+    stacked_params: pytree with leading layer axis divisible by pp.
+    """
+    S = dict(mesh.shape)[axis_name]
+    M = num_microbatches
+    B = inputs.shape[0]
+    assert B % M == 0, (B, M)
+    mb_in = inputs.reshape((M, B // M) + inputs.shape[1:])
+    mb_tg = targets.reshape((M, B // M) + targets.shape[1:])
+
+    act, mbt, arr_f, arr_b = make_1f1b_schedule(M, S)
+    T = act.shape[0]
+
+    def split_stages(a):
+        L = a.shape[0]
+        assert L % S == 0, (L, S)
+        return a.reshape((S, L // S) + a.shape[1:])
+
+    staged = jax.tree_util.tree_map(split_stages, stacked_params)
+    pspec = jax.tree_util.tree_map(
+        lambda a: P(axis_name, *([None] * (a.ndim - 1))), staged)
+    rspec = jax.tree_util.tree_map(lambda a: P(), first_params)
+    lspec = jax.tree_util.tree_map(lambda a: P(), last_params)
+
+    # boundary activation shape (one microbatch through first_fn)
+    mb_abs = jax.eval_shape(lambda a: a[0], mb_in)
+    h_shape = jax.eval_shape(first_fn, first_params, mb_abs)
+    h_like = jnp.zeros(h_shape.shape, hidden_dtype)
+
+    perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+    perm_bwd = [((i + 1) % S, i) for i in range(S)]
+
+    act_t = jnp.asarray(act)
+    mbt_t = jnp.asarray(mbt)
+    arrf_t = jnp.asarray(arr_f)
+    arrb_t = jnp.asarray(arr_b)
+
+    f32 = jnp.float32
+
+    def body(first_p, staged_p, last_p, tok, tgt):
+        stage = jax.lax.axis_index(axis_name)
+        is_first = stage == 0
+        is_last = stage == S - 1
+        sp_local = jax.tree_util.tree_map(lambda a: a[0], staged_p)
+
+        gf0 = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, f32), first_p)
+        gs0 = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, f32), sp_local)
+        gl0 = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, f32), last_p)
+
+        def step(carry, t):
+            (wire_f, wire_b, ring_f, ring_b, in_buf,
+             gf, gs, gl, loss_sum) = carry
+            af = arrf_t[t][stage]
+            ab = arrb_t[t][stage]
+            ring_f = jax.lax.cond(
+                af >= 0,
+                lambda: jax.lax.dynamic_update_index_in_dim(
+                    ring_f, wire_f, jnp.mod(af, 2), 0),
+                lambda: ring_f)
+            ring_b = jax.lax.cond(
+                ab >= 0,
+                lambda: jax.lax.dynamic_update_index_in_dim(
+                    ring_b, wire_b, jnp.mod(ab, 2), 0),
+                lambda: ring_b)
+            a = act_t[t][stage]
+            m = mbt_t[t][stage]
+
+            def br_idle():
+                return (in_buf, gf, gs, gl, loss_sum,
+                        jnp.zeros_like(h_like), jnp.zeros_like(h_like))
+
+            def br_fwd():
+                x_in = jax.lax.cond(
+                    is_first,
+                    lambda: first_fn(first_p, tok[m]).astype(hidden_dtype),
+                    lambda: ring_f[jnp.mod(m, 2)])
+                y = stage_fn(sp_local, x_in).astype(hidden_dtype)
+                buf = jax.lax.dynamic_update_index_in_dim(
+                    in_buf, x_in, jnp.mod(m, S), 0)
+                return (buf, gf, gs, gl, loss_sum, y,
+                        jnp.zeros_like(h_like))
+
+            def br_bwd():
+                x_saved = in_buf[jnp.mod(m, S)]
+                g_in = ring_b[jnp.mod(m, 2)]
+                tok_m, tgt_m = tok[m], tgt[m]
+
+                def obj(fp, sp_, lp, x_s):
+                    x_in = jax.lax.cond(
+                        is_first,
+                        lambda: first_fn(fp, tok_m).astype(hidden_dtype),
+                        lambda: x_s)
+                    y = stage_fn(sp_, x_in)
+                    return jax.lax.cond(
+                        is_last,
+                        lambda: last_fn(lp, y, tgt_m).astype(f32),
+                        lambda: jnp.vdot(y.astype(f32), g_in.astype(f32)))
+
+                val, (gfp, gsp, glp, gx) = jax.value_and_grad(
+                    obj, argnums=(0, 1, 2, 3))(
+                        first_p, sp_local, last_p, x_saved)
+                add = lambda t1, t2: jax.tree_util.tree_map(
+                    lambda a, b: a + b.astype(f32), t1, t2)
+                return (in_buf, add(gf, gfp), add(gs, gsp), add(gl, glp),
+                        loss_sum + jnp.where(is_last, val, 0.0),
+                        jnp.zeros_like(h_like), gx.astype(hidden_dtype))
+
+            (in_buf2, gf2, gs2, gl2, loss2, send_f, send_b) = jax.lax.switch(
+                a, [br_idle, br_fwd, br_bwd])
+            wire_f2 = jax.lax.ppermute(send_f, axis_name, perm_fwd)
+            wire_b2 = jax.lax.ppermute(send_b, axis_name, perm_bwd)
+            return (wire_f2, wire_b2, ring_f, ring_b, in_buf2,
+                    gf2, gs2, gl2, loss2), None
+
+        zero_h = jnp.zeros_like(h_like)
+        carry0 = (zero_h, zero_h,
+                  jnp.zeros((2,) + h_like.shape, hidden_dtype),
+                  jnp.zeros((2,) + h_like.shape, hidden_dtype),
+                  jnp.zeros((S,) + h_like.shape, hidden_dtype),
+                  gf0, gs0, gl0, jnp.zeros((), f32))
+        carry, _ = jax.lax.scan(step, carry0, jnp.arange(T))
+        gf, gs, gl, loss_sum = carry[5], carry[6], carry[7], carry[8]
+
+        inv_m = 1.0 / M
+        # f32 psums only (XLA CPU AllReducePromotion miscompiles bf16
+        # all-reduces from partial-manual regions)
+        gf = jax.tree_util.tree_map(
+            lambda a: jax.lax.psum(a * inv_m, axis_name), gf)
+        gl = jax.tree_util.tree_map(
+            lambda a: jax.lax.psum(a * inv_m, axis_name), gl)
+        loss = jax.lax.psum(loss_sum, axis_name) * inv_m
+        gs = jax.tree_util.tree_map(lambda a: (a * inv_m)[None], gs)
+        return loss, gf, gs, gl
+
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(rspec, pspec, lspec, P(), P()),
+        out_specs=(P(), rspec, pspec, lspec),
+        axis_names={axis_name}, check_vma=False)
+    loss, gf, gs, gl = fn(first_params, staged, last_params, mb_in, mb_tg)
+    g_stacked = jax.tree_util.tree_map(
+        lambda a: a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:]), gs)
+    return loss, (gf, g_stacked, gl)

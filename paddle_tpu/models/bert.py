@@ -211,12 +211,19 @@ def classification_loss(params, batch, config: BertConfig):
     return jnp.mean(logz - gold)
 
 
-def init_train_state(config: BertConfig, key: jax.Array) -> TrainState:
+def init_train_state(config: BertConfig, key: jax.Array,
+                     optimizer: str = "adamw", moment_dtype=jnp.float32,
+                     param_dtype=jnp.float32) -> TrainState:
+    """Same optimizer memory modes as llama.init_train_state (moments must
+    match the ``optimizer=`` later passed to train_step)."""
+    from ..optimizer.functional import init_moments
+
     params = init_params(config, key)
-    return TrainState(params,
-                      jax.tree_util.tree_map(jnp.zeros_like, params),
-                      jax.tree_util.tree_map(jnp.zeros_like, params),
-                      jnp.zeros((), jnp.int32))
+    if param_dtype != jnp.float32:
+        params = jax.tree_util.tree_map(
+            lambda p: p.astype(param_dtype), params)
+    mu, nu = init_moments(params, optimizer, moment_dtype)
+    return TrainState(params, mu, nu, jnp.zeros((), jnp.int32))
 
 
 def train_step(state: TrainState, batch, config: BertConfig, lr=2e-5, **kw):

@@ -73,6 +73,10 @@ class LlamaConfig:
     # >1 switches to the circular interleaved (VPP) schedule with this many
     # chunks per stage (requires num_layers % (pp * chunks) == 0)
     pipeline_chunks: int = 1
+    # "gpipe" (fwd pipeline, XLA-derived bwd) or "1f1b" (fused fwd+bwd with
+    # O(pp) live activations — the reference's default hybrid schedule,
+    # pipeline_parallel.py:684). 1f1b applies to train_step only.
+    pipeline_schedule: str = "gpipe"
 
 
 def llama3_8b() -> LlamaConfig:
@@ -383,6 +387,49 @@ def loss_fn(params, tokens, config: LlamaConfig):
     return jnp.mean(logz - gold)
 
 
+def _loss_and_grads_1f1b(params, tokens, config: LlamaConfig, mesh: Mesh):
+    """Fused 1F1B loss+grad pass (distributed/pipeline.pipeline_train_1f1b):
+    embed runs on stage 0, final-norm+head+CE inside the last stage, so only
+    token ids and one boundary activation per in-flight microbatch exist
+    per device — the reference 1F1B memory profile."""
+    from ..distributed.pipeline import pipeline_train_1f1b
+
+    c = config
+    assert not c.tie_embeddings, "1f1b schedule requires untied embeddings"
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+
+    def first_fn(fp, tok_mb):
+        return fp["embed"].astype(c.dtype)[tok_mb]
+
+    def stage_fn(lp, x):
+        with activation_mesh(None):
+            cos, sin = _rope_tables(x.shape[1], c.head_dim, c.rope_theta)
+            body = functools.partial(_layer_body, cos=cos, sin=sin, config=c)
+            if c.remat:
+                body = jax.checkpoint(body)
+            x, _ = jax.lax.scan(lambda h, p: (body(h, p), None), x, lp)
+        return x
+
+    def last_fn(lp, y, tgt_mb):
+        x = _rms_norm(y, lp["final_norm"], c.rms_eps)
+        logits = (x @ lp["lm_head"].astype(c.dtype)).astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, tgt_mb[..., None],
+                                   axis=-1)[..., 0]
+        return jnp.mean(logz - gold)
+
+    first_params = {"embed": params["embed"]}
+    last_params = {"final_norm": params["final_norm"],
+                   "lm_head": params["lm_head"]}
+    loss, (gf, gs, gl) = pipeline_train_1f1b(
+        first_fn, stage_fn, last_fn, first_params, params["layers"],
+        last_params, inputs, targets, mesh, c.pipeline_microbatches,
+        axis_name="pp", hidden_dtype=c.dtype)
+    grads = {"embed": gf["embed"], "layers": gs,
+             "final_norm": gl["final_norm"], "lm_head": gl["lm_head"]}
+    return loss, grads
+
+
 # ---------------------------------------------------------------------------
 # train state / step  (adamw in plain jax — the whole step is one jit)
 # ---------------------------------------------------------------------------
@@ -402,51 +449,88 @@ class TrainState:
         return cls(*children)
 
 
-def init_train_state(config: LlamaConfig, key: jax.Array) -> TrainState:
+def init_train_state(config: LlamaConfig, key: jax.Array,
+                     optimizer: str = "adamw",
+                     moment_dtype=jnp.float32,
+                     param_dtype=jnp.float32) -> TrainState:
+    """``optimizer``/``moment_dtype``/``param_dtype`` select the memory mode
+    (optimizer/functional.py): adamw+f32 is the default 16-bytes/param
+    recipe; adafactor+bf16 params is ~4 bytes/param — how a >2B model fits
+    one 16GB chip."""
+    from ..optimizer.functional import init_moments
+
     params = init_params(config, key)
-    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
-    return TrainState(params, zeros,
-                      jax.tree_util.tree_map(jnp.zeros_like, params),
-                      jnp.zeros((), jnp.int32))
+    if param_dtype != jnp.float32:
+        params = jax.tree_util.tree_map(
+            lambda p: p.astype(param_dtype), params)
+    mu, nu = init_moments(params, optimizer, moment_dtype)
+    return TrainState(params, mu, nu, jnp.zeros((), jnp.int32))
 
 
 def train_step(state: TrainState, tokens, config,
                lr=3e-4, beta1=0.9, beta2=0.95, eps=1e-8, wd=0.1,
-               clip_norm=1.0, loss_function=None):
-    """One fused pretrain step: fwd+bwd, global-norm clip, AdamW.
+               clip_norm=1.0, loss_function=None, optimizer="adamw",
+               accum_steps=1):
+    """One fused pretrain step: fwd+bwd, global-norm clip, optimizer update
+    (optimizer/functional.py — adamw or factored-moment adafactor).
     The reference splits this across EagerReducer buckets +
     HybridParallelOptimizer (hybrid_parallel_optimizer.py:540); here the whole
     thing is one traced program and GSPMD/XLA overlap the collectives.
     ``loss_function(params, tokens, config)`` defaults to the llama loss —
-    MoE passes its own (models/moe.py)."""
-    lf = loss_function or loss_fn
-    loss, grads = jax.value_and_grad(lf)(state.params, tokens, config)
+    MoE passes its own (models/moe.py). ``accum_steps`` > 1 scans fwd+bwd
+    over batch slices, accumulating grads in f32 (activation memory ÷ N —
+    the reference's GradientMergePass)."""
+    from ..optimizer.functional import optimizer_update
+
+    mesh = _ACT_MESH
+    pp = dict(mesh.shape).get("pp", 1) if mesh is not None else 1
+    if (loss_function is None and pp > 1 and config.pipeline_microbatches > 0
+            and config.pipeline_schedule == "1f1b"):
+        if accum_steps > 1:
+            raise ValueError(
+                "accum_steps>1 is redundant under the 1f1b schedule — raise "
+                "pipeline_microbatches instead (it already slices the batch)")
+        if config.pipeline_chunks > 1:
+            raise NotImplementedError(
+                "interleaved chunks are a gpipe-schedule feature; 1f1b runs "
+                "one chunk per stage (set pipeline_chunks=1)")
+        loss, grads = _loss_and_grads_1f1b(state.params, tokens, config, mesh)
+    elif accum_steps > 1:
+        lf = loss_function or loss_fn
+        if not hasattr(tokens, "shape"):
+            raise ValueError(
+                "accum_steps>1 requires an array batch; tuple batches "
+                "(e.g. bert's (ids, labels)) must pre-slice themselves")
+        B = tokens.shape[0]
+        assert B % accum_steps == 0, (B, accum_steps)
+        slices = tokens.reshape((accum_steps, B // accum_steps)
+                                + tokens.shape[1:])
+
+        def acc(carry, mb):
+            acc_l, acc_g = carry
+            l, g = jax.value_and_grad(lf)(state.params, mb, config)
+            return (acc_l + l, jax.tree_util.tree_map(
+                lambda a, b: a + b.astype(jnp.float32), acc_g, g)), None
+
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+        (loss, grads), _ = jax.lax.scan(acc, (jnp.zeros((), jnp.float32),
+                                              zeros), slices)
+        loss = loss / accum_steps
+        grads = jax.tree_util.tree_map(lambda g: g / accum_steps, grads)
+    else:
+        lf = loss_function or loss_fn
+        loss, grads = jax.value_and_grad(lf)(state.params, tokens, config)
 
     gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
                          for g in jax.tree_util.tree_leaves(grads)))
     scale = jnp.minimum(1.0, clip_norm / (gnorm + 1e-6))
 
-    step = state.step + 1
-    t = step.astype(jnp.float32)
-    bc1 = 1.0 - beta1 ** t
-    bc2 = 1.0 - beta2 ** t
-
-    def upd(p, g, m, n):
-        g = g.astype(jnp.float32) * scale
-        m = beta1 * m + (1 - beta1) * g
-        n = beta2 * n + (1 - beta2) * g * g
-        u = (m / bc1) / (jnp.sqrt(n / bc2) + eps)
-        return p - lr * (u + wd * p), m, n
-
-    flat_p, treedef = jax.tree_util.tree_flatten(state.params)
-    flat_g = jax.tree_util.tree_leaves(grads)
-    flat_m = jax.tree_util.tree_leaves(state.mu)
-    flat_n = jax.tree_util.tree_leaves(state.nu)
-    out = [upd(p, g, m, n) for p, g, m, n in zip(flat_p, flat_g, flat_m, flat_n)]
-    new_p = jax.tree_util.tree_unflatten(treedef, [o[0] for o in out])
-    new_m = jax.tree_util.tree_unflatten(treedef, [o[1] for o in out])
-    new_n = jax.tree_util.tree_unflatten(treedef, [o[2] for o in out])
-    return TrainState(new_p, new_m, new_n, step), loss
+    new_p, new_m, new_n = optimizer_update(
+        state.params, grads, state.mu, state.nu, state.step,
+        optimizer=optimizer, lr=lr, beta1=beta1, beta2=beta2, eps=eps,
+        wd=wd, scale=scale)
+    return TrainState(new_p, new_m, new_n, state.step + 1), loss
 
 
 def flops_per_token(config: LlamaConfig, seq_len: int) -> float:

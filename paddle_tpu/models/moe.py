@@ -293,11 +293,19 @@ def loss_fn(params, tokens, config: MoEConfig):
     return ce + config.router_aux_coef * aux
 
 
-def init_train_state(config: MoEConfig, key: jax.Array) -> TrainState:
+def init_train_state(config: MoEConfig, key: jax.Array,
+                     optimizer: str = "adamw", moment_dtype=jnp.float32,
+                     param_dtype=jnp.float32) -> TrainState:
+    """Same optimizer memory modes as llama.init_train_state (moments must
+    match the ``optimizer=`` later passed to train_step)."""
+    from ..optimizer.functional import init_moments
+
     params = init_params(config, key)
-    z = jax.tree_util.tree_map(jnp.zeros_like, params)
-    z2 = jax.tree_util.tree_map(jnp.zeros_like, params)
-    return TrainState(params, z, z2, jnp.zeros((), jnp.int32))
+    if param_dtype != jnp.float32:
+        params = jax.tree_util.tree_map(
+            lambda p: p.astype(param_dtype), params)
+    mu, nu = init_moments(params, optimizer, moment_dtype)
+    return TrainState(params, mu, nu, jnp.zeros((), jnp.int32))
 
 
 def train_step(state: TrainState, tokens, config: MoEConfig, **kw):

@@ -1,0 +1,134 @@
+"""Functional optimizer steps for the jit-compiled training path.
+
+Parity: the reference's fused device optimizers — AdamW
+(paddle/phi/kernels/gpu/adamw_kernel.cu, python surface
+optimizer/adamw.py:54) plus its memory-saving modes: multi_precision
+bf16-param training (adamw.py `_multi_precision`) and the master-weight
+scheme. The factored second moment is the Adafactor trade
+(memory-efficient-adaptivity; the reference exposes the same trade through
+incubate distributed_fused_lamb / sharding offload knobs).
+
+TPU-native design: pure functions over param pytrees — the whole
+update fuses into the train step's single XLA program; optimizer
+"memory modes" are just dtypes/shapes of the moment pytrees:
+
+  * ``adamw`` + f32 moments: 8 bytes/param of optimizer state.
+  * ``adamw`` + bf16 moments: 4 bytes/param (quality cost ~none at scale).
+  * ``adafactor``: O(rows+cols) second moment, no first moment —
+    ~0 bytes/param; the standard way to fit >2B params on one 16GB chip.
+
+All math runs in f32 regardless of storage dtype; params may themselves be
+stored bf16 (pure-bf16 training) — updates are computed f32 and cast back.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["init_moments", "optimizer_update", "adamw_update",
+           "adafactor_update"]
+
+_f32 = jnp.float32
+
+
+def _tmap(f, *trees):
+    return jax.tree_util.tree_map(f, *trees)
+
+
+def init_moments(params, optimizer: str = "adamw",
+                 moment_dtype=jnp.float32):
+    """Return (mu, nu) moment pytrees for ``optimizer``.
+
+    adamw: mu/nu shaped like params in ``moment_dtype``.
+    adafactor: mu is per-leaf zeros[()] placeholders (no first moment); nu
+    leaves are dicts {"vr": [..., rows], "vc": [..., cols]} for ndim>=2
+    (factored over the trailing two dims, leading stack dims kept) or
+    {"v": full} for vectors/scalars.
+    """
+    if optimizer == "adamw":
+        zeros = _tmap(lambda p: jnp.zeros(p.shape, moment_dtype), params)
+        return zeros, _tmap(lambda p: jnp.zeros(p.shape, moment_dtype),
+                            params)
+    if optimizer == "adafactor":
+        def nu_like(p):
+            if p.ndim >= 2:
+                return {"vr": jnp.zeros(p.shape[:-1], _f32),
+                        "vc": jnp.zeros(p.shape[:-2] + p.shape[-1:], _f32)}
+            return {"v": jnp.zeros(p.shape, _f32)}
+
+        mu = _tmap(lambda p: jnp.zeros((), _f32), params)
+        return mu, _tmap(nu_like, params)
+    raise ValueError(f"unknown optimizer {optimizer!r}")
+
+
+def adamw_update(p, g, m, n, *, lr, beta1, beta2, eps, wd, scale, bc1, bc2):
+    """One AdamW leaf update; moments stored in their own dtype, math f32."""
+    g = g.astype(_f32) * scale
+    mf = m.astype(_f32)
+    nf = n.astype(_f32)
+    mf = beta1 * mf + (1 - beta1) * g
+    nf = beta2 * nf + (1 - beta2) * g * g
+    u = (mf / bc1) / (jnp.sqrt(nf / bc2) + eps)
+    new_p = p.astype(_f32) - lr * (u + wd * p.astype(_f32))
+    return new_p.astype(p.dtype), mf.astype(m.dtype), nf.astype(n.dtype)
+
+
+def adafactor_update(p, g, nu, *, lr, beta2t, eps1, eps2, clip, wd, scale):
+    """One Adafactor leaf update (Shazeer & Stern 2018): factored second
+    moment over the trailing two dims, RMS-clipped update, no first moment."""
+    g = g.astype(_f32) * scale
+    g2 = g * g + eps1
+    if "vr" in nu:
+        vr = beta2t * nu["vr"] + (1 - beta2t) * jnp.mean(g2, axis=-1)
+        vc = beta2t * nu["vc"] + (1 - beta2t) * jnp.mean(g2, axis=-2)
+        # v̂ = vr ⊗ vc / row-sum(vr)  (rank-1 reconstruction)
+        denom = jnp.mean(vr, axis=-1, keepdims=True)
+        v = (vr / denom)[..., :, None] * vc[..., None, :]
+        new_nu = {"vr": vr, "vc": vc}
+    else:
+        v = beta2t * nu["v"] + (1 - beta2t) * g2
+        new_nu = {"v": v}
+    u = g * jax.lax.rsqrt(v + eps1)
+    # clip update RMS to `clip` (d=1.0 in the paper)
+    rms = jnp.sqrt(jnp.mean(u * u) + 1e-30)
+    u = u / jnp.maximum(1.0, rms / clip)
+    step_size = jnp.maximum(eps2, lr)
+    new_p = p.astype(_f32) - step_size * (u + wd * p.astype(_f32))
+    return new_p.astype(p.dtype), new_nu
+
+
+def optimizer_update(params, grads, mu, nu, step, *, optimizer="adamw",
+                     lr=3e-4, beta1=0.9, beta2=0.95, eps=1e-8, wd=0.1,
+                     scale=1.0, adafactor_clip=1.0):
+    """Apply one optimizer step over whole pytrees. Returns
+    (params, mu, nu). ``scale`` folds in grad clipping / accumulation."""
+    t = (step + 1).astype(_f32)
+    if optimizer == "adamw":
+        bc1 = 1.0 - beta1 ** t
+        bc2 = 1.0 - beta2 ** t
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        outs = [adamw_update(p, g, m, n, lr=lr, beta1=beta1, beta2=beta2,
+                             eps=eps, wd=wd, scale=scale, bc1=bc1, bc2=bc2)
+                for p, g, m, n in zip(
+                    flat_p, jax.tree_util.tree_leaves(grads),
+                    jax.tree_util.tree_leaves(mu),
+                    jax.tree_util.tree_leaves(nu))]
+        unflat = lambda i: jax.tree_util.tree_unflatten(
+            treedef, [o[i] for o in outs])
+        return unflat(0), unflat(1), unflat(2)
+    if optimizer == "adafactor":
+        # decaying beta2̂_t = 1 - t^-0.8 (paper §7), lr as relative step
+        beta2t = 1.0 - t ** -0.8
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_g = jax.tree_util.tree_leaves(grads)
+        flat_nu = treedef.flatten_up_to(nu)
+        outs = [adafactor_update(p, g, n, lr=lr, beta2t=beta2t, eps1=1e-30,
+                                 eps2=1e-3, clip=adafactor_clip, wd=wd,
+                                 scale=scale)
+                for p, g, n in zip(flat_p, flat_g, flat_nu)]
+        new_p = jax.tree_util.tree_unflatten(treedef, [o[0] for o in outs])
+        new_nu = jax.tree_util.tree_unflatten(treedef, [o[1] for o in outs])
+        return new_p, mu, new_nu
+    raise ValueError(f"unknown optimizer {optimizer!r}")

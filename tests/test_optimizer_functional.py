@@ -1,0 +1,121 @@
+"""Functional optimizer memory modes (optimizer/functional.py): adamw with
+bf16 moments, adafactor factored second moment, pure-bf16 params, gradient
+accumulation — the recipes that fit >2B params on a 16GB chip (parity:
+reference multi_precision AdamW + memory-efficient optimizer trades)."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.models import llama
+from paddle_tpu.optimizer.functional import (adafactor_update, init_moments,
+                                             optimizer_update)
+
+
+def _cfg():
+    return llama.tiny_llama(vocab=64, hidden=32, layers=2, heads=2,
+                            kv_heads=2, seq=16, ffn=64)
+
+
+def _tokens(cfg):
+    return jax.random.randint(jax.random.PRNGKey(1), (8, 17), 0,
+                              cfg.vocab_size)
+
+
+def _train(state, step, tokens, n=10):
+    losses = []
+    for _ in range(n):
+        state, loss = step(state, tokens)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses)), losses
+    return losses
+
+
+def test_adafactor_bf16_params_train():
+    cfg = _cfg()
+    tokens = _tokens(cfg)
+    st = llama.init_train_state(cfg, jax.random.PRNGKey(0),
+                                optimizer="adafactor",
+                                param_dtype=jnp.bfloat16)
+    step = jax.jit(lambda s, t: llama.train_step(s, t, cfg, lr=1e-2,
+                                                 optimizer="adafactor"))
+    losses = _train(st, step, tokens)
+    assert losses[-1] < losses[0] - 0.5, losses
+
+
+def test_adafactor_second_moment_is_factored():
+    cfg = _cfg()
+    st = llama.init_train_state(cfg, jax.random.PRNGKey(0),
+                                optimizer="adafactor")
+    nu_size = sum(x.size for x in jax.tree_util.tree_leaves(st.nu))
+    p_size = sum(x.size for x in jax.tree_util.tree_leaves(st.params))
+    assert nu_size < 0.2 * p_size, (nu_size, p_size)  # O(rows+cols)
+
+
+def test_adafactor_rank1_reconstruction():
+    """vr ⊗ vc / mean(vr) equals the exact second moment for one step of a
+    rank-1 gradient (the regime the factorization is exact in)."""
+    g = jnp.outer(jnp.arange(1.0, 5.0), jnp.arange(1.0, 4.0))
+    p = jnp.zeros_like(g)
+    nu = {"vr": jnp.zeros(4), "vc": jnp.zeros(3)}
+    _, new_nu = adafactor_update(p, g, nu, lr=0.0, beta2t=0.0, eps1=0.0,
+                                 eps2=0.0, clip=1e9, wd=0.0, scale=1.0)
+    v_exact = g * g
+    denom = jnp.mean(new_nu["vr"], keepdims=True)
+    v_rec = (new_nu["vr"] / denom)[:, None] * new_nu["vc"][None, :]
+    np.testing.assert_allclose(np.asarray(v_rec), np.asarray(v_exact),
+                               rtol=1e-5)
+
+
+def test_adamw_bf16_moments_train():
+    cfg = _cfg()
+    tokens = _tokens(cfg)
+    st = llama.init_train_state(cfg, jax.random.PRNGKey(0),
+                                moment_dtype=jnp.bfloat16)
+    assert all(x.dtype == jnp.bfloat16
+               for x in jax.tree_util.tree_leaves(st.mu))
+    step = jax.jit(lambda s, t: llama.train_step(s, t, cfg, lr=1e-2))
+    losses = _train(st, step, tokens)
+    assert losses[-1] < losses[0] - 0.5, losses
+
+
+def test_grad_accumulation_matches_full_batch():
+    cfg = _cfg()
+    tokens = _tokens(cfg)
+    st = llama.init_train_state(cfg, jax.random.PRNGKey(0))
+    s_full, l_full = jax.jit(
+        lambda s, t: llama.train_step(s, t, cfg))(st, tokens)
+    s_acc, l_acc = jax.jit(
+        lambda s, t: llama.train_step(s, t, cfg, accum_steps=4))(st, tokens)
+    assert abs(float(l_full) - float(l_acc)) < 5e-3
+    for a, b in zip(jax.tree_util.tree_leaves(s_full.params),
+                    jax.tree_util.tree_leaves(s_acc.params)):
+        assert float(jnp.max(jnp.abs(a - b))) < 5e-3
+
+
+def test_accum_steps_rejects_tuple_batch_and_1f1b():
+    cfg = _cfg()
+    st = llama.init_train_state(cfg, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="array batch"):
+        llama.train_step(st, (jnp.zeros((4, 17), jnp.int32),) * 2, cfg,
+                         accum_steps=2,
+                         loss_function=lambda p, t, c: jnp.zeros(()))
+    from jax.sharding import Mesh
+    mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(2, 1, 1, 1),
+                ("pp", "dp", "sp", "tp"))
+    cfg_pp = dataclasses.replace(cfg, pipeline_microbatches=2,
+                                 pipeline_schedule="1f1b")
+    with llama.activation_mesh(mesh), pytest.raises(ValueError,
+                                                    match="redundant"):
+        llama.train_step(st, _tokens(cfg), cfg_pp, accum_steps=2)
+
+
+def test_optimizer_update_unknown_name():
+    with pytest.raises(ValueError):
+        init_moments({"w": jnp.zeros((2, 2))}, optimizer="sgdx")
+    with pytest.raises(ValueError):
+        optimizer_update({"w": jnp.zeros((2, 2))}, {"w": jnp.zeros((2, 2))},
+                         None, None, jnp.zeros((), jnp.int32),
+                         optimizer="sgdx")
