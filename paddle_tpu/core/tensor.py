@@ -278,8 +278,8 @@ class Tensor:
     def to(self, *args, **kwargs):
         # accepts dtype-like or device-like (paddle Tensor.to parity)
         out = self
-        for a in list(args) + list(kwargs.values()):
-            if a is None or a in ("float32",) and False:
+        for key, a in list(zip([None] * len(args), args)) + list(kwargs.items()):
+            if a is None or isinstance(a, bool) or key == "blocking":
                 continue
             try:
                 d = dtypes.convert_dtype(a)

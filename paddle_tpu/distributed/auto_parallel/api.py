@@ -123,6 +123,12 @@ class _ShardOptimizer:
         self._inner.step()
         self._shard_state()
 
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()  # the wrapper's step, so _shard_state runs
+        return None, None
+
     def clear_grad(self, set_to_zero: bool = False):
         self._inner.clear_grad(set_to_zero)
 
@@ -211,17 +217,38 @@ class DistModel:
         return state
 
     def _clip_grads(self, grads):
+        """Functional equivalents of the eager clip classes, so dynamic and
+        to_static updates match for each clip type."""
+        from ...nn.clip import (ClipGradByGlobalNorm, ClipGradByNorm,
+                                ClipGradByValue)
+
         clip = getattr(self._opt, "_grad_clip", None)
-        if clip is None or not hasattr(clip, "clip_norm"):
+        if clip is None:
             return grads
-        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
-                 for g in jax.tree_util.tree_leaves(grads))
-        gnorm = jnp.sqrt(sq)
-        scale = jnp.minimum(1.0, clip.clip_norm / jnp.maximum(gnorm, 1e-12))
-        return jax.tree_util.tree_map(lambda g: g * scale, grads)
+        tmap = jax.tree_util.tree_map
+        if isinstance(clip, ClipGradByGlobalNorm):
+            sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                     for g in jax.tree_util.tree_leaves(grads))
+            gnorm = jnp.sqrt(sq)
+            scale = jnp.minimum(1.0,
+                                clip.clip_norm / jnp.maximum(gnorm, 1e-12))
+            return tmap(lambda g: g * scale, grads)
+        if isinstance(clip, ClipGradByNorm):
+            def per_tensor(g):
+                n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+                return g * jnp.minimum(
+                    1.0, clip.clip_norm / jnp.maximum(n, 1e-12))
+            return tmap(per_tensor, grads)
+        if isinstance(clip, ClipGradByValue):
+            lo = getattr(clip, "min", None)
+            hi = getattr(clip, "max", None)
+            return tmap(lambda g: jnp.clip(g, lo, hi), grads)
+        raise NotImplementedError(
+            f"DistModel: unsupported grad_clip {type(clip).__name__}")
 
     def _build(self, mode):
         from ...autograd import no_grad
+        from ...framework.capture import capture_buffer_updates
 
         layer, opt = self._layer, self._opt
         apply_update = mode == "train" and self._acc_steps == 1
@@ -238,22 +265,28 @@ class DistModel:
                 return leaves
 
             def compute_loss(pv):
-                with layer.bind_state(pv, bufs), no_grad():
+                # buffer updates (BN stats) ride out as aux and are
+                # committed post-step
+                with layer.bind_state(pv, bufs), no_grad(), \
+                        capture_buffer_updates():
                     out = layer(*args[:-1])
-                    return self._loss_value(out, args[-1])
+                    lossv = self._loss_value(out, args[-1])
+                    new_b = {k: b._value for k, b in layer.named_buffers()}
+                return lossv, new_b
 
             if mode == "eval":
-                return compute_loss(pvals)
+                return compute_loss(pvals)[0]
 
-            lossv, grads = jax.value_and_grad(compute_loss)(pvals)
+            (lossv, new_b), grads = jax.value_and_grad(
+                compute_loss, has_aux=True)(pvals)
             if not apply_update:
                 # raw grads out: the merged gradient is clipped once after
                 # accumulation (reference GradientMerge order), not per slice
-                return lossv, grads
+                return lossv, grads, new_b
             grads = self._clip_grads(grads)
             new_p, new_state = opt.apply_gradients_functional(
                 pvals, grads, opt_state, lr)
-            return lossv, new_p, self._constrain_state(new_state)
+            return lossv, new_p, self._constrain_state(new_state), new_b
 
         return jax.jit(step_fn)
 
@@ -300,7 +333,8 @@ class DistModel:
             return Tensor(out)
 
         if self._acc_steps > 1:
-            lossv, grads = out
+            lossv, grads, new_b = out
+            self._commit_buffers(new_b)
             if self._acc_grads is None:
                 self._acc_grads = grads
             else:
@@ -316,9 +350,16 @@ class DistModel:
                 self._acc_count = 0
             return Tensor(lossv)
 
-        lossv, new_p, new_state = out
+        lossv, new_p, new_state, new_b = out
         self._commit(new_p, new_state)
+        self._commit_buffers(new_b)
         return Tensor(lossv)
+
+    def _commit_buffers(self, new_b):
+        named = dict(self._layer.named_buffers())
+        for k, v in (new_b or {}).items():
+            if k in named:
+                named[k]._replace_value(v)
 
     def _commit(self, new_p, new_state):
         named = dict(self._layer.named_parameters())

@@ -1,0 +1,28 @@
+"""Capture-mode context: lets stateful buffer updates (BatchNorm running
+stats) happen on TRACED values inside a program capture (jit.to_static,
+DistModel) whose runner harvests the new buffer values as explicit outputs
+and commits them after execution.
+
+Outside a capture, ops guard against writing tracers into buffers (a traced
+value leaking into eager state is a use-after-trace bug); inside one, the
+write is intentional — the capture layer owns the commit.
+"""
+from __future__ import annotations
+
+import contextlib
+
+_active = 0
+
+
+def buffer_capture_active() -> bool:
+    return _active > 0
+
+
+@contextlib.contextmanager
+def capture_buffer_updates():
+    global _active
+    _active += 1
+    try:
+        yield
+    finally:
+        _active -= 1

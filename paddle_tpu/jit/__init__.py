@@ -12,9 +12,10 @@ backward (so ``loss.backward()`` through a captured program works, the
 analogue of the reference's pir_run_program op —
 python/paddle/jit/dy2static/pir_partial_program.py:555,630).
 
-Known jit-mode semantic: BatchNorm running-stat updates are skipped under
-capture (buffer mutation inside a traced region); use eager mode or the
-functional train-step path when running stats must update.
+Buffer state (BatchNorm running stats) threads through capture: mutations
+land on the bound traced values (framework/capture.py), ride out of the
+jitted program as extra outputs, and are committed back to the layer's
+buffers after each call — so ``to_static(model)`` training matches eager.
 """
 from __future__ import annotations
 
@@ -33,9 +34,28 @@ from ..nn.layer.layers import Layer
 from ..ops.dispatch import apply
 
 __all__ = ["to_static", "InputSpec", "save", "load", "not_to_static",
-           "ignore_module", "enable_to_static", "TranslatedLayer"]
+           "ignore_module", "enable_to_static", "TranslatedLayer",
+           "BuildStrategy"]
 
 _to_static_enabled = True
+
+
+class BuildStrategy:
+    """Capture-behavior knobs (parity surface: paddle.static.BuildStrategy
+    as accepted by jit.to_static — api.py:197).
+
+    ``allow_graph_break`` (default True): when tracing fails on
+    data-dependent Python control flow (``if tensor.item() > 0:`` — a jax
+    ConcretizationTypeError), fall back to EAGER for that input signature
+    and cache the decision, the semantics of the reference's SOT
+    opcode-translator fallback (jit/sot/.../eval_frame_callback.py:54 —
+    mechanism differs: SOT breaks the frame mid-function; here the whole
+    call runs eager, which is always correct, just uncompiled). False =
+    re-raise (the reference's full_graph=True strictness).
+    """
+
+    def __init__(self, allow_graph_break: bool = True):
+        self.allow_graph_break = allow_graph_break
 
 
 def enable_to_static(flag: bool):
@@ -111,15 +131,28 @@ def _rebuild(skel, vals, wrap):
     return skel
 
 
+_GRAPH_BREAK_ERRORS = tuple(
+    e for e in (
+        getattr(jax.errors, "ConcretizationTypeError", None),
+        getattr(jax.errors, "TracerArrayConversionError", None),
+        getattr(jax.errors, "TracerBoolConversionError", None),
+        getattr(jax.errors, "TracerIntegerConversionError", None),
+    ) if e is not None)
+
+
 class StaticFunction:
     """Guard-cached jit wrapper around a function or Layer.forward."""
 
     def __init__(self, function: Callable, layer: Optional[Layer] = None,
-                 input_spec=None, full_graph=True, backend=None):
+                 input_spec=None, full_graph=True, backend=None,
+                 build_strategy: Optional[BuildStrategy] = None):
         self._fn = function
         self._layer = layer
         self._input_spec = input_spec
         self._cache = {}
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._eager_keys = set()  # signatures that graph-broke to eager
+        self._warned_break = False
         functools.update_wrapper(self, function)
 
     @property
@@ -127,9 +160,11 @@ class StaticFunction:
         return self
 
     def concrete_program(self):
-        return list(self._cache.values())
+        return [e["jitted"] for e in self._cache.values()]
 
     def _build(self, skel_args, skel_kwargs, n_args, out_box):
+        from ..framework.capture import capture_buffer_updates
+
         layer = self._layer
         fn = self._fn
 
@@ -138,25 +173,42 @@ class StaticFunction:
             wrap = lambda v: Tensor(v, stop_gradient=True)
             args = _rebuild(skel_args, arg_vals, wrap)
             kwargs = _rebuild(skel_kwargs, arg_vals, wrap)
+            new_bufs = {}
             with rng_context(key), no_grad():
                 if layer is not None:
-                    with layer.bind_state(params, bufs):
+                    # buffer mutations (BN running stats) land on the bound
+                    # traced values and ride out as extra outputs, so
+                    # to_static(model) trains running stats correctly
+                    with layer.bind_state(params, bufs), \
+                            capture_buffer_updates():
                         out = fn(*args, **kwargs)
+                        new_bufs = {k: b._value
+                                    for k, b in layer.named_buffers()}
                 else:
                     out = fn(*args, **kwargs)
             tensors: List[Tensor] = []
             skel_out = _split_tensors(out, tensors)
             out_box["skel"] = skel_out
-            return tuple(t._value for t in tensors)
+            out_box["n_real"] = len(tensors)
+            out_box["buf_names"] = sorted(new_bufs)
+            return tuple(t._value for t in tensors) + tuple(
+                new_bufs[k] for k in out_box["buf_names"])
 
         return jax.jit(pure)
 
     def __call__(self, *args, **kwargs):
         if not _to_static_enabled:
-            if self._layer is not None:
-                return self._fn(*args, **kwargs)
             return self._fn(*args, **kwargs)
-        key = _guard_key(args, kwargs)
+        try:
+            # training mode is part of the guard: train/eval trace different
+            # programs (BN batch-vs-running stats, dropout)
+            mode = self._layer.training if self._layer is not None else None
+            key = (mode, _guard_key(args, kwargs))
+            hash(key)
+        except TypeError:
+            key = None  # unhashable guard state → uncacheable: run eager
+        if key is None or key in self._eager_keys:
+            return self._fn(*args, **kwargs)
         arg_tensors: List[Tensor] = []
         skel_args = _split_tensors(args, arg_tensors)
         skel_kwargs = _split_tensors(kwargs, arg_tensors)
@@ -183,30 +235,67 @@ class StaticFunction:
             params = dict(zip(pnames, pvals))
             return jitted(params, bufs, key_data, *avals)
 
-        outs = apply("jit::" + getattr(self._fn, "__name__", "fn"),
-                     lambda pvals, avals: runner(pvals, avals),
-                     list(ptensors), list(arg_tensors))
+        try:
+            outs = apply("jit::" + getattr(self._fn, "__name__", "fn"),
+                         lambda pvals, avals: runner(pvals, avals),
+                         list(ptensors), list(arg_tensors))
+        except _GRAPH_BREAK_ERRORS as e:
+            # data-dependent Python control flow inside the traced body —
+            # the reference's SOT would break the frame here; we fall back
+            # to eager for this signature and cache the decision
+            if not self._build_strategy.allow_graph_break:
+                raise
+            self._cache.pop(key, None)
+            self._eager_keys.add(key)
+            if not self._warned_break:
+                self._warned_break = True
+                import warnings
+                warnings.warn(
+                    f"to_static({getattr(self._fn, '__name__', 'fn')}): "
+                    f"graph break ({type(e).__name__}) — running this input "
+                    "signature eagerly. Use lax.cond-style ops or "
+                    "BuildStrategy(allow_graph_break=False) to make this an "
+                    "error.", stacklevel=2)
+            return self._fn(*args, **kwargs)
         if not isinstance(outs, tuple):
             outs = (outs,)
-        wrapped = _rebuild(out_box["skel"], list(outs), lambda t: t)
+        n_real = out_box.get("n_real", len(outs))
+        buf_names = out_box.get("buf_names", [])
+        if buf_names and self._layer is not None:
+            named_b = dict(self._layer.named_buffers())
+            with no_grad():
+                for k, t in zip(buf_names, outs[n_real:]):
+                    if k in named_b:
+                        named_b[k]._replace_value(t._value)
+        wrapped = _rebuild(out_box["skel"], list(outs[:n_real]), lambda t: t)
         return wrapped
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, full_graph=True, **kwargs):
-    """paddle.jit.to_static parity (api.py:197)."""
+              backend=None, full_graph=False, **kwargs):
+    """paddle.jit.to_static parity (api.py:197). ``full_graph=False`` (the
+    reference default — SOT mode) permits graph-break fallback to eager;
+    ``full_graph=True`` makes tracing failures raise. An explicit
+    ``build_strategy`` overrides."""
+
+    if isinstance(build_strategy, BuildStrategy):
+        bs = build_strategy
+    else:
+        bs = BuildStrategy(allow_graph_break=not full_graph)
 
     def decorate(obj):
         if isinstance(obj, Layer):
             static_fwd = StaticFunction(obj.forward, layer=obj,
-                                        input_spec=input_spec)
+                                        input_spec=input_spec,
+                                        build_strategy=bs)
             obj.forward = static_fwd
             obj._static_function = static_fwd
             return obj
         layer = getattr(obj, "__self__", None)
         if isinstance(layer, Layer):
-            return StaticFunction(obj, layer=layer, input_spec=input_spec)
-        return StaticFunction(obj, input_spec=input_spec)
+            return StaticFunction(obj, layer=layer, input_spec=input_spec,
+                                  build_strategy=bs)
+        return StaticFunction(obj, input_spec=input_spec, build_strategy=bs)
 
     if function is None:
         return decorate

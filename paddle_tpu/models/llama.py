@@ -193,10 +193,15 @@ def make_mesh(n_devices: Optional[int] = None,
     return Mesh(arr, axes)
 
 
+_FIT_SPEC_WARNED: set = set()
+
+
 def _fit_spec(spec: P, shape, mesh: Mesh) -> P:
     """Drop mesh axes that don't evenly divide the tensor dim (e.g. dp=3
     fsdp over hidden=128) — falls back to replication on that axis, the
-    same degradation the reference's sharding pass applies to odd shapes."""
+    same degradation the reference's sharding pass applies to odd shapes.
+    Warns once per dropped (axis, shape) so a typo'd mesh doesn't silently
+    train replicated."""
     entries = []
     for d, entry in enumerate(spec):
         if entry is None:
@@ -209,6 +214,15 @@ def _fit_spec(spec: P, shape, mesh: Mesh) -> P:
             if ax > 1 and size % ax == 0:
                 keep.append(nm)
                 size //= ax
+            elif ax > 1:
+                sig = (nm, ax, d, tuple(shape))
+                if sig not in _FIT_SPEC_WARNED:
+                    _FIT_SPEC_WARNED.add(sig)
+                    import warnings
+                    warnings.warn(
+                        f"sharding axis '{nm}'={ax} does not divide dim {d} "
+                        f"of shape {tuple(shape)} — replicating on that "
+                        "axis (throughput may drop)", stacklevel=3)
         entries.append(tuple(keep) if len(keep) > 1 else
                        (keep[0] if keep else None))
     return P(*entries)

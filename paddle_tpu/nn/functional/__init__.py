@@ -549,8 +549,10 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
             return m, var
 
         bmean, bvar = apply("batch_norm_stats", stat_fn, _t(x))
-        if isinstance(running_mean, Tensor) and not isinstance(
-            bmean._value, jax.core.Tracer
+        from ...framework.capture import buffer_capture_active
+        if isinstance(running_mean, Tensor) and (
+            not isinstance(bmean._value, jax.core.Tracer)
+            or buffer_capture_active()  # capture layer commits post-run
         ):
             from ...autograd import no_grad
 

@@ -15,6 +15,12 @@ from typing import Callable, Iterable, Optional
 
 import jax
 
+from .statistics import Benchmark, EventLedger, SortedKeys, build_summary
+
+# stack of active profilers: RecordEvent feeds the innermost; a nested
+# profiler's stop() restores the outer one
+_ACTIVE: list = []
+
 
 class ProfilerTarget(Enum):
     CPU = 0
@@ -82,6 +88,7 @@ class Profiler:
         self._active = False
         self._step_times = []
         self._t_last = None
+        self._ledger = EventLedger()
 
     def __enter__(self):
         self.start()
@@ -92,6 +99,8 @@ class Profiler:
         return False
 
     def start(self):
+        if self not in _ACTIVE:
+            _ACTIVE.append(self)
         self._t_last = time.time()
         if self._timer_only:
             return
@@ -131,6 +140,8 @@ class Profiler:
             self._end_trace()
 
     def stop(self):
+        if self in _ACTIVE:
+            _ACTIVE.remove(self)
         self._end_trace()
 
     def step_info(self, unit: str = "samples"):
@@ -146,14 +157,26 @@ class Profiler:
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
-        print(self.step_info())
+        """Overview + per-event tables (parity: Profiler.summary →
+        profiler_statistic._build_table); device kernel detail lives in the
+        exported XPlane trace."""
+        text = build_summary(
+            self._ledger, self._step_times,
+            sorted_by=sorted_by or SortedKeys.CPUTotal,
+            time_unit=time_unit)
+        print(text)
+        return text
 
 
 @contextlib.contextmanager
 def RecordEvent(name: str, event_type=None):
-    """parity: paddle.profiler.RecordEvent → jax TraceAnnotation."""
+    """parity: paddle.profiler.RecordEvent — annotates the device trace
+    (jax TraceAnnotation) AND feeds the host-side statistics ledger."""
+    t0 = time.perf_counter()
     with jax.profiler.TraceAnnotation(name):
         yield
+    if _ACTIVE:
+        _ACTIVE[-1]._ledger.add(name, t0, time.perf_counter())
 
 
 def load_profiler_result(path):

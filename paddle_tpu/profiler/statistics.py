@@ -1,0 +1,161 @@
+"""Profiler statistics summarizer + throughput timer.
+
+Parity: python/paddle/profiler/profiler_statistic.py (the Overview /
+Operator summary tables printed by Profiler.summary, SortedKeys sort
+options) and python/paddle/profiler/timer.py (Benchmark: reader_cost /
+batch_cost / ips rolling averages).
+
+TPU-native framing: device-side kernel timing lives in the XPlane trace
+(TensorBoard/Perfetto — jax.profiler); what stays host-side, exactly like
+the reference's host tracer statistics, is the RecordEvent span ledger and
+the step timer. This module turns those into the reference's tables.
+"""
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from enum import Enum
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["SortedKeys", "EventLedger", "build_summary", "Benchmark"]
+
+
+class SortedKeys(Enum):
+    """parity: profiler_statistic.SortedKeys."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    Calls = 4
+
+
+class EventLedger:
+    """Host-side span ledger filled by RecordEvent while a Profiler is
+    recording: (name, t_begin, t_end) triples."""
+
+    def __init__(self):
+        self.spans: List[Tuple[str, float, float]] = []
+
+    def add(self, name: str, t0: float, t1: float) -> None:
+        self.spans.append((name, t0, t1))
+
+    def clear(self) -> None:
+        self.spans.clear()
+
+
+def _fmt_time(seconds: float, unit: str) -> str:
+    scale = {"s": 1.0, "ms": 1e3, "us": 1e6}[unit]
+    return f"{seconds * scale:.3f}"
+
+
+def build_summary(ledger: EventLedger,
+                  step_times: Optional[List[Tuple[float, Optional[int]]]]
+                  = None,
+                  sorted_by: SortedKeys = SortedKeys.CPUTotal,
+                  time_unit: str = "ms") -> str:
+    """Render the Overview + Event Summary tables (the shape of
+    profiler_statistic's _build_table output)."""
+    agg: Dict[str, List[float]] = defaultdict(list)
+    for name, t0, t1 in ledger.spans:
+        agg[name].append(t1 - t0)
+    total_window = sum(t for t, _ in step_times) if step_times else \
+        sum(sum(v) for v in agg.values())
+
+    rows = []
+    for name, durs in agg.items():
+        tot = sum(durs)
+        rows.append((name, len(durs), tot, tot / len(durs), max(durs),
+                     min(durs), 100.0 * tot / total_window
+                     if total_window else 0.0))
+    keyfn = {
+        SortedKeys.CPUTotal: lambda r: -r[2],
+        SortedKeys.CPUAvg: lambda r: -r[3],
+        SortedKeys.CPUMax: lambda r: -r[4],
+        SortedKeys.CPUMin: lambda r: r[5],
+        SortedKeys.Calls: lambda r: -r[1],
+    }[sorted_by]
+    rows.sort(key=keyfn)
+
+    u = time_unit
+    header = ["Name", "Calls", f"Total({u})", f"Avg({u})", f"Max({u})",
+              f"Min({u})", "Ratio(%)"]
+    table = [header] + [
+        [name, str(calls), _fmt_time(tot, u), _fmt_time(avg, u),
+         _fmt_time(mx, u), _fmt_time(mn, u), f"{ratio:.2f}"]
+        for name, calls, tot, avg, mx, mn, ratio in rows]
+    widths = [max(len(r[c]) for r in table) for c in range(len(header))]
+
+    def line(row):
+        return "  ".join(cell.ljust(w) for cell, w in zip(row, widths))
+
+    out = []
+    if step_times:
+        times = [t for t, _ in step_times]
+        samples = [n for _, n in step_times if n]
+        out.append("---------------- Overview Summary ----------------")
+        out.append(f"steps: {len(times)}   total: "
+                   f"{_fmt_time(sum(times), u)}{u}   avg step: "
+                   f"{_fmt_time(sum(times) / len(times), u)}{u}")
+        if samples:
+            ips = sum(samples) / sum(times)
+            out.append(f"throughput: {ips:.2f} samples/s")
+        out.append("")
+    out.append("----------------- Event Summary ------------------")
+    out.append(line(table[0]))
+    out.append(line(["-" * w for w in widths]))
+    out.extend(line(r) for r in table[1:])
+    if not rows:
+        out.append("(no RecordEvent spans captured)")
+    return "\n".join(out)
+
+
+class Benchmark:
+    """parity: paddle.profiler.timer.Benchmark — rolling reader_cost /
+    batch_cost / ips, reported via ``step_info``. Driven by the hapi/fleet
+    train loops (timer.step_info per log interval)."""
+
+    def __init__(self, window: int = 100):
+        self._window = window
+        self.reset()
+
+    def reset(self):
+        self._reader_costs: List[float] = []
+        self._batch_costs: List[float] = []
+        self._samples = 0
+        self._t_read0 = None
+        self._t_batch0 = None
+
+    # call order per step: before_reader → after_reader → after_step
+    def before_reader(self):
+        self._t_read0 = time.perf_counter()
+
+    def after_reader(self):
+        now = time.perf_counter()
+        if self._t_read0 is not None:
+            self._reader_costs.append(now - self._t_read0)
+            self._reader_costs = self._reader_costs[-self._window:]
+        if self._t_batch0 is None:
+            self._t_batch0 = self._t_read0
+        self._t_read0 = None
+
+    def after_step(self, num_samples: Optional[int] = None):
+        now = time.perf_counter()
+        if self._t_batch0 is not None:
+            self._batch_costs.append(now - self._t_batch0)
+            self._batch_costs = self._batch_costs[-self._window:]
+        self._t_batch0 = now
+        if num_samples:
+            self._samples = num_samples
+
+    def step_info(self, unit: str = "samples") -> str:
+        if not self._batch_costs:
+            return "no steps recorded"
+        avg_batch = sum(self._batch_costs) / len(self._batch_costs)
+        msg = []
+        if self._reader_costs:
+            avg_reader = sum(self._reader_costs) / len(self._reader_costs)
+            msg.append(f"reader_cost: {avg_reader:.5f} s")
+        msg.append(f"batch_cost: {avg_batch:.5f} s")
+        if self._samples:
+            msg.append(f"ips: {self._samples / avg_batch:.2f} {unit}/s")
+        return ", ".join(msg)

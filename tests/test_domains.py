@@ -185,3 +185,38 @@ def test_masked_multihead_attention_matches_dense():
     p = p / p.sum(-1, keepdims=True)
     want = np.einsum("bhk,bhkd->bhd", p, vc).reshape(B, H * D)
     np.testing.assert_allclose(out.numpy(), want, atol=1e-5)
+
+
+def test_profiler_summary_tables_and_timer():
+    """Profiler.summary renders Overview + Event tables from RecordEvent
+    spans (parity: profiler_statistic._build_table); Benchmark gives
+    reader/batch/ips (parity: timer.py)."""
+    import time as _time
+
+    import paddle_tpu.profiler as profiler
+    from paddle_tpu.profiler import Benchmark, SortedKeys
+
+    p = profiler.Profiler(timer_only=True)
+    p.start()
+    for _ in range(3):
+        with profiler.RecordEvent("fwd"):
+            _time.sleep(0.002)
+        with profiler.RecordEvent("bwd"):
+            _time.sleep(0.004)
+        p.step(num_samples=8)
+    text = p.summary(sorted_by=SortedKeys.CPUTotal)
+    p.stop()
+    assert "Overview Summary" in text and "Event Summary" in text
+    lines = [ln for ln in text.splitlines() if ln.startswith(("fwd", "bwd"))]
+    assert lines[0].startswith("bwd")  # sorted by total desc
+    assert "Calls" in text and "throughput" in text
+
+    b = Benchmark()
+    for _ in range(3):
+        b.before_reader()
+        _time.sleep(0.001)
+        b.after_reader()
+        _time.sleep(0.003)
+        b.after_step(num_samples=16)
+    info = b.step_info()
+    assert "reader_cost" in info and "batch_cost" in info and "ips" in info

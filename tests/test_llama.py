@@ -76,3 +76,23 @@ def test_replicated_vs_sharded_same_loss(cfg):
         loss_sharded = float(jax.jit(
             lambda p, t: llama.loss_fn(p, t, cfg))(sp, tokens))
     np.testing.assert_allclose(loss_single, loss_sharded, rtol=2e-2)
+
+
+def test_fit_spec_warns_on_dropped_axis():
+    import warnings
+
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from paddle_tpu.models.llama import _FIT_SPEC_WARNED, _fit_spec
+
+    mesh = Mesh(np.asarray(jax.devices()[:6]).reshape(3, 2), ("dp", "tp"))
+    _FIT_SPEC_WARNED.clear()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out = _fit_spec(P("dp", "tp"), (128, 64), mesh)  # dp=3 ∤ 128
+        assert out == P(None, "tp")
+        assert any("does not divide" in str(wi.message) for wi in w)
+    with warnings.catch_warnings(record=True) as w2:
+        warnings.simplefilter("always")
+        _fit_spec(P("dp", "tp"), (128, 64), mesh)  # warned once only
+        assert not w2
