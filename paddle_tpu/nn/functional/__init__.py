@@ -389,6 +389,10 @@ def _pool(x, kind, kernel, stride, padding, data_format, ceil_mode=False,
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
+    if return_mask:
+        from .extras import max_pool2d_with_index
+        return max_pool2d_with_index(x, kernel_size, stride, padding,
+                                     ceil_mode, data_format)
     return _pool(x, "max", kernel_size, stride, padding, data_format, ceil_mode)
 
 
@@ -1050,29 +1054,6 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
 from ...ops.manipulation import pad  # noqa: E402,F401
 
 
-def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
-    k = _norm_tuple(kernel_sizes, 2)
-    s = _norm_tuple(strides, 2)
-    p = _norm_tuple(paddings, 2)
-    d = _norm_tuple(dilations, 2)
-
-    def fn(v):
-        n, c, h, w = v.shape
-        vp = jnp.pad(v, [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])])
-        oh = (h + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
-        ow = (w + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
-        cols = []
-        for i in range(k[0]):
-            for j in range(k[1]):
-                patch = vp[:, :, i * d[0]:i * d[0] + oh * s[0]:s[0],
-                           j * d[1]:j * d[1] + ow * s[1]:s[1]]
-                cols.append(patch)
-        out = jnp.stack(cols, 2)  # n, c, k*k, oh, ow
-        return out.reshape(n, c * k[0] * k[1], oh * ow)
-
-    return apply("unfold", fn, _t(x))
-
-
 def interpolate(x, size=None, scale_factor=None, mode="nearest",
                 align_corners=False, align_mode=0, data_format="NCHW", name=None):
     def fn(v):
@@ -1143,3 +1124,5 @@ def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
             dtypes.convert_dtype(dtype).np_dtype)
 
     return apply("sequence_mask", fn, _t(lengths))
+
+from .extras import *  # noqa: E402,F401,F403

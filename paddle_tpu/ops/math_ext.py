@@ -1,0 +1,225 @@
+"""Long-tail math/manipulation ops (VERDICT r1 op-gap list).
+
+Parity: python/paddle/tensor/math.py (diff :4708, trapezoid :6647,
+renorm :2546, vander :6868, frexp :6926, gammaln :5280, polygamma :6406,
+igamma Q(x,y) :5383, sinc, i0/i1 Bessel), linalg.py (cdist :4092, pdist),
+manipulation.py (unfold :7230 sliding window, as_strided :7180,
+view_as_complex/view_as_real :7080).
+
+All are thin pure-jax compositions routed through the generic dispatch
+(ops/dispatch.apply) so AMP, autograd and nan-checking apply uniformly.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtypes
+from .dispatch import apply
+
+__all__ = [
+    "diff", "trapezoid", "cumulative_trapezoid", "renorm", "vander",
+    "cdist", "pdist", "frexp", "gammaln", "polygamma", "igamma", "igammac",
+    "multigammaln", "sinc", "view_as_complex", "view_as_real", "as_strided",
+    "unfold", "ldexp",
+]
+
+from .creation import _t  # noqa: E402
+from .math import lgamma  # noqa: E402
+
+# paddle exposes both names for log|Γ| (math.py:5280); one binding
+gammaln = lgamma
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    args = [_t(x)]
+    has_pre = prepend is not None
+    has_app = append is not None
+    if has_pre:
+        args.append(_t(prepend))
+    if has_app:
+        args.append(_t(append))
+
+    def fn(v, *rest):
+        pre = rest[0] if has_pre else None
+        app = rest[-1] if has_app else None
+        return jnp.diff(v, n=n, axis=axis, prepend=pre, append=app)
+
+    return apply("diff", fn, *args)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return apply("trapezoid",
+                     lambda yv, xv: jnp.trapezoid(yv, xv, axis=axis),
+                     _t(y), _t(x))
+    step = 1.0 if dx is None else dx
+    return apply("trapezoid",
+                 lambda yv: jnp.trapezoid(yv, dx=step, axis=axis), _t(y))
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def _cum(yv, spacing):
+        y0 = jnp.take(yv, jnp.arange(yv.shape[axis] - 1), axis=axis)
+        y1 = jnp.take(yv, jnp.arange(1, yv.shape[axis]), axis=axis)
+        return jnp.cumsum((y0 + y1) * spacing / 2.0, axis=axis)
+
+    if x is not None:
+        def fn(yv, xv):
+            d = jnp.diff(xv, axis=axis if xv.ndim == yv.ndim else -1)
+            if d.ndim != yv.ndim:  # 1-D x against n-D y
+                shape = [1] * yv.ndim
+                shape[axis] = d.shape[0]
+                d = d.reshape(shape)
+            return _cum(yv, d)
+        return apply("cumulative_trapezoid", fn, _t(y), _t(x))
+    step = 1.0 if dx is None else dx
+    return apply("cumulative_trapezoid", lambda yv: _cum(yv, step), _t(y))
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    def fn(v):
+        dims = tuple(i for i in range(v.ndim) if i != axis % v.ndim)
+        norms = jnp.sum(jnp.abs(v) ** p, axis=dims, keepdims=True) ** (1 / p)
+        scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-12), 1.0)
+        return v * scale.astype(v.dtype)
+
+    return apply("renorm", fn, _t(x))
+
+
+def vander(x, n=None, increasing=False, name=None):
+    cols = n
+    return apply("vander",
+                 lambda v: jnp.vander(v, N=cols, increasing=increasing),
+                 _t(x))
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    def fn(a, b):
+        if p == 2.0 and compute_mode != "donot_use_mm_for_euclid_dist":
+            # MXU path: |a-b|^2 = |a|^2 + |b|^2 - 2ab
+            sq = (jnp.sum(a * a, -1)[..., :, None]
+                  + jnp.sum(b * b, -1)[..., None, :]
+                  - 2.0 * jnp.matmul(a, jnp.swapaxes(b, -1, -2)))
+            return jnp.sqrt(jnp.maximum(sq, 0.0))
+        d = jnp.abs(a[..., :, None, :] - b[..., None, :, :])
+        if p == 0:
+            return jnp.sum((d != 0).astype(a.dtype), -1)
+        if jnp.isinf(p):
+            return jnp.max(d, -1)
+        return jnp.sum(d ** p, -1) ** (1.0 / p)
+
+    return apply("cdist", fn, _t(x), _t(y))
+
+
+def pdist(x, p=2.0, name=None):
+    def fn(v):
+        n = v.shape[0]
+        full = jnp.abs(v[:, None, :] - v[None, :, :])
+        if jnp.isinf(p):
+            d = jnp.max(full, -1)
+        elif p == 0:
+            d = jnp.sum((full != 0).astype(v.dtype), -1)
+        else:
+            d = jnp.sum(full ** p, -1) ** (1.0 / p)
+        iu = np.triu_indices(n, k=1)
+        return d[iu]
+
+    return apply("pdist", fn, _t(x))
+
+
+def frexp(x, name=None):
+    return apply("frexp", lambda v: tuple(jnp.frexp(v)), _t(x))
+
+
+def polygamma(x, n, name=None):
+    return apply("polygamma",
+                 lambda v: jax.scipy.special.polygamma(n, v), _t(x))
+
+
+def igamma(x, y, name=None):
+    """Regularized UPPER incomplete gamma Q(x, y) (math.py:5383)."""
+    return apply("igamma",
+                 lambda a, b: jax.scipy.special.gammaincc(a, b),
+                 _t(x), _t(y))
+
+
+def igammac(x, y, name=None):
+    """Regularized LOWER incomplete gamma P(x, y)."""
+    return apply("igammac",
+                 lambda a, b: jax.scipy.special.gammainc(a, b),
+                 _t(x), _t(y))
+
+
+def multigammaln(x, p, name=None):
+    return apply("multigammaln",
+                 lambda v: jax.scipy.special.multigammaln(v, p), _t(x))
+
+
+def sinc(x, name=None):
+    return apply("sinc", lambda v: jnp.sinc(v), _t(x))
+
+
+def ldexp(x, y, name=None):
+    return apply("ldexp", lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)),
+                 _t(x), _t(y))
+
+
+def view_as_complex(x, name=None):
+    """[..., 2] real → complex (manipulation.py:7080 as_complex)."""
+    if _t(x).shape[-1] != 2:
+        raise ValueError(
+            f"view_as_complex: last dim must be 2, got {_t(x).shape[-1]}")
+    return apply("view_as_complex",
+                 lambda v: jax.lax.complex(v[..., 0], v[..., 1]), _t(x))
+
+
+def view_as_real(x, name=None):
+    return apply("view_as_real",
+                 lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1),
+                 _t(x))
+
+
+# paddle aliases (manipulation.py as_complex/as_real)
+as_complex = view_as_complex
+as_real = view_as_real
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Strided view emulation via flat gather (manipulation.py:7180). XLA
+    has no aliasing views; the gather compiles to a copy with the same
+    semantics."""
+    shape = tuple(int(s) for s in shape)
+    stride = tuple(int(s) for s in stride)
+
+    def fn(v):
+        flat = v.reshape(-1)
+        idx = jnp.asarray(offset)
+        for size, st in zip(shape, stride):
+            idx = idx[..., None] + jnp.arange(size) * st
+        return flat[idx.reshape(shape)]
+
+    return apply("as_strided", fn, _t(x))
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding-window view along ``axis`` (manipulation.py:7230): output
+    gains a trailing window dim of length ``size``."""
+    def fn(v):
+        L = v.shape[axis]
+        n_win = (L - size) // step + 1
+        starts = jnp.arange(n_win) * step
+        idx = starts[:, None] + jnp.arange(size)[None, :]  # [n_win, size]
+        out = jnp.take(v, idx.reshape(-1), axis=axis)
+        ax = axis % v.ndim
+        new_shape = v.shape[:ax] + (n_win, size) + v.shape[ax + 1:]
+        out = out.reshape(v.shape[:ax] + (n_win * size,) + v.shape[ax + 1:])
+        out = out.reshape(new_shape)
+        # paddle puts the window dim LAST
+        perm = list(range(len(new_shape)))
+        perm.append(perm.pop(ax + 1))
+        return jnp.transpose(out, perm)
+
+    return apply("unfold", fn, _t(x))

@@ -69,3 +69,41 @@ def check_grad(op: Callable, inputs: Sequence[np.ndarray],
             num_flat[i] = (float(lp.item()) - float(lm.item())) / (2 * eps)
         np.testing.assert_allclose(analytic, numeric, atol=atol, rtol=rtol,
                                    err_msg=f"grad mismatch on input {idx}")
+
+
+_DTYPE_TOL = {
+    # per-dtype (atol, rtol) defaults; per-op overrides via tol arg
+    "float32": (1e-5, 1e-5),
+    "bfloat16": (8e-2, 8e-2),
+    "float16": (1e-2, 1e-2),
+}
+
+
+def check_output_dtypes(op: Callable, inputs: Sequence[np.ndarray],
+                        reference: Callable,
+                        dtypes=("float32", "bfloat16", "float16"),
+                        tol=None, **op_kwargs):
+    """Run check_output over a dtype matrix (parity: the reference harness's
+    place×dtype sweep with per-op tolerance whitelists —
+    test/legacy_test/op_test.py:418,2840). The f64/f32 numpy reference is
+    compared against each low-precision run at that dtype's tolerance."""
+    ref = reference(*[x.astype(np.float64) for x in inputs])
+    refs = [np.asarray(r, np.float64)
+            for r in (ref if isinstance(ref, (tuple, list)) else [ref])]
+    for dt in dtypes:
+        atol, rtol = (tol or {}).get(dt, _DTYPE_TOL[dt])
+        ts = []
+        for x in inputs:
+            t = paddle.to_tensor(x)
+            if np.issubdtype(x.dtype, np.floating):
+                t = t.astype(dt)
+            ts.append(t)
+        out = op(*ts, **op_kwargs)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        for o, r in zip(outs, refs):
+            got = np.asarray(o.astype("float32").numpy()
+                             if o.dtype.name in ("bfloat16", "float16")
+                             else o.numpy(), np.float64)
+            np.testing.assert_allclose(
+                got, r, atol=atol, rtol=rtol,
+                err_msg=f"dtype {dt} mismatch")

@@ -1,0 +1,263 @@
+"""VERDICT r1 op-gap list: numeric checks against torch (independent CPU
+reference) and scipy where torch lacks the op."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+rng = np.random.default_rng(0)
+
+
+def _np(t):
+    return np.asarray(t.numpy() if hasattr(t, "numpy") else t)
+
+
+def test_diff_trapezoid_cumulative():
+    x = rng.normal(size=(3, 7)).astype(np.float32)
+    np.testing.assert_allclose(_np(paddle.diff(paddle.to_tensor(x))),
+                               np.diff(x), rtol=1e-6)
+    np.testing.assert_allclose(
+        _np(paddle.trapezoid(paddle.to_tensor(x), dx=0.5)),
+        np.trapezoid(x, dx=0.5, axis=-1), rtol=1e-5)
+    t = torch.cumulative_trapezoid(torch.tensor(x), dx=0.5)
+    np.testing.assert_allclose(
+        _np(paddle.cumulative_trapezoid(paddle.to_tensor(x), dx=0.5)),
+        t.numpy(), rtol=1e-5)
+
+
+def test_renorm():
+    x = rng.normal(size=(4, 5, 3)).astype(np.float32)
+    ref = torch.renorm(torch.tensor(x), p=2, dim=1, maxnorm=1.0)
+    out = paddle.renorm(paddle.to_tensor(x), p=2.0, axis=1, max_norm=1.0)
+    np.testing.assert_allclose(_np(out), ref.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_vander_sinc_frexp():
+    v = np.array([1.0, 2.0, 3.0], np.float32)
+    np.testing.assert_allclose(_np(paddle.vander(paddle.to_tensor(v))),
+                               np.vander(v), rtol=1e-6)
+    x = rng.normal(size=(8,)).astype(np.float32)
+    np.testing.assert_allclose(_np(paddle.sinc(paddle.to_tensor(x))),
+                               np.sinc(x), rtol=1e-5, atol=1e-6)
+    m, e = paddle.frexp(paddle.to_tensor(x))
+    mm, ee = np.frexp(x)
+    np.testing.assert_allclose(_np(m), mm, rtol=1e-6)
+    np.testing.assert_array_equal(_np(e), ee)
+
+
+def test_cdist_pdist():
+    a = rng.normal(size=(5, 3)).astype(np.float32)
+    b = rng.normal(size=(7, 3)).astype(np.float32)
+    for p in (1.0, 2.0, 3.0, float("inf")):
+        ref = torch.cdist(torch.tensor(a), torch.tensor(b), p=p)
+        out = paddle.cdist(paddle.to_tensor(a), paddle.to_tensor(b), p=p)
+        np.testing.assert_allclose(_np(out), ref.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+    ref = torch.pdist(torch.tensor(a), p=2.0)
+    np.testing.assert_allclose(_np(paddle.pdist(paddle.to_tensor(a))),
+                               ref.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_special_gamma_family():
+    from scipy import special
+
+    x = np.abs(rng.normal(size=(6,))).astype(np.float32) + 0.5
+    y = np.abs(rng.normal(size=(6,))).astype(np.float32) + 0.5
+    np.testing.assert_allclose(_np(paddle.gammaln(paddle.to_tensor(x))),
+                               special.gammaln(x), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        _np(paddle.polygamma(paddle.to_tensor(x), 1)),
+        special.polygamma(1, x), rtol=1e-4)
+    np.testing.assert_allclose(
+        _np(paddle.igamma(paddle.to_tensor(x), paddle.to_tensor(y))),
+        special.gammaincc(x, y), rtol=1e-4)
+    np.testing.assert_allclose(
+        _np(paddle.igammac(paddle.to_tensor(x), paddle.to_tensor(y))),
+        special.gammainc(x, y), rtol=1e-4)
+    np.testing.assert_allclose(
+        _np(paddle.i0(paddle.to_tensor(x))), special.i0(x), rtol=1e-5)
+
+
+def test_view_as_complex_real_roundtrip():
+    x = rng.normal(size=(4, 3, 2)).astype(np.float32)
+    c = paddle.view_as_complex(paddle.to_tensor(x))
+    assert _np(c).dtype == np.complex64
+    np.testing.assert_allclose(_np(paddle.view_as_real(c)), x, rtol=1e-6)
+
+
+def test_as_strided_and_tensor_unfold():
+    x = np.arange(12, dtype=np.float32)
+    out = paddle.as_strided(paddle.to_tensor(x), [3, 4], [4, 1])
+    np.testing.assert_array_equal(_np(out), x.reshape(3, 4))
+    # reference example (manipulation.py:7258): arange(9).unfold(0,2,4)
+    u = paddle.unfold(paddle.to_tensor(np.arange(9, dtype=np.float32)), 0, 2, 4)
+    np.testing.assert_array_equal(_np(u), [[0, 1], [4, 5]])
+
+
+def test_functional_unfold_fold_roundtrip():
+    x = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+    cols = F.unfold(paddle.to_tensor(x), kernel_sizes=3, strides=2,
+                    paddings=1)
+    ref = torch.nn.functional.unfold(torch.tensor(x), 3, padding=1, stride=2)
+    np.testing.assert_allclose(_np(cols), ref.numpy(), rtol=1e-5)
+    back = F.fold(cols, output_sizes=(8, 8), kernel_sizes=3, strides=2,
+                  paddings=1)
+    ref_back = torch.nn.functional.fold(ref, (8, 8), 3, padding=1, stride=2)
+    np.testing.assert_allclose(_np(back), ref_back.numpy(), rtol=1e-5)
+
+
+def test_pixel_unshuffle():
+    x = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+    out = F.pixel_unshuffle(paddle.to_tensor(x), 2)
+    ref = torch.nn.functional.pixel_unshuffle(torch.tensor(x), 2)
+    np.testing.assert_allclose(_np(out), ref.numpy(), rtol=1e-6)
+
+
+@pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+@pytest.mark.parametrize("pad", ["zeros", "border", "reflection"])
+@pytest.mark.parametrize("align", [True, False])
+def test_grid_sample(mode, pad, align):
+    x = rng.normal(size=(2, 3, 6, 7)).astype(np.float32)
+    grid = rng.uniform(-1.3, 1.3, size=(2, 4, 5, 2)).astype(np.float32)
+    out = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                        mode=mode, padding_mode=pad, align_corners=align)
+    ref = torch.nn.functional.grid_sample(
+        torch.tensor(x), torch.tensor(grid), mode=mode, padding_mode=pad,
+        align_corners=align)
+    np.testing.assert_allclose(_np(out), ref.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_affine_grid():
+    theta = rng.normal(size=(2, 2, 3)).astype(np.float32)
+    out = F.affine_grid(paddle.to_tensor(theta), [2, 3, 5, 6],
+                        align_corners=True)
+    ref = torch.nn.functional.affine_grid(torch.tensor(theta), (2, 3, 5, 6),
+                                          align_corners=True)
+    np.testing.assert_allclose(_np(out), ref.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_max_pool_mask_and_unpool():
+    x = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+    out, mask = F.max_pool2d(paddle.to_tensor(x), 2, stride=2,
+                             return_mask=True)
+    tout, tidx = torch.nn.functional.max_pool2d(
+        torch.tensor(x), 2, stride=2, return_indices=True)
+    np.testing.assert_allclose(_np(out), tout.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(_np(mask), tidx.numpy())
+    un = F.max_unpool2d(out, mask, 2, stride=2)
+    tun = torch.nn.functional.max_unpool2d(tout, tidx, 2, stride=2)
+    np.testing.assert_allclose(_np(un), tun.numpy(), rtol=1e-6)
+
+
+def test_fractional_max_pool2d():
+    x = rng.normal(size=(2, 3, 9, 9)).astype(np.float32)
+    out, mask = F.fractional_max_pool2d(paddle.to_tensor(x), output_size=4,
+                                        random_u=0.3, return_mask=True)
+    assert _np(out).shape == (2, 3, 4, 4)
+    # every output is the max of SOME window containing its recorded index
+    flat = x.reshape(2, 3, -1)
+    picked = np.take_along_axis(flat, _np(mask).reshape(2, 3, -1), axis=-1)
+    np.testing.assert_allclose(_np(out).reshape(2, 3, -1), picked, rtol=1e-6)
+
+
+def test_loss_zoo_matches_torch():
+    x = rng.normal(size=(8, 5)).astype(np.float32)
+    y = rng.normal(size=(8, 5)).astype(np.float32)
+    yl = (rng.uniform(size=(8, 5)) > 0.5).astype(np.float32)
+    var = np.abs(rng.normal(size=(8, 5))).astype(np.float32) + 0.1
+
+    np.testing.assert_allclose(
+        float(F.poisson_nll_loss(paddle.to_tensor(x), paddle.to_tensor(
+            np.abs(y))).numpy()),
+        float(torch.nn.functional.poisson_nll_loss(
+            torch.tensor(x), torch.tensor(np.abs(y)))), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(F.gaussian_nll_loss(paddle.to_tensor(x), paddle.to_tensor(y),
+                                  paddle.to_tensor(var)).numpy()),
+        float(torch.nn.functional.gaussian_nll_loss(
+            torch.tensor(x), torch.tensor(y), torch.tensor(var))),
+        rtol=1e-4)
+    np.testing.assert_allclose(
+        float(F.multi_label_soft_margin_loss(
+            paddle.to_tensor(x), paddle.to_tensor(yl)).numpy()),
+        float(torch.nn.functional.multilabel_soft_margin_loss(
+            torch.tensor(x), torch.tensor(yl))), rtol=1e-5)
+
+
+def test_margin_cross_entropy():
+    # cosine logits in [-1, 1]
+    logits = np.tanh(rng.normal(size=(6, 10))).astype(np.float32)
+    label = rng.integers(0, 10, size=(6,)).astype(np.int64)
+    loss, sm = F.margin_cross_entropy(
+        paddle.to_tensor(logits), paddle.to_tensor(label),
+        margin1=1.0, margin2=0.5, margin3=0.0, scale=64.0,
+        return_softmax=True)
+    assert np.isfinite(float(loss.numpy()))
+    np.testing.assert_allclose(_np(sm).sum(-1), np.ones(6), rtol=1e-5)
+    # m1=1, m2=0, m3=0 degenerates to plain scaled softmax CE
+    plain = F.margin_cross_entropy(
+        paddle.to_tensor(logits), paddle.to_tensor(label),
+        margin1=1.0, margin2=0.0, margin3=0.0, scale=1.0)
+    ref = torch.nn.functional.cross_entropy(torch.tensor(logits),
+                                            torch.tensor(label))
+    np.testing.assert_allclose(float(plain.numpy()), float(ref), rtol=1e-4)
+
+
+def test_adaptive_log_softmax_with_loss():
+    n, d, vocab = 16, 12, 20
+    cutoffs = [8, 14, 20]
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = rng.integers(0, vocab, size=(n,)).astype(np.int64)
+
+    t = torch.nn.AdaptiveLogSoftmaxWithLoss(
+        d, vocab, cutoffs=cutoffs[:-1], div_value=2.0)
+    with torch.no_grad():
+        ref_out, ref_loss = t(torch.tensor(x), torch.tensor(y))
+
+    head_w = t.head.weight.detach().numpy().T.astype(np.float32)
+    tails = []
+    for m in t.tail:
+        proj = m[0].weight.detach().numpy().T.astype(np.float32)
+        cls = m[1].weight.detach().numpy().T.astype(np.float32)
+        tails.append([paddle.to_tensor(proj), paddle.to_tensor(cls)])
+    out, loss = F.adaptive_log_softmax_with_loss(
+        paddle.to_tensor(x), paddle.to_tensor(y),
+        paddle.to_tensor(head_w), tails, cutoffs)
+    np.testing.assert_allclose(_np(out), ref_out.numpy(), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(float(loss.numpy()), float(ref_loss),
+                               rtol=1e-4)
+
+
+def test_max_pool_mask_nhwc_and_ceil():
+    x = rng.normal(size=(1, 1, 5, 5)).astype(np.float32)
+    out, mask = F.max_pool2d(paddle.to_tensor(x), 2, stride=2,
+                             return_mask=True, ceil_mode=True)
+    tout, tidx = torch.nn.functional.max_pool2d(
+        torch.tensor(x), 2, stride=2, ceil_mode=True, return_indices=True)
+    np.testing.assert_allclose(_np(out), tout.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(_np(mask), tidx.numpy())
+
+    xh = rng.normal(size=(2, 6, 6, 3)).astype(np.float32)  # NHWC
+    oh, mh = F.max_pool2d(paddle.to_tensor(xh), 2, stride=2,
+                          return_mask=True, data_format="NHWC")
+    ref = torch.nn.functional.max_pool2d(
+        torch.tensor(xh.transpose(0, 3, 1, 2)), 2, stride=2)
+    np.testing.assert_allclose(_np(oh).transpose(0, 3, 1, 2), ref.numpy(),
+                               rtol=1e-6)
+
+
+def test_cdist_donot_use_mm_precision():
+    a = np.array([[1.0, 0.0]], np.float32)
+    b = np.array([[1.0, 1e-4]], np.float32)
+    out = paddle.cdist(paddle.to_tensor(a), paddle.to_tensor(b), p=2.0,
+                       compute_mode="donot_use_mm_for_euclid_dist")
+    np.testing.assert_allclose(float(_np(out)), 1e-4, rtol=1e-3)
+
+
+def test_view_as_complex_validates_last_dim():
+    with pytest.raises(ValueError):
+        paddle.view_as_complex(paddle.to_tensor(
+            rng.normal(size=(4, 3)).astype(np.float32)))

