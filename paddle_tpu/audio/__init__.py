@@ -186,3 +186,110 @@ class MFCC(Layer):
         from ..ops.creation import _t
         return apply("mfcc", lambda v, d: jnp.einsum("md,...mt->...dt", d, v),
                      _t(lm), _t(self.dct))
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    """parity: audio/functional/functional.py:126."""
+    from ..framework import dtype as _dt
+
+    lo = hz_to_mel(f_min, htk)
+    hi = hz_to_mel(f_max, htk)
+    mels = jnp.linspace(lo, hi, n_mels)
+    return Tensor(jnp.asarray(mel_to_hz(mels, htk),
+                              _dt.convert_dtype(dtype).np_dtype))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    """parity: audio/functional/functional.py:166."""
+    from ..framework import dtype as _dt
+
+    return Tensor(jnp.linspace(0, sr / 2, 1 + n_fft // 2).astype(
+        _dt.convert_dtype(dtype).np_dtype))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """parity: audio/functional/functional.py:262 (librosa semantics)."""
+    from ..core.tensor import Tensor as _T
+
+    x = spect._value if isinstance(spect, _T) else jnp.asarray(spect)
+    db = 10.0 * jnp.log10(jnp.maximum(amin, x))
+    db = db - 10.0 * jnp.log10(jnp.maximum(amin, ref_value))
+    if top_db is not None:
+        db = jnp.maximum(db, jnp.max(db) - top_db)
+    return _T(db)
+
+
+# ---------------------------------------------------------------------------
+# backends — WAV I/O over the stdlib (parity: audio/backends/wave_backend.py:
+# load/save/info without external soundfile deps)
+# ---------------------------------------------------------------------------
+
+class AudioInfo:
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding="PCM_S"):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples  # frames
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def info(filepath):
+    """parity: paddle.audio.info (backends/wave_backend.py:40)."""
+    import wave
+
+    with wave.open(filepath, "rb") as w:
+        return AudioInfo(w.getframerate(), w.getnframes(), w.getnchannels(),
+                         w.getsampwidth() * 8)
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """parity: paddle.audio.load — returns (waveform Tensor [C, L] (or
+    [L, C]), sample_rate). 16-bit PCM WAV."""
+    import wave
+
+    with wave.open(filepath, "rb") as w:
+        sr = w.getframerate()
+        nch = w.getnchannels()
+        width = w.getsampwidth()
+        w.setpos(frame_offset)
+        n = w.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = w.readframes(n)
+    if width == 1:
+        # WAV stores 8-bit PCM unsigned (0..255), midpoint 128
+        data = (np.frombuffer(raw, dtype=np.uint8).astype(np.int16)
+                - 128).reshape(-1, nch)
+    else:
+        dt = {2: np.int16, 4: np.int32}[width]
+        data = np.frombuffer(raw, dtype=dt).reshape(-1, nch)
+    if normalize:
+        data = data.astype(np.float32) / float(2 ** (8 * width - 1))
+    arr = data.T if channels_first else data
+    return Tensor(jnp.asarray(arr)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         bits_per_sample=16):
+    """parity: paddle.audio.save — 16-bit PCM WAV."""
+    import wave
+
+    from ..core.tensor import Tensor as _T
+
+    arr = np.asarray(src._value if isinstance(src, _T) else src)
+    if channels_first:
+        arr = arr.T  # [L, C]
+    if arr.ndim == 1:
+        arr = arr[:, None]
+    scale = float(2 ** (bits_per_sample - 1) - 1)
+    pcm = np.clip(arr, -1.0, 1.0) * scale
+    if bits_per_sample == 8:
+        pcm = (pcm + 128).astype(np.uint8)  # 8-bit WAV is unsigned
+    else:
+        pcm = pcm.astype({16: np.int16, 32: np.int32}[bits_per_sample])
+    with wave.open(filepath, "wb") as w:
+        w.setnchannels(arr.shape[1])
+        w.setsampwidth(bits_per_sample // 8)
+        w.setframerate(int(sample_rate))
+        w.writeframes(pcm.tobytes())

@@ -374,3 +374,10 @@ from .transform import (  # noqa: F401,E402
     IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
     TanhTransform, Transform, TransformedDistribution,
 )
+
+from .extra import (  # noqa: E402,F401
+    Binomial, Cauchy, Chi2, ContinuousBernoulli, Independent,
+    MultivariateNormal,
+)
+__all__ += ["Binomial", "Cauchy", "Chi2", "ContinuousBernoulli",
+            "Independent", "MultivariateNormal"]

@@ -1,0 +1,269 @@
+"""Distribution zoo extension (parity: python/paddle/distribution/ —
+binomial.py, cauchy.py, chi2.py, continuous_bernoulli.py,
+multivariate_normal.py, independent.py), over jax.random /
+jax.scipy.special.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..framework.random import next_key
+from . import Distribution, Gamma, _v
+
+__all__ = ["Binomial", "Cauchy", "Chi2", "ContinuousBernoulli",
+           "MultivariateNormal", "Independent"]
+
+
+class Binomial(Distribution):
+    """parity: distribution/binomial.py."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = jnp.asarray(total_count)
+        self.probs = _v(probs)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.total_count), self.probs.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs * (1 - self.probs))
+
+    # exact Bernoulli-sum sampling/entropy up to this n; above it the
+    # normal approximation is used (O(n) memory otherwise)
+    _EXACT_N = 1024
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        n = int(jnp.max(self.total_count))
+        if n > self._EXACT_N:
+            mean = self.total_count * self.probs
+            std = jnp.sqrt(mean * (1 - self.probs))
+            g = jax.random.normal(next_key(), shape)
+            counts = jnp.clip(jnp.round(mean + std * g), 0,
+                              self.total_count)
+            return Tensor(counts.astype(jnp.float32))
+        u = jax.random.uniform(next_key(), (n,) + shape)
+        counts = jnp.sum(
+            (u < self.probs)
+            & (jnp.arange(n).reshape((n,) + (1,) * len(shape))
+               < self.total_count), axis=0)
+        return Tensor(counts.astype(jnp.float32))
+
+    def log_prob(self, value):
+        k = _v(value)
+        n = self.total_count.astype(jnp.float32)
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        comb = (jax.scipy.special.gammaln(n + 1)
+                - jax.scipy.special.gammaln(k + 1)
+                - jax.scipy.special.gammaln(n - k + 1))
+        return Tensor(comb + k * jnp.log(p) + (n - k) * jnp.log1p(-p))
+
+    def entropy(self):
+        n = int(jnp.max(self.total_count))
+        if n > self._EXACT_N:
+            # Gaussian-limit entropy 0.5*log(2πe·np(1-p))
+            var = self.total_count * self.probs * (1 - self.probs)
+            return Tensor(0.5 * jnp.log(2 * math.pi * math.e * var))
+        # exact finite sum over support
+        ks = jnp.arange(n + 1, dtype=jnp.float32)
+        ks = ks.reshape((n + 1,) + (1,) * len(self.batch_shape))
+        lp = _v(self.log_prob(Tensor(ks)))
+        valid = ks <= self.total_count
+        return Tensor(-jnp.sum(jnp.where(valid, jnp.exp(lp) * lp, 0.0),
+                               axis=0))
+
+
+class Cauchy(Distribution):
+    """parity: distribution/cauchy.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy has no mean")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy has no variance")
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(self.loc + self.scale
+                      * jax.random.cauchy(next_key(), shape))
+
+    def log_prob(self, value):
+        z = (_v(value) - self.loc) / self.scale
+        return Tensor(-jnp.log(math.pi * self.scale * (1 + z * z)))
+
+    def cdf(self, value):
+        z = (_v(value) - self.loc) / self.scale
+        return Tensor(jnp.arctan(z) / math.pi + 0.5)
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(
+            jnp.log(4 * math.pi * self.scale), self.batch_shape))
+
+
+class Chi2(Gamma):
+    """parity: distribution/chi2.py — Gamma(df/2, 1/2)."""
+
+    def __init__(self, df, name=None):
+        self.df = _v(df)
+        super().__init__(self.df / 2.0, jnp.ones_like(self.df) / 2.0)
+
+
+class ContinuousBernoulli(Distribution):
+    """parity: distribution/continuous_bernoulli.py (Loaiza-Ganem &
+    Cunningham 2019)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = _v(probs)
+        self._lims = lims
+        super().__init__(self.probs.shape)
+
+    def _outside(self):
+        return (self.probs < self._lims[0]) | (self.probs > self._lims[1])
+
+    def _log_norm(self):
+        """log C(λ): λ safe-clamped near 1/2, Taylor there."""
+        lam = jnp.clip(self.probs, 1e-6, 1 - 1e-6)
+        safe = jnp.where(self._outside(), lam, 0.4)
+        log_c = jnp.log(
+            2 * jnp.abs(jnp.arctanh(1 - 2 * safe))
+            / jnp.abs(1 - 2 * safe))
+        taylor = math.log(2.0) + 4.0 / 3.0 * (lam - 0.5) ** 2
+        return jnp.where(self._outside(), log_c, taylor)
+
+    @property
+    def mean(self):
+        lam = jnp.clip(self.probs, 1e-6, 1 - 1e-6)
+        m = lam / (2 * lam - 1) + 1 / (2 * jnp.arctanh(1 - 2 * lam))
+        return Tensor(jnp.where(self._outside(), m, 0.5))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(next_key(), shape, minval=1e-6,
+                               maxval=1 - 1e-6)
+        lam = jnp.clip(self.probs, 1e-6, 1 - 1e-6)
+        s = (jnp.log1p(u * (2 * lam - 1) / (1 - lam))
+             / jnp.log(lam / (1 - lam)))
+        return Tensor(jnp.where(self._outside(), s, u))
+
+    def log_prob(self, value):
+        x = _v(value)
+        lam = jnp.clip(self.probs, 1e-6, 1 - 1e-6)
+        return Tensor(x * jnp.log(lam) + (1 - x) * jnp.log1p(-lam)
+                      + self._log_norm())
+
+
+class MultivariateNormal(Distribution):
+    """parity: distribution/multivariate_normal.py (full covariance)."""
+
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None,
+                 precision_matrix=None, name=None):
+        self.loc = _v(loc)
+        if scale_tril is not None:
+            self._tril = _v(scale_tril)
+        elif covariance_matrix is not None:
+            self._tril = jnp.linalg.cholesky(_v(covariance_matrix))
+        elif precision_matrix is not None:
+            self._tril = jnp.linalg.cholesky(
+                jnp.linalg.inv(_v(precision_matrix)))
+        else:
+            raise ValueError("one of covariance_matrix / scale_tril / "
+                             "precision_matrix is required")
+        super().__init__(self.loc.shape[:-1], self.loc.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.loc)
+
+    @property
+    def covariance_matrix(self):
+        return Tensor(self._tril @ jnp.swapaxes(self._tril, -1, -2))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.sum(jnp.square(self._tril), axis=-1))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape + self.event_shape
+        eps = jax.random.normal(next_key(), shape)
+        return Tensor(self.loc + jnp.einsum("...ij,...j->...i",
+                                            self._tril, eps))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        d = self.event_shape[0]
+        diff = _v(value) - self.loc
+        sol = jax.scipy.linalg.solve_triangular(self._tril, diff[..., None],
+                                                lower=True)[..., 0]
+        half_logdet = jnp.sum(jnp.log(jnp.diagonal(self._tril, axis1=-2,
+                                                   axis2=-1)), -1)
+        return Tensor(-0.5 * jnp.sum(sol * sol, -1) - half_logdet
+                      - 0.5 * d * math.log(2 * math.pi))
+
+    def entropy(self):
+        d = self.event_shape[0]
+        half_logdet = jnp.sum(jnp.log(jnp.diagonal(self._tril, axis1=-2,
+                                                   axis2=-1)), -1)
+        e = 0.5 * d * (1 + math.log(2 * math.pi)) + half_logdet
+        return Tensor(jnp.broadcast_to(e, self.batch_shape))
+
+    def kl_divergence(self, other):
+        d = self.event_shape[0]
+        m = jax.scipy.linalg.solve_triangular(
+            other._tril, self._tril, lower=True)
+        tr = jnp.sum(jnp.square(m), axis=(-2, -1))
+        diff = other.loc - self.loc
+        sol = jax.scipy.linalg.solve_triangular(other._tril, diff[..., None],
+                                                lower=True)[..., 0]
+        maha = jnp.sum(sol * sol, -1)
+        logdet = (jnp.sum(jnp.log(jnp.diagonal(other._tril, axis1=-2,
+                                               axis2=-1)), -1)
+                  - jnp.sum(jnp.log(jnp.diagonal(self._tril, axis1=-2,
+                                                 axis2=-1)), -1))
+        return Tensor(0.5 * (tr + maha - d) + logdet)
+
+
+class Independent(Distribution):
+    """parity: distribution/independent.py — reinterpret batch dims as
+    event dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank=1, name=None):
+        self.base = base
+        self._rank = reinterpreted_batch_rank
+        bs = base.batch_shape
+        super().__init__(bs[:len(bs) - self._rank],
+                         bs[len(bs) - self._rank:] + base.event_shape)
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = _v(self.base.log_prob(value))
+        return Tensor(jnp.sum(lp, axis=tuple(range(-self._rank, 0))))
+
+    def entropy(self):
+        e = _v(self.base.entropy())
+        return Tensor(jnp.sum(e, axis=tuple(range(-self._rank, 0))))

@@ -34,9 +34,8 @@ __all__ = [
 
 
 def _pair(v, n=2):
-    if isinstance(v, (list, tuple)):
-        return tuple(int(x) for x in v)
-    return (int(v),) * n
+    from . import _norm_tuple
+    return _norm_tuple(v, n)
 
 
 def _reduce(loss, reduction):

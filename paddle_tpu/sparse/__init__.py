@@ -1,47 +1,88 @@
-"""paddle_tpu.sparse (parity: python/paddle/sparse/ COO/CSR surface).
+"""paddle_tpu.sparse — COO/CSR tensors and ops.
 
-XLA/TPU has no native sparse kernels; SparseCooTensor keeps (indices, values)
-host-side jax arrays and computes via scatter/gather dense lowering — the
-capability surface (construction, conversion, elementwise, matmul) is
-preserved while heavy compute densifies (documented divergence).
+Parity: python/paddle/sparse/ (creation.py sparse_coo/csr_tensor; unary.py
+zero-preserving elementwise + coalesce/transpose/sum/cast; binary.py
+matmul/masked_matmul/mv/add/subtract/multiply/divide/mask_as; nn/ ReLU,
+BatchNorm, Conv2D/3D, SubmConv3D — the sparse_ops.yaml kernel set).
+
+TPU-native design: values/indices are jax arrays; zero-preserving unary ops
+map over values only (never densify); ``matmul`` lowers through
+jax.experimental.sparse BCOO dot_general (XLA's sparse-dense path);
+add/subtract stay sparse via concat+coalesce. Ops without a sensible sparse
+lowering on TPU (divide by a sparse operand, general conv) compute densely
+and re-sparsify — documented per function. Submanifold conv keeps the
+reference's defining property: outputs only at active input sites.
 """
 from __future__ import annotations
 
+from typing import Optional, Sequence
+
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
 
-__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
-           "SparseCsrTensor", "add", "matmul", "relu"]
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+    "sparse_csr_tensor", "sparse_from_dense", "coalesce", "is_same_shape",
+    "add", "subtract", "multiply", "divide", "matmul", "masked_matmul",
+    "mv", "mask_as", "transpose", "sum", "cast", "neg",
+    "abs", "pow", "sin", "tan", "asin", "atan", "sinh", "asinh", "atanh",
+    "tanh", "square", "sqrt", "log1p", "expm1", "rad2deg", "deg2rad",
+    "relu", "isnan", "nn",
+]
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
 
 
 class SparseCooTensor:
-    def __init__(self, indices, values, shape):
-        self.indices = indices if isinstance(indices, Tensor) else Tensor(indices)
-        self.values = values if isinstance(values, Tensor) else Tensor(values)
-        self.shape = list(shape)
+    """COO sparse tensor: indices [ndim_sparse, nnz] + values [nnz, ...]."""
 
+    def __init__(self, indices, values, shape, coalesced=False):
+        self.indices = indices if isinstance(indices, Tensor) \
+            else Tensor(jnp.asarray(_val(indices), jnp.int32))
+        self.values = values if isinstance(values, Tensor) \
+            else Tensor(_val(values))
+        self.shape = list(int(s) for s in shape)
+        self._coalesced = coalesced
+
+    # -- conversions ------------------------------------------------------
     def to_dense(self) -> Tensor:
-        dense = jnp.zeros(tuple(self.shape),
-                          self.values._value.dtype)
+        dense = jnp.zeros(tuple(self.shape), self.values._value.dtype)
         idx = tuple(self.indices._value.astype(jnp.int32))
         return Tensor(dense.at[idx].add(self.values._value))
 
-    def to_sparse_csr(self):
+    def to_sparse_csr(self) -> "SparseCsrTensor":
         if len(self.shape) != 2:
             raise ValueError("CSR requires 2-D")
-        dense = np.asarray(self.to_dense()._value)
-        rows, cols = np.nonzero(dense)
+        c = coalesce(self)
+        rows = np.asarray(c.indices._value[0])
+        cols = np.asarray(c.indices._value[1])
         crows = np.zeros(self.shape[0] + 1, np.int64)
-        for r in rows:
-            crows[r + 1] += 1
-        crows = np.cumsum(crows)
-        return SparseCsrTensor(crows, cols, dense[rows, cols], self.shape)
+        np.add.at(crows, rows + 1, 1)
+        return SparseCsrTensor(np.cumsum(crows), cols,
+                               c.values._value, self.shape)
 
+    # -- surface ----------------------------------------------------------
     @property
     def nnz(self):
         return self.values.shape[0]
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def coalesce(self):
+        return coalesce(self)
+
+    def transpose(self, perm):
+        return transpose(self, perm)
+
+    def matmul(self, other):
+        return matmul(self, other)
 
     def __repr__(self):
         return f"SparseCooTensor(shape={self.shape}, nnz={self.nnz})"
@@ -49,31 +90,44 @@ class SparseCooTensor:
 
 class SparseCsrTensor:
     def __init__(self, crows, cols, values, shape):
-        self.crows = crows if isinstance(crows, Tensor) else Tensor(np.asarray(crows))
-        self.cols = cols if isinstance(cols, Tensor) else Tensor(np.asarray(cols))
-        self.values = values if isinstance(values, Tensor) else Tensor(np.asarray(values))
-        self.shape = list(shape)
+        self.crows = crows if isinstance(crows, Tensor) \
+            else Tensor(jnp.asarray(np.asarray(crows), jnp.int32))
+        self.cols = cols if isinstance(cols, Tensor) \
+            else Tensor(jnp.asarray(np.asarray(cols), jnp.int32))
+        self.values = values if isinstance(values, Tensor) \
+            else Tensor(_val(values))
+        self.shape = list(int(s) for s in shape)
+
+    @property
+    def nnz(self):
+        return self.values.shape[0]
+
+    def to_sparse_coo(self, sparse_dim=2) -> SparseCooTensor:
+        crows = np.asarray(self.crows._value)
+        rows = np.repeat(np.arange(self.shape[0]), np.diff(crows))
+        idx = jnp.stack([jnp.asarray(rows, jnp.int32),
+                         self.cols._value.astype(jnp.int32)])
+        return SparseCooTensor(Tensor(idx), self.values, self.shape,
+                               coalesced=True)
 
     def to_dense(self) -> Tensor:
-        crows = np.asarray(self.crows._value)
-        cols = np.asarray(self.cols._value)
-        vals = np.asarray(self.values._value)
-        dense = np.zeros(tuple(self.shape), vals.dtype)
-        for r in range(self.shape[0]):
-            for i in range(crows[r], crows[r + 1]):
-                dense[r, cols[i]] += vals[i]
-        return Tensor(dense)
+        return self.to_sparse_coo().to_dense()
 
-    def to_sparse_coo(self, sparse_dim=2):
-        dense = np.asarray(self.to_dense()._value)
-        idx = np.stack(np.nonzero(dense))
-        return SparseCooTensor(idx, dense[tuple(idx)], self.shape)
+    def __repr__(self):
+        return f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz})"
 
+
+# ---------------------------------------------------------------------------
+# creation
+# ---------------------------------------------------------------------------
 
 def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
                       stop_gradient=True):
-    ind = np.asarray(indices._value if isinstance(indices, Tensor) else indices)
-    val = np.asarray(values._value if isinstance(values, Tensor) else values)
+    ind = np.asarray(_val(indices))
+    val = _val(values)
+    if dtype is not None:
+        from ..framework import dtype as dtypes
+        val = val.astype(dtypes.convert_dtype(dtype).np_dtype)
     if shape is None:
         shape = list(ind.max(axis=1) + 1)
     return SparseCooTensor(ind, val, shape)
@@ -84,25 +138,195 @@ def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
     return SparseCsrTensor(crows, cols, values, shape)
 
 
-def add(x, y):
-    return sparse_from_dense(x.to_dense() + y.to_dense())
+def sparse_from_dense(dense, sparse_dim=None):
+    arr = np.asarray(_val(dense))
+    idx = np.stack(np.nonzero(arr)) if arr.ndim else np.zeros((0, 0))
+    return SparseCooTensor(idx, arr[tuple(idx)], list(arr.shape),
+                           coalesced=True)
 
 
-def matmul(x, y):
-    xd = x.to_dense() if isinstance(x, (SparseCooTensor, SparseCsrTensor)) else x
-    yd = y.to_dense() if isinstance(y, (SparseCooTensor, SparseCsrTensor)) else y
+def coalesce(x: SparseCooTensor) -> SparseCooTensor:
+    """Sort indices, merge duplicates by summation (unary.py coalesce)."""
+    if x._coalesced:
+        return x
+    ind = np.asarray(x.indices._value)
+    vals = x.values._value
+    if ind.shape[1] == 0:
+        return SparseCooTensor(ind, vals, x.shape, coalesced=True)
+    flat = np.ravel_multi_index(ind, tuple(x.shape[:ind.shape[0]]))
+    order = np.argsort(flat, kind="stable")
+    flat_sorted = flat[order]
+    uniq = np.unique(flat_sorted)
+    seg = np.searchsorted(uniq, flat_sorted)
+    merged = jax.ops.segment_sum(vals[jnp.asarray(order)],
+                                 jnp.asarray(seg), num_segments=len(uniq))
+    new_ind = np.stack(np.unravel_index(uniq, tuple(x.shape[:ind.shape[0]])))
+    return SparseCooTensor(new_ind, Tensor(merged), x.shape, coalesced=True)
+
+
+def is_same_shape(x, y) -> bool:
+    return list(x.shape) == list(y.shape)
+
+
+# ---------------------------------------------------------------------------
+# unary (zero-preserving: map over values, never densify)
+# ---------------------------------------------------------------------------
+
+def _unary(name, fn):
+    def op(x, *args, **kwargs):
+        if isinstance(x, SparseCsrTensor):
+            return SparseCsrTensor(x.crows, x.cols,
+                                   Tensor(fn(x.values._value, *args)),
+                                   x.shape)
+        return SparseCooTensor(x.indices, Tensor(fn(x.values._value, *args)),
+                               x.shape, coalesced=x._coalesced)
+
+    op.__name__ = name
+    return op
+
+
+sin = _unary("sin", jnp.sin)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+asinh = _unary("asinh", jnp.arcsinh)
+atanh = _unary("atanh", jnp.arctanh)
+tanh = _unary("tanh", jnp.tanh)
+square = _unary("square", jnp.square)
+sqrt = _unary("sqrt", jnp.sqrt)
+log1p = _unary("log1p", jnp.log1p)
+expm1 = _unary("expm1", jnp.expm1)
+abs = _unary("abs", jnp.abs)  # noqa: A001
+neg = _unary("neg", jnp.negative)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+relu = _unary("relu", lambda v: jnp.maximum(v, 0))
+isnan = _unary("isnan", jnp.isnan)
+
+
+def pow(x, factor, name=None):  # noqa: A001
+    return _unary("pow", lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    from ..framework import dtype as dtypes
+
+    out = x
+    if value_dtype is not None:
+        out = _unary("cast", lambda v: v.astype(
+            dtypes.convert_dtype(value_dtype).np_dtype))(out)
+    if index_dtype is not None and isinstance(out, SparseCooTensor):
+        out = SparseCooTensor(
+            Tensor(out.indices._value.astype(
+                dtypes.convert_dtype(index_dtype).np_dtype)),
+            out.values, out.shape, coalesced=out._coalesced)
+    return out
+
+
+def transpose(x: SparseCooTensor, perm: Sequence[int], name=None):
+    perm = list(perm)
+    ind = x.indices._value[jnp.asarray(perm)]
+    shape = [x.shape[p] for p in perm]
+    return SparseCooTensor(Tensor(ind), x.values, shape)
+
+
+def sum(x: SparseCooTensor, axis=None, dtype=None, keepdim=False,  # noqa: A001
+        name=None):
+    """Reduction over sparse dims (unary.py sum); axis reductions return a
+    dense Tensor (the reference's sparse-sum also materializes per-axis)."""
+    c = coalesce(x)
+    if axis is None:
+        return Tensor(jnp.sum(c.values._value))
+    from ..ops import math as _m
+    return _m.sum(c.to_dense(), axis=axis, keepdim=keepdim)
+
+
+# ---------------------------------------------------------------------------
+# binary
+# ---------------------------------------------------------------------------
+
+def _coo(x):
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo()
+    return x
+
+
+def add(x, y, name=None):
+    """sparse + sparse via index concat + coalesce — stays sparse."""
+    was_csr = isinstance(x, SparseCsrTensor)
+    x, y = _coo(x), _coo(y)
+    assert is_same_shape(x, y), (x.shape, y.shape)
+    ind = jnp.concatenate([x.indices._value, y.indices._value], axis=1)
+    vals = jnp.concatenate([x.values._value, y.values._value], axis=0)
+    out = coalesce(SparseCooTensor(Tensor(ind), Tensor(vals), x.shape))
+    return out.to_sparse_csr() if was_csr else out
+
+
+def subtract(x, y, name=None):
+    return add(x, neg(_coo(y)))
+
+
+def multiply(x, y, name=None):
+    """Elementwise product — nonzero only on the index intersection;
+    computed densely then re-masked (documented dense lowering)."""
+    was_csr = isinstance(x, SparseCsrTensor)
+    xc, yc = _coo(x), _coo(y)
+    dense = Tensor(xc.to_dense()._value * yc.to_dense()._value)
+    out = mask_as(dense, coalesce(xc))
+    return out.to_sparse_csr() if was_csr else out
+
+
+def divide(x, y, name=None):
+    was_csr = isinstance(x, SparseCsrTensor)
+    xc, yc = _coo(x), _coo(y)
+    dense = Tensor(xc.to_dense()._value / yc.to_dense()._value)
+    out = mask_as(dense, coalesce(xc))
+    return out.to_sparse_csr() if was_csr else out
+
+
+def mask_as(x, mask, name=None):
+    """Dense tensor masked by a sparse pattern → sparse (binary.py
+    mask_as)."""
+    m = coalesce(_coo(mask))
+    idx = tuple(m.indices._value.astype(jnp.int32))
+    vals = _val(x)[idx]
+    return SparseCooTensor(m.indices, Tensor(vals), list(_val(x).shape),
+                           coalesced=True)
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense through jax.experimental.sparse BCOO dot_general (the
+    XLA sparse-dense path); dense/csr operands accepted (binary.py
+    matmul)."""
+    from jax.experimental import sparse as jsparse
+
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        xc = coalesce(_coo(x))
+        bc = jsparse.BCOO((xc.values._value, xc.indices._value.T),
+                          shape=tuple(xc.shape))
+        yv = y.to_dense()._value if isinstance(
+            y, (SparseCooTensor, SparseCsrTensor)) else _val(y)
+        return Tensor(bc @ yv)
     from ..ops.linalg import matmul as dense_matmul
-
-    return dense_matmul(xd, yd)
-
-
-def relu(x):
-    from ..core.tensor import Tensor as _T
-
-    return SparseCooTensor(x.indices, _T(jnp.maximum(x.values._value, 0)), x.shape)
+    yv = y.to_dense() if isinstance(y, (SparseCooTensor, SparseCsrTensor)) \
+        else y
+    return dense_matmul(x, yv)
 
 
-def sparse_from_dense(dense: Tensor, sparse_dim=None):
-    arr = np.asarray(dense._value)
-    idx = np.stack(np.nonzero(arr))
-    return SparseCooTensor(idx, arr[tuple(idx)], list(arr.shape))
+def masked_matmul(x, y, mask, name=None):
+    """(x @ y) sampled at mask's sparsity — SDDMM (binary.py
+    masked_matmul)."""
+    m = coalesce(_coo(mask))
+    rows = m.indices._value[0]
+    cols = m.indices._value[1]
+    xv, yv = _val(x), _val(y)
+    vals = jnp.einsum("nk,nk->n", xv[rows], yv[:, cols].T)
+    return SparseCooTensor(m.indices, Tensor(vals), m.shape, coalesced=True)
+
+
+def mv(x, vec, name=None):
+    return matmul(x, vec)
+
+
+from . import nn  # noqa: E402,F401

@@ -1,0 +1,248 @@
+"""paddle_tpu.sparse.nn — sparse layers.
+
+Parity: python/paddle/sparse/nn/ (layer/activation.py ReLU/LeakyReLU/
+Softmax, layer/norm.py BatchNorm/SyncBatchNorm, layer/conv.py Conv2D/Conv3D/
+SubmConv3D/SubmConv2D over the sparse conv kernels).
+
+TPU-native design: sparse activations/norms operate on the COO values array
+only (channels-last values [nnz, C] — the reference's layout). Convolutions
+compute via the dense MXU path and re-sparsify: ordinary conv takes the
+natural output sparsity; submanifold conv masks outputs to the INPUT's
+active sites — the property that makes SubmConv3D keep sparsity through
+deep nets (Graham et al.), preserved exactly.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["ReLU", "LeakyReLU", "Softmax", "BatchNorm", "SubmConv2D",
+           "SubmConv3D", "Conv2D", "Conv3D", "functional"]
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        from . import relu
+        return relu(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        from . import _unary
+        return _unary("leaky_relu", lambda v: jnp.where(
+            v > 0, v, self._slope * v))(x)
+
+
+class Softmax(Layer):
+    """Softmax over the last dense (values) axis per nonzero row."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+
+    def forward(self, x):
+        from . import _unary
+        return _unary("softmax", lambda v: jax.nn.softmax(v, axis=-1))(x)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over sparse values [nnz, C] (sparse/nn/layer/norm.py:30):
+    statistics across the nonzero sites only, running stats tracked like the
+    dense layer."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        from ..nn import initializer as I
+
+        self._momentum = momentum
+        self._eps = epsilon
+        self.weight = self.create_parameter(
+            [num_features], default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter(
+            [num_features], default_initializer=I.Constant(0.0))
+        self.register_buffer("_mean", Tensor(jnp.zeros([num_features])))
+        self.register_buffer("_variance", Tensor(jnp.ones([num_features])))
+
+    def forward(self, x):
+        from . import SparseCooTensor
+
+        v = x.values._value
+        if self.training:
+            mean = jnp.mean(v, axis=0)
+            var = jnp.var(v, axis=0)
+            m = self._momentum
+            self._mean._replace_value(m * self._mean._value + (1 - m) * mean)
+            self._variance._replace_value(
+                m * self._variance._value + (1 - m) * var)
+        else:
+            mean, var = self._mean._value, self._variance._value
+        out = (v - mean) * jax.lax.rsqrt(var + self._eps) \
+            * self.weight._value + self.bias._value
+        return SparseCooTensor(x.indices, Tensor(out), x.shape,
+                               coalesced=x._coalesced)
+
+
+class _SparseConv(Layer):
+    """Shared machinery: densify → lax.conv (MXU) → re-sparsify."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, subm=False,
+                 padding_mode="zeros", weight_attr=None, bias_attr=None,
+                 data_format="NDHWC", nd=3):
+        super().__init__()
+        self._nd = nd
+        self._subm = subm
+        ks = kernel_size if isinstance(kernel_size, (list, tuple)) \
+            else (kernel_size,) * nd
+        self._ks = tuple(int(k) for k in ks)
+        self._stride = stride if isinstance(stride, (list, tuple)) \
+            else (stride,) * nd
+        self._padding = padding if isinstance(padding, (list, tuple)) \
+            else (padding,) * nd
+        self._dilation = dilation if isinstance(dilation, (list, tuple)) \
+            else (dilation,) * nd
+        from ..nn import initializer as I
+
+        self._groups = groups
+        fan_in = in_channels * int(np.prod(self._ks))
+        bound = 1.0 / math.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            list(self._ks) + [in_channels // groups, out_channels],
+            default_initializer=I.Uniform(-bound, bound))
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                [out_channels], default_initializer=I.Constant(0.0))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        from . import SparseCooTensor
+
+        dense = x.to_dense()._value  # [N, *spatial, C] channels-last
+        nd = self._nd
+        dn = jax.lax.conv_dimension_numbers(
+            dense.shape, self.weight._value.shape,
+            ("NDHWC", "DHWIO", "NDHWC") if nd == 3
+            else ("NHWC", "HWIO", "NHWC"))
+        if self._subm:
+            # submanifold: stride 1, SAME padding (asymmetric for even
+            # kernels) so output sites line up 1:1 with input sites
+            pads = [(((k - 1) * d) // 2, (k - 1) * d - ((k - 1) * d) // 2)
+                    for k, d in zip(self._ks, self._dilation)]
+            out = jax.lax.conv_general_dilated(
+                dense, self.weight._value, (1,) * nd, pads,
+                rhs_dilation=self._dilation, dimension_numbers=dn,
+                feature_group_count=self._groups)
+        else:
+            pads = [(p, p) for p in self._padding]
+            out = jax.lax.conv_general_dilated(
+                dense, self.weight._value, tuple(self._stride), pads,
+                rhs_dilation=self._dilation, dimension_numbers=dn,
+                feature_group_count=self._groups)
+        if self.bias is not None:
+            out = out + self.bias._value
+        if self._subm:
+            # outputs only at the INPUT's active sites (same indices)
+            c = x.coalesce()
+            site_idx = c.indices._value  # [nd+1, nnz] (batch + spatial)
+            vals = out[tuple(site_idx[i]
+                             for i in range(site_idx.shape[0]))]
+            return SparseCooTensor(c.indices, Tensor(vals),
+                                   list(out.shape), coalesced=True)
+        # output sparsity is STRUCTURAL (reachable from input sites via the
+        # kernel support), not value-based — a bias must not densify, and
+        # off-support sites stay zero exactly like the reference kernels
+        occ = (jnp.any(dense != 0, axis=-1, keepdims=True)
+               .astype(dense.dtype))
+        ones_k = jnp.ones(self._ks + (1, 1), dense.dtype)
+        reach = jax.lax.conv_general_dilated(
+            occ, ones_k, tuple(self._stride),
+            [(p, p) for p in self._padding], rhs_dilation=self._dilation,
+            dimension_numbers=dn)
+        active = np.stack(np.nonzero(np.asarray(reach[..., 0]) > 0))
+        out = out * (reach > 0)  # zero off-support sites (incl. bias)
+        vals = out[tuple(active[i] for i in range(active.shape[0]))]
+        return SparseCooTensor(active, Tensor(vals), list(out.shape),
+                               coalesced=True)
+
+
+class Conv3D(_SparseConv):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, subm=False,
+                         bias_attr=bias_attr, nd=3)
+
+
+class SubmConv3D(_SparseConv):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, subm=True,
+                         bias_attr=bias_attr, nd=3)
+
+
+class Conv2D(_SparseConv):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, subm=False,
+                         bias_attr=bias_attr, nd=2)
+
+
+class SubmConv2D(_SparseConv):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, subm=True,
+                         bias_attr=bias_attr, nd=2)
+
+
+class functional:  # namespace parity: paddle.sparse.nn.functional
+    @staticmethod
+    def relu(x):
+        from . import relu as _r
+        return _r(x)
+
+    @staticmethod
+    def softmax(x, axis=-1):
+        return Softmax()(x)
+
+    @staticmethod
+    def attention(query, key, value, sparse_mask, key_padding_mask=None,
+                  attn_mask=None, name=None):
+        """Sparse-mask attention (functional/transformer.py): dense QK^T
+        sampled at the mask pattern, softmax over present keys, then AV."""
+        from . import masked_matmul
+
+        q, k, v = query._value, key._value, value._value
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        # [B, H, S, D] dense path with mask applied densely (docs note:
+        # the sparse pattern is honored via -inf masking)
+        scores = jnp.einsum("bhsd,bhtd->bhst", q, k) * scale
+        mask_dense = sparse_mask.to_dense()._value
+        if mask_dense.ndim == 3:
+            # paddle contract: [batch*num_heads, S, S]
+            mask_dense = mask_dense.reshape(scores.shape)
+        scores = jnp.where(mask_dense != 0, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhst,bhtd->bhsd", probs, v)
+        return Tensor(out)

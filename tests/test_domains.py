@@ -220,3 +220,150 @@ def test_profiler_summary_tables_and_timer():
         b.after_step(num_samples=16)
     info = b.step_info()
     assert "reader_cost" in info and "batch_cost" in info and "ips" in info
+
+
+def test_distribution_zoo_extras():
+    """Binomial/Cauchy/Chi2/ContinuousBernoulli/MultivariateNormal/
+    Independent vs torch.distributions (parity: distribution/*.py)."""
+    import torch
+    import torch.distributions as td
+
+    import paddle_tpu as paddle
+    from paddle_tpu import distribution as D
+
+    # Binomial
+    b = D.Binomial(10, 0.3)
+    tb = td.Binomial(10, torch.tensor(0.3))
+    np.testing.assert_allclose(float(b.mean.numpy()), float(tb.mean),
+                               rtol=1e-6)
+    np.testing.assert_allclose(
+        float(b.log_prob(paddle.to_tensor(4.0)).numpy()),
+        float(tb.log_prob(torch.tensor(4.0))), rtol=1e-5)
+    s = b.sample((500,))
+    assert 1.5 < float(s.numpy().mean()) < 4.5
+
+    # Cauchy
+    c = D.Cauchy(1.0, 2.0)
+    tc = td.Cauchy(torch.tensor(1.0), torch.tensor(2.0))
+    np.testing.assert_allclose(
+        float(c.log_prob(paddle.to_tensor(0.5)).numpy()),
+        float(tc.log_prob(torch.tensor(0.5))), rtol=1e-5)
+    np.testing.assert_allclose(float(c.entropy().numpy()),
+                               float(tc.entropy()), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(c.cdf(paddle.to_tensor(2.0)).numpy()),
+        float(tc.cdf(torch.tensor(2.0))), rtol=1e-5)
+
+    # Chi2
+    x2 = D.Chi2(3.0)
+    tx2 = td.Chi2(torch.tensor(3.0))
+    np.testing.assert_allclose(
+        float(x2.log_prob(paddle.to_tensor(2.5)).numpy()),
+        float(tx2.log_prob(torch.tensor(2.5))), rtol=1e-5)
+
+    # ContinuousBernoulli
+    cb = D.ContinuousBernoulli(0.3)
+    tcb = td.ContinuousBernoulli(torch.tensor(0.3))
+    np.testing.assert_allclose(
+        float(cb.log_prob(paddle.to_tensor(0.7)).numpy()),
+        float(tcb.log_prob(torch.tensor(0.7))), rtol=1e-4)
+    np.testing.assert_allclose(float(cb.mean.numpy()), float(tcb.mean),
+                               rtol=1e-4)
+
+    # MultivariateNormal (+ KL)
+    rng2 = np.random.default_rng(5)
+    A = rng2.normal(size=(3, 3)).astype(np.float32)
+    cov = (A @ A.T + 3 * np.eye(3)).astype(np.float32)
+    mu = rng2.normal(size=(3,)).astype(np.float32)
+    mvn = D.MultivariateNormal(paddle.to_tensor(mu),
+                               covariance_matrix=paddle.to_tensor(cov))
+    tmvn = td.MultivariateNormal(torch.tensor(mu),
+                                 covariance_matrix=torch.tensor(cov))
+    val = rng2.normal(size=(3,)).astype(np.float32)
+    np.testing.assert_allclose(
+        float(mvn.log_prob(paddle.to_tensor(val)).numpy()),
+        float(tmvn.log_prob(torch.tensor(val))), rtol=1e-4)
+    np.testing.assert_allclose(float(mvn.entropy().numpy()),
+                               float(tmvn.entropy()), rtol=1e-4)
+    mvn2 = D.MultivariateNormal(paddle.to_tensor(mu + 1),
+                                covariance_matrix=paddle.to_tensor(
+                                    2 * cov))
+    tmvn2 = td.MultivariateNormal(torch.tensor(mu + 1),
+                                  covariance_matrix=torch.tensor(2 * cov))
+    np.testing.assert_allclose(
+        float(mvn.kl_divergence(mvn2).numpy()),
+        float(td.kl_divergence(tmvn, tmvn2)), rtol=1e-4)
+
+    # Independent
+    base = D.Normal(np.zeros((4, 3), np.float32),
+                    np.ones((4, 3), np.float32))
+    ind = D.Independent(base, 1)
+    assert ind.batch_shape == (4,) and ind.event_shape == (3,)
+    v = rng2.normal(size=(4, 3)).astype(np.float32)
+    tind = td.Independent(td.Normal(torch.zeros(4, 3), torch.ones(4, 3)), 1)
+    np.testing.assert_allclose(ind.log_prob(paddle.to_tensor(v)).numpy(),
+                               tind.log_prob(torch.tensor(v)).numpy(),
+                               rtol=1e-4)
+
+
+def test_audio_wav_backend_and_functional(tmp_path):
+    import paddle_tpu as paddle
+    import paddle_tpu.audio as audio
+
+    sr = 8000
+    t = np.linspace(0, 1, sr, endpoint=False)
+    wave_np = (0.5 * np.sin(2 * np.pi * 440 * t)).astype(np.float32)
+    path = str(tmp_path / "tone.wav")
+    audio.save(path, paddle.to_tensor(wave_np[None, :]), sr)
+
+    meta = audio.info(path)
+    assert meta.sample_rate == sr and meta.num_channels == 1
+    assert meta.bits_per_sample == 16 and meta.num_samples == sr
+
+    back, sr2 = audio.load(path)
+    assert sr2 == sr
+    np.testing.assert_allclose(np.asarray(back.numpy())[0], wave_np,
+                               atol=2e-4)
+
+    # functional additions
+    freqs = audio.fft_frequencies(sr, 512)
+    assert freqs.shape[0] == 257 and float(freqs.numpy()[-1]) == sr / 2
+    mf = audio.mel_frequencies(10, 0.0, 4000.0)
+    mfv = np.asarray(mf.numpy())
+    assert mfv.shape == (10,) and np.all(np.diff(mfv) > 0)
+    db = audio.power_to_db(paddle.to_tensor(
+        np.array([1.0, 0.1, 1e-12], np.float32)))
+    dbv = np.asarray(db.numpy())
+    np.testing.assert_allclose(dbv[0], 0.0, atol=1e-5)
+    np.testing.assert_allclose(dbv[1], -10.0, atol=1e-4)
+    assert dbv[2] >= dbv[0] - 80.0 - 1e-5  # top_db floor
+
+
+def test_audio_8bit_wav_roundtrip(tmp_path):
+    import paddle_tpu as paddle
+    import paddle_tpu.audio as audio
+
+    tone = (0.4 * np.sin(np.linspace(0, 50, 2000))).astype(np.float32)
+    p = str(tmp_path / "tone8.wav")
+    audio.save(p, paddle.to_tensor(tone[None]), 8000, bits_per_sample=8)
+    meta = audio.info(p)
+    assert meta.bits_per_sample == 8
+    back, sr = audio.load(p)
+    # 8-bit has ~2^-7 quantization; silence must round-trip near zero
+    np.testing.assert_allclose(np.asarray(back.numpy())[0], tone, atol=2e-2)
+
+
+def test_binomial_large_n_normal_approx():
+    import time as _time
+
+    from paddle_tpu import distribution as D
+
+    b = D.Binomial(1_000_000, 0.5)
+    t0 = _time.perf_counter()
+    s = b.sample((100,))
+    dt = _time.perf_counter() - t0
+    assert dt < 5.0, dt  # no O(n) blowup
+    m = float(np.asarray(s.numpy()).mean())
+    assert abs(m - 500_000) < 2000
+    e = float(b.entropy().numpy())
+    assert abs(e - 0.5 * np.log(2 * np.pi * np.e * 250_000)) < 1e-3

@@ -1,0 +1,236 @@
+"""Sparse package depth (parity: python/paddle/sparse/ — COO/CSR ops,
+sparse matmul/SDDMM, sparse BatchNorm/ReLU, SubmConv3D) — every op checked
+against its dense equivalent."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.sparse as sparse
+
+rng = np.random.default_rng(3)
+
+
+def _rand_coo(shape=(4, 5), density=0.4, seed=0):
+    r = np.random.default_rng(seed)
+    dense = r.normal(size=shape).astype(np.float32)
+    dense[r.uniform(size=shape) > density] = 0.0
+    return sparse.sparse_from_dense(paddle.to_tensor(dense)), dense
+
+
+def test_coo_csr_roundtrips():
+    coo, dense = _rand_coo()
+    np.testing.assert_allclose(coo.to_dense().numpy(), dense)
+    csr = coo.to_sparse_csr()
+    np.testing.assert_allclose(csr.to_dense().numpy(), dense)
+    back = csr.to_sparse_coo()
+    np.testing.assert_allclose(back.to_dense().numpy(), dense)
+
+
+def test_coalesce_merges_duplicates():
+    ind = np.array([[0, 0, 1], [1, 1, 2]])
+    vals = np.array([1.0, 2.0, 5.0], np.float32)
+    c = sparse.coalesce(sparse.sparse_coo_tensor(ind, vals, [3, 4]))
+    assert c.nnz == 2
+    dense = c.to_dense().numpy()
+    assert dense[0, 1] == 3.0 and dense[1, 2] == 5.0
+
+
+def test_unary_zero_preserving_matches_dense():
+    coo, dense = _rand_coo()
+    for name in ("sin", "tanh", "square", "expm1", "abs", "neg", "relu",
+                 "asinh", "atan", "sinh"):
+        out = getattr(sparse, name)(coo)
+        ref = getattr(np, name.replace("neg", "negative")
+                      .replace("relu", "abs"), None)
+        np_fn = {"sin": np.sin, "tanh": np.tanh, "square": np.square,
+                 "expm1": np.expm1, "abs": np.abs, "neg": np.negative,
+                 "relu": lambda v: np.maximum(v, 0), "asinh": np.arcsinh,
+                 "atan": np.arctan, "sinh": np.sinh}[name]
+        np.testing.assert_allclose(out.to_dense().numpy(), np_fn(dense),
+                                   rtol=1e-5, atol=1e-6)
+        assert out.nnz == coo.nnz  # never densified
+
+
+def test_add_subtract_stay_sparse():
+    a, da = _rand_coo(seed=1)
+    b, db = _rand_coo(seed=2)
+    s = sparse.add(a, b)
+    np.testing.assert_allclose(s.to_dense().numpy(), da + db, rtol=1e-6)
+    d = sparse.subtract(a, b)
+    np.testing.assert_allclose(d.to_dense().numpy(), da - db, rtol=1e-6)
+    assert isinstance(s, sparse.SparseCooTensor)
+
+
+def test_multiply_divide():
+    a, da = _rand_coo(seed=1)
+    b, db = _rand_coo(seed=2)
+    m = sparse.multiply(a, b)
+    np.testing.assert_allclose(m.to_dense().numpy(), da * db,
+                               rtol=1e-6, atol=1e-7)
+    dv = sparse.divide(a, a)  # avoid 0/0 off-pattern: same pattern
+    got = dv.to_dense().numpy()
+    expect = np.where(da != 0, 1.0, 0.0)
+    np.testing.assert_allclose(got, expect, rtol=1e-6)
+
+
+def test_sparse_matmul_bcoo():
+    coo, dense = _rand_coo((6, 4), seed=4)
+    y = rng.normal(size=(4, 3)).astype(np.float32)
+    out = sparse.matmul(coo, paddle.to_tensor(y))
+    np.testing.assert_allclose(out.numpy(), dense @ y, rtol=1e-5,
+                               atol=1e-6)
+    # csr operand
+    out2 = sparse.matmul(coo.to_sparse_csr(), paddle.to_tensor(y))
+    np.testing.assert_allclose(out2.numpy(), dense @ y, rtol=1e-5,
+                               atol=1e-6)
+    # mv
+    v = rng.normal(size=(4,)).astype(np.float32)
+    np.testing.assert_allclose(
+        sparse.mv(coo, paddle.to_tensor(v)).numpy(), dense @ v,
+        rtol=1e-5, atol=1e-6)
+
+
+def test_masked_matmul_sddmm():
+    mask, dmask = _rand_coo((5, 6), seed=7)
+    x = rng.normal(size=(5, 8)).astype(np.float32)
+    y = rng.normal(size=(8, 6)).astype(np.float32)
+    out = sparse.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y),
+                               mask)
+    expect = (x @ y) * (dmask != 0)
+    np.testing.assert_allclose(out.to_dense().numpy(), expect, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_mask_as_transpose_sum_cast():
+    coo, dense = _rand_coo((4, 5), seed=9)
+    full = rng.normal(size=(4, 5)).astype(np.float32)
+    m = sparse.mask_as(paddle.to_tensor(full), coo)
+    np.testing.assert_allclose(m.to_dense().numpy(),
+                               full * (dense != 0), rtol=1e-6)
+    t = sparse.transpose(coo, [1, 0])
+    np.testing.assert_allclose(t.to_dense().numpy(), dense.T, rtol=1e-6)
+    np.testing.assert_allclose(float(sparse.sum(coo).numpy()),
+                               dense.sum(), rtol=1e-5)
+    np.testing.assert_allclose(sparse.sum(coo, axis=1).numpy(),
+                               dense.sum(1), rtol=1e-5)
+    c = sparse.cast(coo, value_dtype="float16")
+    assert c.values.dtype.name == "float16"
+
+
+def test_sparse_batchnorm_matches_dense_values():
+    from paddle_tpu.sparse.nn import BatchNorm
+
+    ind = np.stack(np.nonzero(rng.uniform(size=(2, 3, 3, 3)) > 0.5))
+    vals = rng.normal(size=(ind.shape[1], 4)).astype(np.float32)
+    x = sparse.sparse_coo_tensor(ind, vals, [2, 3, 3, 3, 4])
+    bn = BatchNorm(4)
+    bn.train()
+    out = bn(x)
+    got = np.asarray(out.values.numpy())
+    mu, var = vals.mean(0), vals.var(0)
+    expect = (vals - mu) / np.sqrt(var + 1e-5)
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-4)
+    assert np.abs(np.asarray(bn._mean.numpy()) - 0.1 * mu).max() < 1e-5
+    bn.eval()
+    out2 = bn(x)
+    assert np.isfinite(np.asarray(out2.values.numpy())).all()
+
+
+def test_subm_conv3d_preserves_sparsity_and_matches_dense():
+    from paddle_tpu.sparse.nn import SubmConv3D
+
+    ind = np.stack(np.nonzero(rng.uniform(size=(2, 4, 4, 4)) > 0.6))
+    vals = rng.normal(size=(ind.shape[1], 3)).astype(np.float32)
+    x = sparse.sparse_coo_tensor(ind, vals, [2, 4, 4, 4, 3])
+    conv = SubmConv3D(3, 5, kernel_size=3)
+    out = conv(x)
+    # the submanifold property: output indices == input indices
+    np.testing.assert_array_equal(np.asarray(out.indices.numpy()),
+                                  np.asarray(x.coalesce().indices.numpy()))
+    # values match the dense conv sampled at active sites
+    ref = torch.nn.functional.conv3d(
+        torch.tensor(np.asarray(x.to_dense().numpy()).transpose(
+            0, 4, 1, 2, 3)),
+        torch.tensor(np.asarray(
+            conv.weight.numpy()).transpose(4, 3, 0, 1, 2)),
+        torch.tensor(np.asarray(conv.bias.numpy())), padding=1)
+    ref = ref.numpy().transpose(0, 2, 3, 4, 1)
+    got_dense = out.to_dense().numpy()
+    site = tuple(np.asarray(out.indices.numpy()))
+    np.testing.assert_allclose(got_dense[site], ref[site], rtol=1e-3,
+                               atol=1e-4)
+
+
+def test_sparse_conv2d_output_sparsity():
+    """Output sites are STRUCTURAL (reachable from input sites); bias does
+    not densify, and values at reachable sites match the dense conv."""
+    from paddle_tpu.sparse.nn import Conv2D
+
+    ind = np.stack(np.nonzero(rng.uniform(size=(1, 6, 6)) > 0.7))
+    vals = rng.normal(size=(ind.shape[1], 2)).astype(np.float32)
+    x = sparse.sparse_coo_tensor(ind, vals, [1, 6, 6, 2])
+    conv = Conv2D(2, 4, kernel_size=3, padding=1)
+    out = conv(x)
+    ref = torch.nn.functional.conv2d(
+        torch.tensor(np.asarray(x.to_dense().numpy()).transpose(0, 3, 1, 2)),
+        torch.tensor(np.asarray(conv.weight.numpy()).transpose(3, 2, 0, 1)),
+        torch.tensor(np.asarray(conv.bias.numpy())), padding=1)
+    ref = ref.numpy().transpose(0, 2, 3, 1)
+    got = out.to_dense().numpy()
+    # reachability mask: any input site within the 3x3 support
+    occ = np.any(np.asarray(x.to_dense().numpy()) != 0, -1)[0]
+    reach = np.zeros_like(occ)
+    for di in (-1, 0, 1):
+        for dj in (-1, 0, 1):
+            reach |= np.roll(np.roll(occ, di, 0), dj, 1) & ~(
+                ((di > 0) & (np.arange(6)[:, None] < di))
+                | ((dj > 0) & (np.arange(6)[None, :] < dj)))
+    np.testing.assert_allclose(got[0][reach], ref[0][reach],
+                               rtol=1e-3, atol=1e-4)
+    # bias must NOT densify: unreachable sites are exactly zero
+    assert out.nnz < 36
+    np.testing.assert_array_equal(got[0][~reach], 0.0)
+
+
+def test_subm_conv_even_kernel_keeps_shape():
+    from paddle_tpu.sparse.nn import SubmConv2D
+
+    ind = np.stack(np.nonzero(rng.uniform(size=(1, 5, 5)) > 0.5))
+    vals = rng.normal(size=(ind.shape[1], 2)).astype(np.float32)
+    x = sparse.sparse_coo_tensor(ind, vals, [1, 5, 5, 2])
+    out = SubmConv2D(2, 3, kernel_size=2)(x)
+    assert out.shape[:3] == [1, 5, 5]
+    np.testing.assert_array_equal(np.asarray(out.indices.numpy()),
+                                  np.asarray(x.coalesce().indices.numpy()))
+
+
+def test_sparse_attention_3d_mask():
+    from paddle_tpu.sparse.nn import functional as sF
+
+    B, H, S, Dh = 2, 4, 6, 8
+    q = paddle.to_tensor(rng.normal(size=(B, H, S, Dh)).astype(np.float32))
+    k = paddle.to_tensor(rng.normal(size=(B, H, S, Dh)).astype(np.float32))
+    v = paddle.to_tensor(rng.normal(size=(B, H, S, Dh)).astype(np.float32))
+    tril = np.tril(np.ones((S, S), np.float32))
+    mask = sparse.sparse_from_dense(paddle.to_tensor(
+        np.broadcast_to(tril, (B * H, S, S)).copy()))
+    out = sF.attention(q, k, v, mask)
+    assert tuple(out.shape) == (B, H, S, Dh)
+    assert np.isfinite(np.asarray(out.numpy())).all()
+
+
+def test_sparse_relu_layer_and_softmax():
+    from paddle_tpu.sparse.nn import ReLU, Softmax
+
+    coo, dense = _rand_coo((4, 6), seed=11)
+    out = ReLU()(coo)
+    np.testing.assert_allclose(out.to_dense().numpy(),
+                               np.maximum(dense, 0), rtol=1e-6)
+    ind = np.stack(np.nonzero(rng.uniform(size=(3,)) >= 0))
+    vals = rng.normal(size=(3, 5)).astype(np.float32)
+    s = sparse.sparse_coo_tensor(ind, vals, [3, 5])
+    sm = Softmax()(s)
+    got = np.asarray(sm.values.numpy())
+    e = np.exp(vals - vals.max(-1, keepdims=True))
+    np.testing.assert_allclose(got, e / e.sum(-1, keepdims=True), rtol=1e-5)
