@@ -157,3 +157,9 @@ class Executor:
 
     def close(self):
         pass
+
+from .plan import (  # noqa: E402,F401
+    Job, Plan, StandaloneExecutor, build_gradient_merge_plan,
+)
+__all__ += ["Job", "Plan", "StandaloneExecutor",
+            "build_gradient_merge_plan"]

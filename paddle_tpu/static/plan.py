@@ -1,0 +1,167 @@
+"""Plan / Job multi-program orchestration (the "new executor" surface).
+
+Parity: the reference's static executor Plan/Job model —
+paddle/fluid/framework/new_executor/interpreter (Plan = ordered Jobs, each
+a program with a type and micro_batch_id; built by the pipeline scheduler
+passes, run by StandaloneExecutor — python/paddle/base/executor.py:677
+_ExecutorCache builds Plan([Job("default")])).
+
+TPU-native re-design: a Job wraps one COMPILED jax program (any callable
+over named arrays — jitted on first use) plus the names it consumes and
+produces; a Plan is the ordered job list; StandaloneExecutor threads a
+scope {name: array} through the jobs. This is the orchestration layer for
+schedules that genuinely need several programs with host sequencing
+(gradient-merge F-then-apply, eval/predict alternation, pipeline stages as
+separate programs) — the single-program hot path stays one pjit.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+
+__all__ = ["Job", "Plan", "StandaloneExecutor", "build_gradient_merge_plan"]
+
+
+class Job:
+    """One schedulable program (parity: interpreter Job — type +
+    micro_batch_id).
+
+    ``fn`` is called POSITIONALLY with the scope values named by
+    ``inputs`` (in order) and must return a tuple/list whose length equals
+    ``outputs`` (a single bare return is treated as a 1-tuple).
+    ``micro_batch_id`` >= 0 selects the micro-batch slice fed to this job
+    for keys listed in ``sliced`` (the scheduler passes' microbatching).
+    Keys in ``donate`` are buffer-donated to XLA and removed from the
+    scope unless the job re-produces them via ``outputs``.
+    """
+
+    def __init__(self, fn: Callable, job_type: str = "default",
+                 micro_batch_id: int = -1,
+                 inputs: Optional[Sequence[str]] = None,
+                 outputs: Optional[Sequence[str]] = None,
+                 sliced: Sequence[str] = (), donate: Sequence[str] = ()):
+        self._raw_fn = fn
+        self.type = job_type
+        self.micro_batch_id = micro_batch_id
+        self.inputs = list(inputs or [])
+        self.outputs = list(outputs or [])
+        self.sliced = tuple(sliced)
+        self.donate = tuple(donate)
+        self._jitted = None
+
+    def set_micro_batch_id(self, mb_id: int):
+        self.micro_batch_id = mb_id
+
+    def _compile(self, cache: Optional[dict] = None):
+        if self._jitted is None:
+            donate = tuple(self.inputs.index(k) for k in self.donate
+                           if k in self.inputs)
+            key = (self._raw_fn, donate)
+            if cache is not None and key in cache:
+                # jobs sharing one fn (per-micro-batch clones) share the
+                # compiled program — micro_batch_id only changes host-side
+                # slicing, not the trace
+                self._jitted = cache[key]
+            else:
+                self._jitted = jax.jit(self._raw_fn, donate_argnums=donate)
+                if cache is not None:
+                    cache[key] = self._jitted
+        return self._jitted
+
+
+class Plan:
+    """Ordered job list (parity: framework Plan(jobs,
+    type_to_program))."""
+
+    def __init__(self, jobs: List[Job], num_micro_batches: int = 1):
+        self.jobs = list(jobs)
+        self.num_micro_batches = num_micro_batches
+
+    def job_types(self):
+        return [j.type for j in self.jobs]
+
+
+class StandaloneExecutor:
+    """Threads a scope through the plan's jobs (parity:
+    StandaloneExecutor.run — new_executor/standalone_executor.cc; feed by
+    name, fetch by name)."""
+
+    def __init__(self, place=None, plan: Optional[Plan] = None):
+        self.place = place
+        self.plan = plan
+        self._jit_cache: dict = {}
+
+    def run(self, feed: Dict[str, object],
+            fetch_list: Optional[Sequence[str]] = None):
+        scope = dict(feed)
+        M = self.plan.num_micro_batches
+        for job in self.plan.jobs:
+            fn = job._compile(self._jit_cache)
+            args = []
+            for k in job.inputs:
+                v = scope[k]
+                if k in job.sliced and job.micro_batch_id >= 0:
+                    B = v.shape[0]
+                    if B % M:
+                        raise ValueError(
+                            f"Plan: sliced input '{k}' batch {B} is not "
+                            f"divisible by num_micro_batches={M}")
+                    mb = B // M
+                    v = v[job.micro_batch_id * mb:
+                          (job.micro_batch_id + 1) * mb]
+                args.append(v)
+            out = fn(*args)
+            if not isinstance(out, (tuple, list)):
+                out = (out,)
+            if len(out) != len(job.outputs):
+                raise ValueError(
+                    f"Plan: job '{job.type}' returned {len(out)} values "
+                    f"but declares outputs {job.outputs}")
+            for k in job.donate:  # donated buffers are dead — drop them
+                scope.pop(k, None)
+            scope.update(dict(zip(job.outputs, out)))
+        if fetch_list is None:
+            return scope
+        return [scope[k] for k in fetch_list]
+
+
+def build_gradient_merge_plan(loss_and_grads_fn: Callable,
+                              apply_fn: Callable,
+                              num_micro_batches: int) -> Plan:
+    """The GradientMergePass schedule as a Plan: one forward+backward job
+    per micro-batch accumulating grads, then one optimizer-apply job
+    (parity: passes/pipeline_scheduler_pass FThenB + gradient merge).
+
+    loss_and_grads_fn(params, batch) -> (loss, grads);
+    apply_fn(params, grads, opt_state) -> (params, opt_state).
+    Scope keys: params, batch (sliced), opt_state, grads_acc, loss_acc.
+    Builder jobs do not donate (feeds are caller-owned); pass donate= on
+    hand-built Jobs when the scope owns its buffers.
+    """
+    import jax.numpy as jnp
+
+    def fwd_bwd(params, batch, grads_acc, loss_acc):
+        loss, grads = loss_and_grads_fn(params, batch)
+        acc = jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(a.dtype), grads_acc, grads)
+        return acc, loss_acc + loss
+
+    def apply(params, grads_acc, opt_state):
+        mean_g = jax.tree_util.tree_map(
+            lambda g: g / num_micro_batches, grads_acc)
+        new_p, new_state = apply_fn(params, mean_g, opt_state)
+        zero = jax.tree_util.tree_map(jnp.zeros_like, grads_acc)
+        return new_p, new_state, zero
+
+    jobs = []
+    for mb in range(num_micro_batches):
+        jobs.append(Job(
+            fwd_bwd, job_type="forward_backward", micro_batch_id=mb,
+            inputs=["params", "batch", "grads_acc", "loss_acc"],
+            outputs=["grads_acc", "loss_acc"], sliced=("batch",)))
+    jobs.append(Job(
+        apply, job_type="optimizer",
+        inputs=["params", "grads_acc", "opt_state"],
+        outputs=["params", "opt_state", "grads_acc"]))
+    return Plan(jobs, num_micro_batches)
