@@ -246,12 +246,44 @@ class DistModel:
         raise NotImplementedError(
             f"DistModel: unsupported grad_clip {type(clip).__name__}")
 
+    def _param_shardings(self):
+        """NamedShardings for params with explicit placements — the layout
+        contract the compiled step must preserve across updates. Empty when
+        ShardingStage3 owns the parameter layout (re-pinning to declared
+        placements would undo the ZeRO-3 sharding)."""
+        from . import placements_to_spec
+
+        if getattr(self._shard_fn, "_shard_params", False):
+            return {}
+        out = {}
+        for k, p in self._layer.named_parameters():
+            attr = getattr(p, "_dist_attr", None)
+            if attr is not None:
+                spec = placements_to_spec(attr.placements, attr.process_mesh,
+                                          p.ndim)
+                out[k] = NamedSharding(attr.process_mesh.jax_mesh(), spec)
+        return out
+
+    @staticmethod
+    def _pin_params(new_p, shardings):
+        if not shardings:
+            return new_p
+        return {k: (jax.lax.with_sharding_constraint(v, shardings[k])
+                    if k in shardings else v)
+                for k, v in new_p.items()}
+
     def _build(self, mode):
         from ...autograd import no_grad
         from ...framework.capture import capture_buffer_updates
 
         layer, opt = self._layer, self._opt
         apply_update = mode == "train" and self._acc_steps == 1
+        # updated params keep their declared placements (the reference
+        # re-applies dist_attr on program outputs); GSPMD would otherwise
+        # propagate e.g. the ZeRO moment layout into them
+        param_shardings = self._param_shardings()
+        keep_placements = lambda new_p: self._pin_params(new_p,
+                                                         param_shardings)
 
         def step_fn(pvals, bufs, opt_state, lr, invals):
             args = [Tensor(v, stop_gradient=True) for v in invals]
@@ -286,7 +318,8 @@ class DistModel:
             grads = self._clip_grads(grads)
             new_p, new_state = opt.apply_gradients_functional(
                 pvals, grads, opt_state, lr)
-            return lossv, new_p, self._constrain_state(new_state), new_b
+            return (lossv, keep_placements(new_p),
+                    self._constrain_state(new_state), new_b)
 
         return jax.jit(step_fn)
 
@@ -295,14 +328,17 @@ class DistModel:
         Clips the MERGED gradient, then updates."""
         opt = self._opt
 
-        def apply_fn(pvals, grads, opt_state, lr):
-            grads = self._clip_grads(grads)
-            new_p, new_state = opt.apply_gradients_functional(
-                pvals, grads, opt_state, lr)
-            return new_p, self._constrain_state(new_state)
-
         key = ("apply", jax.tree_util.tree_structure(self._opt_state))
         if key not in self._cache:
+            param_shardings = self._param_shardings()
+
+            def apply_fn(pvals, grads, opt_state, lr):
+                grads = self._clip_grads(grads)
+                new_p, new_state = opt.apply_gradients_functional(
+                    pvals, grads, opt_state, lr)
+                return (self._pin_params(new_p, param_shardings),
+                        self._constrain_state(new_state))
+
             self._cache[key] = jax.jit(apply_fn)
         new_p, new_state = self._cache[key](pvals, grads, self._opt_state, lr)
         return new_p, new_state

@@ -233,6 +233,18 @@ def pipeline_apply_interleaved(stage_fn: Callable, stacked_params, x,
 #   * The loss head runs inside the last stage (lax.cond), so microbatch
 #     inputs are token ids (tiny) and nothing O(M * hidden) is ever
 #     replicated or broadcast — the two traffic problems of the GPipe path.
+#
+# ZeroBubble note (pipeline_zero_bubble.py:62): ZB splits backward into a
+# B (input-grad) slot and a W (weight-grad) slot so W fills the cooldown
+# bubble. The table generator extends naturally (act ∈ {idle,F,B,W}), but
+# ZB's win requires the B slot to REUSE stored forward residuals — under
+# this recompute-based design each split slot would recompute the stage
+# forward, and one jax.vjp already yields dx and dw together, so the split
+# costs a full extra recompute per microbatch·stage and nets out negative
+# on TPU (MXU-bound stages). A stored-residual ZB variant needs scan-carry
+# residual buffers (S-deep, stage-activation sized) — the memory 1F1B
+# exists to avoid. Documented trade: 1F1B is the memory-shaped schedule;
+# ZB is intentionally not implemented.
 
 _IDLE, _FWD, _BWD = 0, 1, 2
 

@@ -59,9 +59,25 @@ def make_scheduler(closed: int = 0, ready: int = 0, record: int = 1,
 
 
 def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """Returns an on_trace_ready handler that writes the host-side event
+    ledger as a chrome://tracing JSON next to the jax XPlane dump
+    (parity: profiler.export_chrome_tracing — profiler.py:227)."""
     def handler(prof):
-        # the jax trace directory already contains a perfetto/chrome trace
-        print(f"[profiler] trace exported under {dir_name}")
+        import json
+        import os as _os
+
+        _os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"worker_{_os.getpid()}"
+        events = [
+            {"name": n, "ph": "X", "pid": 0, "tid": 0,
+             "ts": t0 * 1e6, "dur": (t1 - t0) * 1e6,
+             "cat": "host"}
+            for n, t0, t1 in prof._ledger.spans]
+        path = _os.path.join(dir_name, f"{name}.pt.trace.json")
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events}, f)
+        print(f"[profiler] chrome trace: {path} "
+              f"(device XPlane under {dir_name})")
 
     handler._dir = dir_name
     return handler
@@ -180,7 +196,18 @@ def RecordEvent(name: str, event_type=None):
 
 
 def load_profiler_result(path):
-    raise NotImplementedError("load XPlane dumps with TensorBoard")
+    """Load a chrome trace written by export_chrome_tracing back into an
+    EventLedger (parity surface: profiler.load_profiler_result; XPlane
+    device dumps are for TensorBoard)."""
+    import json
+
+    with open(path) as f:
+        data = json.load(f)
+    ledger = EventLedger()
+    for ev in data.get("traceEvents", []):
+        t0 = ev["ts"] / 1e6
+        ledger.add(ev["name"], t0, t0 + ev.get("dur", 0) / 1e6)
+    return ledger
 
 
 class benchmark:  # noqa: N801  (paddle.profiler.benchmark parity)

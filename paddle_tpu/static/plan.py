@@ -102,6 +102,11 @@ class StandaloneExecutor:
             for k in job.inputs:
                 v = scope[k]
                 if k in job.sliced and job.micro_batch_id >= 0:
+                    if job.micro_batch_id >= M:
+                        raise ValueError(
+                            f"Plan: job micro_batch_id="
+                            f"{job.micro_batch_id} out of range for "
+                            f"num_micro_batches={M}")
                     B = v.shape[0]
                     if B % M:
                         raise ValueError(
@@ -118,8 +123,12 @@ class StandaloneExecutor:
                 raise ValueError(
                     f"Plan: job '{job.type}' returned {len(out)} values "
                     f"but declares outputs {job.outputs}")
-            for k in job.donate:  # donated buffers are dead — drop them
-                scope.pop(k, None)
+            # drop only buffers that were actually donated: the key must be
+            # a real input, and a sliced input donates only its slice (the
+            # full scope array stays alive for the other micro-batches)
+            for k in job.donate:
+                if k in job.inputs and k not in job.sliced:
+                    scope.pop(k, None)
             scope.update(dict(zip(job.outputs, out)))
         if fetch_list is None:
             return scope
@@ -135,7 +144,9 @@ def build_gradient_merge_plan(loss_and_grads_fn: Callable,
 
     loss_and_grads_fn(params, batch) -> (loss, grads);
     apply_fn(params, grads, opt_state) -> (params, opt_state).
-    Scope keys: params, batch (sliced), opt_state, grads_acc, loss_acc.
+    Scope keys: params, batch (sliced), opt_state, grads_acc, loss_acc;
+    the optimizer job writes "loss" (merged mean) and resets
+    grads_acc/loss_acc so the scope threads directly into the next step.
     Builder jobs do not donate (feeds are caller-owned); pass donate= on
     hand-built Jobs when the scope owns its buffers.
     """
@@ -147,12 +158,15 @@ def build_gradient_merge_plan(loss_and_grads_fn: Callable,
             lambda a, g: a + g.astype(a.dtype), grads_acc, grads)
         return acc, loss_acc + loss
 
-    def apply(params, grads_acc, opt_state):
+    def apply(params, grads_acc, loss_acc, opt_state):
         mean_g = jax.tree_util.tree_map(
             lambda g: g / num_micro_batches, grads_acc)
         new_p, new_state = apply_fn(params, mean_g, opt_state)
         zero = jax.tree_util.tree_map(jnp.zeros_like, grads_acc)
-        return new_p, new_state, zero
+        # report the merged mean loss and reset the accumulator so the
+        # scope can thread straight into the next step
+        return (new_p, new_state, zero, loss_acc / num_micro_batches,
+                jnp.zeros_like(loss_acc))
 
     jobs = []
     for mb in range(num_micro_batches):
@@ -162,6 +176,6 @@ def build_gradient_merge_plan(loss_and_grads_fn: Callable,
             outputs=["grads_acc", "loss_acc"], sliced=("batch",)))
     jobs.append(Job(
         apply, job_type="optimizer",
-        inputs=["params", "grads_acc", "opt_state"],
-        outputs=["params", "opt_state", "grads_acc"]))
+        inputs=["params", "grads_acc", "loss_acc", "opt_state"],
+        outputs=["params", "opt_state", "grads_acc", "loss", "loss_acc"]))
     return Plan(jobs, num_micro_batches)

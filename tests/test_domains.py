@@ -367,3 +367,22 @@ def test_binomial_large_n_normal_approx():
     assert abs(m - 500_000) < 2000
     e = float(b.entropy().numpy())
     assert abs(e - 0.5 * np.log(2 * np.pi * np.e * 250_000)) < 1e-3
+
+
+def test_profiler_chrome_trace_roundtrip(tmp_path):
+    import time as _time
+
+    import paddle_tpu.profiler as profiler
+
+    handler = profiler.export_chrome_tracing(str(tmp_path), "w0")
+    p = profiler.Profiler(timer_only=True, on_trace_ready=handler)
+    p.start()
+    with profiler.RecordEvent("step"):
+        _time.sleep(0.002)
+    handler(p)
+    p.stop()
+    ledger = profiler.load_profiler_result(
+        str(tmp_path / "w0.pt.trace.json"))
+    assert len(ledger.spans) == 1 and ledger.spans[0][0] == "step"
+    text = profiler.build_summary(ledger)
+    assert "step" in text

@@ -50,8 +50,8 @@ def test_gradient_merge_plan_matches_single_program():
     ref_p = W - 0.1 * g
     np.testing.assert_allclose(np.asarray(scope["params"]),
                                np.asarray(ref_p), rtol=1e-5, atol=1e-6)
-    np.testing.assert_allclose(
-        float(scope["loss_acc"]) / 4, float(loss), rtol=1e-5)
+    np.testing.assert_allclose(float(scope["loss"]), float(loss),
+                               rtol=1e-5)
     # accumulator was reset for the next step
     np.testing.assert_allclose(np.asarray(scope["grads_acc"]), 0.0)
 
@@ -86,3 +86,37 @@ def test_plan_donated_key_removed_from_scope():
     scope = StandaloneExecutor(plan=Plan([j])).run(
         {"x": jnp.ones((2,)) + 0})
     assert "x" not in scope and float(scope["y"][0]) == 2.0
+
+
+def test_gradient_merge_plan_threads_across_steps():
+    """Scope threads step-to-step: loss_acc resets, loss reports the merged
+    mean, out-of-range micro_batch_id raises."""
+    rng = np.random.default_rng(1)
+    W = jnp.asarray(rng.normal(size=(4, 1)).astype(np.float32))
+    batch = jnp.asarray(rng.normal(size=(8, 5)).astype(np.float32))
+
+    def lg(params, b):
+        x, y = b[:, :4], b[:, 4:]
+        return jax.value_and_grad(
+            lambda w: jnp.mean((x @ w - y) ** 2))(params)
+
+    plan = build_gradient_merge_plan(
+        lg, lambda p, g, s: (p - 0.1 * g, s), 2)
+    exe = StandaloneExecutor(plan=plan)
+    scope = {"params": W, "batch": batch,
+             "grads_acc": jnp.zeros_like(W),
+             "loss_acc": jnp.zeros(()), "opt_state": jnp.zeros(())}
+    losses = []
+    for _ in range(3):
+        scope["batch"] = batch
+        scope = exe.run(scope)
+        losses.append(float(scope["loss"]))
+        assert float(scope["loss_acc"]) == 0.0  # reset for the next step
+    assert losses[2] < losses[0]
+
+    bad = Job(lambda b: (b.sum(),), micro_batch_id=2, inputs=["b"],
+              outputs=["s"], sliced=("b",))
+    import pytest
+    with pytest.raises(ValueError, match="out of range"):
+        StandaloneExecutor(plan=Plan([bad], num_micro_batches=2)).run(
+            {"b": jnp.ones((4, 2))})
