@@ -643,9 +643,12 @@ def forward_with_cache(params, tokens, cache, config: LlamaConfig):
 
 
 def generate(params, prompt_tokens, config: LlamaConfig, max_new_tokens: int,
-             temperature: float = 0.0, key=None, eos_token_id=None):
+             temperature: float = 0.0, key=None, eos_token_id=None,
+             top_k: int = 0, top_p: float = 1.0):
     """Greedy (temperature=0) or sampled generation with a jitted decode
-    step. prompt_tokens: [B, S_prompt] → [B, S_prompt + max_new_tokens]."""
+    step; ``top_k``/``top_p`` restrict the sampling pool (nucleus — the
+    reference's top_p_sampling op). prompt_tokens: [B, S_prompt] →
+    [B, S_prompt + max_new_tokens]."""
     B, S0 = prompt_tokens.shape
     max_len = S0 + max_new_tokens
     cache = init_kv_cache(config, B, max_len)
@@ -659,7 +662,21 @@ def generate(params, prompt_tokens, config: LlamaConfig, max_new_tokens: int,
     for i in range(max_new_tokens):
         if temperature > 0:
             key, sub = jax.random.split(key)
-            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+            lg = logits / temperature
+            if top_k > 0:
+                kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
+                lg = jnp.where(lg < kth, -1e30, lg)
+            if top_p < 1.0:
+                sort_idx = jnp.argsort(-lg, axis=-1)
+                sort_p = jnp.take_along_axis(
+                    jax.nn.softmax(lg, axis=-1), sort_idx, axis=-1)
+                cum = jnp.cumsum(sort_p, axis=-1)
+                drop_sorted = cum - sort_p >= top_p  # keep first ≥p prefix
+                drop = jnp.zeros_like(drop_sorted).at[
+                    jnp.arange(lg.shape[0])[:, None], sort_idx].set(
+                    drop_sorted)
+                lg = jnp.where(drop, -1e30, lg)
+            nxt = jax.random.categorical(sub, lg, axis=-1)
         else:
             nxt = jnp.argmax(logits, axis=-1)
         nxt = nxt[:, None].astype(prompt_tokens.dtype)

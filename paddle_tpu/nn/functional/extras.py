@@ -27,9 +27,12 @@ from ...ops.dispatch import apply
 __all__ = [
     "unfold", "fold", "pixel_unshuffle", "grid_sample", "affine_grid",
     "max_unpool1d", "max_unpool2d", "max_unpool3d", "fractional_max_pool2d",
-    "poisson_nll_loss", "gaussian_nll_loss", "multi_label_soft_margin_loss",
-    "margin_cross_entropy", "adaptive_log_softmax_with_loss",
-    "max_pool2d_with_index",
+    "fractional_max_pool3d", "poisson_nll_loss", "gaussian_nll_loss",
+    "multi_label_soft_margin_loss", "margin_cross_entropy",
+    "adaptive_log_softmax_with_loss", "max_pool2d_with_index",
+    "channel_shuffle", "maxout", "thresholded_relu", "lp_pool2d",
+    "conv3d_transpose", "gather_tree", "edit_distance",
+    "class_center_sample",
 ]
 
 
@@ -477,3 +480,231 @@ def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
 
     out, loss = apply("adaptive_log_softmax_with_loss", fn, *args)
     return out, loss
+
+
+# ---------------------------------------------------------------------------
+# remaining op-ledger gaps (tools/ops_coverage.py audit)
+# ---------------------------------------------------------------------------
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    """parity: ops.yaml channel_shuffle / shuffle_channel (ShuffleNet)."""
+    g = int(groups)
+
+    def fn(v):
+        if data_format == "NCHW":
+            N, C, H, W = v.shape
+            return v.reshape(N, g, C // g, H, W).swapaxes(1, 2).reshape(
+                N, C, H, W)
+        N, H, W, C = v.shape
+        return v.reshape(N, H, W, g, C // g).swapaxes(3, 4).reshape(
+            N, H, W, C)
+
+    return apply("channel_shuffle", fn, _t(x))
+
+
+def maxout(x, groups, axis=1, name=None):
+    """parity: ops.yaml maxout — max over `groups` consecutive channels."""
+    g = int(groups)
+
+    def fn(v):
+        ax = axis % v.ndim
+        C = v.shape[ax]
+        shape = v.shape[:ax] + (C // g, g) + v.shape[ax + 1:]
+        return jnp.max(v.reshape(shape), axis=ax + 1)
+
+    return apply("maxout", fn, _t(x))
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply("thresholded_relu",
+                 lambda v: jnp.where(v > threshold, v, value), _t(x))
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    """parity: ops.yaml lp_pool2d — (avg of |x|^p * count)^(1/p)."""
+    from . import avg_pool2d
+
+    p = float(norm_type)
+    kh, kw = _pair(kernel_size)
+
+    # |x|^p: fractional p on negatives would NaN; exclusive=False makes
+    # avg*kh*kw an exact window sum (padded zeros contribute zero)
+    powed = apply("lp_pow", lambda v: jnp.abs(v) ** p, _t(x))
+    pooled = avg_pool2d(powed, kernel_size, stride, padding,
+                        ceil_mode=ceil_mode, exclusive=False,
+                        data_format=data_format)
+    return apply("lp_root",
+                 lambda v: (v * (kh * kw)) ** (1.0 / p), pooled)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    """parity: ops.yaml conv3d_transpose — gradient/transpose of conv3d
+    via lhs-dilated conv (same construction as conv2d_transpose)."""
+    from . import _conv_padding, _norm_tuple
+
+    n = 3
+    strides = _norm_tuple(stride, n)
+    dil = _norm_tuple(dilation, n)
+    opad = _norm_tuple(output_padding, n)
+    padding_n = _conv_padding(padding, n)
+
+    def fn(v, w, *b):
+        if isinstance(padding_n, str):
+            pads = padding_n
+        else:
+            pads = []
+            for i in range(n):
+                k = (w.shape[2 + i] - 1) * dil[i] + 1
+                lo = k - 1 - padding_n[i][0]
+                hi = k - 1 - padding_n[i][1] + opad[i]
+                pads.append((lo, hi))
+        w_flip = jnp.flip(w, axis=(2, 3, 4))
+        if groups > 1:
+            ic, ocg = w.shape[0], w.shape[1]
+            w_flip = w_flip.reshape(groups, ic // groups, ocg, *w.shape[2:])
+            w_flip = jnp.moveaxis(w_flip, 2, 1).reshape(
+                groups * ocg, ic // groups, *w.shape[2:])
+        else:
+            w_flip = jnp.swapaxes(w_flip, 0, 1)
+        out = jax.lax.conv_general_dilated(
+            v, w_flip, window_strides=(1,) * n, padding=pads,
+            lhs_dilation=strides, rhs_dilation=dil,
+            dimension_numbers=(data_format, "OIDHW", data_format),
+            feature_group_count=groups)
+        if b:
+            out = out + b[0].reshape((1, -1) + (1,) * n)
+        return out
+
+    args = [_t(x), _t(weight)] + ([_t(bias)] if bias is not None else [])
+    return apply("conv3d_transpose", fn, *args)
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """parity: ops.yaml fractional_max_pool3d — per-depth-slice 2-D
+    fractional pooling with a shared u, then depth pooling."""
+    od, oh, ow = (output_size if isinstance(output_size, (list, tuple))
+                  else (output_size,) * 3)
+    if random_u is None:
+        from ...framework.random import next_key
+        u = float(jax.random.uniform(next_key(), ()))
+    else:
+        u = float(random_u)
+
+    def bounds(in_size, out_size):
+        alpha = in_size / out_size
+        idx = (np.arange(out_size + 1) + u) * alpha
+        b = np.floor(idx).astype(np.int64) - int(np.floor(u * alpha))
+        b = np.clip(b, 0, in_size)
+        b[-1] = in_size
+        return b
+
+    def fn(v):
+        N, C, D, H, W = v.shape
+        db, hb, wb = bounds(D, od), bounds(H, oh), bounds(W, ow)
+        outs = []
+        idxs = []
+        for i in range(od):
+            d0, d1 = int(db[i]), max(int(db[i + 1]), int(db[i]) + 1)
+            rows, ridx = [], []
+            for j in range(oh):
+                h0, h1 = int(hb[j]), max(int(hb[j + 1]), int(hb[j]) + 1)
+                cols, cidx = [], []
+                for k in range(ow):
+                    w0, w1 = int(wb[k]), max(int(wb[k + 1]),
+                                             int(wb[k]) + 1)
+                    win = v[:, :, d0:d1, h0:h1, w0:w1].reshape(N, C, -1)
+                    a = jnp.argmax(win, axis=-1)
+                    dd, hh, ww = d1 - d0, h1 - h0, w1 - w0
+                    di = d0 + a // (hh * ww)
+                    hi = h0 + (a // ww) % hh
+                    wi = w0 + a % ww
+                    cols.append(jnp.max(win, axis=-1))
+                    cidx.append((di * H * W + hi * W + wi).astype(
+                        jnp.int32))
+                rows.append(jnp.stack(cols, -1))
+                ridx.append(jnp.stack(cidx, -1))
+            outs.append(jnp.stack(rows, -2))
+            idxs.append(jnp.stack(ridx, -2))
+        return jnp.stack(outs, -3), jnp.stack(idxs, -3)
+
+    out, idx = apply("fractional_max_pool3d", fn, _t(x))
+    return (out, idx) if return_mask else out
+
+
+def gather_tree(ids, parents, name=None):
+    """parity: ops.yaml gather_tree — beam-search backtrace: follow parent
+    pointers from the last step to recover full sequences.
+    ids/parents: [max_time, batch, beam]."""
+    def fn(idv, par):
+        T = idv.shape[0]
+
+        def step(beams, t):
+            # beams: [batch, beam] current beam indices at time t+1
+            tt = T - 1 - t
+            out_ids = jnp.take_along_axis(idv[tt], beams, axis=1)
+            prev = jnp.take_along_axis(par[tt], beams, axis=1)
+            return prev, out_ids
+
+        init = jnp.broadcast_to(jnp.arange(idv.shape[2], dtype=idv.dtype),
+                                idv.shape[1:])
+        _, rev = jax.lax.scan(step, init, jnp.arange(T))
+        return jnp.flip(rev, axis=0)
+
+    return apply("gather_tree", fn, _t(ids), _t(parents))
+
+
+def edit_distance(hyps, refs, normalized=True, ignored_tokens=None,
+                  name=None):
+    """parity: ops.yaml edit_distance (Levenshtein). hyps/refs:
+    [B, T] int arrays padded with -1 (host DP — inherently sequential)."""
+    h = np.asarray(_t(hyps)._value)
+    r = np.asarray(_t(refs)._value)
+    out = []
+    for a, b in zip(h, r):
+        a = [int(x) for x in a if x >= 0]
+        b = [int(x) for x in b if x >= 0]
+        if ignored_tokens:
+            a = [x for x in a if x not in ignored_tokens]
+            b = [x for x in b if x not in ignored_tokens]
+        dp = np.arange(len(b) + 1, dtype=np.float32)
+        for i, ca in enumerate(a, 1):
+            prev = dp.copy()
+            dp[0] = i
+            for j, cb in enumerate(b, 1):
+                dp[j] = min(prev[j] + 1, dp[j - 1] + 1,
+                            prev[j - 1] + (ca != cb))
+        d = dp[-1]
+        if normalized and len(b):
+            d /= len(b)
+        out.append(d)
+    from ...core.tensor import Tensor as _T2
+    return _T2(jnp.asarray(np.asarray(out, np.float32)[:, None]))
+
+
+def class_center_sample(label, num_classes, num_samples, group=None,
+                        name=None):
+    """parity: ops.yaml class_center_sample (PLSC partial-FC): sample the
+    union of positive classes plus random negatives, remap labels into the
+    sampled index space."""
+    from ...framework.random import next_key
+
+    lab = np.asarray(_t(label)._value)
+    pos = np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        neg_pool = np.setdiff1d(np.arange(num_classes), pos)
+        # framework RNG: reproducible under paddle.seed
+        pick = np.asarray(jax.random.choice(
+            next_key(), len(neg_pool), (num_samples - len(pos),),
+            replace=False))
+        sampled = np.sort(np.concatenate([pos, neg_pool[pick]]))
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    out_label = remap[lab]
+    return (Tensor(jnp.asarray(out_label)),
+            Tensor(jnp.asarray(sampled.astype(np.int64))))

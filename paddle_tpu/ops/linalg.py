@@ -293,3 +293,36 @@ def householder_product(x, tau, name=None):
         return q[..., :, :n]
 
     return apply("householder_product", fn, _t(x), _t(tau))
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """parity: linalg.py lu_unpack — split packed LU into (P, L, U),
+    batched. x: packed LU from paddle.linalg.lu; y: 1-based pivots."""
+    lu_mat = np.asarray(x._value)
+    piv = np.asarray(y._value) - 1
+    n = lu_mat.shape[-2]
+    batch = lu_mat.shape[:-2]
+    lu_flat = lu_mat.reshape((-1, n, lu_mat.shape[-1]))
+    piv_flat = piv.reshape((-1, piv.shape[-1]))
+    Ps, Ls, Us = [], [], []
+    for b in range(lu_flat.shape[0]):
+        perm = np.arange(n)
+        for i, p in enumerate(piv_flat[b]):
+            perm[i], perm[int(p)] = perm[int(p)], perm[i]
+        P = np.zeros((n, n), lu_mat.dtype)
+        P[perm, np.arange(n)] = 1.0
+        L = np.tril(lu_flat[b], -1)
+        np.fill_diagonal(L, 1.0)
+        Ps.append(P)
+        Ls.append(L)
+        Us.append(np.triu(lu_flat[b]))
+    shape = batch + (n, n)
+    P = np.stack(Ps).reshape(shape)
+    L = np.stack(Ls).reshape(batch + Ls[0].shape)
+    U = np.stack(Us).reshape(batch + Us[0].shape)
+    outs = []
+    if unpack_pivots:
+        outs.append(Tensor(jnp.asarray(P)))
+    if unpack_ludata:
+        outs += [Tensor(jnp.asarray(L)), Tensor(jnp.asarray(U))]
+    return tuple(outs)

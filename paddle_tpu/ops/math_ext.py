@@ -223,3 +223,68 @@ def unfold(x, axis, size, step, name=None):
         return jnp.transpose(out, perm)
 
     return apply("unfold", fn, _t(x))
+
+
+def unstack(x, axis=0, num=None, name=None):
+    """parity: manipulation.py unstack — split along axis into a list."""
+    t = _t(x)
+    ax = axis % t.ndim
+    n = t.shape[ax]
+    outs = apply("unstack",
+                 lambda v: tuple(jnp.squeeze(s, ax) for s in
+                                 jnp.split(v, n, axis=ax)), t)
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    """parity: manipulation.py fill_diagonal_ (functional form)."""
+    def fn(v):
+        n, m = v.shape[-2], v.shape[-1]
+        i = jnp.arange(n)[:, None]
+        j = jnp.arange(m)[None, :]
+        mask = (j - i) == offset
+        return jnp.where(mask, jnp.asarray(value, v.dtype), v)
+
+    return apply("fill_diagonal", fn, _t(x))
+
+
+def reduce_as(x, target, name=None):
+    """parity: ops.yaml reduce_as — sum x down to target's shape
+    (the broadcast adjoint)."""
+    def fn(v, t):
+        extra = v.ndim - t.ndim
+        if extra:
+            v = jnp.sum(v, axis=tuple(range(extra)))
+        axes = tuple(i for i, (a, b) in enumerate(zip(v.shape, t.shape))
+                     if a != b)
+        return jnp.sum(v, axis=axes, keepdims=True).reshape(t.shape) \
+            if axes else v
+
+    return apply("reduce_as", fn, _t(x), _t(target))
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    """parity: ops.yaml top_p_sampling — nucleus sampling over the last
+    axis: keep the smallest prefix of sorted probs whose mass >= p, then
+    sample. Returns (values, indices) of the sampled token."""
+    from ..framework.random import next_key
+
+    key = next_key() if seed is None else jax.random.PRNGKey(int(seed))
+
+    def fn(logits, p):
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        sort_idx = jnp.argsort(-probs, axis=-1)
+        sort_p = jnp.take_along_axis(probs, sort_idx, axis=-1)
+        cum = jnp.cumsum(sort_p, axis=-1)
+        keep = cum - sort_p < p[..., None]  # first token always kept
+        filt = jnp.where(keep, sort_p, 0.0)
+        filt = filt / jnp.sum(filt, axis=-1, keepdims=True)
+        choice = jax.random.categorical(key, jnp.log(filt + 1e-30), axis=-1)
+        idx = jnp.take_along_axis(sort_idx, choice[..., None], axis=-1)
+        val = jnp.take_along_axis(probs, idx, axis=-1)
+        return val, idx
+
+    return apply("top_p_sampling", fn, _t(x), _t(ps))
+
+
+__all__ += ["unstack", "fill_diagonal", "reduce_as", "top_p_sampling"]

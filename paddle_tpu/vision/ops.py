@@ -19,7 +19,9 @@ from ..ops.creation import _t
 from ..ops.dispatch import apply
 
 __all__ = ["nms", "roi_align", "roi_pool", "box_coder", "box_area", "box_iou",
-           "distribute_fpn_proposals"]
+           "distribute_fpn_proposals", "prior_box", "yolo_box",
+           "deform_conv2d", "correlation", "psroi_pool", "matrix_nms",
+           "generate_proposals", "yolo_loss"]
 
 
 def box_area(boxes):
@@ -220,3 +222,472 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     restore = Tensor(jnp.asarray(order.astype(np.int32)[:, None]))
     nums = [Tensor(jnp.asarray(np.asarray([len(i)], np.int32))) for i in idxs]
     return outs, restore, nums
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """parity: ops.yaml prior_box (SSD anchor generation). input [N,C,H,W]
+    feature map, image [N,C,Him,Wim]; returns (boxes [H,W,A,4],
+    variances [H,W,A,4]) normalized to [0,1]."""
+    H, W = int(input.shape[2]), int(input.shape[3])
+    Him, Wim = int(image.shape[2]), int(image.shape[3])
+    step_h = steps[1] or Him / H
+    step_w = steps[0] or Wim / W
+
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+
+    whs = []
+    for mi, ms in enumerate(min_sizes):
+        if min_max_aspect_ratios_order:
+            # Caffe/TensorRT order: min, max, then remaining aspect ratios
+            whs.append((ms, ms))
+            if max_sizes:
+                s = np.sqrt(ms * max_sizes[mi])
+                whs.append((s, s))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        else:
+            for ar in ars:
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            if max_sizes:
+                for mx in max_sizes:
+                    s = np.sqrt(ms * mx)
+                    whs.append((s, s))
+    A = len(whs)
+    cx = (np.arange(W) + offset) * step_w
+    cy = (np.arange(H) + offset) * step_h
+    gx, gy = np.meshgrid(cx, cy)  # [H, W]
+    boxes = np.zeros((H, W, A, 4), np.float32)
+    for a, (bw, bh) in enumerate(whs):
+        boxes[:, :, a, 0] = (gx - bw / 2) / Wim
+        boxes[:, :, a, 1] = (gy - bh / 2) / Him
+        boxes[:, :, a, 2] = (gx + bw / 2) / Wim
+        boxes[:, :, a, 3] = (gy + bh / 2) / Him
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          boxes.shape).copy()
+    return Tensor(jnp.asarray(boxes)), Tensor(jnp.asarray(var))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """parity: ops.yaml yolo_box — decode YOLOv3 head predictions into
+    boxes [N, H*W*A, 4] and scores [N, H*W*A, class_num]."""
+    def fn(v, imgs):
+        N, C, H, W = v.shape
+        A = len(anchors) // 2
+        ioup = None
+        if iou_aware:
+            # PP-YOLO layout: first A channels are the IoU predictions
+            ioup = jax.nn.sigmoid(v[:, :A])
+            v = v[:, A:]
+        v = v.reshape(N, A, 5 + class_num, H, W)
+        gx = (jnp.arange(W) + 0.0)[None, None, None, :]
+        gy = (jnp.arange(H) + 0.0)[None, None, :, None]
+        sx = scale_x_y
+        bx = (jax.nn.sigmoid(v[:, :, 0]) * sx - (sx - 1) / 2 + gx) / W
+        by = (jax.nn.sigmoid(v[:, :, 1]) * sx - (sx - 1) / 2 + gy) / H
+        anc = jnp.asarray(anchors, jnp.float32).reshape(A, 2)
+        bw = jnp.exp(v[:, :, 2]) * anc[None, :, 0, None, None] \
+            / (W * downsample_ratio)
+        bh = jnp.exp(v[:, :, 3]) * anc[None, :, 1, None, None] \
+            / (H * downsample_ratio)
+        conf = jax.nn.sigmoid(v[:, :, 4])
+        if ioup is not None:
+            f = iou_aware_factor
+            conf = conf ** (1.0 - f) * ioup ** f
+        cls = jax.nn.sigmoid(v[:, :, 5:]) * conf[:, :, None]
+        imh = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+        imw = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+        x0 = (bx - bw / 2) * imw
+        y0 = (by - bh / 2) * imh
+        x1 = (bx + bw / 2) * imw
+        y1 = (by + bh / 2) * imh
+        if clip_bbox:
+            x0 = jnp.clip(x0, 0, imw - 1)
+            y0 = jnp.clip(y0, 0, imh - 1)
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+        boxes = jnp.stack([x0, y0, x1, y1], axis=-1).reshape(N, -1, 4)
+        scores = jnp.moveaxis(cls, 2, -1).reshape(N, -1, class_num)
+        keep = (conf.reshape(N, -1) > conf_thresh)[..., None]
+        return boxes * keep, scores * keep
+
+    boxes, scores = apply("yolo_box", fn, _t(x), _t(img_size))
+    return boxes, scores
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """parity: ops.yaml deformable_conv (v2 when mask given). TPU-native:
+    bilinear-sample the input at offset kernel taps (vectorized gather,
+    the grid_sample machinery) into an im2col tensor, then one MXU matmul
+    with the weights — no per-point scatter kernels."""
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    ph, pw = (padding, padding) if isinstance(padding, int) else padding
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) else dilation
+
+    def fn(v, off, w, *rest):
+        has_mask = mask is not None
+        mk = rest[0] if has_mask else None
+        b = rest[-1] if bias is not None else None
+        N, C, H, W = v.shape
+        Co, Cg, kh, kw = w.shape
+        Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        K = kh * kw
+        off = off.reshape(N, deformable_groups, K, 2, Ho, Wo)
+
+        base_h = (jnp.arange(Ho) * sh - ph)[None, :, None]
+        base_w = (jnp.arange(Wo) * sw - pw)[None, None, :]
+        kh_off = (jnp.arange(kh) * dh).repeat(kw).reshape(K, 1, 1)
+        kw_off = jnp.tile(jnp.arange(kw) * dw, kh).reshape(K, 1, 1)
+        # sample coords [N, dg, K, Ho, Wo]
+        py = base_h + kh_off + off[:, :, :, 0]
+        px = base_w + kw_off + off[:, :, :, 1]
+
+        def bilinear(coords_y, coords_x):
+            y0 = jnp.floor(coords_y)
+            x0 = jnp.floor(coords_x)
+            wy = coords_y - y0
+            wx = coords_x - x0
+
+            def gather(yi, xi):
+                inb = ((yi >= 0) & (yi <= H - 1)
+                       & (xi >= 0) & (xi <= W - 1))
+                yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+                xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+                # v: [N, C, H, W]; index per (n, dg, k, ho, wo)
+                vals = v[jnp.arange(N)[:, None, None, None, None],
+                         :, yc, xc]          # [N, dg, K, Ho, Wo, C]
+                return vals * inb[..., None]
+
+            g00 = gather(y0, x0)
+            g01 = gather(y0, x0 + 1)
+            g10 = gather(y0 + 1, x0)
+            g11 = gather(y0 + 1, x0 + 1)
+            top = g00 * (1 - wx)[..., None] + g01 * wx[..., None]
+            bot = g10 * (1 - wx)[..., None] + g11 * wx[..., None]
+            return top * (1 - wy)[..., None] + bot * wy[..., None]
+
+        samp = bilinear(py, px)              # [N, dg, K, Ho, Wo, C]
+        if has_mask:
+            samp = samp * mk.reshape(N, deformable_groups, K, Ho,
+                                     Wo)[..., None]
+        # each deformable group's offsets act on its own channel slice
+        dg = deformable_groups
+        cpg = C // dg
+        samp = jnp.concatenate(
+            [samp[:, g, ..., g * cpg:(g + 1) * cpg] for g in range(dg)],
+            axis=-1)                          # [N, K, Ho, Wo, C]
+        samp = jnp.moveaxis(samp, -1, 1)      # [N, C, K, Ho, Wo]
+        wv = w.reshape(groups, Co // groups, Cg, K)
+        sv = samp.reshape(N, groups, Cg, K, Ho, Wo)
+        out = jnp.einsum("gock,ngckhw->ngohw", wv, sv)
+        out = out.reshape(N, Co, Ho, Wo)
+        if b is not None:
+            out = out + b.reshape(1, -1, 1, 1)
+        return out
+
+    args = [_t(x), _t(offset), _t(weight)]
+    if mask is not None:
+        args.append(_t(mask))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply("deform_conv2d", fn, *args)
+
+
+def correlation(x1, x2, pad_size=0, kernel_size=1, max_displacement=1,
+                stride1=1, stride2=1, corr_type_multiply=1, name=None):
+    """parity: ops.yaml correlation (FlowNet cost volume): mean channel dot
+    product of x1 against x2 shifted over the displacement grid."""
+    md, s2 = max_displacement, stride2
+    disp = list(range(-md, md + 1, s2))
+
+    def fn(a, b):
+        N, C, H, W = a.shape
+        pads = ((0, 0), (0, 0), (pad_size, pad_size), (pad_size, pad_size))
+        bp = jnp.pad(b, pads)
+        outs = []
+        for dy in disp:
+            for dx in disp:
+                shifted = jax.lax.dynamic_slice(
+                    bp, (0, 0, pad_size + dy, pad_size + dx), a.shape)
+                outs.append(jnp.mean(a * shifted, axis=1))
+        return jnp.stack(outs, axis=1)   # [N, D*D, H, W]
+
+    return apply("correlation", fn, _t(x1), _t(x2))
+
+
+def psroi_pool(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
+               name=None):
+    """parity: ops.yaml psroi_pool (R-FCN position-sensitive RoI pooling):
+    input channels C = out_c * ph * pw; bin (i,j) average-pools its own
+    channel group inside the RoI."""
+    ph = pw = output_size if isinstance(output_size, int) else None
+    if ph is None:
+        ph, pw = output_size
+
+    def fn(v, bx):
+        N, C, H, W = v.shape
+        out_c = C // (ph * pw)
+        R = bx.shape[0]
+        # map each RoI to its image via boxes_num (reference contract)
+        if boxes_num is not None:
+            counts = np.asarray(_t(boxes_num)._value)
+            img_of = np.repeat(np.arange(len(counts)), counts)
+        elif N == 1:
+            img_of = np.zeros(R, np.int64)
+        else:
+            raise ValueError("psroi_pool: boxes_num required when the "
+                             "batch has more than one image")
+        results = []
+        for r in range(R):
+            n_img = int(img_of[r])
+            x0, y0, x1, y1 = [bx[r, i] * spatial_scale for i in range(4)]
+            rh = jnp.maximum(y1 - y0, 1e-3) / ph
+            rw = jnp.maximum(x1 - x0, 1e-3) / pw
+            bins = []
+            yy = jnp.arange(H, dtype=jnp.float32)[:, None]
+            xx = jnp.arange(W, dtype=jnp.float32)[None, :]
+            for i in range(ph):
+                for j in range(pw):
+                    in_bin = ((yy >= y0 + i * rh) & (yy < y0 + (i + 1) * rh)
+                              & (xx >= x0 + j * rw) & (xx < x0 + (j + 1) * rw))
+                    cnt = jnp.maximum(jnp.sum(in_bin), 1.0)
+                    grp = v[n_img,
+                            (i * pw + j) * out_c:(i * pw + j + 1) * out_c]
+                    bins.append(jnp.sum(grp * in_bin[None], axis=(1, 2))
+                                / cnt)
+            results.append(jnp.stack(bins, 1).reshape(out_c, ph, pw))
+        return jnp.stack(results)
+
+    return apply("psroi_pool", fn, _t(x), _t(boxes))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               name=None):
+    """parity: ops.yaml matrix_nms (SOLOv2 soft suppression): decay each
+    score by the worst overlap with any higher-scored box of its class —
+    fully vectorized, no sequential suppression loop (TPU-friendly)."""
+    def fn(bx, sc):
+        # bx [M, 4]; sc [cls, M]
+        n_cls, M = sc.shape
+        area = jnp.maximum(bx[:, 2] - bx[:, 0], 0) \
+            * jnp.maximum(bx[:, 3] - bx[:, 1], 0)
+        lt = jnp.maximum(bx[:, None, :2], bx[None, :, :2])
+        rb = jnp.minimum(bx[:, None, 2:], bx[None, :, 2:])
+        wh = jnp.maximum(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        iou = inter / jnp.maximum(area[:, None] + area[None, :] - inter,
+                                  1e-9)
+        outs = []
+        for c in range(n_cls):
+            if c == background_label:
+                continue
+            s = sc[c]
+            # pre-filter raw scores, cap at nms_top_k before decay
+            s = jnp.where(s > score_threshold, s, 0.0)
+            order = jnp.argsort(-s)[:nms_top_k]
+            s_sorted = s[order]
+            iou_s = iou[order][:, order]
+            upper = jnp.triu(iou_s, k=1)           # ious vs higher-scored
+            comp = jnp.max(upper, axis=0)          # per-box max overlap
+            if use_gaussian:
+                decay = jnp.exp(-(comp ** 2) / gaussian_sigma)
+            else:
+                decay = 1.0 - comp
+            dec = s_sorted * decay * (s_sorted > 0)
+            keep = dec > post_threshold
+            row = jnp.stack([jnp.full_like(dec, c), dec * keep], 1)
+            outs.append(jnp.concatenate([row, bx[order]], 1))
+        out = jnp.concatenate(outs, 0)  # [*, 6]: label, score, box
+        # keep_top_k across classes (zero-score rows sort last)
+        final = jnp.argsort(-out[:, 1])[:keep_top_k]
+        return out[final]
+
+    return apply("matrix_nms", fn, _t(bboxes), _t(scores))
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """parity: ops.yaml generate_proposals (RPN): decode anchor deltas,
+    clip to the image, filter tiny boxes, top-k + NMS. Composition of the
+    existing box decode and nms pieces (host-sequenced like the reference's
+    CPU kernel; per-image loop)."""
+    sc = np.asarray(_t(scores)._value)        # [N, A, H, W]
+    bd = np.asarray(_t(bbox_deltas)._value)   # [N, 4A, H, W]
+    im = np.asarray(_t(img_size)._value)      # [N, 2] (h, w)
+    an = np.asarray(_t(anchors)._value).reshape(-1, 4)
+    va = np.asarray(_t(variances)._value).reshape(-1, 4)
+
+    N = sc.shape[0]
+    all_rois, rois_num = [], []
+    off = 1.0 if pixel_offset else 0.0
+    for n in range(N):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)
+        d = bd[n].reshape(-1, 4, sc.shape[2], sc.shape[3]) \
+            .transpose(2, 3, 0, 1).reshape(-1, 4)
+        aw = an[:, 2] - an[:, 0] + off
+        ah = an[:, 3] - an[:, 1] + off
+        acx = an[:, 0] + aw / 2
+        acy = an[:, 1] + ah / 2
+        cx = va[:, 0] * d[:, 0] * aw + acx
+        cy = va[:, 1] * d[:, 1] * ah + acy
+        w = np.exp(np.minimum(va[:, 2] * d[:, 2], 10.0)) * aw
+        h = np.exp(np.minimum(va[:, 3] * d[:, 3], 10.0)) * ah
+        boxes = np.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - off, cy + h / 2 - off], 1)
+        H_im, W_im = float(im[n, 0]), float(im[n, 1])
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, W_im - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, H_im - off)
+        keep = ((boxes[:, 2] - boxes[:, 0] >= min_size)
+                & (boxes[:, 3] - boxes[:, 1] >= min_size))
+        boxes, s = boxes[keep], s[keep]
+        order = np.argsort(-s)[:pre_nms_top_n]
+        boxes, s = boxes[order], s[order]
+        from ..core.tensor import Tensor as _T
+        kept = nms(_T(jnp.asarray(boxes)), nms_thresh,
+                   scores=_T(jnp.asarray(s)), top_k=post_nms_top_n)
+        kept = np.asarray(kept._value)
+        all_rois.append(boxes[kept])
+        rois_num.append(len(kept))
+    rois = Tensor(jnp.asarray(np.concatenate(all_rois, 0)))
+    if return_rois_num:
+        return rois, Tensor(jnp.asarray(np.asarray(rois_num, np.int32)))
+    return rois
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=False, scale_x_y=1.0, name=None):
+    """parity: ops.yaml yolo_loss (YOLOv3 training loss, per feature level).
+    x: [N, A*(5+C), H, W] raw head; gt_box: [N, B, 4] normalized
+    (cx, cy, w, h); gt_label: [N, B] int; anchors: full anchor list
+    (pixels), anchor_mask selects this level's A anchors.
+
+    Per gt: the best wh-IoU anchor (over ALL anchors) is assigned; if it
+    belongs to this level, the responsible cell takes xy-BCE, wh-MSE,
+    obj-BCE(1) and cls-BCE; other cells take obj-BCE(0) unless their best
+    box IoU exceeds ignore_thresh. Returns [N] per-sample loss."""
+    mask = list(anchor_mask)
+    A = len(mask)
+    anc = np.asarray(anchors, np.float32).reshape(-1, 2)
+
+    def fn(v, gb, gl, *rest):
+        gs = rest[0] if gt_score is not None else None
+        N, _, H, W = v.shape
+        C = class_num
+        v = v.reshape(N, A, 5 + C, H, W)
+        tx, ty = v[:, :, 0], v[:, :, 1]
+        tw, th = v[:, :, 2], v[:, :, 3]
+        tobj = v[:, :, 4]
+        tcls = v[:, :, 5:]
+
+        in_w = W * downsample_ratio
+        in_h = H * downsample_ratio
+        # decoded boxes for the ignore-mask IoU test (normalized)
+        gx = (jax.nn.sigmoid(tx) + jnp.arange(W)[None, None, None, :]) / W
+        gy = (jax.nn.sigmoid(ty) + jnp.arange(H)[None, None, :, None]) / H
+        lw = anc[mask][:, 0][None, :, None, None]
+        lh = anc[mask][:, 1][None, :, None, None]
+        gw = jnp.exp(tw) * lw / in_w
+        gh = jnp.exp(th) * lh / in_h
+
+        B = gb.shape[1]
+        obj_target = jnp.zeros((N, A, H, W))
+        ignore = jnp.zeros((N, A, H, W), bool)
+        loss_xy = jnp.zeros((N,))
+        loss_wh = jnp.zeros((N,))
+        loss_cls = jnp.zeros((N,))
+
+        def bce(logit, target):
+            return jnp.maximum(logit, 0) - logit * target \
+                + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+        for b in range(B):
+            bx, by, bw, bh = gb[:, b, 0], gb[:, b, 1], gb[:, b, 2], \
+                gb[:, b, 3]
+            valid = (bw > 0) & (bh > 0)
+            score = gs[:, b] if gs is not None else jnp.ones_like(bx)
+            # best anchor by wh IoU over ALL anchors (pixel space)
+            pw, ph_ = bw * in_w, bh * in_h
+            inter = jnp.minimum(pw[:, None], anc[None, :, 0]) \
+                * jnp.minimum(ph_[:, None], anc[None, :, 1])
+            union = pw[:, None] * ph_[:, None] \
+                + anc[None, :, 0] * anc[None, :, 1] - inter
+            best = jnp.argmax(inter / jnp.maximum(union, 1e-9), axis=1)
+            # which of this level's slots (if any)
+            level_slot = jnp.full_like(best, -1)
+            for s_i, m in enumerate(mask):
+                level_slot = jnp.where(best == m, s_i, level_slot)
+            on_level = (level_slot >= 0) & valid
+            gi = jnp.clip((bx * W).astype(jnp.int32), 0, W - 1)
+            gj = jnp.clip((by * H).astype(jnp.int32), 0, H - 1)
+            sl = jnp.clip(level_slot, 0, A - 1)
+            nidx = jnp.arange(N)
+            wgt = (2.0 - bw * bh) * score  # small-box upweight (paddle)
+
+            sel = lambda t: t[nidx, sl, :, gj, gi] if t.ndim == 5 \
+                else t[nidx, sl, gj, gi]
+            txy_x = bx * W - gi
+            txy_y = by * H - gj
+            loss_xy = loss_xy + jnp.where(
+                on_level, wgt * (bce(sel(tx), txy_x)
+                                 + bce(sel(ty), txy_y)), 0.0)
+            tw_t = jnp.log(jnp.maximum(
+                bw * in_w / jnp.maximum(anc[best][:, 0], 1e-9), 1e-9))
+            th_t = jnp.log(jnp.maximum(
+                bh * in_h / jnp.maximum(anc[best][:, 1], 1e-9), 1e-9))
+            loss_wh = loss_wh + jnp.where(
+                on_level, wgt * 0.5 * ((sel(tw) - tw_t) ** 2
+                                       + (sel(th) - th_t) ** 2), 0.0)
+            smooth = 1.0 / jnp.maximum(C, 1) if use_label_smooth else 0.0
+            onehot = jax.nn.one_hot(gl[:, b], C) * (1 - smooth) \
+                + smooth / jnp.maximum(C, 1)
+            cls_logit = tcls[nidx, sl, :, gj, gi]
+            loss_cls = loss_cls + jnp.where(
+                on_level, score * jnp.sum(bce(cls_logit, onehot), -1), 0.0)
+            obj_target = obj_target.at[nidx, sl, gj, gi].set(
+                jnp.where(on_level, score, obj_target[nidx, sl, gj, gi]))
+            # ignore mask: predicted boxes overlapping this gt strongly
+            ix0 = jnp.maximum(gx - gw / 2, (bx - bw / 2)[:, None, None,
+                                                         None])
+            iy0 = jnp.maximum(gy - gh / 2, (by - bh / 2)[:, None, None,
+                                                         None])
+            ix1 = jnp.minimum(gx + gw / 2, (bx + bw / 2)[:, None, None,
+                                                         None])
+            iy1 = jnp.minimum(gy + gh / 2, (by + bh / 2)[:, None, None,
+                                                         None])
+            ia = jnp.maximum(ix1 - ix0, 0) * jnp.maximum(iy1 - iy0, 0)
+            ua = gw * gh + (bw * bh)[:, None, None, None] - ia
+            iou = ia / jnp.maximum(ua, 1e-9)
+            ignore = ignore | ((iou > ignore_thresh)
+                               & valid[:, None, None, None])
+
+        obj_bce = bce(tobj, obj_target)
+        keep = (obj_target > 0) | ~ignore
+        loss_obj = jnp.sum(obj_bce * keep, axis=(1, 2, 3))
+        return loss_xy + loss_wh + loss_cls + loss_obj
+
+    args = [_t(x), _t(gt_box), _t(gt_label)]
+    if gt_score is not None:
+        args.append(_t(gt_score))
+    return apply("yolo_loss", fn, *args)
