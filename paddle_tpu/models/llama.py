@@ -63,6 +63,10 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     # gradient checkpointing of the layer body (reference: fleet/recompute)
     remat: bool = True
+    # remat policy: "full" recomputes everything; "dots" saves matmul
+    # outputs (jax checkpoint_dots) — fewer recomputed MXU ops when HBM
+    # allows (reference analogue: recompute_granularity="core_attn")
+    remat_policy: str = "full"
     use_flash: bool = True
     # exact blockwise ring attention over the 'sp' mesh axis (long-context;
     # capability the reference's SEP axis delegates to model code — §5.7)
@@ -319,6 +323,13 @@ def _layer_body(x, layer_params, cos, sin, config: LlamaConfig):
     return _constrain(x)
 
 
+def _remat(body, config: LlamaConfig):
+    if config.remat_policy == "dots":
+        policy = jax.checkpoint_policies.checkpoint_dots
+        return jax.checkpoint(body, policy=policy)
+    return jax.checkpoint(body)
+
+
 _ACT_MESH: Optional[Mesh] = None
 
 
@@ -359,7 +370,7 @@ def forward(params, tokens, config: LlamaConfig):
 
     body = functools.partial(_layer_body, cos=cos, sin=sin, config=c)
     if c.remat:
-        body = jax.checkpoint(body)  # trade FLOPs for HBM (reference: recompute)
+        body = _remat(body, c)  # trade FLOPs for HBM (reference: recompute)
 
     def scan_fn(carry, layer_params):
         return body(carry, layer_params), None
@@ -420,7 +431,7 @@ def _loss_and_grads_1f1b(params, tokens, config: LlamaConfig, mesh: Mesh):
             cos, sin = _rope_tables(x.shape[1], c.head_dim, c.rope_theta)
             body = functools.partial(_layer_body, cos=cos, sin=sin, config=c)
             if c.remat:
-                body = jax.checkpoint(body)
+                body = _remat(body, c)
             x, _ = jax.lax.scan(lambda h, p: (body(h, p), None), x, lp)
         return x
 
@@ -659,6 +670,7 @@ def generate(params, prompt_tokens, config: LlamaConfig, max_new_tokens: int,
     decode = jax.jit(functools.partial(forward_with_cache, config=config))
     out = [prompt_tokens]
     key = key if key is not None else jax.random.PRNGKey(0)
+    finished = jnp.zeros((B,), bool)
     for i in range(max_new_tokens):
         if temperature > 0:
             key, sub = jax.random.split(key)
@@ -679,8 +691,14 @@ def generate(params, prompt_tokens, config: LlamaConfig, max_new_tokens: int,
             nxt = jax.random.categorical(sub, lg, axis=-1)
         else:
             nxt = jnp.argmax(logits, axis=-1)
+        if eos_token_id is not None:
+            # finished rows keep emitting eos (the reference's EOS stop)
+            nxt = jnp.where(finished, eos_token_id, nxt)
+            finished = finished | (nxt == eos_token_id)
         nxt = nxt[:, None].astype(prompt_tokens.dtype)
         out.append(nxt)
+        if eos_token_id is not None and bool(jnp.all(finished)):
+            break
         if i + 1 < max_new_tokens:
             logits, cache = decode(params, nxt, cache)
     return jnp.concatenate(out, axis=1)

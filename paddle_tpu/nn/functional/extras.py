@@ -528,9 +528,10 @@ def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
     p = float(norm_type)
     kh, kw = _pair(kernel_size)
 
-    # |x|^p: fractional p on negatives would NaN; exclusive=False makes
+    # signed x^p (the reference/torch contract — odd p cancels sign;
+    # fractional p on negatives NaNs there too); exclusive=False makes
     # avg*kh*kw an exact window sum (padded zeros contribute zero)
-    powed = apply("lp_pow", lambda v: jnp.abs(v) ** p, _t(x))
+    powed = apply("lp_pow", lambda v: v ** p, _t(x))
     pooled = avg_pool2d(powed, kernel_size, stride, padding,
                         ceil_mode=ceil_mode, exclusive=False,
                         data_format=data_format)
@@ -548,10 +549,19 @@ def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
     n = 3
     strides = _norm_tuple(stride, n)
     dil = _norm_tuple(dilation, n)
-    opad = _norm_tuple(output_padding, n)
+    opad = list(_norm_tuple(output_padding, n))
     padding_n = _conv_padding(padding, n)
 
     def fn(v, w, *b):
+        sp_in = v.shape[2:5] if data_format == "NCDHW" else v.shape[1:4]
+        if output_size is not None and not isinstance(padding_n, str):
+            # derive extra output padding so the result hits output_size
+            want = [int(s) for s in output_size][-n:]
+            for i in range(n):
+                k = (w.shape[2 + i] - 1) * dil[i] + 1
+                default = ((sp_in[i] - 1) * strides[i] - padding_n[i][0]
+                           - padding_n[i][1] + k)
+                opad[i] = want[i] - default
         if isinstance(padding_n, str):
             pads = padding_n
         else:
@@ -575,7 +585,9 @@ def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
             dimension_numbers=(data_format, "OIDHW", data_format),
             feature_group_count=groups)
         if b:
-            out = out + b[0].reshape((1, -1) + (1,) * n)
+            bshape = ((1, -1) + (1,) * n if data_format == "NCDHW"
+                      else (1,) * (n + 1) + (-1,))
+            out = out + b[0].reshape(bshape)
         return out
 
     args = [_t(x), _t(weight)] + ([_t(bias)] if bias is not None else [])

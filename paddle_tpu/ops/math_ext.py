@@ -230,6 +230,8 @@ def unstack(x, axis=0, num=None, name=None):
     t = _t(x)
     ax = axis % t.ndim
     n = t.shape[ax]
+    if num is not None and num != n:
+        raise ValueError(f"unstack: num={num} != axis length {n}")
     outs = apply("unstack",
                  lambda v: tuple(jnp.squeeze(s, ax) for s in
                                  jnp.split(v, n, axis=ax)), t)
@@ -237,12 +239,15 @@ def unstack(x, axis=0, num=None, name=None):
 
 
 def fill_diagonal(x, value, offset=0, wrap=False, name=None):
-    """parity: manipulation.py fill_diagonal_ (functional form)."""
+    """parity: manipulation.py fill_diagonal_ (functional form). With
+    ``wrap`` a tall matrix restarts the diagonal after each m+1-row block
+    (numpy fill_diagonal(wrap=True) semantics)."""
     def fn(v):
         n, m = v.shape[-2], v.shape[-1]
         i = jnp.arange(n)[:, None]
         j = jnp.arange(m)[None, :]
-        mask = (j - i) == offset
+        row = jnp.mod(i, m + 1) if (wrap and n > m) else i
+        mask = (j - row) == offset
         return jnp.where(mask, jnp.asarray(value, v.dtype), v)
 
     return apply("fill_diagonal", fn, _t(x))

@@ -411,10 +411,15 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
 
 def correlation(x1, x2, pad_size=0, kernel_size=1, max_displacement=1,
                 stride1=1, stride2=1, corr_type_multiply=1, name=None):
-    """parity: ops.yaml correlation (FlowNet cost volume): mean channel dot
-    product of x1 against x2 shifted over the displacement grid."""
+    """parity: ops.yaml correlation (FlowNet cost volume): per-displacement
+    channel-mean dot product averaged over a kernel_size patch, output
+    positions subsampled by stride1."""
+    if corr_type_multiply != 1:
+        raise NotImplementedError(
+            "correlation: only multiply mode (the reference kernel's mode)")
     md, s2 = max_displacement, stride2
     disp = list(range(-md, md + 1, s2))
+    k = int(kernel_size)
 
     def fn(a, b):
         N, C, H, W = a.shape
@@ -425,8 +430,16 @@ def correlation(x1, x2, pad_size=0, kernel_size=1, max_displacement=1,
             for dx in disp:
                 shifted = jax.lax.dynamic_slice(
                     bp, (0, 0, pad_size + dy, pad_size + dx), a.shape)
-                outs.append(jnp.mean(a * shifted, axis=1))
-        return jnp.stack(outs, axis=1)   # [N, D*D, H, W]
+                prod = jnp.mean(a * shifted, axis=1, keepdims=True)
+                if k > 1:  # patch average around each position
+                    kp = (k - 1) // 2
+                    prod = jax.lax.reduce_window(
+                        prod, 0.0, jax.lax.add, (1, 1, k, k),
+                        (1, 1, 1, 1),
+                        ((0, 0), (0, 0), (kp, k - 1 - kp),
+                         (kp, k - 1 - kp))) / (k * k)
+                outs.append(prod[:, 0, ::stride1, ::stride1])
+        return jnp.stack(outs, axis=1)   # [N, D*D, Ho, Wo]
 
     return apply("correlation", fn, _t(x1), _t(x2))
 
@@ -515,6 +528,8 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
             keep = dec > post_threshold
             row = jnp.stack([jnp.full_like(dec, c), dec * keep], 1)
             outs.append(jnp.concatenate([row, bx[order]], 1))
+        if not outs:  # every class was background — empty detection set
+            return jnp.zeros((0, 6), bx.dtype)
         out = jnp.concatenate(outs, 0)  # [*, 6]: label, score, box
         # keep_top_k across classes (zero-score rows sort last)
         final = jnp.argsort(-out[:, 1])[:keep_top_k]

@@ -96,3 +96,20 @@ def test_fit_spec_warns_on_dropped_axis():
         warnings.simplefilter("always")
         _fit_spec(P("dp", "tp"), (128, 64), mesh)  # warned once only
         assert not w2
+
+
+def test_remat_policy_dots_matches_full():
+    import dataclasses
+
+    cfg = llama.tiny_llama(vocab=64, hidden=32, layers=2, heads=2,
+                           kv_heads=2, seq=16, ffn=64)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 17), 0,
+                                cfg.vocab_size)
+    losses = {}
+    for pol in ("full", "dots"):
+        c = dataclasses.replace(cfg, remat=True, remat_policy=pol)
+        st = llama.init_train_state(c, jax.random.PRNGKey(0))
+        st, loss = jax.jit(lambda s, t: llama.train_step(s, t, c))(st,
+                                                                   tokens)
+        losses[pol] = float(loss)
+    assert abs(losses["full"] - losses["dots"]) < 1e-5, losses

@@ -238,10 +238,15 @@ def test_review_fixes_detection_ops():
     wh = _np(b1)[0, 0, :, 2] - _np(b1)[0, 0, :, 0]
     np.testing.assert_allclose(wh[0] * 32, 16.0, rtol=1e-5)  # min first
 
-    # lp_pool2d survives negative inputs with fractional p
+    # lp_pool2d matches torch bit-for-NaN on fractional p with negatives
+    # (signed x^p is the reference contract)
     xn = rng.normal(size=(1, 2, 4, 4)).astype(np.float32)
-    lp = F.lp_pool2d(paddle.to_tensor(xn), 1.5, 2, stride=2)
-    assert np.isfinite(_np(lp)).all()
+    lp = _np(F.lp_pool2d(paddle.to_tensor(xn), 1.5, 2, stride=2))
+    ref = torch.nn.functional.lp_pool2d(torch.tensor(xn), 1.5, 2,
+                                        stride=2).numpy()
+    np.testing.assert_array_equal(np.isnan(lp), np.isnan(ref))
+    m = ~np.isnan(ref)
+    np.testing.assert_allclose(lp[m], ref[m], rtol=1e-4)
 
     # batched lu_unpack round-trips
     Ab = rng.normal(size=(3, 4, 4)).astype(np.float32)
@@ -258,3 +263,65 @@ def test_review_fixes_detection_ops():
     _, c2 = F.class_center_sample(paddle.to_tensor(
         np.array([3, 7], np.int64)), 50, 10)
     np.testing.assert_array_equal(_np(c1), _np(c2))
+
+
+def test_review_fixes_round2():
+    """eos stop in generate, correlation kernel/stride, single-class
+    matrix_nms, conv3d_transpose output_size + NDHWC bias, signed lp_pool,
+    fill_diagonal wrap."""
+    import jax
+
+    from paddle_tpu.models import llama
+
+    # eos stops generation early and pads with eos
+    cfg = llama.tiny_llama(vocab=16, hidden=16, layers=1, heads=2,
+                           kv_heads=2, seq=8, ffn=16)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    out = llama.generate(params, np.array([[1, 2]], np.int32), cfg,
+                         max_new_tokens=6, temperature=0.0,
+                         eos_token_id=int(np.asarray(llama.generate(
+                             params, np.array([[1, 2]], np.int32), cfg,
+                             max_new_tokens=1))[0, -1]))
+    arr = np.asarray(out)[0, 2:]
+    assert (arr == arr[0]).all()  # greedy first token == eos → all eos
+
+    # correlation: kernel_size patch-avg + stride1 subsampling shape
+    a = rng.normal(size=(1, 3, 8, 8)).astype(np.float32)
+    c = vops.correlation(paddle.to_tensor(a), paddle.to_tensor(a),
+                         pad_size=1, kernel_size=3, max_displacement=1,
+                         stride1=2)
+    assert _np(c).shape == (1, 9, 4, 4)
+
+    # single-class matrix_nms returns empty, not crash
+    mn = vops.matrix_nms(
+        paddle.to_tensor(np.zeros((2, 4), np.float32)),
+        paddle.to_tensor(np.ones((1, 2), np.float32)),
+        score_threshold=0.1)
+    assert _np(mn).shape == (0, 6)
+
+    # conv3d_transpose output_size honored + NDHWC bias broadcast
+    w = rng.normal(size=(4, 3, 3, 3, 3)).astype(np.float32) * 0.1
+    x3 = rng.normal(size=(1, 4, 5, 5, 5)).astype(np.float32)
+    ct = F.conv3d_transpose(paddle.to_tensor(x3), paddle.to_tensor(w),
+                            stride=2, padding=1,
+                            output_size=[10, 10, 10])
+    assert _np(ct).shape == (1, 3, 10, 10, 10)
+    xh = np.moveaxis(x3, 1, -1)
+    b = rng.normal(size=(3,)).astype(np.float32)
+    cth = F.conv3d_transpose(paddle.to_tensor(xh), paddle.to_tensor(w),
+                             bias=paddle.to_tensor(b), stride=2,
+                             padding=1, data_format="NDHWC")
+    assert _np(cth).shape == (1, 9, 9, 9, 3)
+
+    # lp_pool2d p=1 with negatives matches torch (signed sum)
+    xn = rng.normal(size=(1, 2, 4, 4)).astype(np.float32)
+    lp = F.lp_pool2d(paddle.to_tensor(xn), 1.0, 2, stride=2)
+    ref = torch.nn.functional.lp_pool2d(torch.tensor(xn), 1.0, 2, stride=2)
+    np.testing.assert_allclose(_np(lp), ref.numpy(), rtol=1e-4, atol=1e-5)
+
+    # fill_diagonal wrap on a tall matrix matches numpy
+    tall = np.zeros((6, 3), np.float32)
+    fd = paddle.fill_diagonal(paddle.to_tensor(tall), 2.0, wrap=True)
+    expect = tall.copy()
+    np.fill_diagonal(expect, 2.0, wrap=True)
+    np.testing.assert_array_equal(_np(fd), expect)
