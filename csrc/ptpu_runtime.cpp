@@ -105,6 +105,10 @@ struct StoreServer {
           kv[key] = val;
         }
         cv.notify_all();
+        // Ack after the store is applied: without it, set() returning on the
+        // sender does not order before a get() on another connection.
+        uint8_t ok = 1;
+        if (!send_all(fd, &ok, 1)) break;
       } else if (cmd == kGet) {
         std::string val;
         uint8_t found = 0;
@@ -276,10 +280,11 @@ int ptpu_store_set(void* h, const char* key, const char* val, int len) {
   auto* c = static_cast<StoreClient*>(h);
   std::lock_guard<std::mutex> g(c->mu);
   uint8_t cmd = kSet;
-  return send_all(c->fd, &cmd, 1) && send_bytes(c->fd, key) &&
-                 send_bytes(c->fd, std::string(val, val + len))
-             ? 0
-             : -1;
+  if (!send_all(c->fd, &cmd, 1) || !send_bytes(c->fd, key) ||
+      !send_bytes(c->fd, std::string(val, val + len)))
+    return -1;
+  uint8_t ok = 0;
+  return recv_all(c->fd, &ok, 1) && ok == 1 ? 0 : -1;
 }
 
 // returns length, -1 if missing, -2 on error; caller buffer must be big enough

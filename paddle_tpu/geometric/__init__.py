@@ -18,7 +18,7 @@ from ..ops.dispatch import apply
 __all__ = [
     "segment_sum", "segment_mean", "segment_max", "segment_min",
     "send_u_recv", "send_ue_recv", "send_uv", "reindex_graph",
-    "sample_neighbors",
+    "reindex_heter_graph", "sample_neighbors", "weighted_sample_neighbors",
 ]
 
 
@@ -118,45 +118,114 @@ def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
     return apply("send_uv", fn, _t(x), _t(y), _t(src_index), _t(dst_index))
 
 
+def _reindex_impl(xv, nb_cat):
+    """Local-id mapping: x first (ids 0..len(x)-1), then neighbor nodes in
+    first-seen order — the reference's graph_reindex contract
+    (geometric/reindex.py:34 example ordering)."""
+    cat = np.concatenate([xv, nb_cat])
+    uniq, first_idx, inv = np.unique(cat, return_index=True,
+                                     return_inverse=True)
+    order = np.argsort(first_idx, kind="stable")
+    rank = np.empty(len(order), np.int64)
+    rank[order] = np.arange(len(order))
+    local = rank[inv]
+    out_nodes = cat[np.sort(first_idx)]
+    return local[len(xv):], out_nodes
+
+
 def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
                   name=None):
-    """Compact global node ids to local ids (host-side — graph prep is not a
-    jit path)."""
-    xv = np.asarray(_t(x)._value)
-    nb = np.asarray(_t(neighbors)._value)
-    uniq, inv = np.unique(np.concatenate([xv, nb]), return_inverse=True)
-    order = {int(v): i for i, v in enumerate(xv)}
-    remap = np.empty(len(uniq), np.int64)
-    nxt = len(xv)
-    out_nodes = list(xv)
-    for u in uniq:
-        if int(u) in order:
-            remap[np.searchsorted(uniq, u)] = order[int(u)]
-        else:
-            remap[np.searchsorted(uniq, u)] = nxt
-            out_nodes.append(u)
-            nxt += 1
-    reindexed = remap[inv[len(xv):]]
-    return (Tensor(jnp.asarray(reindexed)),
-            Tensor(jnp.asarray(np.asarray(out_nodes))),
-            Tensor(_t(count)._value))
+    """parity: geometric/reindex.py:34 reindex_graph → (reindex_src,
+    reindex_dst, out_nodes). Host-side — graph prep is not a jit path."""
+    xv = np.asarray(_t(x)._value).reshape(-1)
+    nb = np.asarray(_t(neighbors)._value).reshape(-1)
+    cnt = np.asarray(_t(count)._value).reshape(-1)
+    src, out_nodes = _reindex_impl(xv, nb)
+    dst = np.repeat(np.arange(len(xv), dtype=np.int64), cnt)
+    return (Tensor(jnp.asarray(src)), Tensor(jnp.asarray(dst)),
+            Tensor(jnp.asarray(out_nodes)))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """parity: geometric/reindex.py:153 reindex_heter_graph — neighbors /
+    count are per-edge-type lists; edges are concatenated in graph order and
+    all nodes share one local-id space."""
+    xv = np.asarray(_t(x)._value).reshape(-1)
+    nbs = [np.asarray(_t(nb)._value).reshape(-1) for nb in neighbors]
+    cnts = [np.asarray(_t(c)._value).reshape(-1) for c in count]
+    nb_cat = (np.concatenate(nbs) if nbs
+              else np.zeros((0,), xv.dtype))
+    src, out_nodes = _reindex_impl(xv, nb_cat)
+    dst = np.concatenate([
+        np.repeat(np.arange(len(xv), dtype=np.int64), c) for c in cnts
+    ]) if cnts else np.zeros((0,), np.int64)
+    return (Tensor(jnp.asarray(src)), Tensor(jnp.asarray(dst)),
+            Tensor(jnp.asarray(out_nodes)))
+
+
+def _sample_csc(row, colptr, input_nodes, sample_size, eids, return_eids,
+                weight=None):
+    # seed from the framework RNG stream so paddle.seed() reproduces
+    # sampling like every other random op
+    from ..framework.random import next_key
+
+    rng = np.random.default_rng(
+        np.asarray(jax.random.key_data(next_key())).view(np.uint32))
+    rowv = np.asarray(_t(row)._value).reshape(-1)
+    cp = np.asarray(_t(colptr)._value).reshape(-1)
+    nodes = np.asarray(_t(input_nodes)._value).reshape(-1)
+    ev = (np.asarray(_t(eids)._value).reshape(-1) if eids is not None
+          else None)
+    wv = (np.asarray(_t(weight)._value).reshape(-1) if weight is not None
+          else None)
+    out, out_eids, counts = [], [], []
+    for nmid in nodes:
+        lo, hi = int(cp[nmid]), int(cp[nmid + 1])
+        pick = np.arange(lo, hi)
+        if 0 <= sample_size < hi - lo:
+            if wv is not None:
+                # Efraimidis–Spirakis: smallest Exp(1)/w keys = weighted
+                # sample without replacement; zero-weight edges get +inf
+                # keys so they fill remaining slots (random tiebreak)
+                # rather than crashing when positives < sample_size.
+                w = wv[lo:hi].astype(np.float64)
+                keys = np.where(
+                    w > 0, rng.exponential(size=hi - lo)
+                    / np.where(w > 0, w, 1.0), np.inf)
+                order = np.lexsort((rng.random(hi - lo), keys))
+                pick = pick[order[:sample_size]]
+            else:
+                pick = rng.choice(pick, size=sample_size, replace=False)
+        out.append(rowv[pick])
+        if ev is not None:
+            out_eids.append(ev[pick])
+        counts.append(len(pick))
+    cat = np.concatenate(out) if out else np.zeros((0,), rowv.dtype)
+    res = [Tensor(jnp.asarray(cat)),
+           Tensor(jnp.asarray(np.asarray(counts, np.int32)))]
+    if return_eids:
+        if ev is None:
+            raise ValueError("return_eids=True requires eids")
+        ecat = (np.concatenate(out_eids) if out_eids
+                else np.zeros((0,), rowv.dtype))
+        res.append(Tensor(jnp.asarray(ecat)))
+    return tuple(res)
 
 
 def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
                      eids=None, return_eids=False, perm_buffer=None,
                      name=None):
-    """Uniform neighbor sampling on a CSC graph (host-side)."""
-    rng = np.random.default_rng()
-    rowv = np.asarray(_t(row)._value)
-    cp = np.asarray(_t(colptr)._value)
-    nodes = np.asarray(_t(input_nodes)._value)
-    out, counts = [], []
-    for nmid in nodes:
-        lo, hi = int(cp[nmid]), int(cp[nmid + 1])
-        nbrs = rowv[lo:hi]
-        if 0 <= sample_size < len(nbrs):
-            nbrs = rng.choice(nbrs, size=sample_size, replace=False)
-        out.append(nbrs)
-        counts.append(len(nbrs))
-    cat = np.concatenate(out) if out else np.zeros((0,), rowv.dtype)
-    return Tensor(jnp.asarray(cat)), Tensor(jnp.asarray(np.asarray(counts)))
+    """parity: geometric/sampling/neighbors.py sample_neighbors — uniform
+    neighbor sampling on a CSC graph (host-side)."""
+    return _sample_csc(row, colptr, input_nodes, sample_size, eids,
+                       return_eids)
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """parity: geometric/sampling/neighbors.py:256 — selection probability
+    proportional to edge weight, sampled without replacement."""
+    return _sample_csc(row, colptr, input_nodes, sample_size, eids,
+                       return_eids, weight=edge_weight)

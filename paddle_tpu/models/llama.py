@@ -327,6 +327,10 @@ def _remat(body, config: LlamaConfig):
     if config.remat_policy == "dots":
         policy = jax.checkpoint_policies.checkpoint_dots
         return jax.checkpoint(body, policy=policy)
+    if config.remat_policy != "full":
+        raise ValueError(
+            f"remat_policy={config.remat_policy!r}: expected 'full' or "
+            "'dots'")
     return jax.checkpoint(body)
 
 
@@ -659,7 +663,9 @@ def generate(params, prompt_tokens, config: LlamaConfig, max_new_tokens: int,
     """Greedy (temperature=0) or sampled generation with a jitted decode
     step; ``top_k``/``top_p`` restrict the sampling pool (nucleus — the
     reference's top_p_sampling op). prompt_tokens: [B, S_prompt] →
-    [B, S_prompt + max_new_tokens]."""
+    [B, S_prompt + n] with n <= max_new_tokens: when ``eos_token_id`` is set
+    and every row has finished, generation stops early (finished rows pad
+    with eos up to the last emitted step)."""
     B, S0 = prompt_tokens.shape
     max_len = S0 + max_new_tokens
     cache = init_kv_cache(config, B, max_len)

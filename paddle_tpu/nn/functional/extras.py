@@ -522,7 +522,9 @@ def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
 
 def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
               ceil_mode=False, data_format="NCHW", name=None):
-    """parity: ops.yaml lp_pool2d — (avg of |x|^p * count)^(1/p)."""
+    """parity: ops.yaml lp_pool2d — (window-sum of x^p)^(1/p), signed x^p
+    as in the reference/torch (odd p cancels sign; fractional p NaNs on
+    negative inputs there too)."""
     from . import avg_pool2d
 
     p = float(norm_type)
@@ -552,16 +554,17 @@ def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
     opad = list(_norm_tuple(output_padding, n))
     padding_n = _conv_padding(padding, n)
 
+    if output_size is not None and isinstance(padding_n, str):
+        raise ValueError(
+            "conv3d_transpose: output_size cannot be combined with "
+            "'SAME'/'VALID' padding")
+
     def fn(v, w, *b):
         sp_in = v.shape[2:5] if data_format == "NCDHW" else v.shape[1:4]
-        if output_size is not None and not isinstance(padding_n, str):
-            # derive extra output padding so the result hits output_size
-            want = [int(s) for s in output_size][-n:]
-            for i in range(n):
-                k = (w.shape[2 + i] - 1) * dil[i] + 1
-                default = ((sp_in[i] - 1) * strides[i] - padding_n[i][0]
-                           - padding_n[i][1] + k)
-                opad[i] = want[i] - default
+        if output_size is not None:
+            from . import _transpose_out_padding
+            _transpose_out_padding("conv3d_transpose", output_size, n, sp_in,
+                                   strides, dil, padding_n, w, opad)
         if isinstance(padding_n, str):
             pads = padding_n
         else:

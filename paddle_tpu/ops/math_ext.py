@@ -239,15 +239,18 @@ def unstack(x, axis=0, num=None, name=None):
 
 
 def fill_diagonal(x, value, offset=0, wrap=False, name=None):
-    """parity: manipulation.py fill_diagonal_ (functional form). With
-    ``wrap`` a tall matrix restarts the diagonal after each m+1-row block
-    (numpy fill_diagonal(wrap=True) semantics)."""
+    """parity: manipulation.py fill_diagonal_ (functional form), matching
+    the reference kernel (cpu/fill_diagonal_kernel.cc:45-54): flat stepping
+    by m+1 with offsets that never cross rows. With ``wrap`` a tall matrix
+    restarts the diagonal after each m+1-row block; rows whose diagonal base
+    falls off the matrix (base == m) and, without wrap, rows >= m are never
+    filled."""
     def fn(v):
         n, m = v.shape[-2], v.shape[-1]
         i = jnp.arange(n)[:, None]
         j = jnp.arange(m)[None, :]
         row = jnp.mod(i, m + 1) if (wrap and n > m) else i
-        mask = (j - row) == offset
+        mask = ((j - row) == offset) & (row < m)
         return jnp.where(mask, jnp.asarray(value, v.dtype), v)
 
     return apply("fill_diagonal", fn, _t(x))

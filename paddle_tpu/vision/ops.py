@@ -411,34 +411,47 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
 
 def correlation(x1, x2, pad_size=0, kernel_size=1, max_displacement=1,
                 stride1=1, stride2=1, corr_type_multiply=1, name=None):
-    """parity: ops.yaml correlation (FlowNet cost volume): per-displacement
-    channel-mean dot product averaged over a kernel_size patch, output
-    positions subsampled by stride1."""
+    """parity: ops.yaml correlation (FlowNet cost volume). Geometry follows
+    funcs/correlation_funcs.h CorrelationOutputSize + the forward kernel
+    (gpu/correlation_kernel.cu): both inputs zero-padded by pad_size; output
+    position (oy, ox) reads padded coordinate h1 = oy*stride1 +
+    max_displacement; displacement grid radius max_displacement//stride2;
+    value is the product mean over the kernel_size patch and channels."""
     if corr_type_multiply != 1:
         raise NotImplementedError(
             "correlation: only multiply mode (the reference kernel's mode)")
-    md, s2 = max_displacement, stride2
-    disp = list(range(-md, md + 1, s2))
-    k = int(kernel_size)
+    md, s2, k = max_displacement, stride2, int(kernel_size)
+    dr = md // s2
+    disp = [i * s2 for i in range(-dr, dr + 1)]
+    kr = (k - 1) // 2
+    border = kr + md
 
     def fn(a, b):
         N, C, H, W = a.shape
-        pads = ((0, 0), (0, 0), (pad_size, pad_size), (pad_size, pad_size))
-        bp = jnp.pad(b, pads)
+        pH, pW = H + 2 * pad_size, W + 2 * pad_size
+        out_h = max(0, -(-(pH - 2 * border) // stride1))
+        out_w = max(0, -(-(pW - 2 * border) // stride1))
+        ap = jnp.pad(a, ((0, 0), (0, 0), (pad_size, pad_size),
+                         (pad_size, pad_size)))
+        # extra md margin so every displacement shift stays in-bounds;
+        # out-of-range reads are zeros, matching the zero-filled rinput2
+        bp = jnp.pad(b, ((0, 0), (0, 0), (pad_size + md, pad_size + md),
+                         (pad_size + md, pad_size + md)))
         outs = []
         for dy in disp:
             for dx in disp:
                 shifted = jax.lax.dynamic_slice(
-                    bp, (0, 0, pad_size + dy, pad_size + dx), a.shape)
-                prod = jnp.mean(a * shifted, axis=1, keepdims=True)
+                    bp, (0, 0, md + dy, md + dx), ap.shape)
+                prod = jnp.mean(ap * shifted, axis=1, keepdims=True)
                 if k > 1:  # patch average around each position
-                    kp = (k - 1) // 2
                     prod = jax.lax.reduce_window(
                         prod, 0.0, jax.lax.add, (1, 1, k, k),
                         (1, 1, 1, 1),
-                        ((0, 0), (0, 0), (kp, k - 1 - kp),
-                         (kp, k - 1 - kp))) / (k * k)
-                outs.append(prod[:, 0, ::stride1, ::stride1])
+                        ((0, 0), (0, 0), (kr, k - 1 - kr),
+                         (kr, k - 1 - kr))) / (k * k)
+                outs.append(prod[:, 0,
+                                 md:md + out_h * stride1:stride1,
+                                 md:md + out_w * stride1:stride1])
         return jnp.stack(outs, axis=1)   # [N, D*D, Ho, Wo]
 
     return apply("correlation", fn, _t(x1), _t(x2))

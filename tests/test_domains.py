@@ -45,6 +45,54 @@ def test_geometric_segment_and_message_passing():
     np.testing.assert_allclose(out.numpy(), [[0.0], [4.0], [2.0]])
 
 
+def test_geometric_reindex_and_sampling():
+    """Numbers from the reference docstring examples
+    (geometric/reindex.py:34,153)."""
+    x = paddle.to_tensor(np.array([0, 1, 2], np.int64))
+    nb = paddle.to_tensor(np.array([8, 9, 0, 4, 7, 6, 7], np.int64))
+    cnt = paddle.to_tensor(np.array([2, 3, 2], np.int32))
+    src, dst, nodes = paddle.geometric.reindex_graph(x, nb, cnt)
+    np.testing.assert_array_equal(src.numpy(), [3, 4, 0, 5, 6, 7, 6])
+    np.testing.assert_array_equal(dst.numpy(), [0, 0, 1, 1, 1, 2, 2])
+    np.testing.assert_array_equal(nodes.numpy(), [0, 1, 2, 8, 9, 4, 7, 6])
+
+    nb_b = paddle.to_tensor(np.array([0, 2, 3, 5, 1], np.int64))
+    cnt_b = paddle.to_tensor(np.array([1, 3, 1], np.int32))
+    src, dst, nodes = paddle.geometric.reindex_heter_graph(
+        x, [nb, nb_b], [cnt, cnt_b])
+    np.testing.assert_array_equal(
+        src.numpy(), [3, 4, 0, 5, 6, 7, 6, 0, 2, 8, 9, 1])
+    np.testing.assert_array_equal(
+        dst.numpy(), [0, 0, 1, 1, 1, 2, 2, 0, 1, 1, 1, 2])
+    np.testing.assert_array_equal(
+        nodes.numpy(), [0, 1, 2, 8, 9, 4, 7, 6, 3, 5])
+
+    # CSC graph: node 0 has neighbors {1,2,3}, node 1 has {0}, node 2 has {}
+    row = paddle.to_tensor(np.array([1, 2, 3, 0], np.int64))
+    colptr = paddle.to_tensor(np.array([0, 3, 4, 4, 4], np.int64))
+    eids = paddle.to_tensor(np.array([10, 11, 12, 13], np.int64))
+    nbrs, cnts, oeids = paddle.geometric.sample_neighbors(
+        row, colptr, paddle.to_tensor(np.array([0, 1, 2], np.int64)),
+        sample_size=2, eids=eids, return_eids=True)
+    assert list(cnts.numpy()) == [2, 1, 0]
+    got = nbrs.numpy()
+    assert set(got[:2]) <= {1, 2, 3} and got[2] == 0
+    # eids align with the sampled edges (edge i has eid 10+i; row[i] is its
+    # source)
+    np.testing.assert_array_equal(oeids.numpy() - 10,
+                                  [list(row.numpy()).index(v) for v in got])
+
+    # weighted: huge weight on edge→3 dominates sampling of node 0
+    w = paddle.to_tensor(np.array([1e-9, 1e-9, 1.0, 1.0], np.float32))
+    hits = 0
+    for _ in range(10):
+        nbrs, cnts = paddle.geometric.weighted_sample_neighbors(
+            row, colptr, w, paddle.to_tensor(np.array([0], np.int64)),
+            sample_size=1)
+        hits += int(nbrs.numpy()[0] == 3)
+    assert hits >= 8
+
+
 def test_audio_features():
     sr = 16000
     t = np.linspace(0, 1, sr, dtype=np.float32)

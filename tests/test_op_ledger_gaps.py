@@ -290,7 +290,8 @@ def test_review_fixes_round2():
     c = vops.correlation(paddle.to_tensor(a), paddle.to_tensor(a),
                          pad_size=1, kernel_size=3, max_displacement=1,
                          stride1=2)
-    assert _np(c).shape == (1, 9, 4, 4)
+    # CorrelationOutputSize: ceil((8+2-2*(1+1))/2) = 3
+    assert _np(c).shape == (1, 9, 3, 3)
 
     # single-class matrix_nms returns empty, not crash
     mn = vops.matrix_nms(

@@ -150,8 +150,6 @@ MAPPED = {
     "crf_decoding": "text.viterbi_decode",
     "graph_khop_sampler": "geometric.sample_neighbors (per hop)",
     "graph_sample_neighbors": "geometric.sample_neighbors",
-    "weighted_sample_neighbors": "geometric.sample_neighbors (uniform; "
-                                 "weights via rejection on host)",
     # quantization family
     "llm_int8_linear": "quantization PTQ observers + matmul",
     "weight_only_linear": "quantization PTQ (weight observers)",
