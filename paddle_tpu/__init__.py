@@ -41,6 +41,7 @@ from .framework import dtype as _dtype_mod
 from .framework.dtype import (  # noqa: F401
     bfloat16, bool_, complex64, complex128, float16, float32, float64,
     get_default_dtype, int8, int16, int32, int64, set_default_dtype, uint8,
+    DType as dtype, finfo, float8_e4m3fn, float8_e5m2, iinfo, pstring, raw,
 )
 from .framework.dtype import bool_ as bool  # noqa: F401,A001
 from .framework.flags import get_flags, set_flags  # noqa: F401
@@ -48,9 +49,10 @@ from .framework.random import get_rng_state, seed, set_rng_state  # noqa: F401
 from .core.tensor import Parameter, Tensor, is_tensor  # noqa: F401
 from . import device  # noqa: F401
 from .device import (  # noqa: F401
-    CPUPlace, CustomPlace, Place, TPUPlace, get_device, is_compiled_with_tpu,
-    set_device,
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, CustomPlace, Place, TPUPlace,
+    XPUPlace, get_device, is_compiled_with_tpu, set_device,
 )
+from .framework.param_attr import ParamAttr  # noqa: F401
 from . import autograd  # noqa: F401
 from .autograd import enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
 from .autograd.py_layer import PyLayer  # noqa: F401
@@ -83,11 +85,115 @@ from . import quantization  # noqa: F401
 from . import inference  # noqa: F401
 from . import utils  # noqa: F401
 from .hapi.model import Model  # noqa: F401
+from .hapi.summary import summary  # noqa: F401
+from .hapi.dynamic_flops import flops  # noqa: F401
 from .nn.layer.layers import Layer  # noqa: F401
+from .distributed.parallel import DataParallel  # noqa: F401
+from .utils.dlpack import from_dlpack, to_dlpack  # noqa: F401
 
 # paddle-parity aliases
 disable_static = lambda place=None: None  # dygraph is the only eager mode
 enable_static = lambda: None
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """parity: paddle.create_parameter (tensor/creation.py) — a standalone
+    trainable Parameter outside any Layer."""
+    import numpy as _np
+
+    from .framework.dtype import convert_dtype as _cd
+    from .nn import initializer as _init
+
+    d = _cd(dtype)
+    init = default_initializer
+    if init is None and attr is not None:
+        init = getattr(ParamAttr._to_attr(attr), "initializer", None)
+    if init is None:
+        init = (_init.Constant(0.0) if is_bias
+                else _init.XavierNormal())
+    p = Parameter(_np.zeros(shape, d.np_dtype))
+    init(p)
+    return p
+
+
+class LazyGuard:
+    """parity: paddle.LazyGuard (python/paddle/base/dygraph/base.py).
+    The reference defers parameter materialization inside the guard; here
+    parameters are cheap host-initialized jax arrays, so the guard simply
+    marks the scope (layers initialize eagerly — documented divergence)."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """parity: paddle.set_printoptions — governs Tensor repr (numpy-backed)."""
+    import numpy as _np
+
+    kw = {}
+    if precision is not None:
+        kw["precision"] = int(precision)
+    if threshold is not None:
+        kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def disable_signal_handler():
+    """parity: paddle.disable_signal_handler — no custom signal handlers are
+    installed in this framework, so nothing to disable."""
+
+
+def get_cuda_rng_state():
+    """parity: paddle.get_cuda_rng_state — no CUDA generators in a TPU
+    build; returns an empty list like the reference on a CPU-only build."""
+    return []
+
+
+def set_cuda_rng_state(state_list):
+    if state_list:
+        raise RuntimeError("set_cuda_rng_state: no CUDA devices available")
+
+
+def batch(reader, batch_size, drop_last=False):
+    """parity: paddle.batch (python/paddle/reader/decorator.py) — wrap a
+    sample reader into a batch reader."""
+    def batch_reader():
+        b = []
+        for sample in reader():
+            b.append(sample)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    if batch_size <= 0:
+        raise ValueError("batch_size must be positive")
+    return batch_reader
+
+
+def check_shape(shape):
+    """parity: paddle.check_shape (static graph shape validation)."""
+    from collections.abc import Sequence as _Seq
+
+    if isinstance(shape, Tensor):
+        return
+    if not isinstance(shape, _Seq):
+        raise TypeError(f"shape must be a list/tuple/Tensor, got {type(shape)}")
+    for s in shape:
+        if not isinstance(s, (int, Tensor)) or (isinstance(s, int) and s < -1):
+            raise ValueError(f"invalid dim {s!r} in shape {shape}")
 
 def in_dynamic_mode():
     return True

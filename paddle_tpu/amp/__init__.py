@@ -136,3 +136,15 @@ from .grad_scaler import GradScaler  # noqa: E402,F401
 __all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler"]
 
 from . import debugging  # noqa: F401,E402
+
+
+def is_float16_supported(device=None):
+    """parity: amp.is_float16_supported — TPU MXU computes in bf16; fp16
+    tensors are supported via XLA conversion."""
+    return True
+
+
+def is_bfloat16_supported(device=None):
+    """parity: amp.is_bfloat16_supported — bf16 is the TPU-native compute
+    dtype."""
+    return True

@@ -293,3 +293,6 @@ def save(filepath, src, sample_rate, channels_first=True,
         w.setsampwidth(bits_per_sample // 8)
         w.setframerate(int(sample_rate))
         w.writeframes(pcm.tobytes())
+
+# submodule structure parity (reference audio/__init__.py imports them)
+from . import backends, datasets, features, functional  # noqa: E402,F401

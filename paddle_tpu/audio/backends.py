@@ -1,0 +1,24 @@
+"""paddle.audio.backends (parity: python/paddle/audio/backends/) — the WAV
+backend over the stdlib ``wave`` module."""
+from . import AudioInfo, info, load, save  # noqa: F401
+
+
+def list_available_backends():
+    """parity: backends.list_available_backends — only the in-tree wave
+    backend exists (soundfile is an optional extra in the reference)."""
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return "wave_backend"
+
+
+def set_backend(backend_name):
+    if backend_name != "wave_backend":
+        raise NotImplementedError(
+            f"audio backend {backend_name!r} unavailable: only the stdlib "
+            "wave backend is built in")
+
+
+__all__ = ["info", "load", "save", "list_available_backends",
+           "get_current_backend", "set_backend"]

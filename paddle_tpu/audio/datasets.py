@@ -1,0 +1,85 @@
+"""paddle.audio.datasets (parity: python/paddle/audio/datasets/) — TESS and
+ESC50 over local archives (this environment has no network egress; pass the
+downloaded archive_path / files explicitly)."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["AudioClassificationDataset", "TESS", "ESC50"]
+
+
+class AudioClassificationDataset(Dataset):
+    """parity: audio/datasets/dataset.py:29 — (file, label) pairs with
+    feature extraction ('raw' or a feature name from audio.features)."""
+
+    def __init__(self, files=None, labels=None, feat_type="raw",
+                 sample_rate=None, **kwargs):
+        super().__init__()
+        self.files = list(files or [])
+        self.labels = list(labels or [])
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self._feat_kwargs = kwargs
+
+    def _convert(self, wav, sr):
+        import paddle_tpu as paddle
+
+        if self.feat_type == "raw":
+            return wav
+        from . import MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram
+
+        layer = {"mfcc": MFCC, "spectrogram": Spectrogram,
+                 "melspectrogram": MelSpectrogram,
+                 "logmelspectrogram": LogMelSpectrogram}[self.feat_type](
+            sr=sr, **self._feat_kwargs)
+        return layer(paddle.to_tensor(wav[None]))[0]
+
+    def __getitem__(self, idx):
+        from . import load
+
+        wav, sr = load(self.files[idx])
+        arr = np.asarray(wav._value if hasattr(wav, "_value") else wav)
+        return self._convert(arr[0] if arr.ndim > 1 else arr,
+                             self.sample_rate or sr), self.labels[idx]
+
+    def __len__(self):
+        return len(self.files)
+
+
+class _FolderDataset(AudioClassificationDataset):
+    def __init__(self, name, archive_path=None, mode="train",
+                 feat_type="raw", split=None, **kwargs):
+        if archive_path is None or not os.path.isdir(archive_path):
+            raise RuntimeError(
+                f"{name}: no network egress in this environment; pass "
+                "archive_path=<extracted dataset directory>")
+        files, labels = [], []
+        classes = sorted(d for d in os.listdir(archive_path)
+                         if os.path.isdir(os.path.join(archive_path, d)))
+        self.label_list = classes
+        for ci, cls in enumerate(classes):
+            for f in sorted(os.listdir(os.path.join(archive_path, cls))):
+                if f.lower().endswith(".wav"):
+                    files.append(os.path.join(archive_path, cls, f))
+                    labels.append(ci)
+        super().__init__(files, labels, feat_type, **kwargs)
+
+
+class TESS(_FolderDataset):
+    """parity: audio/datasets/tess.py — Toronto emotional speech set."""
+
+    def __init__(self, mode="train", feat_type="raw", archive_path=None,
+                 **kwargs):
+        super().__init__("TESS", archive_path, mode, feat_type, **kwargs)
+
+
+class ESC50(_FolderDataset):
+    """parity: audio/datasets/esc50.py — environmental sound classification."""
+
+    def __init__(self, mode="train", feat_type="raw", archive_path=None,
+                 **kwargs):
+        super().__init__("ESC50", archive_path, mode, feat_type, **kwargs)

@@ -1,0 +1,4 @@
+"""paddle.audio.features (parity: python/paddle/audio/features/layers.py)."""
+from . import LogMelSpectrogram, MelSpectrogram, MFCC, Spectrogram  # noqa: F401
+
+__all__ = ["LogMelSpectrogram", "MelSpectrogram", "MFCC", "Spectrogram"]

@@ -1,0 +1,10 @@
+"""paddle.audio.functional (parity: python/paddle/audio/functional/) —
+re-export of the functional surface."""
+from . import (  # noqa: F401
+    compute_fbank_matrix, create_dct, fft_frequencies, get_window, hz_to_mel,
+    mel_frequencies, mel_to_hz, power_to_db,
+)
+
+__all__ = ["compute_fbank_matrix", "create_dct", "fft_frequencies",
+           "hz_to_mel", "mel_frequencies", "mel_to_hz", "power_to_db",
+           "get_window"]

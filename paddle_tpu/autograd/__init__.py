@@ -17,12 +17,12 @@ from .tape import (  # noqa: F401
     no_grad, set_grad_enabled,
 )
 from .backward_engine import run_backward
-from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+from .py_layer import PyLayer, PyLayerContext, saved_tensors_hooks  # noqa: F401
 
 __all__ = [
     "backward", "grad", "no_grad", "enable_grad", "set_grad_enabled",
     "is_grad_enabled", "PyLayer", "PyLayerContext", "jacobian", "hessian",
-    "vjp", "jvp",
+    "vjp", "jvp", "saved_tensors_hooks",
 ]
 
 

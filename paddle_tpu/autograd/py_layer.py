@@ -18,14 +18,25 @@ class PyLayerContext:
         self._extra = {}
 
     def save_for_backward(self, *tensors):
-        self._saved = tuple(tensors)
+        hooks = _current_saved_hooks()
+        if hooks is not None:
+            self._saved = tuple(hooks[0](t) for t in tensors)
+            self._pack_hooks = hooks
+        else:
+            self._saved = tuple(tensors)
+            self._pack_hooks = None
+
+    def _unpacked(self):
+        if getattr(self, "_pack_hooks", None) is not None:
+            return tuple(self._pack_hooks[1](t) for t in self._saved)
+        return self._saved
 
     @property
     def saved_tensor(self):
-        return self._saved
+        return self._unpacked()
 
     def saved_tensors(self):
-        return self._saved
+        return self._unpacked()
 
     def __setattr__(self, k, v):
         object.__setattr__(self, k, v)
@@ -93,3 +104,34 @@ class PyLayer(metaclass=PyLayerMeta):
 
 class LegacyPyLayer(PyLayer):
     pass
+
+
+# ---------------------------------------------------------------------------
+# saved-tensor pack/unpack hooks
+# ---------------------------------------------------------------------------
+_saved_hooks_stack = []
+
+
+class saved_tensors_hooks:
+    """parity: autograd/saved_tensors_hooks.py — registers a pack/unpack
+    hook pair for tensors saved for backward. Applies to PyLayer
+    ``save_for_backward`` (the explicit save path). The generic op path
+    keeps residuals inside jax.vjp closures, where XLA owns buffer
+    lifetime; the reference's main use (activation offload) maps onto
+    jax.checkpoint / remat on TPU (documented divergence)."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        _saved_hooks_stack.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        _saved_hooks_stack.pop()
+        return False
+
+
+def _current_saved_hooks():
+    return _saved_hooks_stack[-1] if _saved_hooks_stack else None

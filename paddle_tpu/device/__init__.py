@@ -262,3 +262,95 @@ class stream_guard:
 
     def __exit__(self, *exc):
         set_stream(self._prev)
+
+
+class CUDAPlace(Place):
+    """parity: paddle.CUDAPlace. This build targets TPU (CUDA disabled), so
+    construction raises — matching the reference in a non-CUDA build
+    (phi/common/place.h + is_compiled_with_cuda() checks) — while remaining
+    a class so ``isinstance(place, paddle.CUDAPlace)`` works in ported
+    code."""
+
+    def __init__(self, idx: int = 0):
+        raise RuntimeError(
+            "CUDAPlace is unavailable: paddle_tpu is not compiled with "
+            "CUDA. Use TPUPlace()/CPUPlace() instead.")
+
+
+class CUDAPinnedPlace(Place):
+    """parity: paddle.CUDAPinnedPlace (unavailable in a non-CUDA build)."""
+
+    def __init__(self):
+        raise RuntimeError(
+            "CUDAPinnedPlace is unavailable: paddle_tpu is not compiled "
+            "with CUDA.")
+
+
+class XPUPlace(Place):
+    """parity: paddle.XPUPlace (unavailable: no XPU in this build)."""
+
+    def __init__(self, idx: int = 0):
+        raise RuntimeError(
+            "XPUPlace is unavailable: paddle_tpu is not compiled with XPU.")
+
+
+class IPUPlace(Place):
+    """parity: paddle.device.IPUPlace (unavailable: no IPU in this build)."""
+
+    def __init__(self):
+        raise RuntimeError(
+            "IPUPlace is unavailable: paddle_tpu is not compiled with IPU.")
+
+
+def get_all_device_type():
+    """parity: device.get_all_device_type — device types visible to the
+    runtime."""
+    return sorted({_platform_of(d) for d in jax.devices()} | {"cpu"})
+
+
+def get_all_custom_device_type():
+    return [t for t in get_all_device_type() if t not in ("cpu", "gpu")]
+
+
+def get_available_device():
+    return [f"{_platform_of(d)}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return [s for s in get_available_device()
+            if not s.startswith(("cpu", "gpu"))]
+
+
+def get_cudnn_version():
+    """parity: device.get_cudnn_version — None when CUDA is unavailable."""
+    return None
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def is_compiled_with_distribute():
+    return True
+
+
+def is_compiled_with_custom_device(device_type: str) -> bool:
+    """TPU rides the PJRT plugin mechanism — report it as the available
+    custom device type."""
+    return device_type in get_all_device_type()

@@ -43,3 +43,24 @@ from . import rpc  # noqa: F401,E402
 from .store import TCPStore  # noqa: F401,E402
 from . import checkpoint  # noqa: F401,E402
 from .checkpoint import load_state_dict, save_state_dict  # noqa: F401,E402
+
+# round-2 parity surface: intermediate parallelize API, comm extras,
+# PS-side config classes, launch/io submodules
+from . import io  # noqa: F401,E402
+from . import launch  # noqa: F401,E402
+from .auto_parallel import (  # noqa: F401,E402
+    DistAttr, Strategy, shard_dataloader,
+)
+from .collective import (  # noqa: F401,E402
+    alltoall, alltoall_single, broadcast_object_list, gather,
+    gloo_barrier, gloo_init_parallel_env, gloo_release, irecv, isend,
+    scatter_object_list, split,
+)
+from .parallelize import (  # noqa: F401,E402
+    ColWiseParallel, CountFilterEntry, InMemoryDataset, LocalLayer,
+    ParallelMode, PrepareLayerInput, PrepareLayerOutput, ProbabilityEntry,
+    QueueDataset, ReduceType, RowWiseParallel, SequenceParallelBegin,
+    SequenceParallelDisable, SequenceParallelEnable, SequenceParallelEnd,
+    ShowClickEntry, SplitPoint, parallelize, to_distributed,
+    unshard_dtensor,
+)

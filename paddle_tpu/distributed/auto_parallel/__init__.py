@@ -343,3 +343,41 @@ from .api import (  # noqa: E402,F401
 )
 __all__ += ["DistModel", "ShardingStage1", "ShardingStage2",
             "ShardingStage3", "shard_optimizer", "shard_scaler", "to_static"]
+
+
+class _StrategyConfig:
+    def __init__(self, **defaults):
+        self.__dict__.update(defaults)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.__dict__})"
+
+
+class Strategy:
+    """parity: auto_parallel/api.py:1973 Strategy — sharding / fused_passes /
+    gradient_merge / pipeline / amp configuration groups, dict-initializable.
+    Consumed by dist.to_static and the pipeline recipes."""
+
+    def __init__(self, config=None):
+        self.sharding = _StrategyConfig(enable=False, stage=1, degree=8)
+        self.fused_passes = _StrategyConfig(enable=False, fused_passes_list=[])
+        self.gradient_merge = _StrategyConfig(enable=False, k_steps=1,
+                                              avg=True)
+        self.pipeline = _StrategyConfig(enable=False, schedule_mode="1F1B",
+                                        micro_batch_size=1,
+                                        accumulate_steps=1)
+        self.amp = _StrategyConfig(enable=False, dtype="bfloat16", level="O1")
+        if config:
+            for group, vals in config.items():
+                tgt = getattr(self, group, None)
+                if tgt is None:
+                    setattr(self, group, _StrategyConfig(**dict(vals)))
+                elif isinstance(vals, dict):
+                    tgt.__dict__.update(vals)
+
+    def __repr__(self):
+        return (f"Strategy(sharding={self.sharding}, "
+                f"pipeline={self.pipeline}, amp={self.amp})")
+
+
+__all__ += ["Strategy"]

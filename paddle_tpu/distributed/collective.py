@@ -213,3 +213,173 @@ def destroy_process_group(group=None):
 
 def get_backend(group=None) -> str:
     return "xla"
+
+
+# -- reference communication/ extras ----------------------------------------
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """parity: communication/all_to_all.py:26 alltoall (alias of
+    all_to_all)."""
+    return all_to_all(out_tensor_list, in_tensor_list, group, sync_op)
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """parity: communication/all_to_all.py alltoall_single — single-tensor
+    all-to-all splitting dim 0 across ranks."""
+    if not _multi_process(group):
+        out_tensor._replace_value(in_tensor._value)
+        return out_tensor
+    raise NotImplementedError(
+        "cross-host eager alltoall_single; use lax.all_to_all in the SPMD "
+        "path")
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """parity: communication/gather.py:29 — collect tensors on dst."""
+    if not _multi_process(group):
+        if gather_list is not None:
+            gather_list.append(Tensor(tensor._value))
+        return
+    gathered = _allgather_arrays(tensor._value, group)
+    if get_rank() == dst and gather_list is not None:
+        for i in range(gathered.shape[0]):
+            gather_list.append(Tensor(gathered[i]))
+
+
+class _Task:
+    """Completed-communication handle (reference returns an async task)."""
+
+    def __init__(self, tensor=None):
+        self._tensor = tensor
+
+    def wait(self):
+        if self._tensor is not None:
+            jax.block_until_ready(self._tensor._value)
+
+    def is_completed(self):
+        return True
+
+
+def isend(tensor, dst, group=None):
+    """parity: communication/send.py:68 isend — eager sends complete
+    synchronously here (XLA owns async scheduling); returns a done task."""
+    send(tensor, dst, group)
+    return _Task(tensor)
+
+
+def irecv(tensor, src=None, group=None):
+    """parity: communication/recv.py:68 irecv."""
+    recv(tensor, src if src is not None else 0, group)
+    return _Task(tensor)
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """parity: communication/broadcast.py broadcast_object_list — pickle +
+    byte-broadcast."""
+    if not _multi_process(group):
+        return
+    import pickle
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    if get_rank() == src:
+        payload = pickle.dumps(list(object_list))
+        data = np.frombuffer(payload, np.uint8)
+        n = np.asarray([len(data)], np.int64)
+    else:
+        data = np.zeros(0, np.uint8)
+        n = np.asarray([0], np.int64)
+    n = multihost_utils.broadcast_one_to_all(n, is_source=get_rank() == src)
+    buf = np.zeros(int(n[0]), np.uint8)
+    buf[:len(data)] = data
+    buf = multihost_utils.broadcast_one_to_all(buf,
+                                               is_source=get_rank() == src)
+    got = pickle.loads(buf.tobytes())
+    object_list[:] = got
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """parity: communication/scatter.py scatter_object_list — broadcast the
+    src rank's list, keep this rank's element."""
+    if not _multi_process(group):
+        if in_object_list:
+            out_object_list[:] = [in_object_list[0]]
+        return
+    objs = (list(in_object_list) if in_object_list
+            else [None] * get_world_size())
+    broadcast_object_list(objs, src, group)
+    out_object_list[:] = [objs[get_rank()]]
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """parity: collective.py split — the megatron-style parallel layer
+    helper: builds a row/column-parallel Linear or a vocab-parallel
+    Embedding whose weight is sharded over the 'mp' mesh axis (GSPMD
+    inserts the collectives the reference issues through mp groups)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from .auto_parallel import Shard, get_mesh, shard_tensor
+
+    if operation not in ("linear", "embedding"):
+        raise ValueError(
+            f"dist.split: operation must be 'linear' or 'embedding', got "
+            f"{operation!r}")
+    mesh = get_mesh()
+
+    def _shard(w, dim):
+        if mesh is None or "mp" not in mesh.dim_names:
+            return w
+        from .auto_parallel import Replicate
+
+        placements = [Replicate() for _ in mesh.dim_names]
+        placements[mesh.dim_names.index("mp")] = Shard(dim)
+        return shard_tensor(w, mesh, placements)
+
+    if operation == "embedding":
+        w = paddle.create_parameter(list(size), "float32", attr=weight_attr)
+        w = _shard(w, 0)  # vocab-parallel rows
+        return F.embedding(x, w)
+    w = paddle.create_parameter(list(size), "float32", attr=weight_attr)
+    # axis=0: row-parallel (input dim sharded); axis=1: column-parallel
+    w = _shard(w, 0 if axis == 0 else 1)
+    b = None
+    if bias_attr is not False:
+        b = paddle.create_parameter([size[1]], "float32", attr=bias_attr,
+                                    is_bias=True)
+    return F.linear(x, w, b)
+
+
+# gloo compat: the reference's CPU-rendezvous barrier trio
+# (parallel_with_gloo.py). CPU coordination here rides the TCPStore.
+_gloo_store = {}
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """parity: distributed/parallel_with_gloo.py gloo_init_parallel_env."""
+    from .store import TCPStore
+
+    host, _, port = server_endpoint.partition(":")
+    if rank_id == 0:
+        _gloo_store["server"] = TCPStore(host, int(port), is_master=True,
+                                         world_size=rank_num)
+    _gloo_store["client"] = TCPStore(host, int(port), world_size=rank_num)
+    _gloo_store["rank_num"] = rank_num
+
+
+def gloo_barrier():
+    if "client" not in _gloo_store:
+        raise RuntimeError("gloo_barrier: call gloo_init_parallel_env first")
+    _gloo_store.setdefault("seq", 0)
+    _gloo_store["seq"] += 1
+    _gloo_store["client"].barrier(f"gloo/b{_gloo_store['seq']}",
+                                  _gloo_store["rank_num"])
+
+
+def gloo_release():
+    for k in ("client", "server"):
+        st = _gloo_store.pop(k, None)
+        if st is not None:
+            st.close()

@@ -1,0 +1,33 @@
+"""paddle.distributed.io (parity: python/paddle/distributed/io.py) —
+persistable save/load for distributed programs. In this framework programs
+are captured callables whose state lives in Layers / the static scope, so
+these delegate to static save/load (the PS remote-table paths are out of
+scope with the D19 skip)."""
+from __future__ import annotations
+
+__all__ = ["save_persistables", "load_persistables", "is_persistable"]
+
+
+def is_persistable(var):
+    """parity: distributed/io.py:352 — parameters and scope vars persist."""
+    from ..core.tensor import Parameter
+
+    return isinstance(var, Parameter) or getattr(var, "persistable", False)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    import os
+
+    from ..static.compat import save as _save
+
+    os.makedirs(dirname, exist_ok=True)
+    _save(main_program, os.path.join(dirname, filename or "persistables"))
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    import os
+
+    from ..static.compat import load as _load
+
+    return _load(main_program,
+                 os.path.join(dirname, filename or "persistables"))

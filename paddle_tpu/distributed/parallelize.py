@@ -1,0 +1,363 @@
+"""paddle.distributed intermediate parallelize API + compat surface.
+
+Parity: python/paddle/distributed/auto_parallel/intermediate/ (parallelize.py:51,
+tensor_parallel.py ColWiseParallel/RowWiseParallel/PrepareLayerInput/
+PrepareLayerOutput + sequence-parallel plan markers, pipeline_parallel.py
+SplitPoint), plus paddle.distributed misc exports (ParallelMode, ReduceType,
+entry_attr.py entries, LocalLayer, unshard_dtensor, to_distributed).
+
+TPU-native: a parallelize plan is a sharding recipe — ColWise/RowWise mark
+layer weights with Shard placements on the 'mp' mesh axis and GSPMD inserts
+the collectives; sharding_level maps onto the ZeRO ShardingStage wrappers;
+pp split points mark stage boundaries for the pipeline recipes.
+"""
+from __future__ import annotations
+
+import fnmatch
+from enum import Enum
+
+import numpy as np
+
+__all__ = [
+    "SplitPoint", "ColWiseParallel", "RowWiseParallel", "PrepareLayerInput",
+    "PrepareLayerOutput", "SequenceParallelBegin", "SequenceParallelDisable",
+    "SequenceParallelEnable", "SequenceParallelEnd", "parallelize",
+    "to_distributed", "LocalLayer", "ParallelMode", "ReduceType",
+    "CountFilterEntry", "ProbabilityEntry", "ShowClickEntry",
+    "unshard_dtensor", "InMemoryDataset", "QueueDataset",
+]
+
+
+class SplitPoint(Enum):
+    """parity: intermediate/pipeline_parallel.py SplitPoint — where a
+    pipeline stage boundary sits relative to the named layer."""
+    BEGINNING = 0
+    END = 1
+
+
+class ParallelMode:
+    """parity: fleet ParallelMode constants."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+class ReduceType:
+    """parity: dist.ReduceType (used by Partial placements)."""
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+class _PlanBase:
+    def apply(self, layer, mesh):
+        raise NotImplementedError
+
+
+class ColWiseParallel(_PlanBase):
+    """Shard the layer's weight on its output dim over the 'mp' axis
+    (reference: intermediate/tensor_parallel.py ColWiseParallel)."""
+
+    def __init__(self, gather_output=False):
+        self.gather_output = gather_output
+
+    def apply(self, layer, mesh):
+        from .auto_parallel import Shard, shard_tensor
+
+        axis = "mp" if "mp" in mesh.dim_names else mesh.dim_names[-1]
+        mp_idx = mesh.dim_names.index(axis)
+        if getattr(layer, "weight", None) is not None:
+            # Linear weight [in, out] / Embedding [vocab, hidden]: column =
+            # output dim (last)
+            layer.weight = shard_tensor(
+                layer.weight, mesh,
+                _expand(mesh, {mp_idx: Shard(layer.weight.ndim - 1)}))
+        if getattr(layer, "bias", None) is not None:
+            layer.bias = shard_tensor(
+                layer.bias, mesh, _expand(mesh, {mp_idx: Shard(0)}))
+
+
+class RowWiseParallel(_PlanBase):
+    """Shard the layer's weight on its input dim over the 'mp' axis."""
+
+    def __init__(self, is_input_parallel=True):
+        self.is_input_parallel = is_input_parallel
+
+    def apply(self, layer, mesh):
+        from .auto_parallel import Shard, shard_tensor
+
+        axis = "mp" if "mp" in mesh.dim_names else mesh.dim_names[-1]
+        mp_idx = mesh.dim_names.index(axis)
+        if getattr(layer, "weight", None) is not None:
+            layer.weight = shard_tensor(
+                layer.weight, mesh, _expand(mesh, {mp_idx: Shard(0)}))
+
+
+class PrepareLayerInput(_PlanBase):
+    """parity: wraps the layer to preprocess (e.g. reshard) its inputs."""
+
+    def __init__(self, fn=None):
+        self.fn = fn
+
+    def apply(self, layer, mesh):
+        if self.fn is None:
+            return
+        orig = layer.forward
+
+        def wrapped(*args, **kwargs):
+            args = self.fn(args, process_mesh=mesh) or args
+            return orig(*args, **kwargs)
+
+        layer.forward = wrapped
+
+
+class PrepareLayerOutput(_PlanBase):
+    def __init__(self, fn=None):
+        self.fn = fn
+
+    def apply(self, layer, mesh):
+        if self.fn is None:
+            return
+        orig = layer.forward
+
+        def wrapped(*args, **kwargs):
+            out = orig(*args, **kwargs)
+            return self.fn(out, process_mesh=mesh) or out
+
+        layer.forward = wrapped
+
+
+class _SPMarker(_PlanBase):
+    """Sequence-parallel plan markers: record the intent on the layer; the
+    activation sharding itself is GSPMD's job ('sp' axis in act specs)."""
+
+    def apply(self, layer, mesh):
+        layer._sequence_parallel = type(self).__name__
+
+
+class SequenceParallelBegin(_SPMarker):
+    def __init__(self, need_transpose=True):
+        self.need_transpose = need_transpose
+
+
+class SequenceParallelEnd(_SPMarker):
+    def __init__(self, need_transpose=True):
+        self.need_transpose = need_transpose
+
+
+class SequenceParallelEnable(_SPMarker):
+    pass
+
+
+class SequenceParallelDisable(_SPMarker):
+    def __init__(self, need_transpose=True):
+        self.need_transpose = need_transpose
+
+
+def _expand(mesh, idx_to_placement):
+    from .auto_parallel import Replicate
+
+    out = [Replicate() for _ in mesh.dim_names]
+    for i, p in idx_to_placement.items():
+        out[i] = p
+    return out
+
+
+def parallelize(model, optimizer=None, mesh=None, config=None):
+    """parity: auto_parallel/intermediate/parallelize.py:51 — apply a
+    {dp_config, mp_config, pp_config} plan to (model, optimizer).
+    Returns (model, optimizer)."""
+    from . import auto_parallel as ap
+
+    config = config or {}
+    mesh = mesh or ap.get_mesh()
+    if mesh is None:
+        raise ValueError(
+            "parallelize: pass mesh= or call dist.auto_parallel.set_mesh "
+            "first")
+
+    mp_cfg = config.get("mp_config") or {}
+    plan = mp_cfg.get("parallelize_plan") or {}
+    if plan:
+        named = dict(model.named_sublayers(include_self=True))
+        for pattern, actions in plan.items():
+            acts = actions if isinstance(actions, (list, tuple)) else [
+                actions]
+            for name, sub in named.items():
+                if fnmatch.fnmatch(name, pattern) or name == pattern:
+                    for a in acts:
+                        a.apply(sub, mesh)
+
+    pp_cfg = config.get("pp_config") or {}
+    split_spec = pp_cfg.get("split_spec")
+    if split_spec:
+        # record stage boundaries; pipeline recipes consume them
+        model._pp_split_spec = split_spec
+
+    dp_cfg = config.get("dp_config") or {}
+    level = dp_cfg.get("sharding_level", 0)
+    if optimizer is not None and level:
+        from .auto_parallel import (ShardingStage1, ShardingStage2,
+                                    ShardingStage3, shard_optimizer)
+
+        stage = {1: ShardingStage1, 2: ShardingStage2,
+                 3: ShardingStage3}[int(level)]
+        axis = "dp" if "dp" in mesh.dim_names else mesh.dim_names[0]
+        optimizer = shard_optimizer(optimizer, stage(axis, mesh))
+    return model, optimizer
+
+
+def to_distributed(model, optimizer, dataloader, device_num=None,
+                   node_num=1, config=None):
+    """parity: dist.to_distributed — one-click distribution: shards the
+    dataloader over the data axis and returns (model, optimizer, loader);
+    model placement falls to GSPMD propagation from the sharded batch."""
+    from . import auto_parallel as ap
+
+    mesh = ap.get_mesh()
+    if mesh is None:
+        import jax
+
+        from .auto_parallel import ProcessMesh
+
+        n = device_num or len(jax.devices())
+        mesh = ProcessMesh(np.arange(n).reshape(n), dim_names=["dp"])
+        ap.set_mesh(mesh)
+    loader = ap.shard_dataloader(dataloader, mesh)
+    return model, optimizer, loader
+
+
+class LocalLayer:
+    """parity: dist.LocalLayer — wraps a Layer so its computation stays
+    rank-local under auto-parallel (inputs resharded to local shards). With
+    GSPMD, wrapping in shard_map with per-axis sharding achieves this; the
+    class records the local in/out placements for the recipe layer."""
+
+    def __init__(self, out_dist_attrs=None, grad_dist_attrs=None):
+        self.out_dist_attrs = out_dist_attrs
+        self.grad_dist_attrs = grad_dist_attrs
+
+    def __call__(self, layer):
+        layer._local_layer_attrs = self
+        return layer
+
+
+def unshard_dtensor(dist_tensor):
+    """parity: dist.unshard_dtensor — gather a dist tensor to a replicated
+    dense tensor."""
+    from .auto_parallel import Replicate, reshard
+
+    attr = getattr(dist_tensor, "_dist_attr", None)
+    if attr is None:
+        return dist_tensor
+    mesh = attr.process_mesh
+    return reshard(dist_tensor, mesh,
+                   [Replicate() for _ in mesh.dim_names])
+
+
+# ---------------------------------------------------------------------------
+# PS-side config/dataset compat (D19 parameter-server is a documented skip;
+# these classes keep configuration code importable)
+# ---------------------------------------------------------------------------
+class _EntryAttr:
+    def __init__(self):
+        self._name = None
+
+    def _to_attr(self):
+        return self._name
+
+
+class ProbabilityEntry(_EntryAttr):
+    """parity: entry_attr.py:62 — sparse feature admitted with probability."""
+
+    def __init__(self, probability):
+        super().__init__()
+        self._name = f"probability_entry:{probability}"
+        self.probability = probability
+
+
+class CountFilterEntry(_EntryAttr):
+    """parity: entry_attr.py:107 — sparse feature admitted after N shows."""
+
+    def __init__(self, count_filter):
+        super().__init__()
+        self._name = f"count_filter_entry:{count_filter}"
+        self.count_filter = count_filter
+
+
+class ShowClickEntry(_EntryAttr):
+    """parity: entry_attr.py:155 — show/click statistic columns."""
+
+    def __init__(self, show_name, click_name):
+        super().__init__()
+        self._name = f"show_click_entry:{show_name}:{click_name}"
+        self.show_name = show_name
+        self.click_name = click_name
+
+
+class InMemoryDataset:
+    """parity: base/dataset.py InMemoryDataset (PS data pipeline) — file
+    list loaded into memory, batched iteration; the brpc shuffle/merge
+    plumbing is out of scope with the PS skip."""
+
+    def __init__(self):
+        self._files = []
+        self._batch_size = 1
+        self._records = []
+        self._parser = None
+
+    def init(self, batch_size=1, use_var=None, pipe_command=None, **kwargs):
+        self._batch_size = batch_size
+
+    def set_filelist(self, files):
+        self._files = list(files)
+
+    def load_into_memory(self):
+        self._records = []
+        for path in self._files:
+            with open(path) as f:
+                for line in f:
+                    rec = (self._parser(line) if self._parser
+                           else line.rstrip("\n"))
+                    self._records.append(rec)
+
+    def local_shuffle(self):
+        import random
+
+        random.shuffle(self._records)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        self.local_shuffle()
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._records)
+
+    def release_memory(self):
+        self._records = []
+
+    def __iter__(self):
+        for i in range(0, len(self._records), self._batch_size):
+            yield self._records[i:i + self._batch_size]
+
+
+class QueueDataset(InMemoryDataset):
+    """parity: base/dataset.py QueueDataset — streaming variant; here an
+    iterator over the file list without materializing everything."""
+
+    def __iter__(self):
+        batch = []
+        for path in self._files:
+            with open(path) as f:
+                for line in f:
+                    batch.append(self._parser(line) if self._parser
+                                 else line.rstrip("\n"))
+                    if len(batch) == self._batch_size:
+                        yield batch
+                        batch = []
+        if batch:
+            yield batch

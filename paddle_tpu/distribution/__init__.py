@@ -376,8 +376,10 @@ from .transform import (  # noqa: F401,E402
 )
 
 from .extra import (  # noqa: E402,F401
+    ExponentialFamily, LKJCholesky,
     Binomial, Cauchy, Chi2, ContinuousBernoulli, Independent,
     MultivariateNormal,
 )
-__all__ += ["Binomial", "Cauchy", "Chi2", "ContinuousBernoulli",
+__all__ += ["ExponentialFamily", "LKJCholesky",
+            "Binomial", "Cauchy", "Chi2", "ContinuousBernoulli",
             "Independent", "MultivariateNormal"]

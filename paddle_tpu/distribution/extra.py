@@ -9,13 +9,15 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.tensor import Tensor
 from ..framework.random import next_key
 from . import Distribution, Gamma, _v
 
 __all__ = ["Binomial", "Cauchy", "Chi2", "ContinuousBernoulli",
-           "MultivariateNormal", "Independent"]
+           "MultivariateNormal", "Independent", "ExponentialFamily",
+           "LKJCholesky"]
 
 
 class Binomial(Distribution):
@@ -267,3 +269,88 @@ class Independent(Distribution):
     def entropy(self):
         e = _v(self.base.entropy())
         return Tensor(jnp.sum(e, axis=tuple(range(-self._rank, 0))))
+
+
+class ExponentialFamily(Distribution):
+    """parity: distribution/exponential_family.py — base class whose entropy
+    comes from the Bregman divergence of the log-normalizer (computed here
+    with jax autodiff in place of the reference's dygraph grad)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        raise NotImplementedError
+
+    def entropy(self):
+        nat = [jnp.asarray(p._value if isinstance(p, Tensor) else p)
+               for p in self._natural_parameters]
+        lg = self._log_normalizer(*nat)
+        grads = jax.grad(
+            lambda *ps: jnp.sum(self._log_normalizer(*ps)),
+            argnums=tuple(range(len(nat))))(*nat)
+        ent = -self._mean_carrier_measure + lg
+        for p, g in zip(nat, grads):
+            ent = ent - p * g
+        return Tensor(ent)
+
+
+class LKJCholesky(Distribution):
+    """parity: distribution/lkj_cholesky.py — distribution over Cholesky
+    factors of correlation matrices, LKJ(dim, concentration). Sampling via
+    the onion method; log_prob matches the standard LKJ-Cholesky density
+    Σ_i (dim - i - 1 + 2(η - 1)) log L_ii + log Z(η)."""
+
+    def __init__(self, dim=2, concentration=1.0, sample_method="onion"):
+        if dim < 2:
+            raise ValueError("LKJCholesky: dim must be >= 2")
+        self.dim = int(dim)
+        self.concentration = Tensor(jnp.asarray(float(concentration),
+                                                jnp.float32))
+        self.sample_method = sample_method
+        super().__init__(batch_shape=(), event_shape=(dim, dim))
+
+    def sample(self, shape=()):
+        shape = tuple(shape)
+        n = self.dim
+        eta = float(np.asarray(self.concentration._value))
+        key = next_key()
+        # onion method (LKJ 2009): build rows from Beta marginals + sphere
+        k1, k2 = jax.random.split(key)
+        L = jnp.zeros(shape + (n, n), jnp.float32)
+        L = L.at[..., 0, 0].set(1.0)
+        beta_key = k1
+        for i in range(1, n):
+            beta_key, ku, kn = jax.random.split(beta_key, 3)
+            a = eta + (n - 1 - i) / 2.0
+            y = jax.random.beta(ku, i / 2.0, a, shape)      # squared radius
+            u = jax.random.normal(kn, shape + (i,))
+            u = u / jnp.linalg.norm(u, axis=-1, keepdims=True)
+            w = jnp.sqrt(y)[..., None] * u
+            L = L.at[..., i, :i].set(w)
+            L = L.at[..., i, i].set(jnp.sqrt(jnp.maximum(1.0 - y, 1e-12)))
+        return Tensor(L)
+
+    def log_prob(self, value):
+        L = jnp.asarray(value._value if isinstance(value, Tensor) else value)
+        n = self.dim
+        eta = jnp.asarray(self.concentration._value)
+        diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+        order = jnp.arange(1, n, dtype=jnp.float32)
+        # exponents: (n - i - 1) + 2(eta - 1) for row index i = 1..n-1
+        expo = (n - order - 1.0) + 2.0 * (eta - 1.0)
+        unnorm = jnp.sum(expo * jnp.log(diag), axis=-1)
+        # log normalization (standard LKJ-Cholesky constant, the
+        # torch/numpyro per-row Beta formulation)
+        lognorm = 0.0
+        for k in range(1, n):
+            alpha_k = eta + (n - 1 - k) / 2.0
+            lognorm += (k / 2.0) * jnp.log(jnp.pi) \
+                + jax.scipy.special.gammaln(alpha_k) \
+                - jax.scipy.special.gammaln(alpha_k + k / 2.0)
+        return Tensor(unnorm - lognorm)

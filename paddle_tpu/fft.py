@@ -4,6 +4,8 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+import numpy as np
+
 from .core.tensor import Tensor
 from .ops.dispatch import apply
 from .ops.creation import _t
@@ -71,3 +73,59 @@ def fftshift(x, axes=None, name=None):
 
 def ifftshift(x, axes=None, name=None):
     return apply("ifftshift", lambda v: jnp.fft.ifftshift(v, axes=axes), _t(x))
+
+
+def _hfft_shape(v_shape, s, axes):
+    axes = [a % len(v_shape) for a in axes]
+    if s is not None:
+        return list(s), axes
+    out = [v_shape[a] for a in axes]
+    out[-1] = 2 * (v_shape[axes[-1]] - 1)
+    return out, axes
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """parity: fft.py hfft2 — FFT of a signal Hermitian-symmetric in the
+    last transform axis; real output. Identity (verified vs torch):
+    hfftn(x, s) = irfftn(conj(x), s) * prod(s)."""
+    return hfftn(x, s=s, axes=axes, norm=norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s=s, axes=axes, norm=norm)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    t = _t(x)
+    ax = list(axes) if axes is not None else list(range(t.ndim))
+
+    def fn(v):
+        out_s, axl = _hfft_shape(v.shape, s, ax)
+        scale = 1.0
+        if norm == "backward":
+            scale = float(np.prod(out_s))
+        elif norm == "ortho":
+            scale = float(np.sqrt(np.prod(out_s)))
+        return jnp.fft.irfftn(jnp.conj(v), s=out_s, axes=axl) * scale
+
+    return apply("hfftn", fn, t)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    t = _t(x)
+    ax = list(axes) if axes is not None else list(range(t.ndim))
+
+    def fn(v):
+        axl = [a % v.ndim for a in ax]
+        sl = list(s) if s is not None else [v.shape[a] for a in axl]
+        scale = 1.0
+        if norm == "backward":
+            scale = float(np.prod(sl))
+        elif norm == "ortho":
+            scale = float(np.sqrt(np.prod(sl)))
+        return jnp.conj(jnp.fft.rfftn(v, s=sl, axes=axl)) / scale
+
+    return apply("ihfftn", fn, t)
+
+
+__all__ += ["hfft2", "ihfft2", "hfftn", "ihfftn"]

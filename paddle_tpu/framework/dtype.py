@@ -179,3 +179,50 @@ def set_default_dtype(d):
 
 def get_default_dtype() -> DType:
     return _default_dtype
+
+
+# -- dtype info / misc dtypes -------------------------------------------------
+class finfo:
+    """parity: paddle.finfo — floating dtype limits (eps/min/max/...)."""
+
+    def __init__(self, dtype):
+        d = convert_dtype(dtype)
+        try:
+            import ml_dtypes
+            fi = ml_dtypes.finfo(d.np_dtype)
+        except (ImportError, ValueError):
+            fi = np.finfo(d.np_dtype)
+        self.dtype = str(d)
+        self.bits = fi.bits
+        self.eps = float(fi.eps)
+        self.min = float(fi.min)
+        self.max = float(fi.max)
+        self.tiny = float(fi.tiny)
+        self.smallest_normal = float(fi.tiny)
+        self.resolution = float(fi.resolution)
+
+    def __repr__(self):
+        return (f"finfo(min={self.min}, max={self.max}, eps={self.eps}, "
+                f"bits={self.bits}, dtype={self.dtype})")
+
+
+class iinfo:
+    """parity: paddle.iinfo — integer dtype limits."""
+
+    def __init__(self, dtype):
+        d = convert_dtype(dtype)
+        ii = np.iinfo(d.np_dtype)
+        self.dtype = str(d)
+        self.bits = ii.bits
+        self.min = int(ii.min)
+        self.max = int(ii.max)
+
+    def __repr__(self):
+        return (f"iinfo(min={self.min}, max={self.max}, bits={self.bits}, "
+                f"dtype={self.dtype})")
+
+
+# opaque dtypes of the reference's DataType enum with no numeric lowering on
+# TPU (phi/common/data_type.h: PSTRING, RAW) — sentinels for API compat
+pstring = DType("pstring", np.dtype(object))
+raw = DType("raw", np.dtype(object))

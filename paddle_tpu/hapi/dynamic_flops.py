@@ -1,0 +1,140 @@
+"""paddle.flops — dynamic FLOPs counter over a Layer forward pass.
+
+Parity: python/paddle/hapi/dynamic_flops.py (flops(net, input_size,
+custom_ops, print_detail)): registers forward-post hooks on leaf layers,
+runs one forward on zeros, and sums per-layer FLOP counts. Counting
+conventions follow the reference (multiply-add counted as one op for conv /
+linear).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["flops"]
+
+
+def _numel(shape):
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n
+
+
+def _count_linear(layer, inp, out):
+    # [*, in] @ [in, out]: N_out_positions * in_features
+    in_features = layer.weight.shape[0]
+    return _numel(out.shape) * int(in_features)
+
+
+def _count_conv(layer, inp, out):
+    w = layer.weight
+    # [out_c, in_c/g, *k] — output positions × per-position kernel work
+    kernel_ops = _numel(w.shape[1:])
+    bias_ops = 1 if getattr(layer, "bias", None) is not None else 0
+    return _numel(out.shape) * (kernel_ops + bias_ops)
+
+
+def _count_norm(layer, inp, out):
+    return _numel(inp.shape) * 2
+
+
+def _count_act(layer, inp, out):
+    return _numel(out.shape)
+
+
+def _count_pool(layer, inp, out):
+    k = getattr(layer, "ksize", None) or getattr(layer, "kernel_size", 1)
+    if isinstance(k, (tuple, list)):
+        kn = _numel(k)
+    else:
+        kn = int(k) ** 2
+    return _numel(out.shape) * kn
+
+
+def _count_zero(layer, inp, out):
+    return 0
+
+
+def _default_table():
+    from ..nn import layer as L
+
+    table = {}
+
+    def reg(names, fn):
+        import paddle_tpu.nn as nn
+        for n in names:
+            cls = getattr(nn, n, None)
+            if cls is not None:
+                table[cls] = fn
+
+    reg(["Linear"], _count_linear)
+    reg(["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
+         "Conv3DTranspose"], _count_conv)
+    reg(["BatchNorm", "BatchNorm1D", "BatchNorm2D", "BatchNorm3D",
+         "LayerNorm", "GroupNorm", "InstanceNorm1D", "InstanceNorm2D",
+         "InstanceNorm3D", "SyncBatchNorm"], _count_norm)
+    reg(["ReLU", "ReLU6", "LeakyReLU", "PReLU", "Sigmoid", "Tanh", "GELU",
+         "Silu", "Hardswish", "Hardsigmoid", "Softmax", "ELU"], _count_act)
+    reg(["AvgPool1D", "AvgPool2D", "AvgPool3D", "MaxPool1D", "MaxPool2D",
+         "MaxPool3D"], _count_pool)
+    reg(["AdaptiveAvgPool1D", "AdaptiveAvgPool2D", "AdaptiveAvgPool3D",
+         "AdaptiveMaxPool1D", "AdaptiveMaxPool2D", "AdaptiveMaxPool3D",
+         "Dropout", "Flatten", "Identity"], _count_zero)
+    return table
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Count one forward pass's FLOPs. ``custom_ops`` maps Layer classes to
+    ``fn(layer, input, output) -> int``."""
+    import paddle_tpu as paddle
+
+    table = _default_table()
+    if custom_ops:
+        table.update(custom_ops)
+
+    counts = []  # (name, class, params, flops)
+    handles = []
+
+    def make_hook(name, fn):
+        def hook(layer, inputs, output):
+            inp = inputs[0] if isinstance(inputs, (tuple, list)) else inputs
+            out = output[0] if isinstance(output, (tuple, list)) else output
+            n_params = sum(p.size for p in layer.parameters(
+                include_sublayers=False))
+            counts.append((name, type(layer).__name__, n_params,
+                           int(fn(layer, inp, out))))
+        return hook
+
+    for name, sub in net.named_sublayers(include_self=False):
+        if list(sub.sublayers()):
+            continue  # leaves only
+        fn = table.get(type(sub))
+        if fn is None:
+            for cls, f in table.items():
+                if isinstance(sub, cls):
+                    fn = f
+                    break
+        if fn is None:
+            fn = _count_zero
+        handles.append(sub.register_forward_post_hook(make_hook(name, fn)))
+
+    x = paddle.zeros(list(input_size))
+    training = getattr(net, "training", False)
+    net.eval()
+    try:
+        net(x)
+    finally:
+        if training:
+            net.train()
+        for h in handles:
+            h.remove()
+
+    total = sum(c[3] for c in counts)
+    if print_detail:
+        print(f"{'Layer':<32}{'Type':<20}{'Params':>12}{'FLOPs':>16}")
+        print("-" * 80)
+        for name, cls, p, fl in counts:
+            print(f"{name:<32}{cls:<20}{p:>12,}{fl:>16,}")
+        print("-" * 80)
+        print(f"Total GFLOPs: {total / 1e9:.4f}")
+    return int(total)

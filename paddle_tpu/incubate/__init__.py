@@ -5,3 +5,81 @@ from . import asp  # noqa: F401,E402
 from . import autograd  # noqa: F401,E402
 from . import optimizer  # noqa: F401,E402
 from . import distributed  # noqa: F401,E402
+
+# parity: python/paddle/incubate/__init__.py __all__ — stabilized segment /
+# graph ops re-exported from their graduated homes, plus incubate-only ops
+from .optimizer import LookAhead, ModelAverage  # noqa: E402,F401
+from ..geometric import (  # noqa: E402,F401
+    segment_max, segment_mean, segment_min, segment_sum,
+)
+from ..geometric import (  # noqa: E402
+    reindex_graph as graph_reindex,
+    sample_neighbors as graph_sample_neighbors,
+    send_u_recv as graph_send_recv,
+)
+from .. import inference  # noqa: E402,F401
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """parity: incubate.graph_khop_sampler — multi-hop neighbor sampling:
+    one sample_neighbors pass per hop, frontier = previous hop's nodes."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    from ..geometric import sample_neighbors
+
+    frontier = input_nodes
+    all_edges = []
+    all_counts = []
+    for sz in sample_sizes:
+        nbrs, cnts = sample_neighbors(row, colptr, frontier, sample_size=sz)
+        all_edges.append(nbrs)
+        all_counts.append(cnts)
+        frontier = nbrs
+    import jax.numpy as jnp
+
+    cat = jnp.concatenate([e._value for e in all_edges]) if all_edges else \
+        jnp.zeros((0,), jnp.int64)
+    cnt = jnp.concatenate([c._value for c in all_counts]) if all_counts \
+        else jnp.zeros((0,), jnp.int32)
+    return Tensor(cat), Tensor(cnt)
+
+
+def identity_loss(x, reduction="none"):
+    """parity: incubate.identity_loss — marks x as a loss; reduces it."""
+    from ..ops import math as _m
+
+    if reduction in (0, "sum"):
+        return _m.sum(x)
+    if reduction in (1, "mean"):
+        return _m.mean(x)
+    return x
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """parity: incubate.softmax_mask_fuse — softmax(x + mask) fused by XLA."""
+    import jax
+
+    from ..ops.creation import _t
+    from ..ops.dispatch import apply
+
+    return apply("softmax_mask_fuse",
+                 lambda v, m: jax.nn.softmax(v + m, axis=-1), _t(x), _t(mask))
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """parity: incubate.softmax_mask_fuse_upper_triangle — causal-masked
+    softmax (upper triangle masked out), fused by XLA."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.creation import _t
+    from ..ops.dispatch import apply
+
+    def fn(v):
+        S = v.shape[-1]
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        return jax.nn.softmax(jnp.where(mask, v, -1e30), axis=-1)
+
+    return apply("softmax_mask_fuse_upper_triangle", fn, _t(x))

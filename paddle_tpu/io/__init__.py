@@ -443,3 +443,23 @@ class DataLoader:
 
     def __call__(self):
         return self.__iter__()
+
+
+class SubsetRandomSampler(Sampler):
+    """parity: io/sampler.py SubsetRandomSampler — random order over a fixed
+    index subset."""
+
+    def __init__(self, indices):
+        self.indices = list(indices)
+        if len(self.indices) == 0:
+            raise ValueError(
+                "SubsetRandomSampler: indices must not be empty")
+
+    def __iter__(self):
+        import numpy as _np
+
+        order = _np.random.permutation(len(self.indices))
+        return iter([self.indices[i] for i in order])
+
+    def __len__(self):
+        return len(self.indices)

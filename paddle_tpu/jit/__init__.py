@@ -398,3 +398,16 @@ def load(path, **configs) -> TranslatedLayer:
     params = {k: v._value for k, v in state["params"].items()}
     buffers = {k: v._value for k, v in state["buffers"].items()}
     return TranslatedLayer(exported, params, buffers)
+
+
+# parity: jit/sot debug knobs (python/paddle/jit/__init__.py set_code_level /
+# set_verbosity — utils/envs.py). Here they gate the capture layer's logging.
+_debug = {"code_level": 0, "verbosity": 0}
+
+
+def set_code_level(level=100, also_to_stderr=False):
+    _debug["code_level"] = int(level)
+
+
+def set_verbosity(level=0, also_to_stderr=False):
+    _debug["verbosity"] = int(level)

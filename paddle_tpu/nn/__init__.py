@@ -45,4 +45,7 @@ from .layer.transformer import (  # noqa: F401
     MultiHeadAttention, Transformer, TransformerDecoder,
     TransformerDecoderLayer, TransformerEncoder, TransformerEncoderLayer,
 )
+from .layer.norm import SpectralNorm  # noqa: F401
+from .layer.extras import *  # noqa: F401,F403
+from .layer.extras import BeamSearchDecoder, dynamic_decode  # noqa: F401
 from . import utils  # noqa: F401

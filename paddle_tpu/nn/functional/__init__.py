@@ -306,17 +306,50 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
     return apply("conv3d", fn, *args)
 
 
+def _transpose_out_padding(opname, output_size, n, sp_in, strides, dil,
+                           padding_n, w, opad):
+    """Derive extra output padding from a requested output_size, validated
+    against the reference InferMeta contract: each size must lie in
+    [default, default + stride)."""
+    if isinstance(output_size, int):
+        want = [output_size] * n
+    else:
+        want = [int(s) for s in output_size]
+        if len(want) != n:
+            raise ValueError(
+                f"{opname}: output_size must be an int or {n} values, got "
+                f"{len(want)}")
+    for i in range(n):
+        k = (w.shape[2 + i] - 1) * dil[i] + 1
+        default = ((sp_in[i] - 1) * strides[i] - padding_n[i][0]
+                   - padding_n[i][1] + k)
+        if not default <= want[i] < default + strides[i]:
+            raise ValueError(
+                f"{opname}: output_size[{i}]={want[i]} must be in "
+                f"[{default}, {default + strides[i]}) for this "
+                "input/stride/padding (reference InferMeta contract)")
+        opad[i] = want[i] - default
+
+
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, dilation=1, groups=1,
                      output_size=None, data_format="NCHW", name=None):
     n = 2
     strides = _norm_tuple(stride, n)
     dil = _norm_tuple(dilation, n)
-    opad = _norm_tuple(output_padding, n)
+    opad = list(_norm_tuple(output_padding, n))
     padding_n = _conv_padding(padding, n)
+    if output_size is not None and isinstance(padding_n, str):
+        raise ValueError(
+            "conv2d_transpose: output_size cannot be combined with "
+            "'SAME'/'VALID' padding")
 
     def fn(v, w, *b):
         # weight layout [in_c, out_c/groups, kh, kw] (paddle transpose-conv)
+        if output_size is not None:
+            sp_in = v.shape[2:4] if data_format == "NCHW" else v.shape[1:3]
+            _transpose_out_padding("conv2d_transpose", output_size, n, sp_in,
+                                   strides, dil, padding_n, w, opad)
         if isinstance(padding_n, str):
             pads = padding_n
         else:
@@ -1126,3 +1159,4 @@ def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
     return apply("sequence_mask", fn, _t(lengths))
 
 from .extras import *  # noqa: E402,F401,F403
+from .extras2 import *  # noqa: E402,F401,F403

@@ -1,0 +1,613 @@
+"""Functional long tail #2 — completing nn.functional parity.
+
+Parity targets (reference python/paddle/nn/functional):
+  loss.py      — dice_loss:50, npair_loss (~:380), hsigmoid_loss:926,
+                 soft_margin_loss, multi_margin_loss,
+                 triplet_margin_with_distance_loss, rnnt_loss
+  distance.py  — pairwise_distance
+  flash_attention.py — flash_attn_qkvpacked, flash_attn_varlen_qkvpacked,
+                 flashmask_attention:1299, sparse_attention
+  pooling.py   — adaptive_avg_pool3d, adaptive_max_pool1d,
+                 adaptive_max_pool3d, lp_pool1d
+  common.py    — zeropad2d, feature_alpha_dropout
+  conv.py      — conv1d_transpose
+  activation inplace variants (relu_ etc. — reference inplace API)
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.creation import _t
+from ...ops.dispatch import apply
+
+__all__ = [
+    "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool3d",
+    "conv1d_transpose", "lp_pool1d", "zeropad2d", "feature_alpha_dropout",
+    "dice_loss", "npair_loss", "multi_margin_loss", "soft_margin_loss",
+    "hsigmoid_loss", "triplet_margin_with_distance_loss",
+    "pairwise_distance", "rnnt_loss", "flash_attn_qkvpacked",
+    "flash_attn_varlen_qkvpacked", "flashmask_attention", "sparse_attention",
+    "relu_", "elu_", "hardtanh_", "leaky_relu_", "tanh_", "thresholded_relu_",
+]
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+def _adaptive_bounds(n, o):
+    s = np.floor(np.arange(o) * n / o).astype(int)
+    e = np.ceil((np.arange(o) + 1) * n / o).astype(int)
+    return s, e
+
+
+def _adaptive_nd(x, output_size, nd, reduce):
+    from . import _norm_tuple
+
+    outs = _norm_tuple(output_size, nd)
+
+    def fn(v):
+        # layout [N, C, *spatial]
+        sp = v.shape[2:]
+        red = jnp.mean if reduce == "avg" else jnp.max
+        if all(s % o == 0 for s, o in zip(sp, outs)):
+            shape = [v.shape[0], v.shape[1]]
+            axes = []
+            for i, (s, o) in enumerate(zip(sp, outs)):
+                shape += [o, s // o]
+                axes.append(3 + 2 * i)
+            return red(v.reshape(shape), axis=tuple(axes))
+
+        def rec(vv, dim, idx):
+            if dim == nd:
+                return red(vv[(slice(None), slice(None)) + idx],
+                           axis=tuple(range(2, 2 + nd)))
+            s, e = _adaptive_bounds(sp[dim], outs[dim])
+            return jnp.stack([rec(vv, dim + 1, idx + (slice(s[i], e[i]),))
+                              for i in range(outs[dim])], axis=2)
+
+        return rec(v, 0, ())
+
+    return apply(f"adaptive_{reduce}_pool{nd}d", fn, _t(x))
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    if data_format != "NCDHW":
+        t = _t(x)
+        out = _adaptive_nd(apply("to_ncdhw", lambda v: jnp.moveaxis(v, -1, 1),
+                                 t), output_size, 3, "avg")
+        return apply("to_ndhwc", lambda v: jnp.moveaxis(v, 1, -1), out)
+    return _adaptive_nd(x, output_size, 3, "avg")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_nd(x, output_size, 1, "max")
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_nd(x, output_size, 3, "max")
+    return (out, None) if return_mask else out
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    """parity: lp_pool1d — 1-D Lp pooling via the 2-D kernel on a width-1
+    axis."""
+    from .extras import lp_pool2d
+
+    t = _t(x)
+    x4 = apply("lp1_expand", lambda v: v[:, :, None, :], t)  # NCL → NC1L
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    s = stride if stride is None or isinstance(stride, int) else stride[0]
+    p = padding if isinstance(padding, int) else padding[0]
+    out = lp_pool2d(x4, norm_type, (1, k), (1, s if s is not None else k),
+                    (0, p), ceil_mode=ceil_mode)
+    return apply("lp1_squeeze", lambda v: v[:, :, 0, :], out)
+
+
+# ---------------------------------------------------------------------------
+# padding / dropout
+# ---------------------------------------------------------------------------
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """parity: common.py zeropad2d — [left, right, top, bottom] zero pad."""
+    pl, pr, pt, pb = (padding if isinstance(padding, (list, tuple))
+                      else (padding,) * 4)
+
+    def fn(v):
+        if data_format == "NCHW":
+            pads = ((0, 0), (0, 0), (pt, pb), (pl, pr))
+        else:
+            pads = ((0, 0), (pt, pb), (pl, pr), (0, 0))
+        return jnp.pad(v, pads)
+
+    return apply("zeropad2d", fn, _t(x))
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """parity: common.py feature_alpha_dropout — alpha dropout that drops
+    whole channels (axis 1), preserving self-normalizing statistics."""
+    if not training or p == 0.0:
+        return _t(x)
+    from ...framework.random import next_key
+
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    key = next_key()
+
+    def fn(v):
+        shape = (v.shape[0], v.shape[1]) + (1,) * (v.ndim - 2)
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        a = (1.0 / jnp.sqrt((1 - p) * (1 + p * alpha_p ** 2))).astype(v.dtype)
+        b = -a * alpha_p * p
+        return (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype)
+
+    return apply("feature_alpha_dropout", fn, _t(x))
+
+
+# ---------------------------------------------------------------------------
+# conv
+# ---------------------------------------------------------------------------
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    """parity: conv.py conv1d_transpose — via the 2-D transpose kernel on a
+    height-1 axis."""
+    from . import conv2d_transpose
+
+    t, w = _t(x), _t(weight)
+    chan_last = data_format == "NLC"
+    x4 = apply("c1t_expand",
+               lambda v: (v[:, :, None, :] if not chan_last
+                          else v[:, None, :, :]), t)
+    w4 = apply("c1t_wexpand", lambda v: v[:, :, None, :], w)
+    st = stride if isinstance(stride, int) else stride[0]
+    pd = padding if isinstance(padding, (int, str)) else padding[0]
+    op = output_padding if isinstance(output_padding, int) else \
+        output_padding[0]
+    dl = dilation if isinstance(dilation, int) else dilation[0]
+    osz = None if output_size is None else [
+        1, output_size if isinstance(output_size, int) else output_size[0]]
+    out = conv2d_transpose(
+        x4, w4, bias=bias, stride=(1, st),
+        padding=pd if isinstance(pd, str) else (0, pd),
+        output_padding=(0, op), dilation=(1, dl), groups=groups,
+        output_size=osz,
+        data_format="NCHW" if not chan_last else "NHWC")
+    return apply("c1t_squeeze",
+                 lambda v: (v[:, :, 0, :] if not chan_last else v[:, 0]),
+                 out)
+
+
+# ---------------------------------------------------------------------------
+# losses / distance
+# ---------------------------------------------------------------------------
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):  # noqa: A002
+    """parity: loss.py:50 — 1 - 2·|X∩Y| / (|X|+|Y|), label one-hot over the
+    last dim of input, mean over batch."""
+    t, lb = _t(input), _t(label)
+
+    def fn(v, y):
+        y = jax.nn.one_hot(jnp.squeeze(y, -1), v.shape[-1], dtype=v.dtype)
+        red = tuple(range(1, v.ndim))
+        inse = jnp.sum(v * y, axis=red)
+        denom = jnp.sum(v, axis=red) + jnp.sum(y, axis=red)
+        return jnp.mean(1 - inse * 2 / (denom + epsilon))
+
+    return apply("dice_loss", fn, t, lb)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """parity: loss.py npair_loss — softmax CE over the similarity matrix
+    with soft labels from label equality, plus l2 regularization."""
+    # reference math: celoss = mean(sum(labels * ce_rowwise, 0))
+    def fn2(a, p, y):
+        B = y.shape[0]
+        y = y.reshape(B, 1).astype(jnp.float32)
+        eq = (y == y.T).astype(jnp.float32)
+        soft = eq / jnp.sum(eq, axis=1, keepdims=True)
+        l2 = (jnp.mean(jnp.sum(a * a, 1)) + jnp.mean(jnp.sum(p * p, 1))) \
+            * 0.25 * l2_reg
+        sim = a @ p.T
+        ce = -jnp.sum(soft * jax.nn.log_softmax(sim, axis=-1), axis=-1,
+                      keepdims=True)
+        return l2 + jnp.mean(jnp.sum(soft * ce, axis=0))
+
+    return apply("npair_loss", fn2, _t(anchor), _t(positive), _t(labels))
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,  # noqa: A002
+                      reduction="mean", name=None):
+    """parity: loss.py multi_margin_loss (torch-compatible):
+    mean_j max(0, margin - x_y + x_j)^p / C."""
+    args = [_t(input), _t(label)] + ([_t(weight)] if weight is not None
+                                     else [])
+
+    def fn(v, y, *w):
+        C = v.shape[1]
+        xy = jnp.take_along_axis(v, y[:, None].astype(jnp.int32), axis=1)
+        m = jnp.maximum(0.0, margin - xy + v) ** p
+        if w:
+            m = m * w[0][y][:, None]
+        m = m.at[jnp.arange(v.shape[0]), y].set(0.0)
+        return _reduce(jnp.sum(m, axis=1) / C, reduction)
+
+    return apply("multi_margin_loss", fn, *args)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    """parity: loss.py soft_margin_loss — log(1 + exp(-y·x))."""
+    def fn(v, y):
+        return _reduce(jnp.log1p(jnp.exp(-y.astype(v.dtype) * v)), reduction)
+
+    return apply("soft_margin_loss", fn, _t(input), _t(label))
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """parity: distance.py pairwise_distance — ||x - y + eps||_p over the
+    last dim (p_norm semantics: p=inf → max, p=-inf → min, p=0 → nonzero
+    count)."""
+    def fn(a, b):
+        d = jnp.abs(a - b + epsilon)
+        if np.isinf(p):
+            red = jnp.max if p > 0 else jnp.min
+            return red(d, axis=-1, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((d != 0).astype(a.dtype), axis=-1,
+                           keepdims=keepdim)
+        return jnp.sum(d ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+
+    return apply("pairwise_distance", fn, _t(x), _t(y))
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,  # noqa: A002
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """parity: loss.py triplet_margin_with_distance_loss."""
+    dist = distance_function or pairwise_distance
+    dp = _t(dist(input, positive))
+    dn = _t(dist(input, negative))
+    if swap:
+        dpn = _t(dist(positive, negative))
+        dn = apply("tmwd_swap", lambda a, b: jnp.minimum(a, b), dn, dpn)
+    return apply("tmwd_loss",
+                 lambda a, b: _reduce(jnp.maximum(a - b + margin, 0.0),
+                                      reduction), dp, dn)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,  # noqa: A002
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """parity: loss.py:926 hsigmoid_loss. Default tree = the reference's
+    SimpleCode (funcs/matrix_bit_code.h:100): class c encodes as
+    c + num_classes; node index per bit = (code >> (bit+1)) - 1, binary
+    target = bit of code. Custom trees via path_table/path_code. Loss is
+    summed BCE-with-logits over the path."""
+    t, lb = _t(input), _t(label)
+    w = _t(weight)
+    b = _t(bias) if bias is not None else None
+    yv = np.asarray(lb._value).reshape(-1).astype(np.int64)
+    N = yv.shape[0]
+
+    if path_table is not None:
+        pt = np.asarray(_t(path_table)._value).astype(np.int64)
+        pc = np.asarray(_t(path_code)._value).astype(np.float64)
+        valid = pt >= 0
+        nodes = np.where(valid, pt, 0)
+        bits = np.where(valid, pc, 0.0)
+    else:
+        codes = yv + num_classes
+        L = int(np.floor(np.log2(codes.max()))) if N else 0
+        nodes = np.zeros((N, L), np.int64)
+        bits = np.zeros((N, L), np.float64)
+        valid = np.zeros((N, L), bool)
+        for i, c in enumerate(codes):
+            ln = int(np.floor(np.log2(c)))
+            for j in range(ln):
+                nodes[i, j] = (c >> (j + 1)) - 1
+                bits[i, j] = float((c >> j) & 1)
+                valid[i, j] = True
+
+    nodes_j = jnp.asarray(nodes)
+    bits_j = jnp.asarray(bits.astype(np.float32))
+    valid_j = jnp.asarray(valid)
+
+    def fn(v, wv, *bv):
+        wn = wv[nodes_j]                     # [N, L, D]
+        pre = jnp.einsum("nd,nld->nl", v, wn)
+        if bv:
+            pre = pre + bv[0].reshape(-1)[nodes_j]
+        # BCE with logits, target = bit
+        loss = jax.nn.softplus(pre) - bits_j * pre
+        loss = jnp.where(valid_j, loss, 0.0)
+        return jnp.sum(loss, axis=1, keepdims=True)
+
+    args = [t, w] + ([b] if b is not None else [])
+    return apply("hsigmoid_loss", fn, *args)
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths,  # noqa: A002
+              blank=0, fastemit_lambda=0.001, reduction="mean", name=None):
+    """parity: loss.py rnnt_loss (warprnnt semantics). input: [B, T, U+1, V]
+    log-domain-able acts; label: [B, U]. Forward-variable DP in log space;
+    FastEmit regularization boosts the label-transition gradient by
+    (1 + lambda) (loss value unchanged), matching warprnnt's implementation.
+    """
+    t = _t(input)
+    lb = _t(label)
+    il = np.asarray(_t(input_lengths)._value).astype(np.int32)
+    ll = np.asarray(_t(label_lengths)._value).astype(np.int32)
+
+    def fn(acts, labels):
+        B, T, U1, V = acts.shape
+        U = U1 - 1
+        il_j = jnp.asarray(il)
+        ll_j = jnp.asarray(ll)
+        lp = jax.nn.log_softmax(acts.astype(jnp.float32), axis=-1)
+        blank_lp = lp[..., blank]                                # [B, T, U+1]
+        lab = labels.astype(jnp.int32)                            # [B, U]
+        label_lp = jnp.take_along_axis(
+            lp[:, :, :U, :], lab[:, None, :, None], axis=-1)[..., 0]
+        # FastEmit: gradient-only (1+λ) boost on label transitions
+        if fastemit_lambda:
+            label_lp = label_lp + fastemit_lambda * (
+                label_lp - jax.lax.stop_gradient(label_lp))
+        NEG = jnp.float32(-1e30)
+        umask = jnp.arange(U1)[None, :] <= ll_j[:, None]          # [B, U+1]
+
+        # alpha recursion (alpha[t,u] = logaddexp(alpha[t-1,u]+blank[t-1,u],
+        #                                         alpha[t,u-1]+label[t,u-1]))
+        def step(alpha, xs):
+            blank_prev, label_cur, t_idx = xs   # blank at t-1, label at t
+            from_blank = alpha + blank_prev
+
+            def umove(carry, uu):
+                cur = jnp.logaddexp(from_blank[:, uu],
+                                    carry + label_cur[:, uu - 1])
+                return cur, cur
+
+            first = from_blank[:, 0]
+            _, rest = jax.lax.scan(umove, first, jnp.arange(1, U1))
+            new = jnp.concatenate([first[:, None],
+                                   jnp.moveaxis(rest, 0, 1)], axis=1)
+            new = jnp.where(umask, new, NEG)
+            new = jnp.where(t_idx < il_j[:, None], new, alpha)
+            return new, None
+
+        # t=0 row: alpha[0,u] = prefix sum of label transitions at t=0
+        def u0(carry, uu):
+            cur = carry + label_lp[:, 0, uu - 1]
+            return cur, cur
+
+        z = jnp.zeros((B,), jnp.float32)
+        _, rest0 = jax.lax.scan(u0, z, jnp.arange(1, U1))
+        alpha0 = jnp.concatenate([z[:, None], jnp.moveaxis(rest0, 0, 1)], 1)
+        alpha0 = jnp.where(umask, alpha0, NEG)
+
+        alphaT, _ = jax.lax.scan(
+            step, alpha0,
+            (jnp.moveaxis(blank_lp, 1, 0)[:-1],    # blank at t-1
+             jnp.moveaxis(label_lp, 1, 0)[1:],     # label at t
+             jnp.arange(1, T)))
+        # total log-prob: alpha[T-1, U] + blank emission at (T-1, U)
+        t_last = (il_j - 1).astype(jnp.int32)
+        u_last = ll_j.astype(jnp.int32)
+        aTU = alphaT[jnp.arange(B), u_last]
+        final_blank = blank_lp[jnp.arange(B), t_last, u_last]
+        nll = -(aTU + final_blank)
+        return _reduce(nll, reduction)
+
+    return apply("rnnt_loss", fn, t, lb)
+
+
+# ---------------------------------------------------------------------------
+# attention variants
+# ---------------------------------------------------------------------------
+def flash_attn_qkvpacked(qkv, fixed_seed_offset=None, dropout=0.0,
+                         causal=False, return_softmax=False, training=True,
+                         name=None):
+    """parity: flash_attention.py flash_attn_qkvpacked — qkv packed
+    [B, S, 3, H, D]."""
+    from . import flash_attention
+
+    t = _t(qkv)
+    q = apply("qkv_q", lambda v: v[:, :, 0], t)
+    k = apply("qkv_k", lambda v: v[:, :, 1], t)
+    v = apply("qkv_v", lambda v_: v_[:, :, 2], t)
+    return flash_attention(q, k, v, dropout=dropout, causal=causal,
+                           return_softmax=return_softmax, training=training)
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q, max_seqlen_k, scale=None,
+                                dropout=0.0, causal=False,
+                                return_softmax=False, training=True,
+                                name=None):
+    """parity: flash_attention.py flash_attn_varlen_qkvpacked — packed
+    ragged batch [total_tokens, 3, H, D] with cu_seqlens boundaries;
+    segment-masked attention over the flattened token axis."""
+    t = _t(qkv)
+    cq = np.asarray(_t(cu_seqlens_q)._value).astype(np.int32)
+
+    def fn(pk):
+        total, _, H, D = pk.shape
+        q, k, v = pk[:, 0], pk[:, 1], pk[:, 2]
+        seg = np.zeros((total,), np.int32)
+        for i in range(len(cq) - 1):
+            seg[cq[i]:cq[i + 1]] = i
+        seg_j = jnp.asarray(seg)
+        sc = scale if scale is not None else 1.0 / np.sqrt(D)
+        scores = jnp.einsum("qhd,khd->hqk", q, k) * sc
+        mask = seg_j[:, None] == seg_j[None, :]
+        if causal:
+            pos = jnp.arange(total)
+            mask = mask & (pos[None, :] <= pos[:, None])
+        scores = jnp.where(mask[None], scores, -1e30)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(q.dtype)
+        out = jnp.einsum("hqk,khd->qhd", probs, v)
+        return (out, probs) if return_softmax else out
+
+    if return_softmax:
+        out, probs = apply("flash_attn_varlen_qkvpacked", fn, t)
+        return out, probs
+    return apply("flash_attn_varlen_qkvpacked", fn, t), None
+
+
+def flashmask_attention(query, key, value, startend_row_indices=None,
+                        dropout=0.0, causal=False, window_size=None,
+                        return_softmax_lse=False, return_seed_offset=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """parity: flash_attention.py:1299 flashmask_attention — column-wise
+    sparse mask given as per-key row indices; [B, S, H, D] layout, GQA
+    supported. The Mask semantics follow the reference docstring exactly
+    (LT = lower-triangle start/end, UT = upper-triangle start/end)."""
+    q, k, v = _t(query), _t(key), _t(value)
+    sri = _t(startend_row_indices) if startend_row_indices is not None \
+        else None
+
+    def fn(qv, kv, vv, *rest):
+        B, S, H, D = qv.shape
+        Sk, Hk = kv.shape[1], kv.shape[2]
+        if Hk != H:  # GQA
+            rep = H // Hk
+            kv = jnp.repeat(kv, rep, axis=2)
+            vv = jnp.repeat(vv, rep, axis=2)
+        rows = jnp.arange(S)[:, None]      # query index i
+        cols = jnp.arange(Sk)[None, :]     # key index j
+        allow = jnp.ones((1, 1, S, Sk), bool)
+        if causal:
+            allow = allow & (cols <= rows)
+        if window_size is not None:
+            wl, wr = (window_size if isinstance(window_size, (tuple, list))
+                      else (window_size, window_size))
+            allow = allow & (cols >= rows - wl)
+            if not causal:
+                allow = allow & (cols <= rows + wr)
+        if rest:
+            m = rest[0].astype(jnp.int32)   # [B, Hk_m, Sk, {1,2,4}]
+            nM = m.shape[-1]
+            # broadcast mask heads to attention heads
+            if m.shape[1] == 1:
+                m = jnp.broadcast_to(m, (B, 1, Sk, nM))
+            # per (b, h, j): queries i in [start, end) are masked (LT);
+            # UT masks i in [ut_start, ut_end)
+            i = rows[None, None]            # [1,1,S,1]
+            j = cols[None, None]            # [1,1,1,Sk]
+            lt_start = m[..., 0][:, :, None, :]     # [B,Hm,1,Sk]
+            if causal:
+                lt_end = (m[..., 1][:, :, None, :] if nM == 2
+                          else jnp.full_like(lt_start, S))
+                masked = (i >= lt_start) & (i < lt_end)
+            else:
+                if nM == 2:
+                    lt_end = jnp.full_like(lt_start, S)
+                    ut_start = jnp.zeros_like(lt_start)
+                    ut_end = m[..., 1][:, :, None, :]
+                else:
+                    lt_end = m[..., 1][:, :, None, :]
+                    ut_start = m[..., 2][:, :, None, :]
+                    ut_end = m[..., 3][:, :, None, :]
+                masked = (((i >= lt_start) & (i < lt_end) & (j < i))
+                          | ((i >= ut_start) & (i < ut_end) & (j > i)))
+            allow = allow & ~masked
+        scale = 1.0 / np.sqrt(D)
+        qt = jnp.einsum("bshd->bhsd", qv)
+        kt = jnp.einsum("bshd->bhsd", kv)
+        vt = jnp.einsum("bshd->bhsd", vv)
+        scores = jnp.einsum("bhsd,bhtd->bhst", qt, kt) * scale
+        scores = jnp.where(allow, scores, -1e30)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(
+            qv.dtype)
+        out = jnp.einsum("bhst,bhtd->bhsd", probs, vt)
+        return jnp.einsum("bhsd->bshd", out)
+
+    args = [q, k, v] + ([sri] if sri is not None else [])
+    return apply("flashmask_attention", fn, *args)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """parity: ops.yaml sparse_attention — block-sparse attention with a
+    per-row CSR pattern; [B, H, S, D] layout. Computed as dense attention
+    under the CSR-induced mask (XLA fuses; the reference's CUDA kernel is a
+    gather-based SDD/ DSD pipeline)."""
+    q, k, v = _t(query), _t(key), _t(value)
+    off = np.asarray(_t(sparse_csr_offset)._value).astype(np.int64)
+    cols = np.asarray(_t(sparse_csr_columns)._value).astype(np.int64)
+
+    def fn(qv, kv, vv, *rest):
+        B, H, S, D = qv.shape
+        mask = np.zeros((B, H, S, S), bool)
+        for b in range(B):
+            for h in range(H):
+                o = off[b, h]
+                c = cols[b, h]
+                for r in range(S):
+                    mask[b, h, r, c[o[r]:o[r + 1]]] = True
+        mj = jnp.asarray(mask)
+        scores = jnp.einsum("bhsd,bhtd->bhst", qv, kv) / np.sqrt(D)
+        idx = 0
+        if key_padding_mask is not None:
+            kpm = rest[idx]
+            idx += 1
+            mj = mj & (kpm[:, None, None, :] > 0)
+        if attn_mask is not None:
+            am = rest[idx]
+            scores = scores + am[:, None]
+        scores = jnp.where(mj, scores, -1e30)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(
+            qv.dtype)
+        return jnp.einsum("bhst,bhtd->bhsd", probs, vv)
+
+    args = [q, k, v]
+    if key_padding_mask is not None:
+        args.append(_t(key_padding_mask))
+    if attn_mask is not None:
+        args.append(_t(attn_mask))
+    return apply("sparse_attention", fn, *args)
+
+
+# ---------------------------------------------------------------------------
+# inplace activations (reference inplace functional API)
+# ---------------------------------------------------------------------------
+def relu_(x, name=None):
+    from . import relu
+    return x._adopt(relu(x))
+
+
+def elu_(x, alpha=1.0, name=None):
+    from . import elu
+    return x._adopt(elu(x, alpha))
+
+
+def hardtanh_(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    from . import hardtanh
+    return x._adopt(hardtanh(x, min, max))
+
+
+def leaky_relu_(x, negative_slope=0.01, name=None):
+    from . import leaky_relu
+    return x._adopt(leaky_relu(x, negative_slope))
+
+
+def tanh_(x, name=None):
+    from ...ops.math import tanh
+    return x._adopt(tanh(x))
+
+
+def thresholded_relu_(x, threshold=1.0, value=0.0, name=None):
+    from .extras import thresholded_relu
+    return x._adopt(thresholded_relu(x, threshold, value))

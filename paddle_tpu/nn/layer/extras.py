@@ -1,0 +1,586 @@
+"""Layer long tail — completing paddle.nn class parity.
+
+Parity: python/paddle/nn/__init__.py class surface. Each class is a thin
+stateful wrapper over the functional op (the reference pattern:
+nn/layer/pooling.py, nn/layer/loss.py), except AdaptiveLogSoftmaxWithLoss /
+SpectralNorm-style layers that own parameters, and
+BeamSearchDecoder/dynamic_decode (nn/decode.py) which implement seq2seq
+beam search over an RNN cell.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import functional as F
+from .layers import Layer
+
+__all__ = [
+    "AdaptiveAvgPool3D", "AdaptiveMaxPool1D", "AdaptiveMaxPool3D",
+    "LPPool1D", "LPPool2D", "FractionalMaxPool2D", "FractionalMaxPool3D",
+    "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D", "Conv1DTranspose",
+    "Conv3DTranspose", "ChannelShuffle", "Fold", "PixelUnshuffle",
+    "Unflatten", "ZeroPad1D", "ZeroPad3D", "PairwiseDistance", "Softmax2D",
+    "FeatureAlphaDropout", "ThresholdedReLU", "CTCLoss", "RNNTLoss",
+    "GaussianNLLLoss", "HSigmoidLoss", "MultiLabelSoftMarginLoss",
+    "MultiMarginLoss", "PoissonNLLLoss", "SoftMarginLoss",
+    "TripletMarginWithDistanceLoss", "AdaptiveLogSoftmaxWithLoss",
+    "ParameterDict", "BeamSearchDecoder", "dynamic_decode",
+]
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+class AdaptiveAvgPool3D(Layer):
+    def __init__(self, output_size, data_format="NCDHW", name=None):
+        super().__init__()
+        self._o, self._df = output_size, data_format
+
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, self._o, self._df)
+
+
+class AdaptiveMaxPool1D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self._o, self._rm = output_size, return_mask
+
+    def forward(self, x):
+        return F.adaptive_max_pool1d(x, self._o, self._rm)
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self._o, self._rm = output_size, return_mask
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, self._o, self._rm)
+
+
+class LPPool1D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL", name=None):
+        super().__init__()
+        self._a = (norm_type, kernel_size, stride, padding, ceil_mode,
+                   data_format)
+
+    def forward(self, x):
+        return F.lp_pool1d(x, *self._a)
+
+
+class LPPool2D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__()
+        self._a = (norm_type, kernel_size, stride, padding, ceil_mode,
+                   data_format)
+
+    def forward(self, x):
+        return F.lp_pool2d(x, *self._a)
+
+
+class FractionalMaxPool2D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self._a = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        return F.fractional_max_pool2d(x, *self._a)
+
+
+class FractionalMaxPool3D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self._a = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        return F.fractional_max_pool3d(x, *self._a)
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCL",
+                 output_size=None, name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, osz = self._a
+        return F.max_unpool1d(x, indices, k, s, p, data_format=df,
+                              output_size=osz)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, osz = self._a
+        return F.max_unpool2d(x, indices, k, s, p, data_format=df,
+                              output_size=osz)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, osz = self._a
+        return F.max_unpool3d(x, indices, k, s, p, data_format=df,
+                              output_size=osz)
+
+
+# ---------------------------------------------------------------------------
+# conv transpose layers
+# ---------------------------------------------------------------------------
+class _ConvTransposeNd(Layer):
+    _nd = 1
+    _fn = staticmethod(F.conv1d_transpose)
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format=None,
+                 name=None):
+        super().__init__()
+        ks = (kernel_size if isinstance(kernel_size, (list, tuple))
+              else (kernel_size,) * self._nd)
+        self._stride, self._padding = stride, padding
+        self._output_padding, self._groups = output_padding, groups
+        self._dilation = dilation
+        self._data_format = data_format
+        # paddle transpose-conv weight layout: [in_c, out_c/groups, *k]
+        self.weight = self.create_parameter(
+            [in_channels, out_channels // groups, *ks], attr=weight_attr)
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter([out_channels], attr=bias_attr,
+                                              is_bias=True)
+
+    def forward(self, x, output_size=None):
+        return self._fn(x, self.weight, bias=self.bias, stride=self._stride,
+                        padding=self._padding,
+                        output_padding=self._output_padding,
+                        groups=self._groups, dilation=self._dilation,
+                        output_size=output_size,
+                        data_format=self._data_format)
+
+
+class Conv1DTranspose(_ConvTransposeNd):
+    _nd = 1
+    _fn = staticmethod(F.conv1d_transpose)
+
+    def __init__(self, *args, data_format="NCL", **kw):
+        super().__init__(*args, data_format=data_format, **kw)
+
+
+class Conv3DTranspose(_ConvTransposeNd):
+    _nd = 3
+    _fn = staticmethod(F.conv3d_transpose)
+
+    def __init__(self, *args, data_format="NCDHW", **kw):
+        super().__init__(*args, data_format=data_format, **kw)
+
+
+# ---------------------------------------------------------------------------
+# shape / misc
+# ---------------------------------------------------------------------------
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self._g, self._df = groups, data_format
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self._g, self._df)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self._a = (output_sizes, kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        return F.fold(x, *self._a)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self._r, self._df = downscale_factor, data_format
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self._r, self._df)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self._axis, self._shape = axis, shape
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        return paddle.unflatten(x, self._axis, self._shape)
+
+
+class ZeroPad1D(Layer):
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__()
+        self._p, self._df = padding, data_format
+
+    def forward(self, x):
+        from ...ops.manipulation import pad as pad_fn
+        p = (self._p if isinstance(self._p, (list, tuple))
+             else (self._p,) * 2)
+        return pad_fn(x, list(p), mode="constant", value=0.0,
+                      data_format=self._df)
+
+
+class ZeroPad3D(Layer):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__()
+        self._p, self._df = padding, data_format
+
+    def forward(self, x):
+        from ...ops.manipulation import pad as pad_fn
+        p = (self._p if isinstance(self._p, (list, tuple))
+             else (self._p,) * 6)
+        return pad_fn(x, list(p), mode="constant", value=0.0,
+                      data_format=self._df)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self._a = (p, epsilon, keepdim)
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, *self._a)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel axis of NCHW input (reference
+    nn/layer/activation.py Softmax2D)."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
+
+
+class FeatureAlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, self._p, self.training)
+
+
+class ThresholdedReLU(Layer):
+    def __init__(self, threshold=1.0, value=0.0, name=None):
+        super().__init__()
+        self._t, self._v = threshold, value
+
+    def forward(self, x):
+        return F.thresholded_relu(x, self._t, self._v)
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+class CTCLoss(Layer):
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self._blank, self._reduction = blank, reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          blank=self._blank, reduction=self._reduction,
+                          norm_by_times=norm_by_times)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._a = (blank, fastemit_lambda, reduction)
+
+    def forward(self, input, label, input_lengths, label_lengths):  # noqa: A002
+        b, fe, red = self._a
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           blank=b, fastemit_lambda=fe, reduction=red)
+
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean", name=None):
+        super().__init__()
+        self._a = (full, epsilon, reduction)
+
+    def forward(self, input, label, variance):  # noqa: A002
+        return F.gaussian_nll_loss(input, label, variance, *self._a)
+
+
+class HSigmoidLoss(Layer):
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        self._num_classes = num_classes
+        self._is_custom = is_custom
+        self.weight = self.create_parameter(
+            [num_classes - 1 if not is_custom else num_classes,
+             feature_size], attr=weight_attr)
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                [num_classes - 1 if not is_custom else num_classes, 1],
+                attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):  # noqa: A002
+        return F.hsigmoid_loss(input, label, self._num_classes, self.weight,
+                               self.bias, path_table=path_table,
+                               path_code=path_code)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self._w, self._r = weight, reduction
+
+    def forward(self, input, label):  # noqa: A002
+        return F.multi_label_soft_margin_loss(input, label, self._w, self._r)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._a = (p, margin, weight, reduction)
+
+    def forward(self, input, label):  # noqa: A002
+        return F.multi_margin_loss(input, label, *self._a)
+
+
+class PoissonNLLLoss(Layer):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._a = (log_input, full, epsilon, reduction)
+
+    def forward(self, input, label):  # noqa: A002
+        return F.poisson_nll_loss(input, label, *self._a)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self._r = reduction
+
+    def forward(self, input, label):  # noqa: A002
+        return F.soft_margin_loss(input, label, self._r)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._a = (distance_function, margin, swap, reduction)
+
+    def forward(self, input, positive, negative):  # noqa: A002
+        return F.triplet_margin_with_distance_loss(input, positive, negative,
+                                                   *self._a)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """parity: nn/layer/loss.py AdaptiveLogSoftmaxWithLoss — owns the head
+    and per-cluster tail projections (cluster i projected to
+    in_features/div_value**(i+1) dims)."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        self._cutoffs = list(cutoffs) + [n_classes]
+        n_clusters = len(self._cutoffs) - 1
+        shortlist = self._cutoffs[0]
+        self.head_weight = self.create_parameter(
+            [in_features, shortlist + n_clusters])
+        self.head_bias = (self.create_parameter(
+            [shortlist + n_clusters], is_bias=True) if head_bias else None)
+        self.tail_weights = []
+        for i in range(n_clusters):
+            hsz = max(1, int(in_features / (div_value ** (i + 1))))
+            csz = self._cutoffs[i + 1] - self._cutoffs[i]
+            proj = self.create_parameter([in_features, hsz])
+            out = self.create_parameter([hsz, csz])
+            self.add_parameter(f"tail_{i}_proj", proj)
+            self.add_parameter(f"tail_{i}_out", out)
+            self.tail_weights.append([proj, out])
+
+    def forward(self, input, label):  # noqa: A002
+        return F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.tail_weights,
+            self._cutoffs[:-1], head_bias=self.head_bias)
+
+
+# ---------------------------------------------------------------------------
+# containers
+# ---------------------------------------------------------------------------
+class ParameterDict(Layer):
+    """parity: nn ParameterDict container (keyed parameter storage)."""
+
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters is not None:
+            items = (parameters.items()
+                     if isinstance(parameters, dict) else parameters)
+            for k, v in items:
+                self.add_parameter(str(k), v)
+
+    def __getitem__(self, key):
+        return self._parameters[str(key)]
+
+    def __setitem__(self, key, value):
+        self.add_parameter(str(key), value)
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters)
+
+    def keys(self):
+        return self._parameters.keys()
+
+    def values(self):
+        return self._parameters.values()
+
+    def items(self):
+        return self._parameters.items()
+
+    def update(self, parameters):
+        items = (parameters.items()
+                 if isinstance(parameters, dict) else parameters)
+        for k, v in items:
+            self.add_parameter(str(k), v)
+
+
+# ---------------------------------------------------------------------------
+# seq2seq decoding (parity: python/paddle/nn/decode.py)
+# ---------------------------------------------------------------------------
+class BeamSearchDecoder:
+    """parity: nn/decode.py BeamSearchDecoder — beam search over an RNN
+    cell. The cell maps (input [B, E], state) -> (output [B, H], state); an
+    output_fn (or the embedding weight) projects outputs to vocab logits."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=32, batch_size=1,
+                   **kwargs):
+    """parity: nn/decode.py dynamic_decode — host-loop beam search; returns
+    (token ids [B, beam, T], per-beam scores [B, beam])."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+
+    bs = decoder.beam_size
+    B = batch_size
+
+    def logits_of(cell_out):
+        out = (decoder.output_fn(cell_out) if decoder.output_fn is not None
+               else cell_out)
+        return np.asarray(out._value if hasattr(out, "_value") else out)
+
+    # flatten beams into the batch axis: rows [B*beam]
+    tokens = np.full((B, bs, 0), decoder.end_token, np.int64)
+    scores = np.full((B, bs), -np.inf, np.float64)
+    scores[:, 0] = 0.0  # all beams start identical; keep one live
+    cur_tok = np.full((B, bs), decoder.start_token, np.int64)
+    finished = np.zeros((B, bs), bool)
+    states = [inits] * bs
+
+    for _ in range(max_step_num):
+        all_lp = []
+        new_states = []
+        for b in range(bs):
+            inp = paddle.to_tensor(cur_tok[:, b].astype(np.int64))
+            if decoder.embedding_fn is not None:
+                inp = decoder.embedding_fn(inp)
+            out, st = decoder.cell(inp, states[b])
+            new_states.append(st)
+            lp = logits_of(out)
+            m = lp.max(-1, keepdims=True)   # stable log_softmax
+            lp = lp - m - np.log(np.exp(lp - m).sum(-1, keepdims=True))
+            all_lp.append(lp)
+        V = all_lp[0].shape[-1]
+        cand = np.stack(all_lp, 1)          # [B, beam, V]
+        # finished beams only extend with end_token at zero cost
+        cand = np.where(finished[:, :, None], -np.inf, cand)
+        end_col = np.where(finished, 0.0, -np.inf)
+        total = scores[:, :, None] + cand   # [B, beam, V]
+        flat = np.concatenate(
+            [total.reshape(B, -1), (scores + end_col).reshape(B, -1)], 1)
+        top = np.argsort(-flat, axis=1)[:, :bs]
+        new_scores = np.take_along_axis(flat, top, 1)
+        is_hold = top >= bs * V             # finished-beam hold entries
+        beam_idx = np.where(is_hold, top - bs * V, top // V)
+        tok_idx = np.where(is_hold, decoder.end_token, top % V)
+        tokens = np.concatenate(
+            [tokens[np.arange(B)[:, None], beam_idx],
+             tok_idx[:, :, None]], axis=2)
+        finished = np.take_along_axis(finished, beam_idx, 1) | (
+            tok_idx == decoder.end_token)
+        cur_tok = tok_idx
+        scores = new_scores
+        # reorder states (host-side gather per beam)
+        states = [_gather_state(new_states, beam_idx[:, b], B)
+                  for b in range(bs)]
+        if finished.all():
+            break
+
+    ids = paddle.to_tensor(tokens.astype(np.int64))
+    sc = paddle.to_tensor(scores.astype(np.float32))
+    return ids, sc
+
+
+def _gather_state(states_per_beam, beam_of_row, B):
+    """Pick, for each batch row, the state of its source beam."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from ...core.tensor import Tensor
+
+    def pick(leaf_list):
+        rows = []
+        for r in range(B):
+            v = leaf_list[int(beam_of_row[r])]
+            rows.append(v[r] if v.ndim > 0 else v)
+        return jnp.stack(rows, 0)
+
+    s0 = states_per_beam[0]
+    if s0 is None:
+        return None
+    if isinstance(s0, (tuple, list)):
+        out = []
+        for i in range(len(s0)):
+            leaves = [(_t_state(s[i])) for s in states_per_beam]
+            out.append(Tensor(pick(leaves)))
+        return type(s0)(out)
+    leaves = [_t_state(s) for s in states_per_beam]
+    return Tensor(pick(leaves))
+
+
+def _t_state(s):
+    return s._value if hasattr(s, "_value") else s

@@ -6,7 +6,7 @@ attaches the Tensor method / operator surface
 """
 from __future__ import annotations
 
-from . import creation, indexing, linalg, logic, manipulation, math, random, search, stat
+from . import compat, creation, indexing, linalg, logic, manipulation, math, random, search, stat
 from .creation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
@@ -16,6 +16,7 @@ from .math_ext import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
 from .stat import *  # noqa: F401,F403
+from .compat import *  # noqa: F401,F403
 from . import registry
 from ..core.tensor import Tensor
 
@@ -144,10 +145,107 @@ def _make_inplace(fname):
     return method
 
 
-for _m in ["add", "subtract", "multiply", "divide", "clip", "scale", "exp",
-           "sqrt", "rsqrt", "reciprocal", "floor", "ceil", "round", "tanh",
-           "cast", "pow", "lerp", "remainder", "mod"]:
-    if not hasattr(Tensor, _m + "_"):
+_INPLACE_BASES = [
+    "add", "subtract", "multiply", "divide", "clip", "scale", "exp",
+    "sqrt", "rsqrt", "reciprocal", "floor", "ceil", "round", "tanh",
+    "cast", "pow", "lerp", "remainder", "mod",
+    # reference inplace api surface (python/paddle/__init__.py *_ exports)
+    "abs", "acos", "addmm", "asin", "atan", "bitwise_and", "bitwise_invert",
+    "bitwise_left_shift", "bitwise_not", "bitwise_or", "bitwise_right_shift",
+    "bitwise_xor", "copysign", "cos", "cosh", "cumprod", "cumsum", "digamma",
+    "equal", "erf", "expm1", "floor_divide", "floor_mod", "frac", "gammainc",
+    "gammaincc", "gammaln", "gcd", "greater_equal", "greater_than", "hypot",
+    "i0", "index_add", "index_fill", "index_put", "lcm", "ldexp", "less",
+    "less_equal", "less_than", "lgamma", "log", "log10", "log2",
+    "logical_and", "logical_not", "logical_or", "logical_xor", "logit",
+    "flatten", "masked_scatter", "multigammaln", "nan_to_num", "neg",
+    "not_equal",
+    "polygamma", "renorm", "sgn", "sigmoid", "sin", "sinc", "sinh", "square",
+    "t", "tan", "transpose", "tril", "triu", "trunc",
+]
+for _m in _INPLACE_BASES:
+    if _m in _ns and not hasattr(Tensor, _m + "_"):
         setattr(Tensor, _m + "_", _make_inplace(_m))
+
+
+# top-level inplace functions: paddle.sin_(x) == x.sin_()
+def _make_top_inplace(fname):
+    def f(x, *args, **kwargs):
+        return getattr(x, fname + "_")(*args, **kwargs)
+
+    f.__name__ = fname + "_"
+    f.__doc__ = f"Inplace version of paddle.{fname} (reference inplace API)."
+    return f
+
+
+for _m in _INPLACE_BASES:
+    if hasattr(Tensor, _m + "_") and (_m + "_") not in _ns:
+        _ns[_m + "_"] = _make_top_inplace(_m)
+
+# inplace random fills + where_ (reference: tensor/creation.py cauchy_:3208,
+# geometric_:3247, random.py log_normal_:409, search.py where_:860)
+def cauchy_(x, loc=0, scale=1, name=None):
+    """Fill x with Cauchy(loc, scale) samples (inplace)."""
+    from ..framework.random import next_key
+    from .dispatch import apply as _apply
+    import jax as _jx
+    import jax.numpy as _jnp
+
+    key = next_key()
+
+    def fn(v):
+        u = _jx.random.uniform(key, v.shape, _jnp.float32)
+        return (loc + scale * _jnp.tan(_jnp.pi * (u - 0.5))).astype(v.dtype)
+
+    return x._adopt(_apply("cauchy", fn, x))
+
+
+def geometric_(x, probs, name=None):
+    """Fill x with continuous log(u)/log1p(-p) values — the reference's
+    geometric_ (tensor/creation.py:3247) applies no floor/+1; its docstring
+    samples are fractional."""
+    from ..framework.random import next_key
+    from .dispatch import apply as _apply
+    import jax as _jx
+    import jax.numpy as _jnp
+
+    key = next_key()
+
+    def fn(v):
+        u = _jx.random.uniform(key, v.shape, _jnp.float32,
+                               minval=1e-7, maxval=1.0)
+        k = _jnp.log(u) / _jnp.log1p(-_jnp.float32(probs))
+        return k.astype(v.dtype)
+
+    return x._adopt(_apply("geometric", fn, x))
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    """Fill x with exp(Normal(mean, std)) samples (inplace)."""
+    from ..framework.random import next_key
+    from .dispatch import apply as _apply
+    import jax as _jx
+    import jax.numpy as _jnp
+
+    key = next_key()
+
+    def fn(v):
+        z = _jx.random.normal(key, v.shape, _jnp.float32)
+        return _jnp.exp(mean + std * z).astype(v.dtype)
+
+    return x._adopt(_apply("log_normal", fn, x))
+
+
+def where_(condition, x=None, y=None, name=None):
+    """Inplace where: writes the select result into x and returns it."""
+    if x is None or y is None:
+        raise ValueError("where_: both x and y must be given")
+    return x._adopt(manipulation.where(condition, x, y))
+
+
+Tensor.cauchy_ = cauchy_
+Tensor.geometric_ = geometric_
+Tensor.log_normal_ = log_normal_
+Tensor.where_ = where_
 
 _C_ops = registry.build_c_ops_namespace()

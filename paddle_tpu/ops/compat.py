@@ -1,0 +1,335 @@
+"""Top-level API long tail — ops completing paddle.* surface parity.
+
+Parity targets (reference python/paddle):
+  tensor/math.py       — take:6830, combinations:8117, isin:8476,
+                         cartesian_prod:8666, sgn:6770, positive:5636,
+                         signbit:8188
+  tensor/manipulation.py — unflatten:6997, diagonal_scatter:7375,
+                         select_scatter:7431, slice_scatter:7539,
+                         block_diag:7651
+  tensor/linalg.py     — matrix_transpose:191, vecdot:1880,
+                         histogram_bin_edges:2610, histogramdd:5448
+  tensor/random.py     — standard_gamma:295
+  tensor/math.py gammainc/gammaincc — regularized incomplete gamma
+"""
+from __future__ import annotations
+
+import itertools
+import math as _math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .creation import _t
+from .dispatch import apply
+
+__all__ = [
+    "add_n", "take", "isin", "combinations", "cartesian_prod", "block_diag",
+    "unflatten", "select_scatter", "slice_scatter", "diagonal_scatter",
+    "vecdot", "matrix_transpose", "histogram_bin_edges", "histogramdd",
+    "standard_gamma", "sgn", "positive", "signbit", "less",
+    "bitwise_invert", "gammainc", "gammaincc", "reverse", "rank", "shape",
+    "tolist", "view_as", "pi", "e", "inf", "nan", "newaxis",
+]
+
+# numeric constants (reference: paddle.pi etc. — python/paddle/__init__.py)
+pi = _math.pi
+e = _math.e
+inf = float("inf")
+nan = float("nan")
+newaxis = None
+
+
+def add_n(inputs, name=None):
+    """parity: paddle.add_n (ops.yaml add_n) — elementwise sum of a list of
+    same-shaped tensors."""
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    ts = [_t(v) for v in inputs]
+
+    def fn(*vs):
+        out = vs[0]
+        for v in vs[1:]:
+            out = out + v
+        return out
+
+    return apply("add_n", fn, *ts)
+
+
+def take(x, index, mode="raise", name=None):
+    """Flat-view gather; mode governs out-of-range indices."""
+    if mode not in ("raise", "wrap", "clip"):
+        raise ValueError(f"take: unknown mode {mode!r}")
+    t, idx = _t(x), _t(index)
+    n = 1
+    for s in t.shape:
+        n *= s
+    if mode == "raise":
+        iv = np.asarray(idx._value)
+        if iv.size and (iv.min() < -n or iv.max() >= n):
+            raise IndexError(
+                f"take(mode='raise'): index out of range for input with "
+                f"{n} elements")
+
+    def fn(v, i):
+        flat = v.reshape(-1)
+        if mode == "wrap":
+            i = jnp.mod(i, n)
+        elif mode == "clip":
+            i = jnp.clip(i, 0, n - 1)
+        else:
+            i = jnp.where(i < 0, i + n, i)
+        return flat[i]
+
+    return apply("take", fn, t, idx)
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    """Membership test against the flattened test set."""
+    def fn(v, t):
+        hit = jnp.any(v[..., None] == t.reshape(-1), axis=-1)
+        return ~hit if invert else hit
+
+    return apply("isin", fn, _t(x), _t(test_x))
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """itertools.combinations(/with_replacement) over a 1-D tensor."""
+    t = _t(x)
+    if t.ndim != 1:
+        raise ValueError("combinations: x must be 1-D")
+    n = t.shape[0]
+    gen = (itertools.combinations_with_replacement if with_replacement
+           else itertools.combinations)
+    idx = np.asarray(list(gen(range(n), int(r))), np.int32).reshape(
+        -1, int(r))
+    return apply("combinations", lambda v: v[jnp.asarray(idx)], t)
+
+
+def cartesian_prod(x, name=None):
+    """Cartesian product of 1-D tensors → [prod(n_i), len(x)]."""
+    ts = [_t(v) for v in x]
+
+    def fn(*vs):
+        grids = jnp.meshgrid(*vs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+    out = apply("cartesian_prod", fn, *ts)
+    return out
+
+
+def block_diag(inputs, name=None):
+    """Stack 2-D (or promotable) tensors along the diagonal."""
+    ts = [_t(v) for v in inputs]
+
+    def fn(*vs):
+        vs = [v.reshape(1, -1) if v.ndim < 2 else v for v in vs]
+        R = sum(v.shape[0] for v in vs)
+        C = sum(v.shape[1] for v in vs)
+        out = jnp.zeros((R, C), vs[0].dtype)
+        r = c = 0
+        for v in vs:
+            out = jax.lax.dynamic_update_slice(out, v.astype(out.dtype),
+                                               (r, c))
+            r += v.shape[0]
+            c += v.shape[1]
+        return out
+
+    return apply("block_diag", fn, *ts)
+
+
+def unflatten(x, axis, shape, name=None):
+    """Split one axis into the given shape (one -1 inferred)."""
+    t = _t(x)
+    ax = axis % t.ndim
+    shape = [int(s) for s in shape]
+    if shape.count(-1) > 1:
+        raise ValueError("unflatten: only one -1 allowed in shape")
+    if -1 in shape:
+        known = 1
+        for s in shape:
+            if s != -1:
+                known *= s
+        shape[shape.index(-1)] = t.shape[ax] // known
+    new_shape = tuple(t.shape[:ax]) + tuple(shape) + tuple(t.shape[ax + 1:])
+    return apply("unflatten", lambda v: v.reshape(new_shape), t)
+
+
+def select_scatter(x, values, axis, index, name=None):
+    """Embed values at x[..., index, ...] on the given axis."""
+    t = _t(x)
+    ax = axis % t.ndim
+    idx = tuple(slice(None) if i != ax else int(index)
+                for i in range(t.ndim))
+    return apply("select_scatter",
+                 lambda v, val: v.at[idx].set(val.astype(v.dtype)),
+                 t, _t(values))
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    """Embed value into a strided slice of x."""
+    t = _t(x)
+    sl = [slice(None)] * t.ndim
+    for ax, st, en, sr in zip(axes, starts, ends, strides):
+        sl[ax % t.ndim] = slice(int(st), int(en), int(sr))
+    sl = tuple(sl)
+    return apply("slice_scatter",
+                 lambda v, val: v.at[sl].set(
+                     jnp.broadcast_to(val, v[sl].shape).astype(v.dtype)),
+                 t, _t(value))
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    """Embed y along the (offset) diagonal of axes (axis1, axis2)."""
+    t = _t(x)
+    a1, a2 = axis1 % t.ndim, axis2 % t.ndim
+    n, m = t.shape[a1], t.shape[a2]
+    if offset >= 0:
+        L = min(n, m - offset)
+        ri = jnp.arange(L)
+        ci = jnp.arange(L) + offset
+    else:
+        L = min(n + offset, m)
+        ri = jnp.arange(L) - offset
+        ci = jnp.arange(L)
+
+    def fn(v, dv):
+        # move diag axes to front, scatter, move back
+        perm = [a1, a2] + [i for i in range(v.ndim) if i not in (a1, a2)]
+        inv = np.argsort(perm)
+        vp = jnp.transpose(v, perm)
+        # paddle.diagonal puts the diagonal LAST: dv shape [..., L]
+        dvp = jnp.moveaxis(dv, -1, 0) if dv.ndim > 1 else dv
+        vp = vp.at[ri, ci].set(dvp.astype(v.dtype))
+        return jnp.transpose(vp, inv)
+
+    return apply("diagonal_scatter", fn, t, _t(y))
+
+
+def vecdot(x, y, axis=-1, name=None):
+    """Dot product along an axis (conjugating x for complex)."""
+    def fn(a, b):
+        a = jnp.conj(a) if jnp.iscomplexobj(a) else a
+        return jnp.sum(a * b, axis=axis)
+
+    return apply("vecdot", fn, _t(x), _t(y))
+
+
+def matrix_transpose(x, name=None):
+    return apply("matrix_transpose", lambda v: jnp.swapaxes(v, -2, -1),
+                 _t(x))
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+    t = _t(input)
+    lo, hi = float(min), float(max)
+    if lo == 0 and hi == 0:
+        v = np.asarray(t._value)
+        lo, hi = float(v.min()), float(v.max())
+        if lo == hi:
+            lo, hi = lo - 0.5, hi + 0.5
+    edges = jnp.linspace(lo, hi, int(bins) + 1, dtype=jnp.float32)
+    from ..core.tensor import Tensor
+    return Tensor(edges)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    """N-D histogram → (hist, list of bin-edge tensors)."""
+    t = _t(x)
+    w = _t(weights)._value if weights is not None else None
+    if isinstance(bins, (list, tuple)) and len(bins) and \
+            not isinstance(bins[0], int):
+        bins = [np.asarray(_t(b)._value) for b in bins]
+    hist, edges = jnp.histogramdd(t._value, bins=bins, range=ranges,
+                                  weights=w, density=density)
+    from ..core.tensor import Tensor
+    return Tensor(hist), [Tensor(ed) for ed in edges]
+
+
+def standard_gamma(x, name=None):
+    """Sample Gamma(alpha=x, scale=1) elementwise (differentiable in
+    alpha via JAX's implicit reparameterization)."""
+    from ..framework.random import next_key
+
+    key = next_key()
+    return apply("standard_gamma",
+                 lambda a: jax.random.gamma(key, a.astype(jnp.float32)
+                                            ).astype(a.dtype)
+                 if jnp.issubdtype(a.dtype, jnp.floating)
+                 else jax.random.gamma(key, a.astype(jnp.float32)),
+                 _t(x))
+
+
+def sgn(x, name=None):
+    """sign for real; x/|x| (0 → 0) for complex."""
+    def fn(v):
+        if jnp.iscomplexobj(v):
+            mag = jnp.abs(v)
+            return jnp.where(mag == 0, 0, v / jnp.where(mag == 0, 1, mag))
+        return jnp.sign(v)
+
+    return apply("sgn", fn, _t(x))
+
+
+def positive(x, name=None):
+    t = _t(x)
+    if t.dtype == jnp.bool_:
+        raise TypeError("positive: bool input not supported")
+    return apply("positive", lambda v: +v, t)
+
+
+def signbit(x, name=None):
+    return apply("signbit", lambda v: jnp.signbit(
+        v.astype(jnp.float32) if jnp.issubdtype(v.dtype, jnp.integer)
+        else v), _t(x))
+
+
+def less(x, y, name=None):
+    """Alias of less_than (reference: paddle.less)."""
+    from .logic import less_than
+    return less_than(x, y)
+
+
+def bitwise_invert(x, name=None):
+    """Alias of bitwise_not (reference: paddle.bitwise_invert)."""
+    from .logic import bitwise_not
+    return bitwise_not(x)
+
+
+def gammainc(x, y, name=None):
+    """Regularized lower incomplete gamma P(x, y)."""
+    return apply("gammainc",
+                 lambda a, b: jax.scipy.special.gammainc(a, b), _t(x), _t(y))
+
+
+def gammaincc(x, y, name=None):
+    """Regularized upper incomplete gamma Q(x, y)."""
+    return apply("gammaincc",
+                 lambda a, b: jax.scipy.special.gammaincc(a, b), _t(x), _t(y))
+
+
+def reverse(x, axis, name=None):
+    """Legacy alias of flip."""
+    from .manipulation import flip
+    return flip(x, axis)
+
+
+def rank(input, name=None):
+    from ..core.tensor import Tensor
+    return Tensor(jnp.asarray(_t(input).ndim, jnp.int32))
+
+
+def shape(input, name=None):
+    from ..core.tensor import Tensor
+    return Tensor(jnp.asarray(_t(input).shape, jnp.int32))
+
+
+def tolist(x, name=None):
+    return np.asarray(_t(x)._value).tolist()
+
+
+def view_as(x, other, name=None):
+    from .manipulation import view
+    return view(x, _t(other).shape)

@@ -326,3 +326,137 @@ def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
     if unpack_ludata:
         outs += [Tensor(jnp.asarray(L)), Tensor(jnp.asarray(U))]
     return tuple(outs)
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    """parity: linalg.py cholesky_inverse — inverse of A from its Cholesky
+    factor: (LL^T)^-1 via two triangular solves."""
+    def fn(L):
+        n = L.shape[-1]
+        eye = jnp.eye(n, dtype=L.dtype)
+        if upper:
+            Linv = jax.scipy.linalg.solve_triangular(L, eye, lower=False)
+            return Linv @ jnp.swapaxes(Linv, -2, -1)
+        Linv = jax.scipy.linalg.solve_triangular(L, eye, lower=True)
+        return jnp.swapaxes(Linv, -2, -1) @ Linv
+
+    return apply("cholesky_inverse", fn, _t(x))
+
+
+def matrix_exp(x, name=None):
+    """parity: linalg.py matrix_exp — via jax.scipy.linalg.expm (Padé)."""
+    return apply("matrix_exp", lambda v: jax.scipy.linalg.expm(v), _t(x))
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    """parity: linalg.py ormqr — multiply `other` by Q (from householder
+    factors x, tau): Q @ other / other @ Q, optionally Q^T."""
+    def fn(a, t, c):
+        m = a.shape[-2]
+        k = t.shape[-1]
+
+        def reflect_left(vec, tv, mat):
+            # (I - tau v v^T) mat  as a rank-1 update: O(m·n) per reflector
+            return mat - tv * jnp.outer(vec, vec @ mat)
+
+        def reflect_right(mat, vec, tv):
+            return mat - tv * jnp.outer(mat @ vec, vec)
+
+        # Q = H_0 H_1 ... H_{k-1}; apply reflectors to `other` directly
+        # without materializing Q. Qc applies H_0(H_1(...c)); Q^T c applies
+        # H_{k-1}(...H_0 c).
+        order = range(k - 1, -1, -1)
+        if (left and transpose) or (not left and not transpose):
+            order = range(k)
+        out = c
+        for j in order:
+            v = jnp.concatenate([jnp.zeros(j, a.dtype),
+                                 jnp.ones(1, a.dtype), a[j + 1:, j]])
+            if left:
+                out = reflect_left(v, t[j], out)
+            else:
+                out = reflect_right(out, v, t[j])
+        return out
+
+    return apply("ormqr", fn, _t(x), _t(tau), _t(other))
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """parity: linalg.py svd_lowrank — randomized low-rank SVD (Halko et
+    al.): range finding with power iterations, then exact SVD on the small
+    projection."""
+    from ..framework.random import next_key
+
+    key = next_key()
+    args = [_t(x)] + ([_t(M)] if M is not None else [])
+
+    def fn(a, *m):
+        av = a - m[0] if m else a
+        n = av.shape[-1]
+        G = jax.random.normal(key, av.shape[:-2] + (n, q), jnp.float32
+                              ).astype(av.dtype)
+        Y = av @ G
+        Q, _ = jnp.linalg.qr(Y)
+        for _i in range(niter):
+            Z = jnp.swapaxes(av, -2, -1) @ Q
+            Qz, _ = jnp.linalg.qr(Z)
+            Y = av @ Qz
+            Q, _ = jnp.linalg.qr(Y)
+        B = jnp.swapaxes(Q, -2, -1) @ av
+        Ub, s, Vh = jnp.linalg.svd(B, full_matrices=False)
+        return Q @ Ub, s, jnp.swapaxes(Vh, -2, -1)
+
+    return apply("svd_lowrank", fn, *args)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """parity: linalg.py pca_lowrank — randomized PCA over svd_lowrank."""
+    t = _t(x)
+    n, m = t.shape[-2], t.shape[-1]
+    qq = q if q is not None else min(6, n, m)
+
+    if center:
+        mean = _mean_keepdim(t)
+        return svd_lowrank(t, q=qq, niter=niter, M=mean)
+    return svd_lowrank(t, q=qq, niter=niter)
+
+
+def _mean_keepdim(t):
+    return apply("pca_mean",
+                 lambda v: jnp.broadcast_to(
+                     jnp.mean(v, axis=-2, keepdims=True), v.shape), t)
+
+
+def fp8_fp8_half_gemm_fused(x, y, bias=None, transpose_x=False,
+                            transpose_y=False, output_dtype="float16",
+                            scale=1.0, activation_type="identity", name=None):
+    """parity: incubate fp8 gemm (linalg.py fp8_fp8_half_gemm_fused) —
+    float8_e4m3 inputs, half-precision output. On TPU this lowers to an XLA
+    dot with fp8 operands (hardware fp8 on v5p+; emulated elsewhere)."""
+    from ..framework.dtype import convert_dtype
+
+    out_dt = convert_dtype(output_dtype)
+
+    def fn(a, b, *bias_arr):
+        if transpose_x:
+            a = jnp.swapaxes(a, -2, -1)
+        if transpose_y:
+            b = jnp.swapaxes(b, -2, -1)
+        out = jax.lax.dot_general(
+            a, b, (((a.ndim - 1,), (b.ndim - 2,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if bias_arr:
+            out = out + bias_arr[0]
+        if activation_type == "relu":
+            out = jnp.maximum(out, 0)
+        elif activation_type == "gelu":
+            out = jax.nn.gelu(out)
+        return out.astype(out_dt.np_dtype)
+
+    args = [_t(x), _t(y)] + ([_t(bias)] if bias is not None else [])
+    return apply("fp8_fp8_half_gemm_fused", fn, *args)
+
+
+# re-exports completing the reference linalg namespace
+from .creation import diagonal  # noqa: E402,F401
+from .compat import matrix_transpose, vecdot  # noqa: E402,F401

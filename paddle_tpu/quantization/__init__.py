@@ -203,3 +203,63 @@ def quanted_forward(x, weight, x_scale, w_scale, bits=8):
         return acc.astype(jnp.float32) * (x_scale * w_scale / (qmax * qmax))
 
     return apply("quanted_matmul", fn, _t(x), _t(weight))
+
+
+class BaseQuanter(Layer):
+    """parity: quantization/base_quanter.py:29 — base class for quanters
+    (simulated-quant layers); subclasses implement forward/scales/
+    zero_points/quant_axis/bit_length."""
+
+    def forward(self, input):  # noqa: A002
+        raise NotImplementedError
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        return None
+
+    def quant_axis(self):
+        return None
+
+    def bit_length(self):
+        return 8
+
+
+class _QuanterFactory:
+    """Partial-arg factory produced by the @quanter annotation
+    (quantization/factory.py:78): holds ctor args, instantiates the quanter
+    layer per-tensor via _instance(layer)."""
+
+    def __init__(self, cls, *args, **kwargs):
+        self._cls = cls
+        self._args = args
+        self._kwargs = kwargs
+
+    def _instance(self, layer=None):
+        return self._cls(*self._args, **self._kwargs)
+
+    def __call__(self, *args, **kwargs):
+        return self._cls(*args, **kwargs)
+
+
+def quanter(class_name):
+    """parity: quantization/factory.py:78 @quanter — declares a factory
+    class (named ``class_name``) for the decorated quanter type and
+    registers it in this module's namespace."""
+    def decorator(cls):
+        def factory_init(self, *args, **kwargs):
+            _QuanterFactory.__init__(self, cls, *args, **kwargs)
+
+        factory = type(class_name, (_QuanterFactory,),
+                       {"__init__": factory_init})
+        globals()[class_name] = factory
+        import sys
+
+        setattr(sys.modules[cls.__module__], class_name, factory)
+        return cls
+
+    return decorator
+
+
+__all__ += ["BaseQuanter", "quanter"]

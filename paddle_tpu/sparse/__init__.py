@@ -330,3 +330,84 @@ def mv(x, vec, name=None):
 
 
 from . import nn  # noqa: E402,F401
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    """parity: sparse/binary.py addmm — beta*input + alpha*(x@y); x sparse
+    (COO/CSR), input/y dense."""
+    prod = matmul(x, y)
+    from ..ops import math as _m
+
+    return _m.add(_m.scale(input, beta), _m.scale(prod, alpha))
+
+
+def reshape(x, shape, name=None):
+    """parity: sparse/unary.py:882 reshape — reshapes the sparse dims by
+    re-deriving indices through the flattened linear index (dense semantics
+    preserved; supports -1 and 0 placeholders)."""
+    old_shape = x.shape
+    shape = list(int(s) for s in shape)
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = old_shape[i]
+    if -1 in shape:
+        total = int(np.prod(old_shape))
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape[shape.index(-1)] = total // known
+    coo = x.to_sparse_coo() if isinstance(x, SparseCsrTensor) else coalesce(x)
+    idx = np.asarray(coo.indices._value).astype(np.int64)
+    flat = np.ravel_multi_index(tuple(idx), tuple(old_shape))
+    new_idx = np.stack(np.unravel_index(flat, tuple(shape)))
+    out = SparseCooTensor(
+        Tensor(jnp.asarray(new_idx, jnp.int32)), coo.values, shape,
+        coalesced=True)
+    if isinstance(x, SparseCsrTensor):
+        return out.to_sparse_csr()
+    return out
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    """parity: sparse/unary.py:1017 slice — multi-axis slicing of a sparse
+    tensor (negative indices wrap)."""
+    coo = x.to_sparse_coo() if isinstance(x, SparseCsrTensor) else coalesce(x)
+    idx = np.asarray(coo.indices._value).astype(np.int64)
+    vals = np.asarray(coo.values._value)
+    shape = list(coo.shape)
+    keep = np.ones(idx.shape[1], bool)
+    new_shape = list(shape)
+    offsets = {}
+    for ax, st, en in zip(_as_ints(axes), _as_ints(starts), _as_ints(ends)):
+        n = shape[ax]
+        st = st + n if st < 0 else min(st, n)
+        en = en + n if en < 0 else min(en, n)
+        keep &= (idx[ax] >= st) & (idx[ax] < en)
+        offsets[ax] = st
+        new_shape[ax] = max(0, en - st)
+    idx = idx[:, keep]
+    for ax, st in offsets.items():
+        idx[ax] -= st
+    out = SparseCooTensor(Tensor(jnp.asarray(idx, jnp.int32)),
+                          Tensor(jnp.asarray(vals[keep])), new_shape,
+                          coalesced=True)
+    if isinstance(x, SparseCsrTensor):
+        return out.to_sparse_csr()
+    return out
+
+
+def _as_ints(v):
+    if isinstance(v, Tensor):
+        return [int(i) for i in np.asarray(v._value).reshape(-1)]
+    return [int(i) for i in v]
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """parity: sparse pca_lowrank — densify (randomized PCA needs dense
+    matmuls on TPU) and run linalg.pca_lowrank."""
+    from ..ops import linalg as _linalg
+
+    dense = x.to_dense() if isinstance(
+        x, (SparseCooTensor, SparseCsrTensor)) else x
+    return _linalg.pca_lowrank(dense, q=q, center=center, niter=niter)
+
+
+__all__ += ["addmm", "reshape", "slice", "pca_lowrank"]

@@ -163,3 +163,10 @@ from .plan import (  # noqa: E402,F401
 )
 __all__ += ["Job", "Plan", "StandaloneExecutor",
             "build_gradient_merge_plan"]
+
+
+from .compat import *  # noqa: E402,F401,F403
+from .compat import __all__ as _compat_all  # noqa: E402
+from . import nn  # noqa: E402,F401
+from .. import amp  # noqa: E402,F401
+__all__ += _compat_all

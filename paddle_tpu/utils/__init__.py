@@ -59,3 +59,20 @@ def run_check():
     print(f"paddle_tpu works on {dev.platform}:{dev.id} "
           f"({getattr(dev, 'device_kind', '?')}); matmul checksum "
           f"{float(y.sum()):.0f}")
+
+
+def require_version(min_version, max_version=None):
+    """parity: utils.require_version — validate the installed framework
+    version against a range."""
+    from .. import __version__
+
+    def parts(v):
+        return [int(x) for x in str(v).split(".")[:3] if x.isdigit()]
+
+    cur = parts(__version__)
+    if parts(min_version) > cur:
+        raise Exception(
+            f"installed version {__version__} < required {min_version}")
+    if max_version is not None and parts(max_version) < cur:
+        raise Exception(
+            f"installed version {__version__} > maximum {max_version}")

@@ -16,3 +16,36 @@ def get_image_backend():
     return "numpy"
 
 from . import ops  # noqa: F401,E402
+
+
+def image_load(path, backend=None):
+    """parity: vision/image.py:126 image_load — decode an image file.
+    Backends: 'pil' (PIL.Image) or 'cv2'; default reads into a numpy HWC
+    array via PIL when available, else a minimal PPM/PGM/BMP reader."""
+    backend = backend or get_image_backend() or "pil"
+    try:
+        from PIL import Image
+
+        img = Image.open(path)
+        if backend == "pil":
+            return img
+        import numpy as np
+
+        arr = np.asarray(img)
+        if backend == "cv2" and arr.ndim == 3 and arr.shape[-1] >= 3:
+            arr = arr[..., ::-1]  # cv2 convention: BGR (color images only)
+        return arr
+    except ImportError:
+        import numpy as np
+
+        with open(path, "rb") as f:
+            magic = f.read(2)
+        if magic in (b"P5", b"P6"):  # netpbm
+            with open(path, "rb") as f:
+                toks = f.read().split(maxsplit=4)
+            w, h, maxv = int(toks[1]), int(toks[2]), int(toks[3])
+            data = np.frombuffer(toks[4], np.uint8)
+            ch = 3 if magic == b"P6" else 1
+            return data[:w * h * ch].reshape(h, w, ch).squeeze()
+        raise RuntimeError(
+            f"image_load: no PIL and unsupported format {magic!r}")
