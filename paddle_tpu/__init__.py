@@ -223,3 +223,145 @@ version = type("version", (), {"full_version": "0.1.0", "major": 0, "minor": 1,
                                "patch": 0, "cuda": staticmethod(lambda: False),
                                "show": staticmethod(lambda: print("paddle_tpu 0.1.0"))})
 __version__ = "0.1.0"
+
+
+# ---------------------------------------------------------------------------
+# Tensor method surface completion
+# (reference: python/paddle/tensor/__init__.py tensor_method_func — ~394
+# functions patched onto Tensor; the core set is attached in ops/__init__,
+# this block attaches the long tail once every namespace exists)
+# ---------------------------------------------------------------------------
+def _attach_tensor_method_long_tail():
+    import sys as _sys
+
+    from . import signal as _signal
+    from .ops import linalg as _linalg
+
+    this = _sys.modules[__name__]
+    names = [
+        "acosh", "acosh_", "add_n", "addmm", "as_complex", "as_real",
+        "as_strided", "asinh", "asinh_", "atanh", "atanh_", "atleast_1d",
+        "atleast_2d", "atleast_3d", "bincount", "bitwise_invert",
+        "bitwise_left_shift", "bitwise_right_shift", "block_diag",
+        "broadcast_shape", "broadcast_tensors", "cdist", "cholesky_inverse",
+        "cholesky_solve", "combinations", "concat", "cond", "copysign",
+        "corrcoef", "cov", "create_parameter", "create_tensor",
+        "cumulative_trapezoid", "diag", "diag_embed", "diagflat",
+        "diagonal_scatter", "diff", "dsplit", "eig", "eigvalsh", "erfinv_",
+        "exponential_", "floor_mod", "frexp", "gammainc", "gammaincc",
+        "gammaln", "gcd", "histogram", "histogram_bin_edges", "histogramdd",
+        "householder_product", "hsplit", "hypot", "i0", "i0e", "i1", "i1e",
+        "index_fill", "inner", "inverse", "is_complex", "is_floating_point",
+        "is_integer", "is_tensor", "isin", "isneginf", "isposinf", "isreal",
+        "istft", "kron", "lcm", "ldexp", "less", "log1p_", "logaddexp",
+        "lu", "lu_unpack", "matrix_transpose", "multigammaln",
+        "multinomial", "multiplex", "negative", "nextafter", "ormqr",
+        "outer", "pca_lowrank", "polar", "polygamma", "put_along_axis_",
+        "rank", "reduce_as", "renorm", "reverse", "scatter_nd",
+        "select_scatter", "sgn", "shard_index", "signbit", "sinc", "slice",
+        "slice_scatter", "stack", "stanh", "stft", "svd_lowrank", "take",
+        "tensor_split", "top_p_sampling", "trapezoid", "triangular_solve",
+        "unflatten", "unfold", "unstack", "vander", "view_as", "vsplit",
+    ]
+    for n in names:
+        if hasattr(Tensor, n):
+            continue
+        base = n[:-1] if n.endswith("_") else n
+        fn = None
+        for src in (this, _linalg, _signal):
+            fn = getattr(src, n, None) or getattr(src, base, None)
+            if fn is not None:
+                break
+        if fn is None:
+            continue
+        if n.endswith("_") and getattr(this, n, fn) is fn and \
+                not getattr(fn, "__name__", "").endswith("_"):
+            def _mk(f):
+                def m(self, *a, **k):
+                    return self._adopt(f(self, *a, **k))
+
+                return m
+
+            setattr(Tensor, n, _mk(fn))
+        else:
+            setattr(Tensor, n, fn)
+
+    # random fills (reference Tensor.normal_/uniform_/bernoulli_ semantics:
+    # fill self with samples, keep shape/dtype)
+    import jax as _jx
+    import jax.numpy as _jnp
+
+    from .framework.random import next_key as _nk
+    from .ops.dispatch import apply as _apply
+
+    def _fill(name, sample):
+        def m(self, *args, **kwargs):
+            key = _nk()
+
+            def fn(v):
+                return sample(key, v.shape, *args, **kwargs).astype(v.dtype)
+
+            return self._adopt(_apply(name, fn, self))
+
+        m.__name__ = name
+        return m
+
+    if not hasattr(Tensor, "normal_"):
+        Tensor.normal_ = _fill(
+            "normal_", lambda k, s, mean=0.0, std=1.0:
+            mean + std * _jx.random.normal(k, s, _jnp.float32))
+    if not hasattr(Tensor, "uniform_"):
+        Tensor.uniform_ = _fill(
+            "uniform_", lambda k, s, min=-1.0, max=1.0, seed=0:  # noqa: A002
+            _jx.random.uniform(k, s, _jnp.float32, min, max))
+    if not hasattr(Tensor, "bernoulli_"):
+        Tensor.bernoulli_ = _fill(
+            "bernoulli_", lambda k, s, p=0.5:
+            _jx.random.bernoulli(k, p, s))
+
+    def _resize_(self, shape):
+        """numpy-style resize: flat data truncated/tiled to the new numel."""
+        import numpy as _np
+
+        def fn(v):
+            flat = v.reshape(-1)
+            n = int(_np.prod(shape))
+            if flat.shape[0] == 0:  # numpy resize zero-fills empty input
+                return _jnp.zeros(shape, v.dtype)
+            reps = -(-n // flat.shape[0])
+            return _jnp.tile(flat, reps)[:n].reshape(shape)
+
+        return self._adopt(_apply("resize_", fn, self))
+
+    def _set_(self, source=None, shape=None):
+        """Replace storage with source's (reference Tensor.set_)."""
+        if source is not None:
+            self._replace_value(source._value if hasattr(source, "_value")
+                                else _jnp.asarray(source))
+        if shape is not None:
+            self._replace_value(self._value.reshape(shape))
+        return self
+
+    if not hasattr(Tensor, "resize_"):
+        Tensor.resize_ = _resize_
+    if not hasattr(Tensor, "set_"):
+        Tensor.set_ = _set_
+    if not hasattr(Tensor, "inverse"):
+        Tensor.inverse = _linalg.inv
+
+    def _create_tensor(self, dtype=None, name=None):
+        """parity: Tensor.create_tensor — an empty tensor of this dtype."""
+        import numpy as _np
+
+        from .framework.dtype import convert_dtype as _cd
+
+        d = _cd(dtype) if dtype is not None else None
+        return Tensor(_np.zeros(
+            (0,), d.np_dtype if d else _np.asarray(self._value).dtype))
+
+    if not hasattr(Tensor, "create_tensor"):
+        Tensor.create_tensor = _create_tensor
+
+
+_attach_tensor_method_long_tail()
+del _attach_tensor_method_long_tail

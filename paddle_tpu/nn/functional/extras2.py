@@ -498,9 +498,10 @@ def flashmask_attention(query, key, value, startend_row_indices=None,
         if rest:
             m = rest[0].astype(jnp.int32)   # [B, Hk_m, Sk, {1,2,4}]
             nM = m.shape[-1]
-            # broadcast mask heads to attention heads
-            if m.shape[1] == 1:
-                m = jnp.broadcast_to(m, (B, 1, Sk, nM))
+            # broadcast mask heads to attention heads (GQA: Hm may be the
+            # kv-head count — repeat up to H)
+            if m.shape[1] not in (1, H):
+                m = jnp.repeat(m, H // m.shape[1], axis=1)
             # per (b, h, j): queries i in [start, end) are masked (LT);
             # UT masks i in [ut_start, ut_end)
             i = rows[None, None]            # [1,1,S,1]

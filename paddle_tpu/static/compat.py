@@ -368,6 +368,7 @@ class ExponentialMovingAverage:
     def __init__(self, decay=0.999, thres_steps=None, name=None,
                  layer=None):
         self._decay = float(decay)
+        self._thres_steps = thres_steps
         self._layer = layer
         self._shadow = {}
         self._backup = {}
@@ -380,7 +381,10 @@ class ExponentialMovingAverage:
 
     def update(self):
         self._step += 1
-        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        # reference: the (1+t)/(10+t) warmup only applies with thres_steps
+        d = self._decay
+        if self._thres_steps is not None:
+            d = min(self._decay, (1 + self._step) / (10 + self._step))
         for name, p in self._params():
             cur = np.asarray(p._value, np.float32)
             if name not in self._shadow:

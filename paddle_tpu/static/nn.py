@@ -353,8 +353,10 @@ def while_loop(cond_fn, body, loop_vars, is_test=False, name=None):
     """parity: static.nn.while_loop — host loop in eager; use
     jax.lax.while_loop inside jit-captured code for compiled loops."""
     vals = list(loop_vars)
-    while bool(np.asarray(cond_fn(*vals)._value)
-               if hasattr(cond_fn(*vals), "_value") else cond_fn(*vals)):
+    while True:
+        c = cond_fn(*vals)
+        if not bool(np.asarray(c._value) if hasattr(c, "_value") else c):
+            break
         out = body(*vals)
         vals = list(out) if isinstance(out, (list, tuple)) else [out]
     return vals
@@ -386,9 +388,11 @@ def sequence_conv(input, num_filters, filter_size=3, **kwargs):  # noqa: A002
     D = input.shape[-1]
     w = _param([filter_size * D, num_filters])
     T = input.shape[1]
-    pad = (filter_size - 1) // 2
-    z = paddle.zeros(list(input.shape[:1]) + [pad] + [D])
-    xp = paddle.concat([z, input, z], axis=1)
+    lo = (filter_size - 1) // 2
+    hi = filter_size - 1 - lo  # asymmetric for even filter sizes
+    zl = paddle.zeros(list(input.shape[:1]) + [lo] + [D])
+    zr = paddle.zeros(list(input.shape[:1]) + [hi] + [D])
+    xp = paddle.concat([zl, input, zr], axis=1)
     ctx = paddle.concat([paddle.slice(xp, [1], [k], [k + T])
                          for k in range(filter_size)], axis=-1)
     return paddle.matmul(ctx, w)
@@ -436,9 +440,6 @@ def sequence_expand(x, y, ref_level=-1, name=None):
 
     reps = y.shape[1] if y.ndim > 1 else 1
     return paddle.tile(paddle.unsqueeze(x, 1), [1, reps] + [1] * (x.ndim - 1))
-
-
-from .compat import py_func  # noqa: E402,F401
 
 
 from .compat import py_func  # noqa: E402,F401

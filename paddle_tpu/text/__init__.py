@@ -108,11 +108,14 @@ class Imikolov(Dataset):
 
         with tarfile.open(data_file) as tf:
             train_lines = read_lines(tf, "ptb.train.txt")
-            test_lines = read_lines(tf, "ptb.valid.txt")
-        # vocab over train+test — the SAME word_idx for both modes, so
-        # train/test ids are compatible (reference _build_work_dict:150)
+            valid_lines = read_lines(tf, "ptb.valid.txt")
+            mode_lines = (train_lines if mode == "train"
+                          else read_lines(tf, f"ptb.{mode}.txt"))
+        # vocab over train+valid — the SAME word_idx for every mode, so
+        # split ids are compatible (reference _build_work_dict:150 reads
+        # ptb.train.txt + ptb.valid.txt regardless of mode)
         freq: dict = {}
-        for toks in train_lines + test_lines:
+        for toks in train_lines + valid_lines:
             for t in toks:
                 freq[t] = freq.get(t, 0) + 1
         freq.pop("<unk>", None)
@@ -123,16 +126,21 @@ class Imikolov(Dataset):
         vocab["<unk>"] = len(vocab)
         self.word_idx = vocab
         unk = vocab["<unk>"]
-        lines = train_lines if mode == "train" else test_lines
         self.data = []
-        for toks in lines:
+        for toks in mode_lines:  # toks already has <s>/<e> markers
             ids = [vocab.get(t, unk) for t in toks]
             if data_type.upper() == "NGRAM":
                 n = window_size if window_size > 0 else 5
                 for i in range(len(ids) - n + 1):
                     self.data.append(tuple(ids[i:i + n]))
             else:
-                self.data.append(ids)
+                # SEQ: (src, trg) pair per line (imikolov.py:187-194)
+                inner = ids[1:-1]
+                src_seq = [vocab["<s>"], *inner]
+                trg_seq = [*inner, vocab["<e>"]]
+                if window_size > 0 and len(src_seq) > window_size:
+                    continue
+                self.data.append((src_seq, trg_seq))
 
     def __getitem__(self, idx):
         return self.data[idx]

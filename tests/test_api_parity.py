@@ -57,6 +57,41 @@ def test_all_exports_resolve(modname):
     assert missing == [], f"{modname}: missing {len(missing)}: {missing}"
 
 
+def test_tensor_method_surface():
+    """Every name in the reference's tensor_method_func list
+    (tensor/__init__.py) resolves as a Tensor method here."""
+    tree = ast.parse(open(f"{REF}/tensor/__init__.py").read())
+    names = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "tensor_method_func":
+                    for e in ast.walk(node.value):
+                        if isinstance(e, ast.Constant) and \
+                                isinstance(e.value, str):
+                            names.append(e.value)
+    assert len(names) > 300
+    t = paddle.to_tensor(np.ones((2, 2), np.float32))
+    missing = sorted(n for n in set(names) if not hasattr(t, n))
+    assert missing == [], f"missing {len(missing)}: {missing}"
+
+    # behavior spot-checks for the attach machinery
+    x = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    assert x.take(paddle.to_tensor(np.array([0, 3], np.int32))
+                  ).numpy().tolist() == [1.0, 4.0]
+    assert x.kron(x).shape == [4, 4]
+    assert x.inverse().shape == [2, 2]
+    n1 = paddle.to_tensor(np.zeros((1000,), np.float32))
+    n1.normal_(5.0, 0.1)
+    assert abs(float(n1.numpy().mean()) - 5) < 0.05
+    r = paddle.to_tensor(np.arange(4, dtype=np.float32))
+    r.resize_([6])
+    assert r.numpy().tolist() == [0.0, 1.0, 2.0, 3.0, 0.0, 1.0]
+    s = paddle.to_tensor(np.zeros((2,), np.float32))
+    s.set_(paddle.to_tensor(np.array([7.0, 8.0], np.float32)))
+    assert s.numpy().tolist() == [7.0, 8.0]
+
+
 def test_parallelize_plan():
     """Mirror of the reference parallelize workflow
     (auto_parallel/intermediate/parallelize.py) on the CPU mesh."""
