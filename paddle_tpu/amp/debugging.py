@@ -122,3 +122,62 @@ def enable_tensor_checker(config: TensorCheckerConfig) -> None:
 
 def disable_tensor_checker() -> None:
     _flags.set_flags({"check_nan_inf": False})
+
+
+def check_layer_numerics(func):
+    """parity: amp/debugging.py check_layer_numerics — decorator checking a
+    Layer.forward's tensor inputs/outputs for nan/inf."""
+    import functools
+
+    @functools.wraps(func)
+    def wrapper(self, *args, **kwargs):
+        from ..core.tensor import Tensor
+
+        for i, a in enumerate(args):
+            if isinstance(a, Tensor):
+                check_numerics(a, type(self).__name__, f"input{i}")
+        out = func(self, *args, **kwargs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for i, o in enumerate(outs):
+            if isinstance(o, Tensor):
+                check_numerics(o, type(self).__name__, f"output{i}")
+        return out
+
+    return wrapper
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename,
+                     loss_scale=1, dump_all_tensors=False):
+    """parity: amp/debugging.py compare_accuracy — diff two numerics dump
+    directories (produced by enable_operator_stats_collection runs) into an
+    excel-ish CSV report."""
+    import csv
+    import os
+
+    def load(path):
+        rows = {}
+        if os.path.isdir(path):
+            files = [os.path.join(path, f) for f in sorted(os.listdir(path))]
+        else:
+            files = [path]
+        for fp in files:
+            if not os.path.isfile(fp):
+                continue
+            with open(fp) as f:
+                for line in f:
+                    parts = line.strip().split()
+                    if parts:
+                        rows[parts[0]] = parts[1:]
+        return rows
+
+    a, b = load(dump_path), load(another_dump_path)
+    with open(output_filename, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["op", "run1", "run2", "match"])
+        for k in sorted(set(a) | set(b)):
+            w.writerow([k, " ".join(a.get(k, [])), " ".join(b.get(k, [])),
+                        a.get(k) == b.get(k)])
+    return output_filename
+
+
+__all__ += ["check_layer_numerics", "compare_accuracy"]

@@ -150,3 +150,150 @@ is_first_worker = fleet.is_first_worker
 barrier_worker = fleet.barrier_worker
 
 from .recompute import recompute, recompute_sequential  # noqa: F401,E402
+
+
+# reference fleet/__init__.py __all__ classes
+Fleet = _Fleet
+
+
+class Role:
+    """parity: fleet/base/role_maker.py Role constants."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class RoleMakerBase:
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+        self._role = Role.WORKER
+
+    def _worker_index(self):
+        from ..env import get_rank
+
+        return get_rank()
+
+    def _worker_num(self):
+        from ..env import get_world_size
+
+        return get_world_size()
+
+    def _is_worker(self):
+        return self._role == Role.WORKER
+
+    def _is_server(self):
+        return self._role == Role.SERVER
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """parity: fleet/base/role_maker.py PaddleCloudRoleMaker — roles from
+    the PADDLE_* env contract. Collective (TPU) jobs have workers only; the
+    PS roles exist for API compat (D19 documented skip)."""
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    """parity: role_maker.py UserDefinedRoleMaker — explicit role config."""
+
+    def __init__(self, is_collective=True, current_id=0, role=Role.WORKER,
+                 worker_num=None, server_endpoints=None, **kwargs):
+        super().__init__(is_collective, **kwargs)
+        self._current_id = current_id
+        self._role = role
+        self._worker_num_ = worker_num
+        self._server_endpoints = server_endpoints or []
+
+    def _worker_index(self):
+        return self._current_id
+
+    def _worker_num(self):
+        if self._worker_num_ is not None:
+            return self._worker_num_
+        return super()._worker_num()
+
+
+class UtilBase:
+    """parity: fleet/base/util_factory.py UtilBase — small cross-worker
+    utilities over the collective API."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):  # noqa: A002
+        import numpy as _np
+
+        import paddle_tpu as paddle
+        from ..collective import ReduceOp, all_reduce
+
+        t = paddle.to_tensor(_np.asarray(input))
+        op = {"sum": ReduceOp.SUM, "max": ReduceOp.MAX,
+              "min": ReduceOp.MIN}[mode]
+        all_reduce(t, op)
+        return t.numpy()
+
+    def barrier(self, comm_world="worker"):
+        from ..collective import barrier
+
+        barrier()
+
+    def all_gather(self, input, comm_world="worker"):  # noqa: A002
+        from ..collective import all_gather_object
+
+        out = []
+        all_gather_object(out, input)
+        return out
+
+    def get_file_shard(self, files):
+        from ..env import get_rank, get_world_size
+
+        n, r = get_world_size(), get_rank()
+        return [f for i, f in enumerate(sorted(files)) if i % n == r]
+
+    def print_on_rank(self, message, rank_id=0):
+        from ..env import get_rank
+
+        if get_rank() == rank_id:
+            print(message)
+
+
+class MultiSlotDataGenerator:
+    """parity: fleet/data_generator — line-oriented slot data generator for
+    the PS data pipeline (the generate_sample protocol; PS runtime itself is
+    the documented D19 skip)."""
+
+    def __init__(self):
+        self._proto_info = None
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "subclass must implement generate_sample(line)")
+
+    def set_batch(self, batch_size):
+        self._batch_size = batch_size
+
+    def _format(self, sample):
+        parts = []
+        for name, feas in sample:
+            parts.append(str(len(feas)))
+            parts += [str(f) for f in feas]
+        return " ".join(parts)
+
+    def run_from_stdin(self):
+        import sys
+
+        for line in sys.stdin:
+            g = self.generate_sample(line)
+            for sample in (g() if callable(g) else g):
+                sys.stdout.write(self._format(sample) + "\n")
+
+    def run_from_files(self, filelist):
+        out = []
+        for path in filelist:
+            with open(path) as f:
+                for line in f:
+                    g = self.generate_sample(line)
+                    for sample in (g() if callable(g) else g):
+                        out.append(self._format(sample))
+        return out
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    pass

@@ -75,3 +75,9 @@ def forward_grad(func: Callable, xs, v=None):
     """Alias of jvp's tangent output (parity: primapi.forward_grad)."""
     _, tang = jvp(func, xs, v)
     return tang
+
+
+# parity: incubate/autograd functional aliases (Jacobian/Hessian/grad)
+from ...autograd import grad  # noqa: E402,F401
+from ...autograd import hessian as Hessian  # noqa: E402,F401,N812
+from ...autograd import jacobian as Jacobian  # noqa: E402,F401,N812

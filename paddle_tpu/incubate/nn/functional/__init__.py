@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ....ops.creation import _t
 from ....ops.dispatch import apply
@@ -263,3 +264,226 @@ def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
 
     return apply("fused_moe", fn, _t(x), _t(gate_weight), _t(ffn1_weight),
                  _t(ffn2_weight))
+
+
+def fused_bias_act(x, bias=None, dequant_scales=None, shift=None, smooth=None,
+                   act_method="gelu", compute_dtype="default", quant_scale=-1,
+                   quant_round_type=0, quant_max_bound=0, quant_min_bound=0,
+                   name=None):
+    """parity: incubate/nn/functional/fused_bias_act — bias + activation in
+    one XLA fusion."""
+    import jax
+
+    from ....ops.creation import _t
+    from ....ops.dispatch import apply
+
+    acts = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+            "silu": jax.nn.silu, "swish": jax.nn.silu,
+            "swiglu": None, "geglu": None, "identity": lambda v: v}
+    if act_method in ("swiglu", "geglu"):
+        inner = jax.nn.silu if act_method == "swiglu" else jax.nn.gelu
+
+        def fn(v, *b):
+            if b:
+                v = v + b[0]
+            a, g = jnp.split(v, 2, axis=-1)
+            return inner(a) * g
+    else:
+        act = acts[act_method]
+
+        def fn(v, *b):
+            if b:
+                v = v + b[0]
+            return act(v)
+
+    args = [_t(x)] + ([_t(bias)] if bias is not None else [])
+    return apply("fused_bias_act", fn, *args)
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, mode=
+                      "upscale_in_train", ring_id=-1, name=None):
+    """parity: incubate fused_feedforward — LN → linear → act → dropout →
+    linear → dropout → residual (+LN), fused by XLA."""
+    from ....nn import functional as F
+
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, x.shape[-1:], ln1_scale, ln1_bias, ln1_epsilon)
+    x = F.linear(x, linear1_weight, linear1_bias)
+    x = getattr(F, activation)(x)
+    x = F.dropout(x, dropout1_rate, training=training, mode=mode)
+    x = F.linear(x, linear2_weight, linear2_bias)
+    x = F.dropout(x, dropout2_rate, training=training, mode=mode)
+    x = x + residual
+    if not pre_layer_norm:
+        x = F.layer_norm(x, x.shape[-1:], ln2_scale, ln2_bias, ln2_epsilon)
+    return x
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None, ln_bias=None,
+                               pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.5,
+                               attn_dropout_rate=0.5, ln_epsilon=1e-5,
+                               training=True, mode="upscale_in_train",
+                               ring_id=-1, add_residual=True, num_heads=None,
+                               transpose_qkv_wb=False, name=None):
+    """parity: incubate fused_multi_head_attention — fused QKV projection +
+    SDPA + output projection (+ residual/LN)."""
+    import jax
+
+    from ....core.tensor import Tensor
+    from ....nn import functional as F
+    from ....ops.creation import _t
+
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, x.shape[-1:], pre_ln_scale, pre_ln_bias,
+                         pre_ln_epsilon)
+    xv = _t(x)._value
+    wv = _t(qkv_weight)._value
+    B, S, E = xv.shape
+    if transpose_qkv_wb:
+        # [E, 3*E] layout: heads cannot be inferred from the weight
+        if num_heads is None:
+            raise ValueError(
+                "fused_multi_head_attention: num_heads is required when "
+                "transpose_qkv_wb=True")
+        H = num_heads
+        qkv = xv @ wv
+        qkv = qkv.reshape(B, S, 3, H, E // H)
+    else:
+        # reference layout [3, H, head_dim, E]
+        _, H, D, _ = wv.shape
+        qkv = jnp.einsum("bse,thde->bsthd", xv, wv)
+    if qkv_bias is not None:
+        bv = _t(qkv_bias)._value.reshape(3, -1, qkv.shape[-1]) \
+            if not transpose_qkv_wb else \
+            _t(qkv_bias)._value.reshape(3, qkv.shape[-2], qkv.shape[-1])
+        qkv = qkv + bv[None, None]
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    D = q.shape[-1]
+    scores = jnp.einsum("bshd,bthd->bhst", q, k) / np.sqrt(D)
+    if attn_mask is not None:
+        scores = scores + _t(attn_mask)._value
+    probs = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(q.dtype)
+    if training and attn_dropout_rate:
+        from ....framework.random import next_key
+
+        keep = jax.random.bernoulli(next_key(), 1 - attn_dropout_rate,
+                                    probs.shape)
+        probs = jnp.where(keep, probs / (1 - attn_dropout_rate), 0)
+    out = jnp.einsum("bhst,bthd->bshd", probs, v).reshape(B, S, -1)
+    out = Tensor(out)
+    out = F.linear(out, linear_weight, linear_bias)
+    out = F.dropout(out, dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = out + residual
+    if not pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1:], ln_scale, ln_bias,
+                           ln_epsilon)
+    return out
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+                            linear_weights, linear_biases, ffn_ln_scales,
+                            ffn_ln_biases, ffn1_weights, ffn1_biases,
+                            ffn2_weights, ffn2_biases, pre_layer_norm=True,
+                            epsilon=1e-5, cache_kvs=None, pre_caches=None,
+                            seq_lens=None, rotary_embs=None, beam_offset=None,
+                            time_step=None, attn_mask=None,
+                            dropout_rate=0.0, rotary_emb_dims=0,
+                            activation="gelu", training=False,
+                            mode="upscale_in_train", trans_qkvw=True,
+                            ring_id=-1, name=None):
+    """parity: incubate fused_multi_transformer — a stack of fused decoder
+    layers (the serving fast path). Composes the fused attention + FFN per
+    layer; XLA fuses each block."""
+    out = x
+    n_layers = len(qkv_weights)
+    for i in range(n_layers):
+        ln_kw = (dict(pre_ln_scale=ln_scales[i],
+                      pre_ln_bias=ln_biases[i] if ln_biases else None)
+                 if pre_layer_norm else
+                 dict(ln_scale=ln_scales[i],
+                      ln_bias=ln_biases[i] if ln_biases else None))
+        out = fused_multi_head_attention(
+            out, qkv_weights[i], linear_weights[i],
+            pre_layer_norm=pre_layer_norm,
+            qkv_bias=qkv_biases[i] if qkv_biases else None,
+            linear_bias=linear_biases[i] if linear_biases else None,
+            attn_mask=attn_mask, dropout_rate=dropout_rate,
+            attn_dropout_rate=dropout_rate, training=training, **ln_kw)
+        ffn_kw = (dict(ln1_scale=ffn_ln_scales[i],
+                       ln1_bias=ffn_ln_biases[i] if ffn_ln_biases else None)
+                  if pre_layer_norm else
+                  dict(ln2_scale=ffn_ln_scales[i],
+                       ln2_bias=ffn_ln_biases[i] if ffn_ln_biases else None))
+        out = fused_feedforward(
+            out, ffn1_weights[i], ffn2_weights[i],
+            linear1_bias=ffn1_biases[i] if ffn1_biases else None,
+            linear2_bias=ffn2_biases[i] if ffn2_biases else None,
+            dropout1_rate=dropout_rate, dropout2_rate=dropout_rate,
+            activation=activation, pre_layer_norm=pre_layer_norm,
+            training=training, **ffn_kw)
+    return out
+
+
+def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size,
+                     name=None):
+    """parity: incubate blha_get_max_len — max sequence lengths feeding
+    block_multihead_attention."""
+    from ....core.tensor import Tensor
+    from ....ops.creation import _t
+
+    enc = jnp.max(_t(seq_lens_encoder)._value)
+    dec = jnp.max(_t(seq_lens_decoder)._value)
+    return Tensor(enc), Tensor(dec)
+
+
+def variable_length_memory_efficient_attention(
+        query, key, value, seq_lens, kv_seq_lens, mask=None, scale=None,
+        causal=False, pre_cache_length=0, name=None):
+    """parity: incubate variable_length_memory_efficient_attention —
+    [B, H, S, D] layout with per-batch valid lengths."""
+    import jax
+
+    from ....core.tensor import Tensor
+    from ....ops.creation import _t
+
+    q = _t(query)._value
+    k = _t(key)._value
+    v = _t(value)._value
+    B, H, S, D = q.shape
+    Sk = k.shape[2]
+    sl = _t(seq_lens)._value.reshape(-1)
+    kl = _t(kv_seq_lens)._value.reshape(-1)
+    sc = scale if scale is not None else 1.0 / np.sqrt(D)
+    if k.shape[1] != H:
+        k = jnp.repeat(k, H // k.shape[1], axis=1)
+        v = jnp.repeat(v, H // v.shape[1], axis=1)
+    scores = jnp.einsum("bhsd,bhtd->bhst", q, k) * sc
+    valid_q = jnp.arange(S)[None, :] < sl[:, None]       # [B, S]
+    valid_k = jnp.arange(Sk)[None, :] < kl[:, None]      # [B, Sk]
+    allow = valid_q[:, None, :, None] & valid_k[:, None, None, :]
+    if causal:
+        # align the causal diagonal with per-batch kv lengths: query i (of
+        # sl valid positions) may attend keys j <= i + (kl - sl)
+        offs = (kl - sl)[:, None, None, None]
+        qi = jnp.arange(S)[None, None, :, None]
+        kj = jnp.arange(Sk)[None, None, None, :]
+        allow = allow & (kj <= qi + offs)
+    if mask is not None:
+        scores = scores + _t(mask)._value
+    scores = jnp.where(allow, scores, -1e30)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(q.dtype)
+    out = jnp.einsum("bhst,bhtd->bhsd", probs, v)
+    out = jnp.where(valid_q[:, None, :, None], out, 0)
+    return Tensor(out)

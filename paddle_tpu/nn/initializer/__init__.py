@@ -182,3 +182,22 @@ def set_global_initializer(weight_init, bias_init=None):
 
 _GLOBAL_WEIGHT_INIT = None
 _GLOBAL_BIAS_INIT = None
+
+
+class Bilinear(Initializer):
+    """parity: nn/initializer/Bilinear — bilinear upsampling kernel for
+    transposed convs (weight [C_in, C_out, k, k])."""
+
+    def _generate(self, shape, dtype):
+        import numpy as _np
+
+        w = _np.zeros(tuple(shape), _npd(dtype))
+        k = shape[-1]
+        f = int(_np.ceil(k / 2.0))
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        og = _np.ogrid[:k, :k]
+        filt = ((1 - _np.abs(og[0] / f - c)) *
+                (1 - _np.abs(og[1] / f - c))).astype(w.dtype)
+        w[..., :, :] = filt
+        return jnp.asarray(w)
+

@@ -115,3 +115,9 @@ def shufflenet_v2_x1_5(pretrained=False, **kw):
 
 def shufflenet_v2_x2_0(pretrained=False, **kw):
     return ShuffleNetV2("x2.0", **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    """parity: vision/models/shufflenetv2.py shufflenet_v2_swish — x1.0
+    scale with swish activations."""
+    return ShuffleNetV2(scale="x1.0", act="swish", **kw)

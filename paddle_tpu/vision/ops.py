@@ -21,7 +21,10 @@ from ..ops.dispatch import apply
 __all__ = ["nms", "roi_align", "roi_pool", "box_coder", "box_area", "box_iou",
            "distribute_fpn_proposals", "prior_box", "yolo_box",
            "deform_conv2d", "correlation", "psroi_pool", "matrix_nms",
-           "generate_proposals", "yolo_loss"]
+           "generate_proposals", "yolo_loss",
+           "RoIAlign", "RoIPool", "PSRoIPool", "DeformConv2D",
+           "read_file", "decode_jpeg",
+]
 
 
 def box_area(boxes):
@@ -719,3 +722,96 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
     if gt_score is not None:
         args.append(_t(gt_score))
     return apply("yolo_loss", fn, *args)
+
+
+# ---------------------------------------------------------------------------
+# Layer wrappers + image file ops (parity: vision/ops.py RoIAlign:1316,
+# RoIPool, PSRoIPool, DeformConv2D; vision/image.py read_file/decode_jpeg)
+# ---------------------------------------------------------------------------
+class RoIAlign:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self._a = (output_size, spatial_scale)
+
+    def __call__(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self._a[0], self._a[1],
+                         aligned=aligned)
+
+
+class RoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self._a = (output_size, spatial_scale)
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._a[0], self._a[1])
+
+
+class PSRoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self._a = (output_size, spatial_scale)
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._a[0], self._a[1])
+
+
+class DeformConv2D:
+    """Stateful deformable conv (owns weight/bias like the reference
+    Layer)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        import paddle_tpu as paddle
+
+        ks = (kernel_size if isinstance(kernel_size, (list, tuple))
+              else (kernel_size, kernel_size))
+        self._a = (stride, padding, dilation, deformable_groups, groups)
+        self.weight = paddle.create_parameter(
+            [out_channels, in_channels // groups, *ks], "float32",
+            attr=weight_attr)
+        self.bias = (paddle.create_parameter([out_channels], "float32",
+                                             attr=bias_attr, is_bias=True)
+                     if bias_attr is not False else None)
+
+    def __call__(self, x, offset, mask=None):
+        st, pd, dl, dg, g = self._a
+        return deform_conv2d(x, offset, self.weight, bias=self.bias,
+                             stride=st, padding=pd, dilation=dl,
+                             deformable_groups=dg, groups=g, mask=mask)
+
+
+def read_file(filename, name=None):
+    """parity: vision/image.py read_file — raw bytes as a uint8 tensor."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    return Tensor(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """parity: vision/image.py decode_jpeg — decode a uint8 byte tensor to
+    CHW uint8 (PIL backend)."""
+    import io
+
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+
+    from PIL import Image
+
+    data = bytes(np.asarray(_t(x)._value).astype(np.uint8))
+    img = Image.open(io.BytesIO(data))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))

@@ -237,3 +237,9 @@ class ContrastTransform(BaseTransform):
         f = 1 + _random.uniform(-self.value, self.value)
         mean = arr.mean()
         return np.clip((arr - mean) * f + mean, 0, 255 if arr.max() > 1.5 else 1.0)
+
+
+from .extras import *  # noqa: E402,F401,F403
+from .extras import __all__ as _extras_all  # noqa: E402
+from . import extras as functional_extras  # noqa: E402,F401
+__all__ += _extras_all

@@ -1,0 +1,452 @@
+"""Vision transforms long tail (parity: python/paddle/vision/transforms/
+functional.py + transforms.py) — color jitter, grayscale, geometric warps
+(affine/rotate/perspective via inverse-mapped coordinates), erase. Host-side
+numpy preprocessing like the rest of the package (HWC arrays or PIL)."""
+from __future__ import annotations
+
+import numbers
+import random as _random
+
+import numpy as np
+
+from . import BaseTransform, _chw
+
+__all__ = [
+    "adjust_brightness", "adjust_contrast", "adjust_hue", "to_grayscale",
+    "crop", "pad", "erase", "rotate", "affine", "perspective",
+    "ColorJitter", "Grayscale", "HueTransform", "SaturationTransform",
+    "RandomAffine", "RandomErasing", "RandomPerspective", "RandomRotation",
+]
+
+
+def _as_np(img):
+    from ...core.tensor import Tensor
+
+    if isinstance(img, Tensor):
+        return np.asarray(img._value), "tensor"
+    if isinstance(img, np.ndarray):
+        return img, "np"
+    return np.asarray(img), "pil"
+
+
+def _back(arr, kind, ref=None):
+    if kind == "pil":
+        from PIL import Image
+
+        return Image.fromarray(np.clip(arr, 0, 255).astype(np.uint8))
+    if kind == "tensor":
+        from ...core.tensor import Tensor
+
+        import jax.numpy as jnp
+
+        return Tensor(jnp.asarray(arr))
+    return arr
+
+
+def _maxval(arr):
+    return 255.0 if arr.dtype == np.uint8 or arr.max() > 1.5 else 1.0
+
+
+# ---------------------------------------------------------------------------
+# color ops
+# ---------------------------------------------------------------------------
+def adjust_brightness(img, brightness_factor):
+    """parity: transforms/functional.py adjust_brightness — img * factor."""
+    arr, kind = _as_np(img)
+    out = np.clip(arr.astype(np.float32) * brightness_factor, 0,
+                  _maxval(arr))
+    return _back(out.astype(arr.dtype if arr.dtype != np.uint8 else
+                            np.float32) if kind != "pil" else out, kind)
+
+
+def adjust_contrast(img, contrast_factor):
+    """Blend with the grayscale mean."""
+    arr, kind = _as_np(img)
+    f = arr.astype(np.float32)
+    gray = f.mean() if f.ndim == 2 else (
+        f[..., :3] @ np.array([0.299, 0.587, 0.114], np.float32)).mean()
+    out = np.clip((f - gray) * contrast_factor + gray, 0, _maxval(arr))
+    return _back(out if kind != "pil" else out, kind)
+
+
+def _rgb_to_hsv(rgb):
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    maxc = np.max(rgb, -1)
+    minc = np.min(rgb, -1)
+    v = maxc
+    d = maxc - minc
+    s = np.where(maxc == 0, 0, d / np.maximum(maxc, 1e-12))
+    dz = np.maximum(d, 1e-12)
+    rc = (maxc - r) / dz
+    gc = (maxc - g) / dz
+    bc = (maxc - b) / dz
+    h = np.where(maxc == r, bc - gc,
+                 np.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = np.where(d == 0, 0.0, (h / 6.0) % 1.0)
+    return h, s, v
+
+
+def _hsv_to_rgb(h, s, v):
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - s * f)
+    t = v * (1 - s * (1 - f))
+    i = i.astype(np.int32) % 6
+    choices = [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+               np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+               np.stack([t, p, v], -1), np.stack([v, p, q], -1)]
+    out = np.zeros(h.shape + (3,), np.float32)
+    for k in range(6):
+        out = np.where((i == k)[..., None], choices[k], out)
+    return out
+
+
+def adjust_hue(img, hue_factor):
+    """parity: adjust_hue — shift hue channel by hue_factor ∈ [-0.5, 0.5]."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr, kind = _as_np(img)
+    mx = _maxval(arr)
+    f = arr.astype(np.float32) / mx
+    h, s, v = _rgb_to_hsv(f[..., :3])
+    h = (h + hue_factor) % 1.0
+    out = _hsv_to_rgb(h, s, v) * mx
+    if arr.shape[-1] > 3:
+        out = np.concatenate([out, arr[..., 3:].astype(np.float32)], -1)
+    return _back(out, kind)
+
+
+def to_grayscale(img, num_output_channels=1):
+    """parity: to_grayscale — ITU-R 601 luma."""
+    arr, kind = _as_np(img)
+    f = arr.astype(np.float32)
+    gray = f[..., :3] @ np.array([0.299, 0.587, 0.114], np.float32)
+    out = np.repeat(gray[..., None], num_output_channels, axis=-1)
+    return _back(out, kind)
+
+
+# ---------------------------------------------------------------------------
+# geometry
+# ---------------------------------------------------------------------------
+def crop(img, top, left, height, width):
+    arr, kind = _as_np(img)
+    return _back(arr[top:top + height, left:left + width], kind)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    """parity: functional.pad — [left, right, top, bottom] (int → all)."""
+    arr, kind = _as_np(img)
+    if isinstance(padding, numbers.Number):
+        pl = pr = pt = pb = int(padding)
+    elif len(padding) == 2:
+        pl = pr = int(padding[0])
+        pt = pb = int(padding[1])
+    else:
+        pl, pt, pr, pb = (int(p) for p in padding)
+    pads = [(pt, pb), (pl, pr)] + [(0, 0)] * (arr.ndim - 2)
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    return _back(np.pad(arr, pads, mode=mode, **kw), kind)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """parity: functional.erase — fill the region [i:i+h, j:j+w] with v."""
+    arr, kind = _as_np(img)
+    out = arr if inplace and kind == "np" else arr.copy()
+    chw = out.ndim == 3 and out.shape[0] in (1, 3) and \
+        out.shape[0] < out.shape[-1]
+    val = np.asarray(v._value) if hasattr(v, "_value") else np.asarray(v)
+    if chw:
+        out[:, i:i + h, j:j + w] = val.reshape(-1, 1, 1) \
+            if val.ndim <= 1 else val
+    else:
+        out[i:i + h, j:j + w] = val.reshape(1, 1, -1) if val.ndim <= 1 \
+            else val
+    return _back(out, kind)
+
+
+def _inverse_map(arr, inv_matrix, fill=0.0):
+    """Sample arr (H, W, C) at inverse-mapped coordinates (3x3 homography,
+    output→input), bilinear."""
+    H, W = arr.shape[:2]
+    ys, xs = np.mgrid[0:H, 0:W].astype(np.float32)
+    ones = np.ones_like(xs)
+    coords = np.stack([xs, ys, ones], 0).reshape(3, -1)     # x, y, 1
+    src = inv_matrix @ coords
+    sx = src[0] / np.maximum(np.abs(src[2]), 1e-9) * np.sign(src[2])
+    sy = src[1] / np.maximum(np.abs(src[2]), 1e-9) * np.sign(src[2])
+    x0 = np.floor(sx).astype(np.int32)
+    y0 = np.floor(sy).astype(np.int32)
+    wx = sx - x0
+    wy = sy - y0
+    out = np.zeros((H * W,) + arr.shape[2:], np.float32)
+    valid = (sx >= -1) & (sx <= W) & (sy >= -1) & (sy <= H)
+
+    def gather(yy, xx):
+        inb = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+        vals = arr[np.clip(yy, 0, H - 1), np.clip(xx, 0, W - 1)].astype(
+            np.float32)
+        shape = (-1,) + (1,) * (arr.ndim - 2)
+        return np.where(inb.reshape(shape), vals, fill)
+
+    shape = (-1,) + (1,) * (arr.ndim - 2)
+    out = (gather(y0, x0) * ((1 - wx) * (1 - wy)).reshape(shape)
+           + gather(y0, x0 + 1) * (wx * (1 - wy)).reshape(shape)
+           + gather(y0 + 1, x0) * ((1 - wx) * wy).reshape(shape)
+           + gather(y0 + 1, x0 + 1) * (wx * wy).reshape(shape))
+    out = np.where(valid.reshape(shape), out, fill)
+    return out.reshape(arr.shape)
+
+
+def _affine_matrix(angle, translate, scale, shear, center):
+    rot = np.deg2rad(angle)
+    sx, sy = (np.deg2rad(s) for s in (shear if isinstance(
+        shear, (list, tuple)) else (shear, 0.0)))
+    cx, cy = center
+    tx, ty = translate
+    # forward matrix: T(center) R S Sh T(-center) + translate
+    a = np.cos(rot - sy) / np.cos(sy)
+    b = -np.cos(rot - sy) * np.tan(sx) / np.cos(sy) - np.sin(rot)
+    c = np.sin(rot - sy) / np.cos(sy)
+    d = -np.sin(rot - sy) * np.tan(sx) / np.cos(sy) + np.cos(rot)
+    M = np.array([[a * scale, b * scale, 0.0],
+                  [c * scale, d * scale, 0.0],
+                  [0.0, 0.0, 1.0]], np.float32)
+    M[0, 2] = cx + tx - M[0, 0] * cx - M[0, 1] * cy
+    M[1, 2] = cy + ty - M[1, 0] * cx - M[1, 1] * cy
+    return M
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """parity: functional.affine — rotation/translate/scale/shear warp."""
+    arr, kind = _as_np(img)
+    H, W = arr.shape[:2]
+    if center is None:
+        center = ((W - 1) * 0.5, (H - 1) * 0.5)
+    M = _affine_matrix(angle, translate, scale, shear, center)
+    out = _inverse_map(arr, np.linalg.inv(M), fill=float(
+        fill if isinstance(fill, numbers.Number) else fill[0]))
+    return _back(out, kind)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """parity: functional.rotate — counter-clockwise degrees."""
+    arr, kind = _as_np(img)
+    H, W = arr.shape[:2]
+    if center is None:
+        center = ((W - 1) * 0.5, (H - 1) * 0.5)
+    if expand:
+        rad = np.deg2rad(angle)
+        nW = int(np.ceil(abs(W * np.cos(rad)) + abs(H * np.sin(rad))))
+        nH = int(np.ceil(abs(H * np.cos(rad)) + abs(W * np.sin(rad))))
+        padl = (nW - W) // 2
+        padt = (nH - H) // 2
+        arr = np.pad(arr, [(padt, nH - H - padt), (padl, nW - W - padl)]
+                     + [(0, 0)] * (arr.ndim - 2), constant_values=fill)
+        H, W = nH, nW
+        center = ((W - 1) * 0.5, (H - 1) * 0.5)
+    M = _affine_matrix(-angle, (0, 0), 1.0, (0.0, 0.0), center)
+    out = _inverse_map(arr, np.linalg.inv(M), fill=float(
+        fill if isinstance(fill, numbers.Number) else fill[0]))
+    return _back(out, kind)
+
+
+def _homography(src_pts, dst_pts):
+    """DLT: 3x3 H with H @ src ~ dst (points as [[x, y], ...])."""
+    A = []
+    for (x, y), (u, v) in zip(src_pts, dst_pts):
+        A.append([x, y, 1, 0, 0, 0, -u * x, -u * y, -u])
+        A.append([0, 0, 0, x, y, 1, -v * x, -v * y, -v])
+    _, _, Vt = np.linalg.svd(np.asarray(A, np.float64))
+    return Vt[-1].reshape(3, 3).astype(np.float32)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """parity: functional.perspective — warp mapping startpoints →
+    endpoints."""
+    arr, kind = _as_np(img)
+    Hm = _homography(startpoints, endpoints)   # start → end
+    out = _inverse_map(arr, np.linalg.inv(Hm), fill=float(
+        fill if isinstance(fill, numbers.Number) else fill[0]))
+    return _back(out, kind)
+
+
+# ---------------------------------------------------------------------------
+# transform classes
+# ---------------------------------------------------------------------------
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        return adjust_hue(img, _random.uniform(-self.value, self.value))
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        arr, kind = _as_np(img)
+        f = arr.astype(np.float32)
+        gray = (f[..., :3] @ np.array([0.299, 0.587, 0.114],
+                                      np.float32))[..., None]
+        factor = 1 + _random.uniform(-self.value, self.value)
+        out = np.clip(gray + (f[..., :3] - gray) * factor, 0, _maxval(arr))
+        if arr.shape[-1] > 3:
+            out = np.concatenate([out, f[..., 3:]], -1)
+        return _back(out, kind)
+
+
+class ColorJitter(BaseTransform):
+    """parity: transforms.ColorJitter — random brightness/contrast/
+    saturation/hue in random order."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self.brightness = brightness
+        self.contrast = contrast
+        self.saturation = saturation
+        self.hue = hue
+
+    def _apply_image(self, img):
+        ops = []
+        if self.brightness:
+            f = 1 + _random.uniform(-self.brightness, self.brightness)
+            ops.append(lambda im: adjust_brightness(im, f))
+        if self.contrast:
+            fc = 1 + _random.uniform(-self.contrast, self.contrast)
+            ops.append(lambda im: adjust_contrast(im, fc))
+        if self.saturation:
+            st = SaturationTransform(self.saturation)
+            ops.append(st._apply_image)
+        if self.hue:
+            fh = _random.uniform(-self.hue, self.hue)
+            ops.append(lambda im: adjust_hue(im, fh))
+        _random.shuffle(ops)
+        for op in ops:
+            img = op(img)
+        return img
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = _random.uniform(*self.degrees)
+        return rotate(img, angle, expand=self.expand, center=self.center,
+                      fill=self.fill)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        arr, _ = _as_np(img)
+        H, W = arr.shape[:2]
+        angle = _random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = _random.uniform(-self.translate[0], self.translate[0]) * W
+            ty = _random.uniform(-self.translate[1], self.translate[1]) * H
+        sc = 1.0 if self.scale is None else _random.uniform(*self.scale)
+        sh = 0.0
+        if self.shear is not None:
+            s = self.shear
+            if isinstance(s, numbers.Number):
+                s = (-s, s)
+            sh = _random.uniform(s[0], s[1])
+        return affine(img, angle, (tx, ty), sc, sh, fill=self.fill,
+                      center=self.center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if _random.random() >= self.prob:
+            return img
+        arr, _ = _as_np(img)
+        H, W = arr.shape[:2]
+        d = self.distortion_scale
+        hw = int(W * d / 2)
+        hh = int(H * d / 2)
+
+        def jig(x, y):
+            return (x + _random.randint(-hw, hw) if hw else x,
+                    y + _random.randint(-hh, hh) if hh else y)
+
+        start = [(0, 0), (W - 1, 0), (W - 1, H - 1), (0, H - 1)]
+        end = [jig(*p) for p in start]
+        return perspective(img, start, end, fill=self.fill)
+
+
+class RandomErasing(BaseTransform):
+    """parity: transforms.RandomErasing — erase a random region with value
+    or random noise."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def _apply_image(self, img):
+        if _random.random() >= self.prob:
+            return img
+        arr, kind = _as_np(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and \
+            arr.shape[0] < arr.shape[-1]
+        H, W = (arr.shape[1], arr.shape[2]) if chw else arr.shape[:2]
+        C = arr.shape[0] if chw else (arr.shape[2] if arr.ndim == 3 else 1)
+        area = H * W
+        for _ in range(10):
+            target = _random.uniform(*self.scale) * area
+            ar = np.exp(_random.uniform(*np.log(self.ratio)))
+            h = int(round(np.sqrt(target * ar)))
+            w = int(round(np.sqrt(target / ar)))
+            if h < H and w < W:
+                i = _random.randint(0, H - h)
+                j = _random.randint(0, W - w)
+                if self.value == "random":
+                    v = np.random.normal(size=(C, h, w) if chw
+                                         else (h, w, C)).astype(np.float32)
+                else:
+                    v = np.asarray(self.value, np.float32)
+                return erase(img, i, j, h, w, v, inplace=self.inplace)
+        return img

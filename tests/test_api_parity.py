@@ -39,6 +39,10 @@ _MODULES = [
     "io", "optimizer", "metric", "autograd", "jit", "static", "vision",
     "distribution", "audio", "text", "geometric", "incubate",
     "quantization", "device", "utils", "distributed",
+    # deep namespaces (SURVEY §2.5 package inventory)
+    "vision.transforms", "vision.ops", "vision.models", "vision.datasets",
+    "incubate.nn.functional", "distributed.fleet", "nn.initializer",
+    "nn.utils", "amp.debugging", "incubate.autograd", "optimizer.lr",
 ]
 
 
@@ -49,7 +53,8 @@ def test_all_exports_resolve(modname):
     path = (f"{REF}/{modname.replace('.', '/')}/__init__.py" if modname
             else f"{REF}/__init__.py")
     if modname and not os.path.exists(path):
-        path = f"{REF}/{modname}.py"  # flat re-export modules (linalg, fft)
+        # flat modules (linalg.py, amp/debugging.py)
+        path = f"{REF}/{modname.replace('.', '/')}.py"
     here = paddle
     for part in (modname.split(".") if modname else []):
         here = getattr(here, part)
