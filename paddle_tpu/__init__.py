@@ -84,6 +84,11 @@ from . import text  # noqa: F401
 from . import quantization  # noqa: F401
 from . import inference  # noqa: F401
 from . import utils  # noqa: F401
+from . import callbacks  # noqa: F401
+from . import hub  # noqa: F401
+from . import onnx  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import sysconfig  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 from .hapi.summary import summary  # noqa: F401
 from .hapi.dynamic_flops import flops  # noqa: F401
@@ -106,9 +111,13 @@ def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
     from .nn import initializer as _init
 
     d = _cd(dtype)
-    init = default_initializer
-    if init is None and attr is not None:
+    # precedence mirrors the reference LayerHelper: an attr-supplied
+    # initializer wins; default_initializer applies only when absent
+    init = None
+    if attr is not None:
         init = getattr(ParamAttr._to_attr(attr), "initializer", None)
+    if init is None:
+        init = default_initializer
     if init is None:
         init = (_init.Constant(0.0) if is_bias
                 else _init.XavierNormal())

@@ -1,0 +1,10 @@
+"""paddle.callbacks namespace (parity: python/paddle/hapi/callbacks.py
+re-exported as paddle.callbacks)."""
+from .hapi.callbacks import (  # noqa: F401
+    Callback, CallbackList, EarlyStopping, LRScheduler, ModelCheckpoint,
+    ProgBarLogger, ReduceLROnPlateau, VisualDL, WandbCallback,
+)
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "VisualDL",
+           "LRScheduler", "EarlyStopping", "ReduceLROnPlateau",
+           "WandbCallback"]

@@ -88,24 +88,38 @@ class ModelCheckpoint(Callback):
             self.model.save(f"{self.save_dir}/{epoch}")
 
 
-class EarlyStopping(Callback):
-    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
-                 min_delta=0, baseline=None, save_best_model=True):
+class _MonitorMixin:
+    """Shared monitor resolution + mode comparator (EarlyStopping /
+    ReduceLROnPlateau)."""
+
+    def _init_monitor(self, monitor, mode, min_delta):
         self.monitor = monitor
-        self.patience = patience
         self.min_delta = abs(min_delta)
-        self.baseline = baseline
-        self.wait = 0
-        self.best = None
         if mode == "max" or (mode == "auto" and "acc" in monitor):
             self.cmp = lambda cur, best: cur > best + self.min_delta
         else:
             self.cmp = lambda cur, best: cur < best - self.min_delta
 
-    def on_epoch_end(self, epoch, logs=None):
+    def _current(self, logs):
         cur = (logs or {}).get(self.monitor)
         if cur is None:
             cur = (logs or {}).get("eval_" + self.monitor)
+        return cur
+
+
+class EarlyStopping(_MonitorMixin, Callback):
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        self._init_monitor(monitor, mode, min_delta)
+        self.patience = patience
+        # reference semantics: with a baseline, patience counts epochs that
+        # fail to beat it (best starts at the baseline)
+        self.baseline = baseline
+        self.wait = 0
+        self.best = baseline
+
+    def on_epoch_end(self, epoch, logs=None):
+        cur = self._current(logs)
         if cur is None:
             return
         if self.best is None or self.cmp(cur, self.best):
@@ -138,8 +152,138 @@ class LRScheduler(Callback):
 
 
 class VisualDL(Callback):
+    """parity: hapi/callbacks.py VisualDL — scalar logging. The VisualDL
+    package is not in this image; scalars are written as TSV lines under
+    log_dir (load them into any viewer)."""
+
     def __init__(self, log_dir="./log"):
         self.log_dir = log_dir
+        self._files = {}
+
+    def _write(self, tag, step, value):
+        import os
+
+        os.makedirs(self.log_dir, exist_ok=True)
+        f = self._files.get(tag)
+        if f is None:
+            path = os.path.join(self.log_dir,
+                                tag.replace("/", "_") + ".tsv")
+            f = self._files[tag] = open(path, "a")
+        f.write(f"{step}\t{value}\n")
+        f.flush()
 
     def on_train_batch_end(self, step, logs=None):
-        pass
+        for k, v in (logs or {}).items():
+            try:
+                self._write(f"train/{k}", step, float(v))
+            except (TypeError, ValueError):
+                pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        for k, v in (logs or {}).items():
+            try:
+                self._write(f"epoch/{k}", epoch, float(v))
+            except (TypeError, ValueError):
+                pass
+
+    def on_train_end(self, logs=None):
+        for f in self._files.values():
+            f.close()
+        self._files = {}
+
+
+class WandbCallback(Callback):
+    """parity: hapi/callbacks.py WandbCallback — logs train/eval scalars to
+    a wandb run (requires the wandb package; raises a clear error if
+    absent)."""
+
+    def __init__(self, project=None, entity=None, name=None, dir=None,  # noqa: A002
+                 mode=None, job_type=None, **kwargs):
+        try:
+            import wandb
+        except ImportError as e:
+            raise ModuleNotFoundError(
+                "WandbCallback requires `wandb`, which is not installed in "
+                "this environment") from e
+        self._wandb = wandb
+        self._init_kwargs = dict(project=project, entity=entity, name=name,
+                                 dir=dir, mode=mode, job_type=job_type,
+                                 **kwargs)
+        self._run = None
+
+    def on_train_begin(self, logs=None):
+        if self._run is None:
+            self._run = self._wandb.init(**{
+                k: v for k, v in self._init_kwargs.items()
+                if v is not None})
+
+    def _log(self, prefix, step, logs):
+        if self._run is not None and logs:
+            self._run.log({f"{prefix}/{k}": v for k, v in logs.items()
+                           if isinstance(v, (int, float))}, step=step)
+
+    def on_train_batch_end(self, step, logs=None):
+        self._log("train", step, logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._log("epoch", epoch, logs)
+
+    def on_train_end(self, logs=None):
+        if self._run is not None:
+            self._run.finish()
+            self._run = None
+
+
+class ReduceLROnPlateau(_MonitorMixin, Callback):
+    """parity: hapi/callbacks.py ReduceLROnPlateau — scales the optimizer
+    LR when the monitored metric stops improving."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        self._init_monitor(monitor, mode, min_delta)
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.wait = 0
+        self.cooldown_counter = 0
+        self.best = None
+
+    def on_epoch_end(self, epoch, logs=None):
+        cur = self._current(logs)
+        if cur is None:
+            return
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self.best is None or self.cmp(cur, self.best):
+            self.best = cur
+            self.wait = 0
+        elif self.cooldown_counter <= 0:
+            self.wait += 1
+            if self.wait >= self.patience:
+                opt = getattr(self.model, "_optimizer", None)
+                if opt is not None:
+                    old = float(opt.get_lr())
+                    new = max(old * self.factor, self.min_lr)
+                    if new < old:
+                        sched = getattr(opt, "_learning_rate_scheduler",
+                                        None)
+                        if sched is not None and hasattr(sched, "base_lr"):
+                            # LR comes from a scheduler: scale its base
+                            # with the min_lr clamp (set_lr raises in that
+                            # configuration)
+                            scale = new / old
+                            sched.base_lr = sched.base_lr * scale
+                        else:
+                            opt.set_lr(new)
+                        changed = abs(float(opt.get_lr()) - old) > 1e-12
+                        if self.verbose and changed:
+                            print(f"ReduceLROnPlateau: lr {old:g} -> {new:g}")
+                        if not changed:
+                            # scheduler ignores base_lr (e.g. PiecewiseDecay)
+                            # — nothing was reduced; don't reset the wait
+                            return
+                self.cooldown_counter = self.cooldown
+                self.wait = 0

@@ -1,0 +1,60 @@
+"""paddle.hub (parity: python/paddle/hub.py) — load models from a local
+hubconf.py directory. This environment has no network egress, so only the
+local-dir source works; github/gitee sources raise."""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load", "load_state_dict_from_url"]
+
+_ENTRY = "hubconf.py"
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, _ENTRY)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"hub: no {_ENTRY} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["paddle_tpu_hubconf"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_source(source):
+    if source not in ("local",):
+        raise ValueError(
+            f"hub source {source!r} unavailable: no network egress in this "
+            "environment; clone the repo and use source='local'")
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    """Entrypoints exposed by the repo's hubconf.py."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [k for k, v in vars(mod).items()
+            if callable(v) and not k.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    if not hasattr(mod, model):
+        raise RuntimeError(f"hub: no entrypoint {model!r} in {repo_dir}")
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    if not hasattr(mod, model):
+        raise RuntimeError(f"hub: no entrypoint {model!r} in {repo_dir}")
+    return getattr(mod, model)(**kwargs)
+
+
+def load_state_dict_from_url(url, model_dir=None, check_hash=False):
+    raise RuntimeError(
+        "hub.load_state_dict_from_url: no network egress in this "
+        "environment; download the file out-of-band and use paddle.load")

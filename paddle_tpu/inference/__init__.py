@@ -134,3 +134,97 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+class DataType:
+    """parity: paddle_infer.DataType enum."""
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+    FLOAT64 = 7
+    BOOL = 8
+
+
+class PrecisionType:
+    """parity: paddle_infer.PrecisionType enum (TRT precision knob; on TPU
+    the analogue is the XLA compile dtype)."""
+    Float32 = 0
+    Half = 1
+    Int8 = 2
+    Bfloat16 = 3
+
+
+class XpuConfig:
+    """parity: paddle_infer.XpuConfig — accepted for config compat; no XPU
+    in this build."""
+
+    def __init__(self):
+        self.device_id = 0
+        self.l3_size = 0
+
+
+class PredictorPool:
+    """parity: paddle_infer.PredictorPool — N predictor handles over ONE
+    loaded program (the model deserializes once; XLA executables are
+    thread-safe, so handles share the compiled artifact)."""
+
+    def __init__(self, config, size=1):
+        first = create_predictor(config)
+        self._predictors = [first]
+        for _ in range(int(size) - 1):
+            clone = Predictor.__new__(Predictor)
+            clone.__dict__.update(first.__dict__)
+            self._predictors.append(clone)
+
+    def retrieve(self, idx):
+        return self._predictors[idx]
+
+
+def get_version():
+    from .. import __version__
+
+    return __version__
+
+
+def get_trt_compile_version():
+    """No TensorRT on TPU — the XLA AOT path replaces it."""
+    return (0, 0, 0)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def get_num_bytes_of_data_type(dtype):
+    sizes = {DataType.FLOAT32: 4, DataType.INT64: 8, DataType.INT32: 4,
+             DataType.UINT8: 1, DataType.INT8: 1, DataType.FLOAT16: 2,
+             DataType.BFLOAT16: 2, DataType.FLOAT64: 8, DataType.BOOL: 1}
+    return sizes.get(dtype, 4)
+
+
+def _get_phi_kernel_name(op_name):
+    """parity shim: kernel naming is an XLA concern here; identity map."""
+    return op_name
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision=None,
+                               backend=None, keep_io_types=True,
+                               black_list=None, **kwargs):
+    """parity: inference convert_to_mixed_precision — the reference rewrites
+    a saved program to fp16/bf16. StableHLO exports here stay dtype-typed;
+    re-export the model with amp.auto_cast (documented path)."""
+    raise NotImplementedError(
+        "convert_to_mixed_precision: re-export the model under "
+        "paddle_tpu.amp.auto_cast(dtype='bfloat16') + jit.save — StableHLO "
+        "artifacts carry their dtypes (XLA is the precision rewrite layer)")
+
+
+__all__ += ["DataType", "PrecisionType", "XpuConfig", "PredictorPool",
+            "get_version", "get_trt_compile_version",
+            "get_trt_runtime_version", "get_num_bytes_of_data_type",
+            "convert_to_mixed_precision", "_get_phi_kernel_name"]

@@ -1,0 +1,10 @@
+"""paddle.onnx (parity: python/paddle/onnx/) — ONNX export hook.
+
+The reference shells out to paddle2onnx; that toolchain is CUDA-ecosystem
+specific and not in this image. The TPU-native interchange format is
+StableHLO (paddle_tpu.jit.save) — ONNX export raises with that pointer
+unless paddle2onnx is importable."""
+from . import export as _export_mod  # noqa: F401
+from .export import export  # noqa: F401
+
+__all__ = ["export"]

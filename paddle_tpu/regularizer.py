@@ -1,0 +1,12 @@
+"""paddle.regularizer (parity: python/paddle/regularizer.py)."""
+from .optimizer import L1Decay, L2Decay  # noqa: F401
+
+
+class WeightDecayRegularizer:
+    """Base interface of weight-decay regularizers."""
+
+    def __call__(self, param, grad, block=None):
+        raise NotImplementedError
+
+
+__all__ = ["L1Decay", "L2Decay"]

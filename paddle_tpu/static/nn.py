@@ -17,11 +17,17 @@ __all__ = [
 ]
 
 
-def _param(shape, dtype="float32", attr=None, is_bias=False):
+def _param(shape, dtype="float32", attr=None, is_bias=False, ones=False):
     import paddle_tpu as paddle
 
+    init = None
+    if ones:  # norm scales default to 1 (reference LayerHelper behavior)
+        from paddle_tpu.nn import initializer as _I
+
+        init = _I.Constant(1.0)
     return paddle.create_parameter(list(shape), dtype, attr=attr,
-                                   is_bias=is_bias)
+                                   is_bias=is_bias,
+                                   default_initializer=init)
 
 
 def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
@@ -87,7 +93,7 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,  # noqa: A002
     import paddle_tpu.nn.functional as F
 
     shape = input.shape[begin_norm_axis:]
-    w = _param(shape, attr=param_attr) if scale else None
+    w = _param(shape, attr=param_attr, ones=True) if scale else None
     b = _param(shape, attr=bias_attr, is_bias=True) if shift else None
     out = F.layer_norm(input, shape, w, b, epsilon)
     if act:
@@ -100,7 +106,7 @@ def group_norm(input, groups, epsilon=1e-5, param_attr=None,  # noqa: A002
     import paddle_tpu.nn.functional as F
 
     C = input.shape[1 if data_layout == "NCHW" else -1]
-    w = _param([C], attr=param_attr)
+    w = _param([C], attr=param_attr, ones=True)
     b = _param([C], attr=bias_attr, is_bias=True)
     out = F.group_norm(input, groups, epsilon, w, b,
                        data_format=data_layout)
@@ -114,7 +120,7 @@ def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,  # noqa:
     import paddle_tpu.nn.functional as F
 
     C = input.shape[1]
-    w = _param([C], attr=param_attr)
+    w = _param([C], attr=param_attr, ones=True)
     b = _param([C], attr=bias_attr, is_bias=True)
     return F.instance_norm(input, weight=w, bias=b, eps=epsilon)
 

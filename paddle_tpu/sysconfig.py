@@ -1,0 +1,16 @@
+"""paddle.sysconfig (parity: python/paddle/sysconfig.py)."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    """Directory of framework headers (the C ABI of csrc/)."""
+    return os.path.join(os.path.dirname(_ROOT), "csrc")
+
+
+def get_lib():
+    """Directory containing the native runtime library."""
+    return os.path.join(os.path.dirname(_ROOT), "csrc")

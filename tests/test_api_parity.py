@@ -43,6 +43,7 @@ _MODULES = [
     "vision.transforms", "vision.ops", "vision.models", "vision.datasets",
     "incubate.nn.functional", "distributed.fleet", "nn.initializer",
     "nn.utils", "amp.debugging", "incubate.autograd", "optimizer.lr",
+    "inference", "callbacks", "regularizer", "hub", "onnx", "sysconfig",
 ]
 
 
