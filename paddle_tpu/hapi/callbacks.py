@@ -217,16 +217,20 @@ class WandbCallback(Callback):
                 k: v for k, v in self._init_kwargs.items()
                 if v is not None})
 
-    def _log(self, prefix, step, logs):
+    def _log(self, prefix, logs):
+        # wandb's global step must increase monotonically; fit() resets its
+        # batch index each epoch, so keep our own counter
         if self._run is not None and logs:
+            self._global_step = getattr(self, "_global_step", 0) + 1
             self._run.log({f"{prefix}/{k}": v for k, v in logs.items()
-                           if isinstance(v, (int, float))}, step=step)
+                           if isinstance(v, (int, float))},
+                          step=self._global_step)
 
     def on_train_batch_end(self, step, logs=None):
-        self._log("train", step, logs)
+        self._log("train", logs)
 
     def on_epoch_end(self, epoch, logs=None):
-        self._log("epoch", epoch, logs)
+        self._log("epoch", logs)
 
     def on_train_end(self, logs=None):
         if self._run is not None:
@@ -271,19 +275,27 @@ class ReduceLROnPlateau(_MonitorMixin, Callback):
                         sched = getattr(opt, "_learning_rate_scheduler",
                                         None)
                         if sched is not None and hasattr(sched, "base_lr"):
-                            # LR comes from a scheduler: scale its base
-                            # with the min_lr clamp (set_lr raises in that
-                            # configuration)
-                            scale = new / old
-                            sched.base_lr = sched.base_lr * scale
+                            # scale the scheduler's base with the min_lr
+                            # clamp (set_lr raises in that configuration);
+                            # recompute get_lr() to detect schedulers that
+                            # ignore base_lr (e.g. PiecewiseDecay) —
+                            # last_lr is a cache, so refresh it too
+                            prev_base = sched.base_lr
+                            before = float(sched.get_lr())
+                            sched.base_lr = prev_base * (new / old)
+                            after = float(sched.get_lr())
+                            changed = abs(after - before) > 1e-12
+                            if changed:
+                                sched.last_lr = after
+                            else:
+                                sched.base_lr = prev_base
                         else:
                             opt.set_lr(new)
-                        changed = abs(float(opt.get_lr()) - old) > 1e-12
+                            changed = True
                         if self.verbose and changed:
                             print(f"ReduceLROnPlateau: lr {old:g} -> {new:g}")
                         if not changed:
-                            # scheduler ignores base_lr (e.g. PiecewiseDecay)
-                            # — nothing was reduced; don't reset the wait
+                            # nothing was reduced; don't reset the wait
                             return
                 self.cooldown_counter = self.cooldown
                 self.wait = 0

@@ -49,3 +49,4 @@ from .layer.norm import SpectralNorm  # noqa: F401
 from .layer.extras import *  # noqa: F401,F403
 from .layer.extras import BeamSearchDecoder, dynamic_decode  # noqa: F401
 from . import utils  # noqa: F401
+from . import quant  # noqa: F401

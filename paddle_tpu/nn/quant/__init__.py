@@ -1,0 +1,8 @@
+"""paddle.nn.quant (parity: python/paddle/nn/quant/) — weight-only
+quantization for LLM serving."""
+from .quantized_linear import (  # noqa: F401
+    llm_int8_linear, weight_dequantize, weight_only_linear, weight_quantize,
+)
+
+__all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
+           "llm_int8_linear"]

@@ -1,0 +1,185 @@
+"""Weight-only quantized linear ops.
+
+Parity: python/paddle/nn/quant/quantized_linear.py (weight_quantize:64,
+weight_dequantize:131, weight_only_linear:191, llm_int8_linear:285), which
+back onto the cutlass fpA_intB grouped GEMMs
+(phi/kernels/fusion/cutlass_kernels/). TPU-native: int8/int4 weights are
+stored packed and dequantized inline by XLA (convert+multiply fuses into
+the bf16 MXU matmul) — the memory/bandwidth win of weight-only quant is the
+same; the `arch` argument is accepted and ignored (no SM architectures on
+TPU).
+
+Layout contract matches the reference: weight [in, out]; quantized weight
+int8 [in, out] for int8 / packed uint8? — the reference returns an int8
+tensor of shape [in, out] (int8) or [in/2, out] (int4 packed two-per-byte);
+scales [out] (per-channel) or [in/group_size, out] (group-wise).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.creation import _t
+from ...ops.dispatch import apply
+
+__all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
+           "llm_int8_linear"]
+
+_ALGOS = ("weight_only_int8", "weight_only_int4", "llm.int8")
+
+
+def _check_algo(algo):
+    if algo not in _ALGOS:
+        raise ValueError(f"algo must be one of {_ALGOS}, got {algo!r}")
+
+
+def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1,
+                    name=None):
+    """Quantize a [in, out] weight; returns (quantized_weight, scale).
+    Per-channel (group_size=-1) or group-wise (64/128) absmax scaling."""
+    _check_algo(algo)
+    if group_size not in (-1, 64, 128):
+        raise ValueError("group_size must be -1, 64 or 128")
+
+    def fn(w):
+        K, N = w.shape
+        wf = w.astype(jnp.float32)
+        qmax = 127.0 if algo != "weight_only_int4" else 7.0
+        if group_size == -1:
+            scale = jnp.max(jnp.abs(wf), axis=0) / qmax          # [N]
+            q = jnp.round(wf / jnp.maximum(scale[None, :], 1e-9))
+        else:
+            G = K // group_size
+            wg = wf.reshape(G, group_size, N)
+            scale = jnp.max(jnp.abs(wg), axis=1) / qmax          # [G, N]
+            q = jnp.round(wg / jnp.maximum(scale[:, None, :], 1e-9))
+            q = q.reshape(K, N)
+        q = jnp.clip(q, -qmax - 1, qmax)
+        if algo == "weight_only_int4":
+            # pack two int4 per int8 along the in dim (reference layout
+            # [in/2, out])
+            lo = q[0::2].astype(jnp.int32) & 0xF
+            hi = q[1::2].astype(jnp.int32) & 0xF
+            packed = (lo | (hi << 4)).astype(jnp.int8)
+            return packed, scale.astype(w.dtype)
+        return q.astype(jnp.int8), scale.astype(w.dtype)
+
+    qw, scale = apply("weight_quantize", fn, _t(x))
+    return qw, scale
+
+
+def _unpack_int4(q):
+    lo = (q.astype(jnp.int32) & 0xF)
+    hi = ((q.astype(jnp.int32) >> 4) & 0xF)
+    # sign-extend 4-bit values
+    lo = jnp.where(lo > 7, lo - 16, lo)
+    hi = jnp.where(hi > 7, hi - 16, hi)
+    K2, N = q.shape
+    out = jnp.zeros((K2 * 2, N), jnp.int32)
+    out = out.at[0::2].set(lo)
+    out = out.at[1::2].set(hi)
+    return out
+
+
+def _dequant(qw, scale, algo, group_size, out_dtype):
+    q = _unpack_int4(qw) if algo == "weight_only_int4" else \
+        qw.astype(jnp.int32)
+    K = q.shape[0]
+    if scale.ndim == 1:
+        w = q.astype(jnp.float32) * scale.astype(jnp.float32)[None, :]
+    else:
+        G = scale.shape[0]
+        gs = K // G
+        w = (q.reshape(G, gs, -1).astype(jnp.float32)
+             * scale.astype(jnp.float32)[:, None, :]).reshape(K, -1)
+    return w.astype(out_dtype)
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float16",
+                      group_size=-1, name=None):
+    """Inverse of weight_quantize → dense [in, out] weight."""
+    _check_algo(algo)
+    from ...framework.dtype import convert_dtype
+
+    dt = convert_dtype(out_dtype).np_dtype
+
+    return apply("weight_dequantize",
+                 lambda q, s: _dequant(q, s, algo, group_size, dt),
+                 _t(x), _t(scale))
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1,
+                       name=None):
+    """x @ dequant(weight) + bias — the weight stays quantized in memory;
+    XLA fuses the dequant into the matmul epilogue."""
+    algo = ("weight_only_int4" if str(weight_dtype) in ("int4",)
+            else "weight_only_int8")
+
+    def fn(xv, qw, *rest):
+        i = 0
+        scale = None
+        if weight_scale is not None:
+            scale = rest[i]
+            i += 1
+        w = _dequant(qw, scale, algo, group_size, xv.dtype) if scale is not \
+            None else qw.astype(xv.dtype)
+        out = xv @ w
+        if bias is not None:
+            out = out + rest[i]
+        return out
+
+    args = [_t(x), _t(weight)]
+    if weight_scale is not None:
+        args.append(_t(weight_scale))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply("weight_only_linear", fn, *args)
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None, threshold=6.0,
+                    name=None):
+    """LLM.int8(): outlier channels (|x| > threshold) run in the activation
+    dtype; the rest run int8×int8 with per-channel dequant (Dettmers 2022).
+    weight: int8 [in, out]; weight_scale [out]."""
+    def fn(xv, qw, *rest):
+        i = 0
+        scale = None
+        if weight_scale is not None:
+            scale = rest[i].astype(jnp.float32)
+            i += 1
+        xf = xv.astype(jnp.float32)
+        # outlier channels of the activation (per last-dim feature)
+        red_axes = tuple(range(xf.ndim - 1))
+        is_outlier = jnp.max(jnp.abs(xf), axis=red_axes) > threshold  # [K]
+        x_reg = jnp.where(is_outlier[None, :] if xf.ndim == 2
+                          else is_outlier[(None,) * (xf.ndim - 1)],
+                          0.0, xf)
+        x_out = xf - x_reg
+        # int8 path: quantize regular activations per-row absmax
+        amax = jnp.max(jnp.abs(x_reg), axis=-1, keepdims=True)
+        xs = jnp.maximum(amax / 127.0, 1e-9)
+        xq = jnp.round(x_reg / xs).astype(jnp.int8)
+        acc = jax.lax.dot_general(
+            xq, qw, (((xq.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32).astype(jnp.float32)
+        deq = acc * xs
+        if scale is not None:
+            deq = deq * scale
+            w_out = qw.astype(jnp.float32) * scale[None, :]
+        else:
+            w_out = qw.astype(jnp.float32)
+        # outlier path in full precision
+        out = deq + x_out @ w_out
+        return out.astype(xv.dtype)
+
+    args = [_t(x), _t(weight)]
+    if weight_scale is not None:
+        args.append(_t(weight_scale))
+    out = apply("llm_int8_linear", fn, *args)
+    if bias is not None:
+        from ...ops import math as _m
+
+        out = _m.add(out, bias)
+    return out

@@ -309,3 +309,41 @@ def test_hub_and_misc_namespaces(tmp_path):
     assert os.path.isdir(paddle.sysconfig.get_include())
     with pytest.raises(ModuleNotFoundError):
         paddle.onnx.export(None, "x")
+
+
+def test_weight_only_quantization():
+    """nn.quant weight_quantize/dequantize/weight_only_linear/
+    llm_int8_linear roundtrip + matmul accuracy (reference:
+    nn/quant/quantized_linear.py)."""
+    from paddle_tpu.nn.quant import (llm_int8_linear, weight_dequantize,
+                                     weight_only_linear, weight_quantize)
+
+    w = rng.normal(size=(64, 32)).astype(np.float32)
+    x = rng.normal(size=(4, 64)).astype(np.float32)
+    ref = x @ w
+
+    qw, sc = weight_quantize(paddle.to_tensor(w))
+    assert _np(qw).dtype == np.int8 and _np(sc).shape == (32,)
+    wd = weight_dequantize(qw, sc, out_dtype="float32")
+    assert np.abs(_np(wd) - w).max() / np.abs(w).max() < 0.01
+    out = weight_only_linear(paddle.to_tensor(x), qw, weight_scale=sc)
+    assert np.abs(_np(out) - ref).max() / np.abs(ref).max() < 0.02
+
+    # int4 group-wise: packed [in/2, out], scales [in/gs, out]
+    qw4, sc4 = weight_quantize(paddle.to_tensor(w),
+                               algo="weight_only_int4", group_size=64)
+    assert _np(qw4).shape == (32, 32) and _np(sc4).shape == (1, 32)
+    wd4 = weight_dequantize(qw4, sc4, algo="weight_only_int4",
+                            out_dtype="float32", group_size=64)
+    assert np.abs(_np(wd4) - w).max() / np.abs(w).max() < 0.12
+
+    # llm.int8 with an outlier channel
+    xo = x.copy()
+    xo[:, 3] = 20.0
+    out8 = llm_int8_linear(paddle.to_tensor(xo), qw, weight_scale=sc,
+                           threshold=6.0)
+    ref8 = xo @ w
+    assert np.abs(_np(out8) - ref8).max() / np.abs(ref8).max() < 0.03
+
+    with pytest.raises(ValueError):
+        weight_quantize(paddle.to_tensor(w), algo="int3")

@@ -151,10 +151,6 @@ MAPPED = {
     "graph_khop_sampler": "geometric.sample_neighbors (per hop)",
     "graph_sample_neighbors": "geometric.sample_neighbors",
     # quantization family
-    "llm_int8_linear": "quantization PTQ observers + matmul",
-    "weight_only_linear": "quantization PTQ (weight observers)",
-    "weight_quantize": "quantization observers",
-    "weight_dequantize": "quantization observers",
     "depthwise_conv2d_transpose": "F.conv2d_transpose(groups=C)",
     "fill_diagonal_tensor": "paddle.fill_diagonal (+ diagonal scatter)",
     "multiclass_nms3": "vision.ops.nms(scores, category_idxs)",
@@ -239,7 +235,8 @@ def _surfaces():
             ("distributed", paddle.distributed),
             ("incubate.nn.functional",
              paddle.incubate.nn.functional),
-            ("vision.ops", paddle.vision.ops)]
+            ("vision.ops", paddle.vision.ops),
+            ("nn.quant", paddle.nn.quant)]
     return mods, Tensor
 
 
