@@ -64,3 +64,5 @@ from .parallelize import (  # noqa: F401,E402
     ShowClickEntry, SplitPoint, parallelize, to_distributed,
     unshard_dtensor,
 )
+
+from . import passes  # noqa: F401,E402

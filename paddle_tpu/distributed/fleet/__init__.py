@@ -150,6 +150,7 @@ is_first_worker = fleet.is_first_worker
 barrier_worker = fleet.barrier_worker
 
 from .recompute import recompute, recompute_sequential  # noqa: F401,E402
+from . import utils  # noqa: F401,E402
 
 
 # reference fleet/__init__.py __all__ classes

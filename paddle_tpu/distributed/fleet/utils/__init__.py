@@ -2,3 +2,108 @@
 from . import sequence_parallel_utils  # noqa: F401
 from . import hybrid_parallel_util  # noqa: F401
 from ..recompute import recompute  # noqa: F401
+
+
+# parity: fleet/utils/__init__.py __all__ (fs.py LocalFS/HDFSClient,
+# ps_util.DistributedInfer, recompute)
+
+
+class LocalFS:
+    """parity: fleet/utils/fs.py LocalFS — local filesystem operations."""
+
+    def ls_dir(self, fs_path):
+        import os
+
+        dirs, files = [], []
+        for e in sorted(os.listdir(fs_path)):
+            (dirs if os.path.isdir(os.path.join(fs_path, e))
+             else files).append(e)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        import os
+
+        os.makedirs(fs_path, exist_ok=True)
+
+    def is_exist(self, fs_path):
+        import os
+
+        return os.path.exists(fs_path)
+
+    def is_dir(self, fs_path):
+        import os
+
+        return os.path.isdir(fs_path)
+
+    def is_file(self, fs_path):
+        import os
+
+        return os.path.isfile(fs_path)
+
+    def delete(self, fs_path):
+        import os
+        import shutil
+
+        if os.path.isdir(fs_path):
+            shutil.rmtree(fs_path)
+        elif os.path.exists(fs_path):
+            os.remove(fs_path)
+
+    def rename(self, src, dst):
+        import os
+
+        os.rename(src, dst)
+
+    def mv(self, src, dst, overwrite=False, test_exists=True):
+        import os
+
+        if not overwrite and os.path.exists(dst):
+            raise FileExistsError(dst)
+        os.replace(src, dst)
+
+    def upload(self, local_path, fs_path):
+        import shutil
+
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        import shutil
+
+        shutil.copy(fs_path, local_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        import os
+
+        if os.path.exists(fs_path) and not exist_ok:
+            raise FileExistsError(fs_path)
+        open(fs_path, "a").close()
+
+    def cat(self, fs_path):
+        with open(fs_path, "rb") as f:
+            return f.read()
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+
+class HDFSClient:
+    """parity: fleet/utils/fs.py HDFSClient — requires a hadoop client
+    binary, which this environment doesn't ship."""
+
+    def __init__(self, hadoop_home=None, configs=None, **kwargs):
+        raise RuntimeError(
+            "HDFSClient requires a hadoop installation (hadoop_home); none "
+            "is available in this environment. Use LocalFS or fsspec-style "
+            "tooling out-of-band.")
+
+
+class DistributedInfer:
+    """parity: fleet/utils/ps_util.py DistributedInfer — PS-mode sparse
+    inference helper; the parameter-server architecture is a documented
+    skip (PARITY D19), so this raises with that pointer."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        raise RuntimeError(
+            "DistributedInfer serves the parameter-server runtime, which "
+            "is a documented skip (PARITY.md D19); collective inference "
+            "uses paddle_tpu.inference.Predictor")

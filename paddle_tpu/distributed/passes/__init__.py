@@ -1,0 +1,78 @@
+"""paddle.distributed.passes (parity: python/paddle/distributed/passes/).
+
+The reference's pass zoo rewrites static programs (AMP, sharding,
+recompute, pipeline scheduling...). In this framework those capabilities
+live in XLA's pipeline and the sharding recipes, so new_pass returns
+recorded-config pass objects: applying one annotates the target (the
+capture layer and recipes consume the annotations), keeping ported
+`new_pass(...)` + `PassManager` setup code working.
+"""
+from __future__ import annotations
+
+__all__ = ["new_pass", "PassManager", "PassContext"]
+
+# pass name → the mechanism that provides the capability here
+_KNOWN = {
+    "auto_parallel_amp": "amp.auto_cast / Strategy.amp",
+    "auto_parallel_fp16": "amp.auto_cast(dtype='float16')",
+    "auto_parallel_bf16": "amp.auto_cast(dtype='bfloat16')",
+    "auto_parallel_recompute": "model remat flags / fleet.recompute",
+    "auto_parallel_sharding": "dist.shard_optimizer ShardingStage1/2/3",
+    "auto_parallel_gradient_merge_pass": "train_step accum_steps / "
+                                         "static.plan gradient merge",
+    "auto_parallel_grad_clip": "nn.ClipGradByGlobalNorm",
+    "pipeline_scheduler_FThenB": "static/plan.py FThenB",
+    "pipeline_scheduler_1F1B": "distributed/pipeline.py 1F1B",
+    "fuse_gemm_epilogue": "XLA fusion (automatic)",
+    "fused_attention": "kernels/pallas_attention",
+    "fuse_optimizer": "jit-fused optimizer update (automatic)",
+}
+
+
+class _Pass:
+    def __init__(self, name, attrs=None):
+        self.name = name
+        self.attrs = dict(attrs or {})
+        self.mechanism = _KNOWN.get(name, "XLA pipeline (automatic)")
+
+    def apply(self, main_programs=None, startup_programs=None, context=None):
+        ctx = context or PassContext()
+        ctx.passes_applied.append(self)
+        for prog in (main_programs or []):
+            applied = getattr(prog, "_applied_passes", [])
+            applied.append(self.name)
+            try:
+                prog._applied_passes = applied
+            except AttributeError:
+                pass
+        return ctx
+
+    def __repr__(self):
+        return f"Pass({self.name} -> {self.mechanism})"
+
+
+def new_pass(name, pass_attrs=None):
+    """parity: passes/pass_base.py new_pass."""
+    return _Pass(name, pass_attrs)
+
+
+class PassContext:
+    def __init__(self):
+        self.passes_applied = []
+
+
+class PassManager:
+    """parity: pass_base.py PassManager — applies a pass list in order."""
+
+    def __init__(self, passes):
+        self._passes = list(passes)
+
+    def apply(self, main_programs=None, startup_programs=None):
+        ctx = PassContext()
+        for p in self._passes:
+            p.apply(main_programs, startup_programs, ctx)
+        return ctx
+
+    @property
+    def names(self):
+        return [p.name for p in self._passes]

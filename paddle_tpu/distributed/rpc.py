@@ -133,3 +133,12 @@ def shutdown() -> None:
     _state["pool"].shutdown(wait=False)
     _state["store"].close()
     _state.clear()
+
+
+def get_current_worker_info():
+    """parity: rpc.py:393 get_current_worker_info — this process's worker
+    (looked up by the name registered in init_rpc; the rpc rank is
+    independent of the collective rank)."""
+    if not _state:
+        raise RuntimeError("get_current_worker_info: call init_rpc first")
+    return get_worker_info(_state["name"])

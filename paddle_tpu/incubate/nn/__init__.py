@@ -1,1 +1,3 @@
 from . import functional  # noqa: F401
+
+from .layer_extras import *  # noqa: E402,F401,F403

@@ -1,0 +1,249 @@
+"""paddle.incubate.nn fused Layer classes (parity:
+python/paddle/incubate/nn/__init__.py) — stateful wrappers over
+incubate.nn.functional; XLA fuses each block."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...nn.layer.layers import Layer
+
+__all__ = [
+    "FusedLinear", "FusedFeedForward", "FusedMultiHeadAttention",
+    "FusedMultiTransformer", "FusedTransformerEncoderLayer",
+    "FusedBiasDropoutResidualLayerNorm", "FusedDropoutAdd",
+]
+
+
+class FusedLinear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self._transpose = transpose_weight
+        shape = ([out_features, in_features] if transpose_weight
+                 else [in_features, out_features])
+        self.weight = self.create_parameter(shape, attr=weight_attr)
+        self.bias = (self.create_parameter([out_features], attr=bias_attr,
+                                           is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, x):
+        from . import functional as IF
+
+        return IF.fused_linear(x, self.weight, self.bias,
+                               transpose_weight=self._transpose)
+
+
+class FusedDropoutAdd(Layer):
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self._p, self._mode = p, mode
+
+    def forward(self, x, y):
+        from . import functional as IF
+
+        return IF.fused_dropout_add(x, y, p=self._p,
+                                    training=self.training, mode=self._mode)
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self._p = dropout_rate
+        self._eps = epsilon
+        self.linear_bias = self.create_parameter([embed_dim], is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [embed_dim], default_initializer=_ones())
+        self.ln_bias = self.create_parameter([embed_dim], is_bias=True)
+
+    def forward(self, x, residual):
+        from . import functional as IF
+
+        return IF.fused_bias_dropout_residual_layer_norm(
+            x, residual, bias=self.linear_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, dropout_rate=self._p,
+            ln_epsilon=self._eps, training=self.training)
+
+
+def _ones():
+    from ...nn import initializer as I
+
+    return I.Constant(1.0)
+
+
+class FusedFeedForward(Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self._act = activation
+        self._p = dropout_rate
+        self._act_p = (act_dropout_rate if act_dropout_rate is not None
+                       else dropout_rate)
+        self._pre = normalize_before
+        self._eps = epsilon
+        self.linear1_weight = self.create_parameter(
+            [d_model, dim_feedforward], attr=linear1_weight_attr)
+        self.linear1_bias = self.create_parameter(
+            [dim_feedforward], attr=linear1_bias_attr, is_bias=True)
+        self.linear2_weight = self.create_parameter(
+            [dim_feedforward, d_model], attr=linear2_weight_attr)
+        self.linear2_bias = self.create_parameter(
+            [d_model], attr=linear2_bias_attr, is_bias=True)
+        self.ln1_scale = self.create_parameter(
+            [d_model], attr=ln1_scale_attr, default_initializer=_ones())
+        self.ln1_bias = self.create_parameter([d_model], attr=ln1_bias_attr,
+                                              is_bias=True)
+        self.ln2_scale = self.create_parameter(
+            [d_model], attr=ln2_scale_attr, default_initializer=_ones())
+        self.ln2_bias = self.create_parameter([d_model], attr=ln2_bias_attr,
+                                              is_bias=True)
+
+    def forward(self, src):
+        from . import functional as IF
+
+        return IF.fused_feedforward(
+            src, self.linear1_weight, self.linear2_weight,
+            linear1_bias=self.linear1_bias, linear2_bias=self.linear2_bias,
+            ln1_scale=self.ln1_scale, ln1_bias=self.ln1_bias,
+            ln2_scale=self.ln2_scale, ln2_bias=self.ln2_bias,
+            dropout1_rate=self._act_p, dropout2_rate=self._p,
+            activation=self._act, ln1_epsilon=self._eps,
+            ln2_epsilon=self._eps, pre_layer_norm=self._pre,
+            training=self.training)
+
+
+class FusedMultiHeadAttention(Layer):
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self._heads = num_heads
+        self._p = dropout_rate
+        self._attn_p = attn_dropout_rate
+        self._pre = normalize_before
+        self._eps = epsilon
+        head_dim = embed_dim // num_heads
+        self.qkv_weight = self.create_parameter(
+            [3, num_heads, head_dim, embed_dim], attr=qkv_weight_attr)
+        self.qkv_bias = self.create_parameter(
+            [3 * embed_dim], attr=qkv_bias_attr, is_bias=True)
+        self.linear_weight = self.create_parameter(
+            [embed_dim, embed_dim], attr=linear_weight_attr)
+        self.linear_bias = self.create_parameter(
+            [embed_dim], attr=linear_bias_attr, is_bias=True)
+        self.pre_ln_scale = self.create_parameter(
+            [embed_dim], attr=pre_ln_scale_attr, default_initializer=_ones())
+        self.pre_ln_bias = self.create_parameter(
+            [embed_dim], attr=pre_ln_bias_attr, is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [embed_dim], attr=ln_scale_attr, default_initializer=_ones())
+        self.ln_bias = self.create_parameter([embed_dim], attr=ln_bias_attr,
+                                             is_bias=True)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        from . import functional as IF
+
+        return IF.fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self._pre, pre_ln_scale=self.pre_ln_scale,
+            pre_ln_bias=self.pre_ln_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, pre_ln_epsilon=self._eps,
+            qkv_bias=self.qkv_bias, linear_bias=self.linear_bias,
+            attn_mask=attn_mask, dropout_rate=self._p,
+            attn_dropout_rate=self._attn_p, ln_epsilon=self._eps,
+            training=self.training)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """parity: incubate FusedTransformerEncoderLayer — fused attention +
+    fused FFN."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=(attn_dropout_rate
+                               if attn_dropout_rate is not None
+                               else dropout_rate),
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.fused_attn(src, attn_mask=src_mask)
+        return self.ffn(out)
+
+
+class FusedMultiTransformer(Layer):
+    """parity: incubate FusedMultiTransformer — the serving decoder stack
+    over fused_multi_transformer."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu",
+                 normalize_before=True, num_layers=1, nranks=1, ring_id=-1,
+                 name=None, **kwargs):
+        super().__init__()
+        self._pre = normalize_before
+        self._act = activation
+        self._p = dropout_rate
+        head_dim = embed_dim // num_heads
+        mk = self.create_parameter
+        self.ln_scales = [mk([embed_dim], default_initializer=_ones())
+                          for _ in range(num_layers)]
+        self.ln_biases = [mk([embed_dim], is_bias=True)
+                          for _ in range(num_layers)]
+        self.qkv_weights = [mk([3, num_heads, head_dim, embed_dim])
+                            for _ in range(num_layers)]
+        self.qkv_biases = [mk([3 * embed_dim], is_bias=True)
+                           for _ in range(num_layers)]
+        self.linear_weights = [mk([embed_dim, embed_dim])
+                               for _ in range(num_layers)]
+        self.linear_biases = [mk([embed_dim], is_bias=True)
+                              for _ in range(num_layers)]
+        self.ffn_ln_scales = [mk([embed_dim], default_initializer=_ones())
+                              for _ in range(num_layers)]
+        self.ffn_ln_biases = [mk([embed_dim], is_bias=True)
+                              for _ in range(num_layers)]
+        self.ffn1_weights = [mk([embed_dim, dim_feedforward])
+                             for _ in range(num_layers)]
+        self.ffn1_biases = [mk([dim_feedforward], is_bias=True)
+                            for _ in range(num_layers)]
+        self.ffn2_weights = [mk([dim_feedforward, embed_dim])
+                             for _ in range(num_layers)]
+        self.ffn2_biases = [mk([embed_dim], is_bias=True)
+                            for _ in range(num_layers)]
+        for group in ("ln_scales", "ln_biases", "qkv_weights", "qkv_biases",
+                      "linear_weights", "linear_biases", "ffn_ln_scales",
+                      "ffn_ln_biases", "ffn1_weights", "ffn1_biases",
+                      "ffn2_weights", "ffn2_biases"):
+            for i, p in enumerate(getattr(self, group)):
+                self.add_parameter(f"{group}_{i}", p)
+
+    def forward(self, src, attn_mask=None, caches=None, time_step=None,
+                **kwargs):
+        from . import functional as IF
+
+        return IF.fused_multi_transformer(
+            src, self.ln_scales, self.ln_biases, self.qkv_weights,
+            self.qkv_biases, self.linear_weights, self.linear_biases,
+            self.ffn_ln_scales, self.ffn_ln_biases, self.ffn1_weights,
+            self.ffn1_biases, self.ffn2_weights, self.ffn2_biases,
+            pre_layer_norm=self._pre, attn_mask=attn_mask,
+            dropout_rate=self._p, activation=self._act,
+            training=self.training)

@@ -96,3 +96,7 @@ class DistributedFusedLamb(Lamb):
                          beta2=beta2, epsilon=epsilon, parameters=parameters,
                          grad_clip=grad_clip,
                          exclude_from_weight_decay_fn=exclude_from_weight_decay_fn)
+
+
+# parity: incubate.optimizer.LBFGS (graduated to paddle.optimizer)
+from ...optimizer.optimizers import LBFGS  # noqa: E402,F401

@@ -178,12 +178,11 @@ class PredictorPool:
         for _ in range(int(size) - 1):
             clone = Predictor.__new__(Predictor)
             clone.__dict__.update(first.__dict__)
-            # handles must be per-predictor: fresh IO dicts so concurrent
+            # handles must be per-predictor: fresh IO state so concurrent
             # retrieve() users don't clobber each other (the loaded layer
             # itself stays shared)
-            for k, v in list(clone.__dict__.items()):
-                if isinstance(v, dict):
-                    clone.__dict__[k] = {}
+            clone._inputs = {}
+            clone._outputs = {}
             self._predictors.append(clone)
 
     def retrieve(self, idx):

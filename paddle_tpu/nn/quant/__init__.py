@@ -6,3 +6,23 @@ from .quantized_linear import (  # noqa: F401
 
 __all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
            "llm_int8_linear"]
+
+
+from ..layer.layers import Layer as _Layer  # noqa: E402
+
+
+class Stub(_Layer):
+    """parity: nn/quant/stub.py Stub — placeholder sublayer that an
+    observer replaces before PTQ/QAT (marks a functional-API call site for
+    quantization config). A Layer so sublayer traversal finds it; identity
+    until quantization swaps it."""
+
+    def __init__(self, observer=None):
+        super().__init__()
+        self._observer = observer
+
+    def forward(self, x):
+        return x
+
+
+__all__ += ["Stub"]

@@ -41,6 +41,14 @@ def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1,
     _check_algo(algo)
     if group_size not in (-1, 64, 128):
         raise ValueError("group_size must be -1, 64 or 128")
+    K_in = _t(x).shape[0]
+    if algo == "weight_only_int4" and K_in % 2:
+        raise ValueError(
+            f"weight_only_int4 packs two rows per byte: in-dim {K_in} must "
+            "be even")
+    if group_size > 0 and K_in % group_size:
+        raise ValueError(
+            f"in-dim {K_in} must be divisible by group_size {group_size}")
 
     def fn(w):
         K, N = w.shape
@@ -116,23 +124,19 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
     XLA fuses the dequant into the matmul epilogue."""
     algo = ("weight_only_int4" if str(weight_dtype) in ("int4",)
             else "weight_only_int8")
+    if weight_scale is None:
+        raise ValueError(
+            "weight_only_linear: weight_scale (from weight_quantize) is "
+            "required — raw quantized integers cannot be used directly")
 
-    def fn(xv, qw, *rest):
-        i = 0
-        scale = None
-        if weight_scale is not None:
-            scale = rest[i]
-            i += 1
-        w = _dequant(qw, scale, algo, group_size, xv.dtype) if scale is not \
-            None else qw.astype(xv.dtype)
+    def fn(xv, qw, scale, *rest):
+        w = _dequant(qw, scale, algo, group_size, xv.dtype)
         out = xv @ w
         if bias is not None:
-            out = out + rest[i]
+            out = out + rest[0]
         return out
 
-    args = [_t(x), _t(weight)]
-    if weight_scale is not None:
-        args.append(_t(weight_scale))
+    args = [_t(x), _t(weight), _t(weight_scale)]
     if bias is not None:
         args.append(_t(bias))
     return apply("weight_only_linear", fn, *args)

@@ -44,6 +44,8 @@ _MODULES = [
     "incubate.nn.functional", "distributed.fleet", "nn.initializer",
     "nn.utils", "amp.debugging", "incubate.autograd", "optimizer.lr",
     "inference", "callbacks", "regularizer", "hub", "onnx", "sysconfig",
+    "nn.quant", "distributed.passes", "distributed.rpc", "incubate.nn",
+    "distributed.fleet.utils", "incubate.optimizer",
 ]
 
 
