@@ -89,6 +89,7 @@ from . import hub  # noqa: F401
 from . import onnx  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import sysconfig  # noqa: F401
+from . import cost_model  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 from .hapi.summary import summary  # noqa: F401
 from .hapi.dynamic_flops import flops  # noqa: F401

@@ -354,3 +354,12 @@ def is_compiled_with_custom_device(device_type: str) -> bool:
     """TPU rides the PJRT plugin mechanism — report it as the available
     custom device type."""
     return device_type in get_all_device_type()
+
+
+from ._memory import (  # noqa: E402,F401
+    empty_cache, max_memory_allocated, max_memory_reserved,
+    memory_allocated, memory_reserved, reset_max_memory_allocated,
+    reset_max_memory_reserved,
+)
+from . import cuda  # noqa: E402,F401
+from . import xpu  # noqa: E402,F401

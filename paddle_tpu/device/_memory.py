@@ -1,0 +1,53 @@
+"""Device memory statistics over PJRT (parity: the reference's
+memory/stats.cc registry behind paddle.device.cuda.max_memory_allocated)."""
+from __future__ import annotations
+
+import jax
+
+
+def _stats(device_id=0):
+    try:
+        dev = jax.devices()[device_id if isinstance(device_id, int) else 0]
+        return dev.memory_stats() or {}
+    except Exception:
+        return {}
+
+
+def memory_allocated(device=None, device_id=0):
+    return int(_stats(device_id).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None, device_id=0):
+    s = _stats(device_id)
+    return int(s.get("peak_bytes_in_use", s.get("bytes_in_use", 0)))
+
+
+def memory_reserved(device=None, device_id=0):
+    s = _stats(device_id)
+    return int(s.get("bytes_reserved", s.get("bytes_in_use", 0)))
+
+
+def max_memory_reserved(device=None, device_id=0):
+    s = _stats(device_id)
+    return int(s.get("peak_bytes_reserved",
+                     s.get("peak_bytes_in_use", 0)))
+
+
+def reset_max_memory_allocated(device=None):
+    """PJRT exposes cumulative peaks only; reset is a no-op recorded for
+    API compat."""
+
+
+def reset_max_memory_reserved(device=None):
+    pass
+
+
+def empty_cache():
+    """Ask XLA to release cached buffers (best-effort)."""
+    import gc
+
+    gc.collect()
+    try:
+        jax.clear_caches()
+    except Exception:
+        pass

@@ -1,0 +1,43 @@
+"""paddle.device.cuda (parity: python/paddle/device/cuda/__init__.py).
+
+No CUDA in a TPU build — the Stream/Event/stream_guard ordering API and the
+memory statistics are the device-generic ones (they operate on whatever
+device jax exposes, which is how ported `paddle.device.cuda.*` telemetry
+code keeps working); device_count() reports 0 CUDA devices.
+"""
+from .._memory import (  # noqa: F401
+    empty_cache, max_memory_allocated, max_memory_reserved,
+    memory_allocated, memory_reserved, reset_max_memory_allocated,
+    reset_max_memory_reserved,
+)
+from .. import Event, Stream, current_stream, stream_guard, synchronize  # noqa: F401
+
+__all__ = ["Stream", "Event", "current_stream", "synchronize",
+           "device_count", "empty_cache", "max_memory_allocated",
+           "max_memory_reserved", "memory_allocated", "memory_reserved",
+           "stream_guard", "get_device_properties", "get_device_name",
+           "get_device_capability", "reset_max_memory_allocated",
+           "reset_max_memory_reserved"]
+
+
+def device_count():
+    """Number of CUDA devices — 0 in a TPU build."""
+    return 0
+
+
+def get_device_properties(device=None):
+    raise RuntimeError(
+        "get_device_properties: paddle_tpu is not compiled with CUDA; "
+        "query TPU devices via jax.devices()")
+
+
+def get_device_name(device=None):
+    import jax
+
+    devs = jax.devices()
+    return devs[0].device_kind if devs else "cpu"
+
+
+def get_device_capability(device=None):
+    raise RuntimeError(
+        "get_device_capability: no CUDA SM capability on TPU")

@@ -1,0 +1,6 @@
+"""paddle.device.xpu (parity: python/paddle/device/xpu/) — no XPU in this
+build; synchronize defers to the generic device barrier."""
+from .. import synchronize  # noqa: F401
+from .._memory import empty_cache  # noqa: F401
+
+__all__ = ["synchronize", "empty_cache"]

@@ -66,3 +66,4 @@ from .parallelize import (  # noqa: F401,E402
 )
 
 from . import passes  # noqa: F401,E402
+from . import sharding  # noqa: F401,E402

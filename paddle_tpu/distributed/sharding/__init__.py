@@ -120,3 +120,20 @@ def group_sharded_parallel(model, optimizer, level: str = "os",
              "p_g_os": ShardingStage3}[level]
     wrapped = stage(optimizer, model=model, mesh=mesh, axis=axis)
     return model, wrapped, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """parity: sharding/save_group_sharded_model — persist a group-sharded
+    model (and optimizer state) to `output`."""
+    import os
+
+    import paddle_tpu as paddle
+
+    os.makedirs(output, exist_ok=True)
+    target = getattr(model, "_layers", model)
+    paddle.save(target.state_dict(), os.path.join(output, "model.pdparams"))
+    if optimizer is not None:
+        inner = getattr(optimizer, "_optim", optimizer)
+        if hasattr(inner, "state_dict"):
+            paddle.save(inner.state_dict(),
+                        os.path.join(output, "model.pdopt"))

@@ -59,13 +59,29 @@ def reset_excluded_layers(main_program=None):
 
 def prune_model(model: Layer, n: int = 2, m: int = 4,
                 mask_algo: str = "mask_1d", with_mask: bool = True):
-    """Apply n:m masks to every >=2D parameter (conv/linear weights)."""
+    """Apply n:m masks to every >=2D parameter (conv/linear weights).
+    Layers registered via add_supported_layer with a custom pruning_func
+    use that function (supported_layer_list.py semantics)."""
+    # map param name prefix -> owning layer type name (for the registry)
+    owner = {}
+    for lname, sub in model.named_sublayers(include_self=True):
+        for pname, _ in sub.named_parameters(include_sublayers=False):
+            full = f"{lname}.{pname}" if lname else pname
+            owner[full] = type(sub).__name__
     pruned = {}
     for name, p in model.named_parameters():
         if p is None or len(p.shape) < 2 or name in _excluded:
             continue
-        mask = create_mask(p, mask_algo, n, m)
-        p._replace_value(p._value * jnp.asarray(mask, p._value.dtype))
+        custom = _custom_pruning.get(owner.get(name, ""))
+        if custom is not None:
+            import numpy as _np
+
+            mask, new_w = custom(_np.asarray(p._value), n, m, mask_algo,
+                                 name)
+            p._replace_value(jnp.asarray(new_w, p._value.dtype))
+        else:
+            mask = create_mask(p, mask_algo, n, m)
+            p._replace_value(p._value * jnp.asarray(mask, p._value.dtype))
         if with_mask:
             _masks[id(p)] = mask
         pruned[name] = mask
@@ -92,3 +108,19 @@ class _ASPOptimizer:
 
 def decorate(optimizer):
     return _ASPOptimizer(optimizer)
+
+
+_custom_pruning = {}
+
+
+def add_supported_layer(layer, pruning_func=None):
+    """parity: asp/supported_layer_list.py:96 add_supported_layer —
+    register a layer type (or name) whose weights prune_model should
+    sparsify, optionally with a custom pruning function
+    fn(weight_np, n, m, mask_algo, param_name) -> (mask, pruned)."""
+    key = layer if isinstance(layer, str) else getattr(
+        layer, "__name__", type(layer).__name__)
+    _custom_pruning[key] = pruning_func
+
+
+__all__ += ["add_supported_layer"]

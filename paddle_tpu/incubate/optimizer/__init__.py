@@ -100,3 +100,5 @@ class DistributedFusedLamb(Lamb):
 
 # parity: incubate.optimizer.LBFGS (graduated to paddle.optimizer)
 from ...optimizer.optimizers import LBFGS  # noqa: E402,F401
+
+from . import functional  # noqa: E402,F401

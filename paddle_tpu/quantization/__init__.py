@@ -263,3 +263,6 @@ def quanter(class_name):
 
 
 __all__ += ["BaseQuanter", "quanter"]
+
+from . import observers  # noqa: E402,F401
+from . import quanters  # noqa: E402,F401

@@ -1,0 +1,52 @@
+"""paddle.quantization.observers (parity:
+python/paddle/quantization/observers/) — observer factories for PTQ."""
+from __future__ import annotations
+
+from . import AbsmaxObserver as _AbsmaxLayer
+from . import _QuanterFactory
+
+__all__ = ["AbsmaxObserver", "GroupWiseWeightObserver"]
+
+
+class AbsmaxObserver(_QuanterFactory):
+    """parity: observers/abs_max.py:22 — per-tensor absmax observer
+    factory."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__(_AbsmaxLayer, quant_bits=quant_bits)
+
+
+class _GroupWiseLayer(_AbsmaxLayer):
+    """Channel/group-wise absmax over the weight's output channels."""
+
+    def __init__(self, quant_bits=8, group_size=128):
+        super().__init__(quant_bits=quant_bits)
+        self._group_size = group_size
+
+    def forward(self, x):
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+
+        v = x._value
+        flat = v.reshape(-1, v.shape[-1]) if v.ndim > 1 else v[:, None]
+        K = flat.shape[0]
+        gs = self._group_size
+        if gs > 0 and K % gs == 0 and K >= gs:
+            # one scale per group of group_size input rows per channel
+            amax = jnp.max(jnp.abs(flat.reshape(K // gs, gs, -1)), axis=1)
+        else:
+            amax = jnp.max(jnp.abs(flat), axis=0)
+        self._scale = Tensor(amax / (2 ** (self.quant_bits - 1) - 1))
+        return x
+
+    def scales(self):
+        return getattr(self, "_scale", None)
+
+
+class GroupWiseWeightObserver(_QuanterFactory):
+    """parity: observers/groupwise.py:23 — group-wise weight observer."""
+
+    def __init__(self, quant_bits=8, group_size=128):
+        super().__init__(_GroupWiseLayer, quant_bits=quant_bits,
+                         group_size=group_size)

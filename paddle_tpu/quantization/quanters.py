@@ -1,0 +1,18 @@
+"""paddle.quantization.quanters (parity:
+python/paddle/quantization/quanters/) — QAT quanter factories."""
+from __future__ import annotations
+
+from . import FakeQuanterWithAbsMax as _FakeQuanterLayer
+from . import _QuanterFactory
+
+__all__ = ["FakeQuanterWithAbsMaxObserver"]
+
+
+class FakeQuanterWithAbsMaxObserver(_QuanterFactory):
+    """parity: quanters/abs_max.py — moving-average absmax fake quanter for
+    QAT (STE in the backward)."""
+
+    def __init__(self, moving_rate=0.9, bit_length=8, dtype="float32",
+                 name=None):
+        super().__init__(_FakeQuanterLayer, quant_bits=bit_length,
+                         moving_rate=moving_rate)

@@ -246,3 +246,126 @@ class functional:  # namespace parity: paddle.sparse.nn.functional
         probs = jax.nn.softmax(scores, axis=-1)
         out = jnp.einsum("bhst,bhtd->bhsd", probs, v)
         return Tensor(out)
+
+
+class ReLU6(Layer):
+    """parity: sparse/nn ReLU6 — zero-preserving clip to [0, 6]."""
+
+    def forward(self, x):
+        return _unary_apply(x, lambda v: jnp.clip(v, 0.0, 6.0))
+
+
+class MaxPool3D(Layer):
+    """parity: sparse/nn MaxPool3D — pools the dense form (sparsity after a
+    max-pool is data-dependent; output returned sparse)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding)
+
+    def forward(self, x):
+        from ..nn import functional as F
+        from ..ops.creation import _t as _tt
+        from . import sparse_from_dense
+
+        k, s, p = self._args
+        dense = x.to_dense()
+        # sparse layout is NDHWC; dense max_pool3d expects NCDHW
+        v = jnp.moveaxis(dense._value, -1, 1)
+        out = F.max_pool3d(Tensor(v), k, s, p)
+        out_v = jnp.moveaxis(_tt(out)._value, 1, -1)
+        return sparse_from_dense(Tensor(out_v))
+
+
+class SyncBatchNorm(BatchNorm):
+    """parity: sparse/nn SyncBatchNorm — under GSPMD the batch statistics
+    psum falls out of sharding; same computation as BatchNorm here."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        return layer
+
+
+def _unary_apply(x, fn):
+    from . import SparseCooTensor, SparseCsrTensor
+
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(x.crows, x.cols, Tensor(fn(x.values._value)),
+                               x.shape)
+    return SparseCooTensor(x.indices, Tensor(fn(x.values._value)), x.shape)
+
+
+def _sparse_conv_fn(x, weight, bias, stride, padding, dilation, groups,
+                    subm, nd):
+    """Shared functional conv over the sparse layer machinery."""
+    def tup(v):
+        return tuple(v) if isinstance(v, (list, tuple)) else (v,) * nd
+
+    layer = _SparseConv.__new__(
+        {2: (SubmConv2D if subm else Conv2D),
+         3: (SubmConv3D if subm else Conv3D)}[nd])
+    Layer.__init__(layer)
+    w = weight if hasattr(weight, "_value") else Tensor(weight)
+    layer._nd = nd
+    layer._subm = subm
+    layer._ks = tuple(int(k) for k in w.shape[:nd])
+    layer._stride = tup(stride)
+    layer._padding = tup(padding)
+    layer._dilation = tup(dilation)
+    layer._groups = groups
+    layer.weight = w
+    layer.bias = (bias if bias is None or hasattr(bias, "_value")
+                  else Tensor(bias))
+    return layer.forward(x)
+
+
+def _add_functional():
+    F = functional
+
+    def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+               groups=1, data_format="NDHWC", name=None):
+        return _sparse_conv_fn(x, weight, bias, stride, padding, dilation,
+                               groups, False, 3)
+
+    def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                    groups=1, data_format="NDHWC", key=None, name=None):
+        return _sparse_conv_fn(x, weight, bias, stride, padding, dilation,
+                               groups, True, 3)
+
+    def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+               groups=1, data_format="NHWC", name=None):
+        return _sparse_conv_fn(x, weight, bias, stride, padding, dilation,
+                               groups, False, 2)
+
+    def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                    groups=1, data_format="NHWC", key=None, name=None):
+        return _sparse_conv_fn(x, weight, bias, stride, padding, dilation,
+                               groups, True, 2)
+
+    def relu6(x, name=None):
+        return _unary_apply(x, lambda v: jnp.clip(v, 0.0, 6.0))
+
+    def leaky_relu(x, negative_slope=0.01, name=None):
+        return _unary_apply(
+            x, lambda v: jnp.where(v > 0, v, negative_slope * v))
+
+    def max_pool3d(x, kernel_size, stride=None, padding=0,
+                   data_format="NDHWC", name=None):
+        return MaxPool3D(kernel_size, stride, padding)(x)
+
+    F.conv2d = staticmethod(conv2d)
+    F.conv3d = staticmethod(conv3d)
+    F.subm_conv2d = staticmethod(subm_conv2d)
+    F.subm_conv3d = staticmethod(subm_conv3d)
+    # igemm variants: same math, different GPU kernel strategy in the
+    # reference (implicit gemm); one XLA lowering here
+    F.subm_conv2d_igemm = staticmethod(subm_conv2d)
+    F.subm_conv3d_igemm = staticmethod(subm_conv3d)
+    F.relu6 = staticmethod(relu6)
+    F.leaky_relu = staticmethod(leaky_relu)
+    F.max_pool3d = staticmethod(max_pool3d)
+
+
+_add_functional()
+__all__ += ["ReLU6", "MaxPool3D", "SyncBatchNorm"]

@@ -46,6 +46,10 @@ _MODULES = [
     "inference", "callbacks", "regularizer", "hub", "onnx", "sysconfig",
     "nn.quant", "distributed.passes", "distributed.rpc", "incubate.nn",
     "distributed.fleet.utils", "incubate.optimizer",
+    "sparse.nn", "sparse.nn.functional", "incubate.optimizer.functional",
+    "incubate.asp", "quantization.quanters", "quantization.observers",
+    "profiler", "distributed.sharding", "device.xpu", "device.cuda",
+    "cost_model",
 ]
 
 
