@@ -5,30 +5,43 @@ from __future__ import annotations
 import jax
 
 
-def _stats(device_id=0):
+def _resolve(device, device_id):
+    """Paddle signature puts the device first: accept an int index, a
+    'xpu:N'-style string, a Place, or None (falls back to device_id)."""
+    if device is None:
+        return device_id if isinstance(device_id, int) else 0
+    if isinstance(device, int):
+        return device
+    if isinstance(device, str) and ":" in device:
+        return int(device.rsplit(":", 1)[1])
+    idx = getattr(device, "device_id", None)
+    return idx if isinstance(idx, int) else 0
+
+
+def _stats(device=None, device_id=0):
     try:
-        dev = jax.devices()[device_id if isinstance(device_id, int) else 0]
+        dev = jax.devices()[_resolve(device, device_id)]
         return dev.memory_stats() or {}
     except Exception:
         return {}
 
 
 def memory_allocated(device=None, device_id=0):
-    return int(_stats(device_id).get("bytes_in_use", 0))
+    return int(_stats(device, device_id).get("bytes_in_use", 0))
 
 
 def max_memory_allocated(device=None, device_id=0):
-    s = _stats(device_id)
+    s = _stats(device, device_id)
     return int(s.get("peak_bytes_in_use", s.get("bytes_in_use", 0)))
 
 
 def memory_reserved(device=None, device_id=0):
-    s = _stats(device_id)
+    s = _stats(device, device_id)
     return int(s.get("bytes_reserved", s.get("bytes_in_use", 0)))
 
 
 def max_memory_reserved(device=None, device_id=0):
-    s = _stats(device_id)
+    s = _stats(device, device_id)
     return int(s.get("peak_bytes_reserved",
                      s.get("peak_bytes_in_use", 0)))
 

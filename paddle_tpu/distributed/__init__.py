@@ -67,3 +67,5 @@ from .parallelize import (  # noqa: F401,E402
 
 from . import passes  # noqa: F401,E402
 from . import sharding  # noqa: F401,E402
+from . import communication  # noqa: F401,E402
+stream = communication.stream  # noqa: E402  (paddle.distributed.stream)

@@ -133,7 +133,7 @@ def save_group_sharded_model(model, output, optimizer=None):
     target = getattr(model, "_layers", model)
     paddle.save(target.state_dict(), os.path.join(output, "model.pdparams"))
     if optimizer is not None:
-        inner = getattr(optimizer, "_optim", optimizer)
+        inner = getattr(optimizer, "_inner", optimizer)
         if hasattr(inner, "state_dict"):
             paddle.save(inner.state_dict(),
                         os.path.join(output, "model.pdopt"))

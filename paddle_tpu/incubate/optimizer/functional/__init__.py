@@ -75,7 +75,9 @@ def minimize_bfgs(objective_func, initial_position, max_iters=50,
         gn = grad(xn)
         y = gn - gx
         sy = jnp.vdot(s, y)
-        if jnp.abs(sy) > 1e-12:
+        # only positive-curvature pairs keep H positive-definite (Armijo
+        # backtracking, unlike strong Wolfe, does not guarantee s.y > 0)
+        if sy > 1e-12:
             rho = 1.0 / sy
             I = jnp.eye(n, dtype=x.dtype)
             V = I - rho * jnp.outer(s, y)
@@ -134,7 +136,7 @@ def minimize_lbfgs(objective_func, initial_position, history_size=100,
         xn = x + s
         gn = grad(xn)
         y = gn - gx
-        if jnp.abs(jnp.vdot(s, y)) > 1e-12:
+        if jnp.vdot(s, y) > 1e-12:  # positive curvature only
             S.append(s)
             Y.append(y)
             if len(S) > history_size:

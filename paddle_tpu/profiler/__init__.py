@@ -248,6 +248,7 @@ def export_protobuf(dir_name="./profiler_log", worker_name=None):
         path = os.path.join(dir_name, f"{name}.pb.json")
         _write_ledger(prof, path)
 
+    handler._dir = dir_name  # Profiler writes the XPlane trace here too
     return handler
 
 

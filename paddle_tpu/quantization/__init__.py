@@ -240,7 +240,9 @@ class _QuanterFactory:
         return self._cls(*self._args, **self._kwargs)
 
     def __call__(self, *args, **kwargs):
-        return self._cls(*args, **kwargs)
+        if args or kwargs:
+            return self._cls(*args, **kwargs)
+        return self._cls(*self._args, **self._kwargs)
 
 
 def quanter(class_name):

@@ -252,7 +252,9 @@ class ReLU6(Layer):
     """parity: sparse/nn ReLU6 — zero-preserving clip to [0, 6]."""
 
     def forward(self, x):
-        return _unary_apply(x, lambda v: jnp.clip(v, 0.0, 6.0))
+        from . import _unary
+
+        return _unary("relu6", lambda v: jnp.clip(v, 0.0, 6.0))(x)
 
 
 class MaxPool3D(Layer):
@@ -288,32 +290,26 @@ class SyncBatchNorm(BatchNorm):
 
 
 def _unary_apply(x, fn):
-    from . import SparseCooTensor, SparseCsrTensor
+    """Zero-preserving elementwise op via the package _unary helper
+    (preserves the coalesced flag)."""
+    from . import _unary
 
-    if isinstance(x, SparseCsrTensor):
-        return SparseCsrTensor(x.crows, x.cols, Tensor(fn(x.values._value)),
-                               x.shape)
-    return SparseCooTensor(x.indices, Tensor(fn(x.values._value)), x.shape)
+    return _unary("sparse_unary", fn)(x)
 
 
 def _sparse_conv_fn(x, weight, bias, stride, padding, dilation, groups,
                     subm, nd):
     """Shared functional conv over the sparse layer machinery."""
-    def tup(v):
-        return tuple(v) if isinstance(v, (list, tuple)) else (v,) * nd
-
-    layer = _SparseConv.__new__(
-        {2: (SubmConv2D if subm else Conv2D),
-         3: (SubmConv3D if subm else Conv3D)}[nd])
-    Layer.__init__(layer)
     w = weight if hasattr(weight, "_value") else Tensor(weight)
-    layer._nd = nd
-    layer._subm = subm
-    layer._ks = tuple(int(k) for k in w.shape[:nd])
-    layer._stride = tup(stride)
-    layer._padding = tup(padding)
-    layer._dilation = tup(dilation)
-    layer._groups = groups
+    ks = tuple(int(k) for k in w.shape[:nd])
+    in_ch = int(w.shape[nd]) * groups
+    out_ch = int(w.shape[nd + 1])
+    cls = {2: (SubmConv2D if subm else Conv2D),
+           3: (SubmConv3D if subm else Conv3D)}[nd]
+    # go through the real constructor (future __init__ attrs stay valid),
+    # then install the caller's weight/bias
+    layer = cls(in_ch, out_ch, ks, stride=stride, padding=padding,
+                dilation=dilation, groups=groups, bias_attr=False)
     layer.weight = w
     layer.bias = (bias if bias is None or hasattr(bias, "_value")
                   else Tensor(bias))

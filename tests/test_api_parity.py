@@ -49,7 +49,8 @@ _MODULES = [
     "sparse.nn", "sparse.nn.functional", "incubate.optimizer.functional",
     "incubate.asp", "quantization.quanters", "quantization.observers",
     "profiler", "distributed.sharding", "device.xpu", "device.cuda",
-    "cost_model",
+    "cost_model", "distributed.communication",
+    "distributed.communication.stream",
 ]
 
 
