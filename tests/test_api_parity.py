@@ -50,7 +50,8 @@ _MODULES = [
     "incubate.asp", "quantization.quanters", "quantization.observers",
     "profiler", "distributed.sharding", "device.xpu", "device.cuda",
     "cost_model", "distributed.communication",
-    "distributed.communication.stream",
+    "distributed.communication.stream", "static.nn", "audio.backends",
+    "audio.datasets", "audio.features", "audio.functional",
 ]
 
 
