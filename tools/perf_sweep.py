@@ -1,0 +1,68 @@
+"""Perf sweep on the local chip: 2.6B llama train-step variants.
+
+Tries remat policy x batch size and prints tokens/s + MFU for each so we
+can pick the best bench configuration. Not part of the test suite.
+"""
+import gc
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def run(name, cfg, batch, seq, optimizer, param_dtype):
+    from paddle_tpu.models import llama
+    try:
+        state = llama.init_train_state(
+            cfg, jax.random.PRNGKey(0), optimizer=optimizer,
+            param_dtype=param_dtype)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size)
+        step = jax.jit(
+            lambda s, t: llama.train_step(s, t, cfg, optimizer=optimizer),
+            donate_argnums=0)
+        for _ in range(2):
+            state, loss = step(state, tokens)
+        import numpy as np
+        float(np.asarray(loss))
+        n = 5
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state, loss = step(state, tokens)
+        float(np.asarray(loss))
+        from bench import _peak_flops
+        dt = time.perf_counter() - t0
+        tps = batch * seq * n / dt
+        mfu = (llama.flops_per_token(cfg, seq) * tps
+               / _peak_flops(jax.devices()[0]))
+        print(f"{name}: {tps:,.0f} tok/s  MFU={mfu:.3f}", flush=True)
+    except Exception as e:
+        print(f"{name}: FAILED {str(e)[:160]}", flush=True)
+    finally:
+        state = tokens = step = loss = None
+        gc.collect()
+        jax.clear_caches()
+
+
+def main():
+    from paddle_tpu.models import llama
+    base = dict(vocab_size=32768, hidden_size=3072, intermediate_size=8192,
+                num_layers=24, num_heads=24, num_kv_heads=8, head_dim=128,
+                max_seq_len=2048)
+    for policy in ("full", "dots"):
+        for batch in (8, 16):
+            cfg = llama.LlamaConfig(remat=True, remat_policy=policy, **base)
+            run(f"2.6b remat={policy} b={batch}", cfg, batch, 2048,
+                "adafactor", jnp.bfloat16)
+    # no-remat attempt (may OOM)
+    cfg = llama.LlamaConfig(remat=False, **base)
+    run("2.6b remat=off b=8", cfg, 8, 2048, "adafactor", jnp.bfloat16)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
