@@ -1,0 +1,25 @@
+"""Shared example bootstrap.
+
+`setup()` makes the repo importable and — when JAX_PLATFORMS=cpu is set —
+forces a virtual CPU mesh through jax.config BEFORE paddle_tpu initializes
+the backend (env vars alone don't stick when jax was pre-imported; same
+order-sensitive dance as tests/conftest.py). Call it before importing
+paddle_tpu or any model module.
+"""
+import os
+import sys
+
+
+def setup():
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            jax.config.update(
+                "jax_num_cpu_devices",
+                int(os.environ.get("PADDLE_TPU_VIRTUAL_DEVICES", "8")))
+        except RuntimeError:
+            pass  # backend already initialized — keep whatever it has

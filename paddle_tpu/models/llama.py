@@ -81,6 +81,12 @@ class LlamaConfig:
     # O(pp) live activations — the reference's default hybrid schedule,
     # pipeline_parallel.py:684). 1f1b applies to train_step only.
     pipeline_schedule: str = "gpipe"
+    # >1 computes the training cross-entropy in sequence chunks under
+    # jax.checkpoint, so the [B, S, vocab] f32 logits tensor is never
+    # materialized (peak logits memory ÷ chunks for ~1% recomputed vocab
+    # matmul FLOPs). The reference's fused_linear_param_grad_add /
+    # parallel_cross_entropy serve the same memory goal on GPU.
+    loss_chunks: int = 1
 
 
 def llama3_8b() -> LlamaConfig:
@@ -363,12 +369,12 @@ def _constrain(x):
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, act_spec()))
 
 
-def forward(params, tokens, config: LlamaConfig):
-    """tokens [B, S] int32 → logits [B, S, vocab] (f32)."""
+def hidden_states(params, tokens, config: LlamaConfig):
+    """tokens [B, S] int32 → final-norm hidden states [B, S, h] (model
+    dtype); runs the pipeline schedule when one is configured."""
     c = config
-    dt = c.dtype
     S = tokens.shape[1]
-    x = params["embed"].astype(dt)[tokens]
+    x = params["embed"].astype(c.dtype)[tokens]
     x = _constrain(x)
     cos, sin = _rope_tables(S, c.head_dim, c.rope_theta)
 
@@ -401,14 +407,52 @@ def forward(params, tokens, config: LlamaConfig):
                                c.pipeline_microbatches, "pp")
     else:
         x, _ = jax.lax.scan(scan_fn, x, params["layers"])
-    x = _rms_norm(x, params["final_norm"], c.rms_eps)
+    return _rms_norm(x, params["final_norm"], c.rms_eps)
+
+
+def forward(params, tokens, config: LlamaConfig):
+    """tokens [B, S] int32 → logits [B, S, vocab] (f32)."""
+    c = config
+    x = hidden_states(params, tokens, c)
     head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
-    logits = x @ head.astype(dt)
+    logits = x @ head.astype(c.dtype)
     return logits.astype(jnp.float32)
+
+
+def _chunked_ce_sum(x, targets, head, n_chunks: int):
+    """Summed next-token CE over [B, S, h] hidden states without ever
+    materializing [B, S, vocab] logits: scan over S/n_chunks-sized chunks,
+    each chunk's logits rebuilt in backward (jax.checkpoint)."""
+    B, S, h = x.shape
+    if S % n_chunks:
+        raise ValueError(
+            f"loss_chunks={n_chunks} must divide the next-token sequence "
+            f"length {S} (= seq - 1 of the training batch); pick a "
+            "divisor or a sequence length with small factors")
+    xc = jnp.moveaxis(x.reshape(B, n_chunks, S // n_chunks, h), 1, 0)
+    tc = jnp.moveaxis(targets.reshape(B, n_chunks, S // n_chunks), 1, 0)
+
+    @jax.checkpoint
+    def chunk(carry, inp):
+        xi, ti = inp
+        logits = (xi @ head).astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, ti[..., None], axis=-1)[..., 0]
+        return carry + jnp.sum(logz - gold), None
+
+    total, _ = jax.lax.scan(chunk, jnp.zeros((), jnp.float32), (xc, tc))
+    return total
 
 
 def loss_fn(params, tokens, config: LlamaConfig):
     """Next-token cross-entropy, mean over positions."""
+    c = config
+    if c.loss_chunks > 1:
+        x = hidden_states(params, tokens[:, :-1], c)
+        head = (params["embed"].T if c.tie_embeddings
+                else params["lm_head"]).astype(c.dtype)
+        total = _chunked_ce_sum(x, tokens[:, 1:], head, c.loss_chunks)
+        return total / (x.shape[0] * x.shape[1])
     logits = forward(params, tokens[:, :-1], config)
     targets = tokens[:, 1:]
     logz = jax.nn.logsumexp(logits, axis=-1)
@@ -441,7 +485,11 @@ def _loss_and_grads_1f1b(params, tokens, config: LlamaConfig, mesh: Mesh):
 
     def last_fn(lp, y, tgt_mb):
         x = _rms_norm(y, lp["final_norm"], c.rms_eps)
-        logits = (x @ lp["lm_head"].astype(c.dtype)).astype(jnp.float32)
+        head = lp["lm_head"].astype(c.dtype)
+        if c.loss_chunks > 1:
+            total = _chunked_ce_sum(x, tgt_mb, head, c.loss_chunks)
+            return total / (x.shape[0] * x.shape[1])
+        logits = (x @ head).astype(jnp.float32)
         logz = jax.nn.logsumexp(logits, axis=-1)
         gold = jnp.take_along_axis(logits, tgt_mb[..., None],
                                    axis=-1)[..., 0]
@@ -494,6 +542,43 @@ def init_train_state(config: LlamaConfig, key: jax.Array,
             lambda p: p.astype(param_dtype), params)
     mu, nu = init_moments(params, optimizer, moment_dtype)
     return TrainState(params, mu, nu, jnp.zeros((), jnp.int32))
+
+
+def init_sharded_train_state(config: LlamaConfig, key: jax.Array,
+                             param_shardings, optimizer: str = "adamw",
+                             param_dtype=jnp.float32) -> TrainState:
+    """Initialize the train state DIRECTLY onto the mesh: the init is jitted
+    with ``out_shardings`` so no unsharded copy ever materializes on one
+    device — required for pod-scale models (an 8B f32 state is ~96 GB,
+    far over a single chip's HBM)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..optimizer.functional import moment_shardings
+
+    mu_sh, nu_sh = moment_shardings(
+        param_shardings, _abstract_params(config), optimizer)
+    mesh = jax.tree_util.tree_leaves(param_shardings)[0].mesh
+    out_sh = TrainState(param_shardings, mu_sh, nu_sh,
+                        NamedSharding(mesh, P()))
+    fn = jax.jit(
+        lambda k: init_train_state(config, k, optimizer=optimizer,
+                                   param_dtype=param_dtype),
+        out_shardings=out_sh)
+    return fn(key)
+
+
+def put_train_state(state: TrainState, param_shardings,
+                    optimizer: str = "adamw") -> TrainState:
+    """device_put a TrainState onto the mesh: params take
+    ``param_shardings``; optimizer moments get moment-shaped shardings
+    (adafactor's scalar mu / factored nu are NOT param-shaped —
+    optimizer/functional.moment_shardings)."""
+    from ..optimizer.functional import moment_shardings
+
+    mu_sh, nu_sh = moment_shardings(param_shardings, state.params, optimizer)
+    return TrainState(jax.device_put(state.params, param_shardings),
+                      jax.device_put(state.mu, mu_sh),
+                      jax.device_put(state.nu, nu_sh), state.step)
 
 
 def train_step(state: TrainState, tokens, config,

@@ -308,6 +308,27 @@ def init_train_state(config: MoEConfig, key: jax.Array,
     return TrainState(params, mu, nu, jnp.zeros((), jnp.int32))
 
 
+def init_sharded_train_state(config: MoEConfig, key: jax.Array,
+                             param_shardings, optimizer: str = "adamw",
+                             param_dtype=jnp.float32) -> TrainState:
+    """Initialize the train state directly onto the mesh (jitted init with
+    out_shardings — no unsharded copy on one device; see
+    llama.init_sharded_train_state)."""
+    from ..optimizer.functional import moment_shardings
+
+    abstract = jax.eval_shape(
+        functools.partial(init_params, config), jax.random.PRNGKey(0))
+    mu_sh, nu_sh = moment_shardings(param_shardings, abstract, optimizer)
+    mesh = jax.tree_util.tree_leaves(param_shardings)[0].mesh
+    out_sh = TrainState(param_shardings, mu_sh, nu_sh,
+                        NamedSharding(mesh, P()))
+    fn = jax.jit(
+        lambda k: init_train_state(config, k, optimizer=optimizer,
+                                   param_dtype=param_dtype),
+        out_shardings=out_sh)
+    return fn(key)
+
+
 def train_step(state: TrainState, tokens, config: MoEConfig, **kw):
     """llama's fused AdamW step with the MoE (CE + router aux) loss."""
     return _llama.train_step(state, tokens, config,

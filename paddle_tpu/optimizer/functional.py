@@ -27,8 +27,8 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["init_moments", "optimizer_update", "adamw_update",
-           "adafactor_update"]
+__all__ = ["init_moments", "moment_shardings", "optimizer_update",
+           "adamw_update", "adafactor_update"]
 
 _f32 = jnp.float32
 
@@ -60,6 +60,37 @@ def init_moments(params, optimizer: str = "adamw",
 
         mu = _tmap(lambda p: jnp.zeros((), _f32), params)
         return mu, _tmap(nu_like, params)
+    raise ValueError(f"unknown optimizer {optimizer!r}")
+
+
+def moment_shardings(param_shardings, params, optimizer: str = "adamw"):
+    """Shardings for the (mu, nu) trees of ``init_moments``.
+
+    adamw moments are param-shaped, so they reuse the param shardings.
+    adafactor's mu is scalar placeholders (replicated) and nu is factored
+    {"vr","vc"}/{"v"} dicts whose specs are the param spec with the reduced
+    dim dropped — device_put'ing those with param shardings is a shape
+    mismatch (the memory-mode crash this fixes).
+    ``params`` may be real or abstract (only .ndim is read).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if optimizer == "adamw":
+        return param_shardings, param_shardings
+    if optimizer == "adafactor":
+        def mu_sh(s, p):
+            return NamedSharding(s.mesh, P())
+
+        def nu_sh(s, p):
+            spec = tuple(s.spec) + (None,) * (p.ndim - len(s.spec))
+            if p.ndim >= 2:
+                return {"vr": NamedSharding(s.mesh, P(*spec[:-1])),
+                        "vc": NamedSharding(s.mesh,
+                                            P(*(spec[:-2] + spec[-1:])))}
+            return {"v": NamedSharding(s.mesh, P(*spec))}
+
+        return (_tmap(mu_sh, param_shardings, params),
+                _tmap(nu_sh, param_shardings, params))
     raise ValueError(f"unknown optimizer {optimizer!r}")
 
 

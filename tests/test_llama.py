@@ -113,3 +113,26 @@ def test_remat_policy_dots_matches_full():
                                                                    tokens)
         losses[pol] = float(loss)
     assert abs(losses["full"] - losses["dots"]) < 1e-5, losses
+
+
+def test_chunked_ce_matches_dense():
+    """loss_chunks>1 never materializes [B,S,vocab] logits; loss and grads
+    must match the dense path (f32 tight; default-bf16 within rounding)."""
+    import dataclasses
+
+    import numpy as np
+
+    cfg = dataclasses.replace(llama.tiny_llama(seq=64), dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 65), 0,
+                                cfg.vocab_size)
+    cfg4 = dataclasses.replace(cfg, loss_chunks=4)
+    l1 = llama.loss_fn(params, tokens, cfg)
+    l2 = llama.loss_fn(params, tokens, cfg4)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    g1 = jax.grad(llama.loss_fn)(params, tokens, cfg)
+    g2 = jax.grad(llama.loss_fn)(params, tokens, cfg4)
+    for a, b in zip(jax.tree_util.tree_leaves(g1),
+                    jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, rtol=1e-4)

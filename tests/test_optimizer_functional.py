@@ -119,3 +119,29 @@ def test_optimizer_update_unknown_name():
         optimizer_update({"w": jnp.zeros((2, 2))}, {"w": jnp.zeros((2, 2))},
                          None, None, jnp.zeros((), jnp.int32),
                          optimizer="sgdx")
+
+
+def test_adafactor_moment_shardings_put():
+    """put_train_state with adafactor must not crash: mu is scalar
+    placeholders (replicated) and nu is factored vr/vc dicts whose specs
+    drop the reduced dim (regression: device_put with param shardings)."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from paddle_tpu.models import llama
+
+    cfg = llama.tiny_llama(vocab=512, hidden=128, layers=2, heads=4,
+                           kv_heads=2, seq=64, ffn=256)
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(1, 2, 1, 4),
+                ("pp", "dp", "sp", "tp"))
+    state = llama.init_train_state(cfg, jax.random.PRNGKey(0),
+                                   optimizer="adafactor")
+    sh = llama.make_shardings(cfg, mesh, fsdp=True)
+    state = llama.put_train_state(state, sh, optimizer="adafactor")
+    # one sharded train step still works
+    tok = jax.random.randint(jax.random.PRNGKey(1), (4, 65), 0,
+                             cfg.vocab_size)
+    with llama.activation_mesh(mesh):
+        state, loss = jax.jit(lambda s, t: llama.train_step(
+            s, t, cfg, optimizer="adafactor"))(state, tok)
+    assert bool(jnp.isfinite(loss))

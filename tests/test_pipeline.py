@@ -214,3 +214,30 @@ def test_1f1b_train_step_converges():
             losses.append(float(loss))
     assert all(np.isfinite(losses)), losses
     assert losses[-1] < losses[0] - 0.1, losses
+
+
+def test_1f1b_chunked_ce_matches_dense():
+    """loss_chunks>1 inside the 1F1B last stage (chunked CE under the
+    shard_map schedule) must match the dense per-microbatch CE."""
+    import dataclasses
+
+    from paddle_tpu.models import llama
+
+    cfg = llama.tiny_llama(vocab=512, hidden=128, layers=4, heads=4,
+                           kv_heads=2, seq=65, ffn=256)
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 1, 1, 2),
+                ("pp", "dp", "sp", "tp"))
+    tok = jax.random.randint(jax.random.PRNGKey(1), (8, 65), 0,
+                             cfg.vocab_size)
+    losses = {}
+    for chunks in (1, 4):
+        c = dataclasses.replace(cfg, pipeline_microbatches=4,
+                                pipeline_schedule="1f1b",
+                                loss_chunks=chunks)
+        state = llama.init_train_state(c, jax.random.PRNGKey(0))
+        state = llama.put_train_state(state, llama.make_shardings(c, mesh))
+        with llama.activation_mesh(mesh):
+            _, loss = jax.jit(lambda s, t, c=c: llama.train_step(s, t, c))(
+                state, tok)
+        losses[chunks] = float(loss)
+    assert abs(losses[1] - losses[4]) < 1e-4, losses

@@ -1,7 +1,9 @@
 """Perf sweep on the local chip: 2.6B llama train-step variants.
 
-Tries remat policy x batch size and prints tokens/s + MFU for each so we
-can pick the best bench configuration. Not part of the test suite.
+Tries cross-entropy chunking x batch size and prints tokens/s + MFU for
+each so we can pick the best bench configuration. Edit the loop literals
+in main() to sweep other axes (remat policy, optimizer mode). Not part of
+the test suite.
 """
 import gc
 import os
@@ -15,6 +17,7 @@ import jax.numpy as jnp
 
 
 def run(name, cfg, batch, seq, optimizer, param_dtype):
+    from bench import _peak_flops
     from paddle_tpu.models import llama
     try:
         state = llama.init_train_state(
@@ -34,7 +37,6 @@ def run(name, cfg, batch, seq, optimizer, param_dtype):
         for _ in range(n):
             state, loss = step(state, tokens)
         float(np.asarray(loss))
-        from bench import _peak_flops
         dt = time.perf_counter() - t0
         tps = batch * seq * n / dt
         mfu = (llama.flops_per_token(cfg, seq) * tps
@@ -53,14 +55,11 @@ def main():
     base = dict(vocab_size=32768, hidden_size=3072, intermediate_size=8192,
                 num_layers=24, num_heads=24, num_kv_heads=8, head_dim=128,
                 max_seq_len=2048)
-    for policy in ("full", "dots"):
+    for chunks in (1, 8):
         for batch in (8, 16):
-            cfg = llama.LlamaConfig(remat=True, remat_policy=policy, **base)
-            run(f"2.6b remat={policy} b={batch}", cfg, batch, 2048,
+            cfg = llama.LlamaConfig(remat=True, loss_chunks=chunks, **base)
+            run(f"2.6b ce_chunks={chunks} b={batch}", cfg, batch, 2048,
                 "adafactor", jnp.bfloat16)
-    # no-remat attempt (may OOM)
-    cfg = llama.LlamaConfig(remat=False, **base)
-    run("2.6b remat=off b=8", cfg, 8, 2048, "adafactor", jnp.bfloat16)
     return 0
 
 
