@@ -431,7 +431,54 @@ class DistModel:
                 for ak, av in st.items():
                     out[f"{k}.{ak}"] = Tensor(av) if not isinstance(
                         av, Tensor) else av
+        if mode in ("all", "opt") and self._opt is not None:
+            # schedule progress, so a resumed run continues the LR schedule
+            # where it left off rather than replaying warmup
+            out["_optimizer.global_step"] = Tensor(
+                jnp.asarray(self._opt._global_step, jnp.int32))
+            sched = self._opt._learning_rate_scheduler
+            if sched is not None:
+                for sk, sv in sched.state_dict().items():
+                    if isinstance(sv, (int, float, bool)):
+                        out[f"_optimizer.lr.{sk}"] = Tensor(
+                            jnp.asarray(sv, jnp.float32 if isinstance(
+                                sv, float) else jnp.int32))
         return out
+
+    def set_state_dict(self, state_dict):
+        """parity: api.py:2826. Restore parameters (structured name) and
+        optimizer slot values (``"<param>.<slot>"`` keys, the inverse of
+        ``state_dict``) into the live layer and optimizer state — required
+        for checkpoint resume, since ``state_dict`` returns value snapshots
+        for the optimizer slots, not live references."""
+        named = dict(self._layer.named_parameters())
+        sched = (self._opt._learning_rate_scheduler
+                 if self._opt is not None else None)
+        opt_updates = {}
+        for k, v in state_dict.items():
+            val = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+            if k in named:
+                named[k]._replace_value(val)
+                continue
+            if k == "_optimizer.global_step":
+                if self._opt is not None:
+                    self._opt._global_step = int(val)
+                continue
+            if k.startswith("_optimizer.lr."):
+                if sched is not None:
+                    sk = k[len("_optimizer.lr."):]
+                    cur = getattr(sched, sk, None)
+                    setattr(sched, sk, type(cur)(val) if isinstance(
+                        cur, (int, float, bool)) else float(val))
+                continue
+            base, _, slot = k.rpartition(".")
+            if base:
+                opt_updates.setdefault(base, {})[slot] = val
+        if opt_updates:
+            if self._opt_state is None:
+                self._opt_state = {kk: {} for kk in named}
+            for base, slots in opt_updates.items():
+                self._opt_state.setdefault(base, {}).update(slots)
 
     def dist_main_program(self, mode=None):
         """The compiled-step cache is the program store in this design."""

@@ -742,6 +742,117 @@ def forward_with_cache(params, tokens, cache, config: LlamaConfig):
     return logits, cache
 
 
+def _sample_impl(logits, key, temperature, top_k, top_p, *, sampled: bool,
+                 use_top_k: bool, use_top_p: bool):
+    """Next-token sampling from [B, vocab] logits. The three keyword flags
+    are STATIC (they shape the program); temperature/top_k/top_p values may
+    be traced scalars, so the fused decode loop never recompiles when a
+    serving loop varies them per request."""
+    if not sampled:
+        return jnp.argmax(logits, axis=-1)
+    lg = logits / temperature
+    B, vocab = lg.shape
+    if use_top_k:
+        srt = jnp.sort(lg, axis=-1)
+        idx = jnp.clip(vocab - top_k, 0, vocab - 1)
+        kth = jnp.take_along_axis(
+            srt, jnp.full((B, 1), idx, jnp.int32), axis=-1)
+        lg = jnp.where(lg < kth, -1e30, lg)
+    if use_top_p:
+        sort_idx = jnp.argsort(-lg, axis=-1)
+        sort_p = jnp.take_along_axis(
+            jax.nn.softmax(lg, axis=-1), sort_idx, axis=-1)
+        cum = jnp.cumsum(sort_p, axis=-1)
+        drop_sorted = cum - sort_p >= top_p      # keep the first >=p prefix
+        drop = jnp.zeros_like(drop_sorted).at[
+            jnp.arange(B)[:, None], sort_idx].set(drop_sorted)
+        lg = jnp.where(drop, -1e30, lg)
+    return jax.random.categorical(key, lg, axis=-1)
+
+
+def _sample_logits(logits, key, temperature: float, top_k: int,
+                   top_p: float):
+    """Eager entry: flags derived from the python values."""
+    return _sample_impl(logits, key, temperature, top_k, top_p,
+                        sampled=temperature > 0, use_top_k=top_k > 0,
+                        use_top_p=top_p < 1.0)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("config", "max_new_tokens", "sampled",
+                              "use_top_k", "use_top_p", "has_eos"))
+def _generate_fused_jit(params, prompt_tokens, key, temperature, top_k,
+                        top_p, eos_id, config: LlamaConfig,
+                        max_new_tokens: int, sampled: bool, use_top_k: bool,
+                        use_top_p: bool, has_eos: bool):
+    B, S0 = prompt_tokens.shape
+    cache = init_kv_cache(config, B, S0 + max_new_tokens)
+
+    def sample(logits, finished, key):
+        key, sub = jax.random.split(key)
+        nxt = _sample_impl(logits, sub, temperature, top_k, top_p,
+                           sampled=sampled, use_top_k=use_top_k,
+                           use_top_p=use_top_p)
+        if has_eos:
+            nxt = jnp.where(finished, eos_id, nxt)
+            finished = finished | (nxt == eos_id)
+        return nxt.astype(prompt_tokens.dtype), finished, key
+
+    logits, cache = forward_with_cache(params, prompt_tokens, cache, config)
+    nxt, finished, key = sample(logits, jnp.zeros((B,), bool), key)
+    toks = jnp.zeros((B, max_new_tokens), prompt_tokens.dtype)
+    toks = toks.at[:, 0].set(nxt)
+
+    # carry holds the LAST token, not logits: the forward for step i runs at
+    # the TOP of iteration i, so no trailing forward is wasted after the
+    # final sample (and the [B, vocab] f32 logits stay out of the carry)
+    def cond(st):
+        i, _, _, _, finished, _ = st
+        return jnp.logical_and(i < max_new_tokens,
+                               jnp.logical_not(jnp.all(finished)))
+
+    def body(st):
+        i, last, cache, toks, finished, key = st
+        logits, cache = forward_with_cache(
+            params, last[:, None], cache, config)
+        nxt, finished, key = sample(logits, finished, key)
+        toks = jax.lax.dynamic_update_slice(toks, nxt[:, None], (0, i))
+        return (i + 1, nxt, cache, toks, finished, key)
+
+    i, _, _, toks, finished, _ = jax.lax.while_loop(
+        cond, body, (jnp.ones((), jnp.int32), nxt, cache, toks, finished,
+                     key))
+    return jnp.concatenate([prompt_tokens, toks], axis=1), i
+
+
+def generate_fused(params, prompt_tokens, config: LlamaConfig,
+                   max_new_tokens: int, temperature: float = 0.0, key=None,
+                   eos_token_id=None, top_k: int = 0, top_p: float = 1.0):
+    """Whole generation as ONE compiled program: prefill + a
+    ``lax.while_loop`` decode with on-device sampling and EOS early exit.
+    The python-loop ``generate`` pays a host->device dispatch per token,
+    which dominates decode latency on remote-attached TPUs (~30x at 2.6B);
+    this is the analogue of the reference's fused block-decode path
+    (block_multihead_attention + top_p_sampling ops in one graph).
+    Same output contract as ``generate``; sampling values (temperature /
+    top_k / top_p / eos id) are traced, so varying them per request does
+    not recompile."""
+    if max_new_tokens <= 0:
+        return prompt_tokens
+    key = key if key is not None else jax.random.PRNGKey(0)
+    temperature = float(temperature)
+    eos_arr = jnp.asarray(
+        0 if eos_token_id is None else eos_token_id, jnp.int32)
+    out, n = _generate_fused_jit(
+        params, prompt_tokens, key, jnp.float32(max(temperature, 1e-6)),
+        jnp.int32(top_k), jnp.float32(top_p), eos_arr, config,
+        max_new_tokens, sampled=temperature > 0,
+        use_top_k=int(top_k) > 0, use_top_p=float(top_p) < 1.0,
+        has_eos=eos_token_id is not None)
+    S0 = prompt_tokens.shape[1]
+    return out[:, :S0 + int(n)]
+
+
 def generate(params, prompt_tokens, config: LlamaConfig, max_new_tokens: int,
              temperature: float = 0.0, key=None, eos_token_id=None,
              top_k: int = 0, top_p: float = 1.0):
@@ -763,25 +874,8 @@ def generate(params, prompt_tokens, config: LlamaConfig, max_new_tokens: int,
     key = key if key is not None else jax.random.PRNGKey(0)
     finished = jnp.zeros((B,), bool)
     for i in range(max_new_tokens):
-        if temperature > 0:
-            key, sub = jax.random.split(key)
-            lg = logits / temperature
-            if top_k > 0:
-                kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
-                lg = jnp.where(lg < kth, -1e30, lg)
-            if top_p < 1.0:
-                sort_idx = jnp.argsort(-lg, axis=-1)
-                sort_p = jnp.take_along_axis(
-                    jax.nn.softmax(lg, axis=-1), sort_idx, axis=-1)
-                cum = jnp.cumsum(sort_p, axis=-1)
-                drop_sorted = cum - sort_p >= top_p  # keep first ≥p prefix
-                drop = jnp.zeros_like(drop_sorted).at[
-                    jnp.arange(lg.shape[0])[:, None], sort_idx].set(
-                    drop_sorted)
-                lg = jnp.where(drop, -1e30, lg)
-            nxt = jax.random.categorical(sub, lg, axis=-1)
-        else:
-            nxt = jnp.argmax(logits, axis=-1)
+        key, sub = jax.random.split(key)
+        nxt = _sample_logits(logits, sub, temperature, top_k, top_p)
         if eos_token_id is not None:
             # finished rows keep emitting eos (the reference's EOS stop)
             nxt = jnp.where(finished, eos_token_id, nxt)

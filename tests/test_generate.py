@@ -62,3 +62,28 @@ def test_generate_matches_no_cache_argmax(setup):
         nxt = jnp.argmax(logits[:, -1], -1)[:, None].astype(seq.dtype)
         seq = jnp.concatenate([seq, nxt], axis=1)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+
+
+def test_generate_fused_matches_python_loop(setup):
+    """generate_fused (one compiled prefill + lax.while_loop decode) must
+    reproduce the python-loop generate exactly: greedy, sampled with the
+    same key stream, and with eos early-exit enabled."""
+    cfg, params = setup
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0,
+                                cfg.vocab_size)
+    a = llama.generate(params, prompt, cfg, max_new_tokens=12)
+    b = llama.generate_fused(params, prompt, cfg, max_new_tokens=12)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    kw = dict(temperature=0.8, top_k=20, top_p=0.9,
+              key=jax.random.PRNGKey(5))
+    a = llama.generate(params, prompt, cfg, max_new_tokens=12, **kw)
+    b = llama.generate_fused(params, prompt, cfg, max_new_tokens=12, **kw)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    eos = int(np.asarray(a)[0, 10])
+    a = llama.generate(params, prompt, cfg, max_new_tokens=24,
+                       eos_token_id=eos)
+    b = llama.generate_fused(params, prompt, cfg, max_new_tokens=24,
+                             eos_token_id=eos)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
