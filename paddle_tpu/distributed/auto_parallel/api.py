@@ -433,16 +433,20 @@ class DistModel:
                         av, Tensor) else av
         if mode in ("all", "opt") and self._opt is not None:
             # schedule progress, so a resumed run continues the LR schedule
-            # where it left off rather than replaying warmup
-            out["_optimizer.global_step"] = Tensor(
-                jnp.asarray(self._opt._global_step, jnp.int32))
+            # where it left off rather than replaying warmup. Saved as
+            # numpy f64/i64 (NOT framework tensors): orbax keeps the full
+            # precision, so resume is bit-exact on the LR schedule.
+            import numpy as np
+
+            out["_optimizer.global_step"] = np.asarray(
+                self._opt._global_step, np.int64)
             sched = self._opt._learning_rate_scheduler
             if sched is not None:
                 for sk, sv in sched.state_dict().items():
                     if isinstance(sv, (int, float, bool)):
-                        out[f"_optimizer.lr.{sk}"] = Tensor(
-                            jnp.asarray(sv, jnp.float32 if isinstance(
-                                sv, float) else jnp.int32))
+                        out[f"_optimizer.lr.{sk}"] = np.asarray(
+                            sv, np.float64 if isinstance(sv, float)
+                            else np.int64)
         return out
 
     def set_state_dict(self, state_dict):
@@ -451,29 +455,39 @@ class DistModel:
         ``state_dict``) into the live layer and optimizer state — required
         for checkpoint resume, since ``state_dict`` returns value snapshots
         for the optimizer slots, not live references."""
+        import numpy as np
+
         named = dict(self._layer.named_parameters())
         sched = (self._opt._learning_rate_scheduler
                  if self._opt is not None else None)
         opt_updates = {}
         for k, v in state_dict.items():
-            val = v._value if isinstance(v, Tensor) else jnp.asarray(v)
             if k in named:
-                named[k]._replace_value(val)
+                named[k]._replace_value(
+                    v._value if isinstance(v, Tensor) else jnp.asarray(v))
                 continue
             if k == "_optimizer.global_step":
                 if self._opt is not None:
-                    self._opt._global_step = int(val)
+                    self._opt._global_step = int(np.asarray(v))
                 continue
             if k.startswith("_optimizer.lr."):
                 if sched is not None:
                     sk = k[len("_optimizer.lr."):]
                     cur = getattr(sched, sk, None)
-                    setattr(sched, sk, type(cur)(val) if isinstance(
-                        cur, (int, float, bool)) else float(val))
+                    # numpy (not jnp): full f64 precision survives restore
+                    raw = np.asarray(
+                        v._value if isinstance(v, Tensor) else v).item()
+                    setattr(sched, sk, type(cur)(raw) if isinstance(
+                        cur, (int, float, bool)) else raw)
                 continue
             base, _, slot = k.rpartition(".")
-            if base:
-                opt_updates.setdefault(base, {})[slot] = val
+            if base not in named:
+                raise KeyError(
+                    f"set_state_dict: {k!r} matches no parameter or "
+                    f"optimizer slot of this model (params: "
+                    f"{sorted(named)[:5]}...) — wrong or stale checkpoint?")
+            opt_updates.setdefault(base, {})[slot] = (
+                v._value if isinstance(v, Tensor) else jnp.asarray(v))
         if opt_updates:
             if self._opt_state is None:
                 self._opt_state = {kk: {} for kk in named}

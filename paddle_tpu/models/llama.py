@@ -834,9 +834,11 @@ def generate_fused(params, prompt_tokens, config: LlamaConfig,
     which dominates decode latency on remote-attached TPUs (~30x at 2.6B);
     this is the analogue of the reference's fused block-decode path
     (block_multihead_attention + top_p_sampling ops in one graph).
-    Same output contract as ``generate``; sampling values (temperature /
+    Same output contract as ``generate``; sampling VALUES (temperature /
     top_k / top_p / eos id) are traced, so varying them per request does
-    not recompile."""
+    not recompile — but crossing an on/off boundary (greedy <-> sampled,
+    top_k 0 <-> >0, top_p 1.0 <-> <1.0, eos None <-> set) changes the
+    program shape and compiles once per regime."""
     if max_new_tokens <= 0:
         return prompt_tokens
     key = key if key is not None else jax.random.PRNGKey(0)

@@ -288,7 +288,9 @@ def test_dist_model_save_load_resume(tmp_path):
     # (the reference's load flow: load_state_dict + DistModel.set_state_dict)
     resumed(paddle.to_tensor(xs[0]), paddle.to_tensor(ys[0]))
     sd = resumed.state_dict()
-    dist.checkpoint.load_state_dict(sd, path)
+    # in-place for framework Tensors; numpy leaves (the "_optimizer.*"
+    # schedule progress) come back in the RETURNED dict
+    sd = dist.checkpoint.load_state_dict(sd, path)
     resumed.set_state_dict(sd)
     tail = [float(resumed(paddle.to_tensor(x), paddle.to_tensor(y)).numpy())
             for x, y in zip(xs[3:], ys[3:])]
