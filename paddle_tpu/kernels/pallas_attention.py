@@ -293,8 +293,8 @@ def flash_attention_fwd(q, k, v, causal: bool = False,
                         block_q: int = 1024, block_kv: int = 1024):
     """q/k/v: [batch, seq, heads, head_dim] (same-heads; expand GQA outside).
     Differentiable (custom FA2 backward). Default 1024-blocks measured
-    fastest on v5e (2.6B train step: 6.97k vs 6.56k tok/s at 512-blocks);
-    _pick_block shrinks them for shorter sequences."""
+    fastest on v5e (2.6B train step: 6.89k vs 6.52k tok/s at 512-blocks,
+    bench.py runs); _pick_block shrinks them for shorter sequences."""
     B, S, H, D = q.shape
     scale = 1.0 / math.sqrt(D)
 

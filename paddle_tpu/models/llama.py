@@ -678,22 +678,25 @@ def init_kv_cache(config: LlamaConfig, batch: int, max_len: int):
 
 def _cached_attention(q, k_cache, v_cache, pos, config: LlamaConfig):
     """q: [B, S_new, Hq, D]; caches: [B, max_len, Hkv, D]; valid keys < pos +
-    S_new with causality inside the new block."""
+    S_new with causality inside the new block. GQA-native: query heads are
+    grouped against their kv head in the einsum — the KV cache is never
+    materialized repeated (decode is KV-bandwidth-bound; a 3x repeat at
+    Hq/Hkv=3 would triple the per-step HBM traffic)."""
     c = config
     B, S, Hq, D = q.shape
     groups = Hq // c.num_kv_heads
-    k = jnp.repeat(k_cache, groups, axis=2)
-    v = jnp.repeat(v_cache, groups, axis=2)
+    qg = q.reshape(B, S, c.num_kv_heads, groups, D)
     scale = 1.0 / math.sqrt(D)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_cache,
                    preferred_element_type=jnp.float32) * scale
-    max_len = k.shape[1]
+    max_len = k_cache.shape[1]
     key_idx = jnp.arange(max_len)[None, :]
     qry_idx = pos + jnp.arange(S)[:, None]
     mask = key_idx <= qry_idx                        # [S, max_len]
-    s = jnp.where(mask[None, None], s, -1e30)
+    s = jnp.where(mask[None, None, None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", p, v_cache)
+    return out.reshape(B, S, Hq, D)
 
 
 def forward_with_cache(params, tokens, cache, config: LlamaConfig):
@@ -713,8 +716,11 @@ def forward_with_cache(params, tokens, cache, config: LlamaConfig):
     cos, sin = jnp.cos(ang), jnp.sin(ang)
 
     # python loop over layers (decode is matmul-small; L is static and the
-    # cache-threading stays explicit)
-    new_k, new_v = [], []
+    # cache-threading stays explicit). Cache writes are per-layer slice
+    # updates on the STACKED arrays — XLA aliases them in place inside the
+    # fused decode while_loop; a rebuild (stack of per-layer copies) would
+    # move the whole multi-GB cache through HBM every step.
+    ck, cv = cache["k"], cache["v"]
     for l in range(c.num_layers):
         p = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
         hn = _rms_norm(x, p["attn_norm"], c.rms_eps)
@@ -723,13 +729,9 @@ def forward_with_cache(params, tokens, cache, config: LlamaConfig):
         v = (hn @ p["wv"].astype(dt)).reshape(B, S, c.num_kv_heads, c.head_dim)
         q = _apply_rope(q, cos, sin)
         k = _apply_rope(k, cos, sin)
-        kc = jax.lax.dynamic_update_slice(
-            cache["k"][l], k, (0, pos, 0, 0))
-        vc = jax.lax.dynamic_update_slice(
-            cache["v"][l], v, (0, pos, 0, 0))
-        new_k.append(kc)
-        new_v.append(vc)
-        att = _cached_attention(q, kc, vc, pos, c)
+        ck = jax.lax.dynamic_update_slice(ck, k[None], (l, 0, pos, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v[None], (l, 0, pos, 0, 0))
+        att = _cached_attention(q, ck[l], cv[l], pos, c)
         x = x + att.reshape(B, S, c.num_heads * c.head_dim) @ p["wo"].astype(dt)
         hn = _rms_norm(x, p["mlp_norm"], c.rms_eps)
         gate = jax.nn.silu(hn @ p["w_gate"].astype(dt))
@@ -738,7 +740,7 @@ def forward_with_cache(params, tokens, cache, config: LlamaConfig):
     x = _rms_norm(x, params["final_norm"], c.rms_eps)
     head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
     logits = (x[:, -1] @ head.astype(dt)).astype(jnp.float32)
-    cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v), "pos": pos + S}
+    cache = {"k": ck, "v": cv, "pos": pos + S}
     return logits, cache
 
 

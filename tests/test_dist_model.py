@@ -295,3 +295,45 @@ def test_dist_model_save_load_resume(tmp_path):
     tail = [float(resumed(paddle.to_tensor(x), paddle.to_tensor(y)).numpy())
             for x, y in zip(xs[3:], ys[3:])]
     np.testing.assert_allclose(tail, full_losses[3:], rtol=2e-3, atol=2e-3)
+
+
+def test_dist_model_state_dict_includes_buffers():
+    """Persistent buffers (BN running stats) ride in state_dict and restore
+    through set_state_dict — a layer-level checkpoint with buffer keys must
+    not be rejected as stale."""
+    mesh = _mesh()
+    dist.auto_parallel.set_mesh(mesh)
+
+    class BNNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 8)
+            self.bn = nn.BatchNorm1D(8)
+
+        def forward(self, x):
+            return self.bn(self.fc(x))
+
+    m = BNNet()
+    model = dist.to_static(m, loss=nn.MSELoss(),
+                           optimizer=paddle.optimizer.SGD(
+                               learning_rate=0.1,
+                               parameters=m.parameters()))
+    model.train()
+    x = paddle.to_tensor(np.random.default_rng(0).normal(
+        size=(16, 8)).astype(np.float32))
+    model(x, paddle.to_tensor(np.zeros((16, 8), np.float32)))
+
+    sd = model.state_dict()
+    bn_keys = [k for k in sd if "_mean" in k or "_variance" in k]
+    assert bn_keys, sorted(sd)
+    mean_before = np.asarray(sd[bn_keys[0]].numpy())
+
+    m2 = BNNet()
+    model2 = dist.to_static(m2, loss=nn.MSELoss(),
+                            optimizer=paddle.optimizer.SGD(
+                                learning_rate=0.1,
+                                parameters=m2.parameters()))
+    model2.set_state_dict(sd)
+    sd2 = model2.state_dict()
+    np.testing.assert_allclose(np.asarray(sd2[bn_keys[0]].numpy()),
+                               mean_before)
