@@ -424,10 +424,9 @@ class DistModel:
     def state_dict(self, mode: str = "all"):
         out = {}
         if mode in ("all", "param"):
-            for k, p in self._layer.named_parameters():
-                out[k] = p
-            for k, b in self._layer.named_buffers():
-                out[k] = b  # persistent buffers (BN running stats)
+            # params + persistable buffers (BN running stats), with the
+            # layer's own non-persistable filtering applied
+            out.update(self._layer.state_dict())
         if mode in ("all", "opt") and self._opt_state is not None:
             for k, st in self._opt_state.items():
                 for ak, av in st.items():
@@ -460,14 +459,13 @@ class DistModel:
         import numpy as np
 
         named = dict(self._layer.named_parameters())
-        buffers = dict(self._layer.named_buffers())
+        targets = self._layer.state_dict()  # params + persistable buffers
         sched = (self._opt._learning_rate_scheduler
                  if self._opt is not None else None)
         opt_updates = {}
         for k, v in state_dict.items():
-            if k in named or k in buffers:
-                target = named[k] if k in named else buffers[k]
-                target._replace_value(
+            if k in targets:
+                targets[k]._replace_value(
                     v._value if isinstance(v, Tensor) else jnp.asarray(v))
                 continue
             if k == "_optimizer.global_step":

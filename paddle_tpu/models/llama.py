@@ -280,20 +280,22 @@ def _attention(q, k, v, config: LlamaConfig):
     reference math."""
     B, S, H, D = q.shape
     groups = H // k.shape[2]
-    if groups > 1:
-        k = jnp.repeat(k, groups, axis=2)
-        v = jnp.repeat(v, groups, axis=2)
     mesh = _ACT_MESH
-    if (config.context_parallel and mesh is not None
-            and dict(mesh.shape).get("sp", 1) > 1):
-        from ..kernels.ring_attention import ring_attention_sharded
-        return ring_attention_sharded(q, k, v, mesh, "sp", causal=True)
-    if config.use_flash and S >= 128 and D % 128 == 0:
+    use_ring = (config.context_parallel and mesh is not None
+                and dict(mesh.shape).get("sp", 1) > 1)
+    if (not use_ring and config.use_flash and S >= 128 and D % 128 == 0):
         try:
             from ..kernels.pallas_attention import flash_attention_fwd
+            # GQA-native kernel: no repeated K/V materialized
             return flash_attention_fwd(q, k, v, causal=True)
         except Exception:
             pass
+    if groups > 1:
+        k = jnp.repeat(k, groups, axis=2)
+        v = jnp.repeat(v, groups, axis=2)
+    if use_ring:
+        from ..kernels.ring_attention import ring_attention_sharded
+        return ring_attention_sharded(q, k, v, mesh, "sp", causal=True)
     scale = 1.0 / math.sqrt(D)
     qt = jnp.einsum("bshd->bhsd", q)
     kt = jnp.einsum("bshd->bhsd", k)
