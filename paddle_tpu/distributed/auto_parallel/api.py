@@ -482,6 +482,9 @@ class DistModel:
                     setattr(sched, sk, type(cur)(raw) if isinstance(
                         cur, (int, float, bool)) else raw)
                 continue
+            if k in dict(self._layer.named_buffers()):
+                continue  # non-persistable buffer from an older checkpoint:
+                # runtime-derived — skip rather than clobber or error
             base, _, slot = k.rpartition(".")
             if base not in named:
                 raise KeyError(

@@ -20,6 +20,10 @@ from ..ops.dispatch import apply
 
 
 def _reference_attention(q, k, v, causal):
+    if k.shape[2] != q.shape[2]:  # GQA: expand K/V for the dense fallback
+        g = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, g, axis=2)
+        v = jnp.repeat(v, g, axis=2)
     scale = 1.0 / math.sqrt(q.shape[-1])
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)

@@ -30,20 +30,37 @@ __all__ = ["ring_attention", "ring_attention_sharded"]
 
 def _block_attend(q, k, v, m, l, acc, mask):
     """One online-softmax accumulation step.
-    q: [B,Sq,H,D]; k,v: [B,Skv,H,D]; m,l: [B,H,Sq,1]; acc: [B,H,Sq,D];
-    mask: [Sq,Skv] bool or None (True = attend)."""
-    scale = 1.0 / math.sqrt(q.shape[-1])
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) * scale
+    q: [B,Sq,Hq,D]; k,v: [B,Skv,Hkv,D] with Hq % Hkv == 0 (GQA: query head
+    h reads kv head h // (Hq//Hkv), grouped in the einsum so K/V are never
+    materialized repeated — they are what rides the ring over ICI);
+    m,l: [B,Hq,Sq,1]; acc: [B,Hq,Sq,D]; mask: [Sq,Skv] bool or None."""
+    B, Sq, Hq, D = q.shape
+    Skv, Hkv = k.shape[1], k.shape[2]
+    G = Hq // Hkv
+    scale = 1.0 / math.sqrt(D)
+    if G == 1:
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) * scale
+    else:
+        qg = q.reshape(B, Sq, Hkv, G, D)
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
+                       preferred_element_type=jnp.float32) * scale
+        s = s.reshape(B, Hq, Sq, Skv)
     if mask is not None:
         s = jnp.where(mask[None, None], s, -1e30)
-    m_cur = jnp.max(s, axis=-1, keepdims=True)            # [B,H,Sq,1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)            # [B,Hq,Sq,1]
     m_new = jnp.maximum(m, m_cur)
     p = jnp.exp(s - m_new)
     alpha = jnp.exp(m - m_new)
     l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-    pv = jnp.einsum("bhqk,bkhd->bhqd", p.astype(v.dtype), v,
-                    preferred_element_type=jnp.float32)
+    if G == 1:
+        pv = jnp.einsum("bhqk,bkhd->bhqd", p.astype(v.dtype), v,
+                        preferred_element_type=jnp.float32)
+    else:
+        pg = p.reshape(B, Hkv, G, Sq, Skv).astype(v.dtype)
+        pv = jnp.einsum("bhgqk,bkhd->bhgqd", pg, v,
+                        preferred_element_type=jnp.float32)
+        pv = pv.reshape(B, Hq, Sq, D)
     acc_new = acc * alpha + pv
     return m_new, l_new, acc_new
 
@@ -54,7 +71,9 @@ def ring_attention(q, k, v, axis_name: str, axis_size: int,
     the sequence axis sharded over ``axis_name`` (static size ``axis_size``).
     Returns [B, S_local, H, D]. Differentiable (lax.scan ring).
 
-    GQA: expand K/V heads to Q heads before calling.
+    GQA: pass K/V with their own (fewer) heads — the grouped einsum attends
+    query head h to kv head h // (Hq//Hkv), and the ring hops move the
+    UNREPEATED K/V blocks (ICI traffic / (Hq//Hkv) vs pre-expanding).
     """
     n = axis_size
     my = jax.lax.axis_index(axis_name)

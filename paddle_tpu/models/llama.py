@@ -290,12 +290,13 @@ def _attention(q, k, v, config: LlamaConfig):
             return flash_attention_fwd(q, k, v, causal=True)
         except Exception:
             pass
+    if use_ring:
+        # GQA-native ring: unrepeated K/V blocks ride the ICI ring
+        from ..kernels.ring_attention import ring_attention_sharded
+        return ring_attention_sharded(q, k, v, mesh, "sp", causal=True)
     if groups > 1:
         k = jnp.repeat(k, groups, axis=2)
         v = jnp.repeat(v, groups, axis=2)
-    if use_ring:
-        from ..kernels.ring_attention import ring_attention_sharded
-        return ring_attention_sharded(q, k, v, mesh, "sp", causal=True)
     scale = 1.0 / math.sqrt(D)
     qt = jnp.einsum("bshd->bhsd", q)
     kt = jnp.einsum("bshd->bhsd", k)

@@ -72,3 +72,23 @@ def test_llama_context_parallel_loss_matches(mesh):
         loss_cp = float(jax.jit(
             lambda p, t: llama.loss_fn(p, t, cfg_cp))(sp, tok))
     np.testing.assert_allclose(loss_ref, loss_cp, rtol=1e-3)
+
+
+def test_ring_gqa_matches_dense(mesh):
+    """GQA ring: K/V carry fewer heads and ride the ring unrepeated; result
+    must match dense attention over the repeated-KV reference."""
+    B, S, Hq, Hkv, D = 2, 64, 4, 2, 16
+    q = jax.random.normal(jax.random.PRNGKey(0), (B, S, Hq, D)) * 0.4
+    k = jax.random.normal(jax.random.PRNGKey(1), (B, S, Hkv, D)) * 0.4
+    v = jax.random.normal(jax.random.PRNGKey(2), (B, S, Hkv, D)) * 0.4
+    out = ring_attention_sharded(q, k, v, mesh, "sp", causal=True)
+
+    kk = jnp.repeat(k, Hq // Hkv, axis=2)
+    vv = jnp.repeat(v, Hq // Hkv, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, kk) / jnp.sqrt(D * 1.0)
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, -1)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", p, vv)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
