@@ -460,6 +460,7 @@ class DistModel:
 
         named = dict(self._layer.named_parameters())
         targets = self._layer.state_dict()  # params + persistable buffers
+        all_buffers = dict(self._layer.named_buffers())
         sched = (self._opt._learning_rate_scheduler
                  if self._opt is not None else None)
         opt_updates = {}
@@ -482,7 +483,7 @@ class DistModel:
                     setattr(sched, sk, type(cur)(raw) if isinstance(
                         cur, (int, float, bool)) else raw)
                 continue
-            if k in dict(self._layer.named_buffers()):
+            if k in all_buffers:
                 continue  # non-persistable buffer from an older checkpoint:
                 # runtime-derived — skip rather than clobber or error
             base, _, slot = k.rpartition(".")

@@ -31,7 +31,36 @@ def to_dlpack(x):
     return _DLPackCarrier(val)
 
 
+class _LegacyCapsule:
+    """Adapter: a bare DLPack PyCapsule (e.g. torch.utils.dlpack.to_dlpack
+    output) re-exposed through the modern protocol jax consumes. A bare
+    capsule does not say which device its memory lives on, so this adapter
+    reads the DLTensor header's device field via ctypes rather than
+    assuming host memory."""
+
+    def __init__(self, capsule):
+        self._capsule = capsule
+
+    def __dlpack__(self, *args, **kwargs):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        import ctypes
+
+        get = ctypes.pythonapi.PyCapsule_GetPointer
+        get.restype = ctypes.c_void_p
+        get.argtypes = [ctypes.py_object, ctypes.c_char_p]
+        ptr = get(self._capsule, b"dltensor")
+        # DLManagedTensor: {DLTensor dl_tensor; ...}; DLTensor starts with
+        # {void* data; DLDevice {int32 device_type; int32 device_id}; ...}
+        base = ctypes.cast(ptr, ctypes.POINTER(ctypes.c_int32))
+        off = ctypes.sizeof(ctypes.c_void_p) // 4
+        return (int(base[off]), int(base[off + 1]))
+
+
 def from_dlpack(obj) -> Tensor:
     if isinstance(obj, Tensor):
         return obj
+    if type(obj).__name__ == "PyCapsule":
+        obj = _LegacyCapsule(obj)
     return Tensor(jax.dlpack.from_dlpack(obj))

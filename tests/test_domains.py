@@ -434,3 +434,22 @@ def test_profiler_chrome_trace_roundtrip(tmp_path):
     assert len(ledger.spans) == 1 and ledger.spans[0][0] == "step"
     text = profiler.build_summary(ledger)
     assert "step" in text
+
+
+def test_utils_dlpack_torch_interop():
+    """Cross-framework: accept torch's LEGACY PyCapsule (to_dlpack) and the
+    modern __dlpack__ protocol; zero-copy back out to torch."""
+    import numpy as np
+    import torch
+
+    t = torch.arange(6).reshape(2, 3).float()
+    via_capsule = paddle.utils.dlpack.from_dlpack(
+        torch.utils.dlpack.to_dlpack(t))
+    via_protocol = paddle.utils.dlpack.from_dlpack(t)
+    np.testing.assert_array_equal(np.asarray(via_capsule.numpy()),
+                                  t.numpy())
+    np.testing.assert_array_equal(np.asarray(via_protocol.numpy()),
+                                  t.numpy())
+    back = torch.utils.dlpack.from_dlpack(
+        paddle.utils.dlpack.to_dlpack(via_capsule))
+    assert torch.equal(back, t)
