@@ -23,30 +23,51 @@ __all__ = ["ulysses_attention", "ulysses_attention_sharded"]
 
 
 def _dense_causal(q, k, v, causal):
-    scale = 1.0 / math.sqrt(q.shape[-1])
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) * scale
+    """Full-sequence attention; GQA-aware (k/v may carry fewer heads —
+    query head h attends kv head h // (Hq//Hkv))."""
+    B, Sq, Hq, D = q.shape
+    Hkv = k.shape[2]
+    G = Hq // Hkv
+    scale = 1.0 / math.sqrt(D)
+    if G == 1:
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) * scale
+    else:
+        qg = q.reshape(B, Sq, Hkv, G, D)
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
+                       preferred_element_type=jnp.float32) * scale
+        s = s.reshape(B, Hq, Sq, k.shape[1])
     if causal:
         S = s.shape[-1]
         mask = jnp.tril(jnp.ones((S, S), bool))
         s = jnp.where(mask[None, None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    if G == 1:
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    pg = p.reshape(B, Hkv, G, Sq, k.shape[1])
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", pg, v)
+    return out.reshape(B, Sq, Hq, D)
 
 
 def ulysses_attention(q, k, v, axis_name: str, axis_size: int,
                       causal: bool = True):
     """Per-shard body under shard_map. q/k/v: [B, S_local, H, D] with the
-    sequence axis sharded over axis_name; H must be divisible by axis_size.
+    sequence axis sharded over axis_name; axis_size must divide every
+    tensor's OWN head count (q's and k/v's separately) — GQA K/V keep
+    their fewer heads through the all-to-all (traffic / (Hq/Hkv) vs
+    pre-expanding), since an equal split of q heads and kv heads lands
+    group-aligned slices on the same device. If Hkv < axis_size, expand
+    K/V (jnp.repeat) to a multiple of axis_size before calling.
     all_to_all #1: gather sequence, scatter heads → [B, S_full, H_local, D];
     attention; all_to_all #2: the reverse."""
-    B, S, H, D = q.shape
+    B, S, _, D = q.shape
     n = axis_size
-    assert H % n == 0, (H, n)
 
     def seq2head(x):
         # [B, S, H, D] -> [B, S, n, h, D]: head groups; all-to-all sends each
         # group to its device while gathering the full sequence
+        H = x.shape[2]
+        assert H % n == 0, (H, n)
         x = x.reshape(B, S, n, H // n, D)
         out = jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
                                  tiled=True)
@@ -55,6 +76,7 @@ def ulysses_attention(q, k, v, axis_name: str, axis_size: int,
 
     def head2seq(x):
         # inverse: [B, S*n, h, D] -> regroup sequence shards then swap back
+        H = x.shape[2] * n
         x = x.reshape(B, n, S, H // n, D)
         out = jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=3,
                                  tiled=True)
