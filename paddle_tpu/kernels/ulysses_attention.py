@@ -26,25 +26,18 @@ def _dense_causal(q, k, v, causal):
     """Full-sequence attention; GQA-aware (k/v may carry fewer heads —
     query head h attends kv head h // (Hq//Hkv))."""
     B, Sq, Hq, D = q.shape
-    Hkv = k.shape[2]
-    G = Hq // Hkv
+    Skv, Hkv = k.shape[1], k.shape[2]
+    G = Hq // Hkv  # grouped path is exact for G == 1 too (reshapes are free)
     scale = 1.0 / math.sqrt(D)
-    if G == 1:
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                       preferred_element_type=jnp.float32) * scale
-    else:
-        qg = q.reshape(B, Sq, Hkv, G, D)
-        s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
-                       preferred_element_type=jnp.float32) * scale
-        s = s.reshape(B, Hq, Sq, k.shape[1])
+    qg = q.reshape(B, Sq, Hkv, G, D)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
+                   preferred_element_type=jnp.float32) * scale
+    s = s.reshape(B, Hq, Sq, Skv)
     if causal:
-        S = s.shape[-1]
-        mask = jnp.tril(jnp.ones((S, S), bool))
+        mask = jnp.tril(jnp.ones((Sq, Skv), bool))
         s = jnp.where(mask[None, None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-    if G == 1:
-        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
-    pg = p.reshape(B, Hkv, G, Sq, k.shape[1])
+    pg = p.reshape(B, Hkv, G, Sq, Skv)
     out = jnp.einsum("bhgqk,bkhd->bqhgd", pg, v)
     return out.reshape(B, Sq, Hq, D)
 

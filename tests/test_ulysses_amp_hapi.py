@@ -103,8 +103,8 @@ def test_ulysses_gqa_matches_dense():
     equal head split lands group-aligned slices per device); must match the
     dense repeated-KV reference."""
     from jax.sharding import Mesh
+
     from paddle_tpu.kernels.ulysses_attention import ulysses_attention_sharded
-    import jax.numpy as jnp
 
     mesh = Mesh(np.array(jax.devices()[:2]), ("sp",))
     B, S, Hq, Hkv, D = 2, 64, 4, 2, 16
