@@ -65,7 +65,9 @@ class LlamaConfig:
     remat: bool = True
     # remat policy: "full" recomputes everything; "dots" saves matmul
     # outputs (jax checkpoint_dots) — fewer recomputed MXU ops when HBM
-    # allows (reference analogue: recompute_granularity="core_attn")
+    # allows; "attn" saves only the attention outputs (skips flash-kernel
+    # recompute in backward — +10% at 2k seq on 740m, costs [B,S,h]/layer)
+    # (reference analogue: recompute_granularity="core_attn")
     remat_policy: str = "full"
     use_flash: bool = True
     # exact blockwise ring attention over the 'sp' mesh axis (long-context;
@@ -321,7 +323,9 @@ def _layer_body(x, layer_params, cos, sin, config: LlamaConfig):
     v = (hn @ p["wv"].astype(dt)).reshape(B, S, c.num_kv_heads, c.head_dim)
     q = _apply_rope(q, cos, sin)
     k = _apply_rope(k, cos, sin)
+    from jax.ad_checkpoint import checkpoint_name
     att = _attention(q, k, v, c).reshape(B, S, c.num_heads * c.head_dim)
+    att = checkpoint_name(att, "attn_out")
     x = x + att @ p["wo"].astype(dt)
     x = _constrain(x)
 
@@ -336,10 +340,17 @@ def _remat(body, config: LlamaConfig):
     if config.remat_policy == "dots":
         policy = jax.checkpoint_policies.checkpoint_dots
         return jax.checkpoint(body, policy=policy)
+    if config.remat_policy == "attn":
+        # save only the attention outputs ([B,S,h] per layer): backward
+        # skips re-running the flash kernel but still recomputes the cheap
+        # elementwise/FFN chain — the middle point between "full" (all
+        # recomputed) and "dots" (all matmul outputs saved, OOMs at 2.6B)
+        policy = jax.checkpoint_policies.save_only_these_names("attn_out")
+        return jax.checkpoint(body, policy=policy)
     if config.remat_policy != "full":
         raise ValueError(
-            f"remat_policy={config.remat_policy!r}: expected 'full' or "
-            "'dots'")
+            f"remat_policy={config.remat_policy!r}: expected 'full', "
+            "'dots', or 'attn'")
     return jax.checkpoint(body)
 
 

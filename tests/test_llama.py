@@ -106,13 +106,14 @@ def test_remat_policy_dots_matches_full():
     tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 17), 0,
                                 cfg.vocab_size)
     losses = {}
-    for pol in ("full", "dots"):
+    for pol in ("full", "dots", "attn"):
         c = dataclasses.replace(cfg, remat=True, remat_policy=pol)
         st = llama.init_train_state(c, jax.random.PRNGKey(0))
         st, loss = jax.jit(lambda s, t: llama.train_step(s, t, c))(st,
                                                                    tokens)
         losses[pol] = float(loss)
     assert abs(losses["full"] - losses["dots"]) < 1e-5, losses
+    assert abs(losses["full"] - losses["attn"]) < 1e-5, losses
 
 
 def test_chunked_ce_matches_dense():
