@@ -905,3 +905,94 @@ def generate(params, prompt_tokens, config: LlamaConfig, max_new_tokens: int,
         if i + 1 < max_new_tokens:
             logits, cache = decode(params, nxt, cache)
     return jnp.concatenate(out, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# HF / torch checkpoint interchange
+# (the reference ecosystem's convert utilities live in PaddleNLP; this is
+#  the in-core equivalent so a switching user can load public weights)
+# ---------------------------------------------------------------------------
+
+def convert_hf_state_dict(state_dict, config: LlamaConfig):
+    """HuggingFace Llama ``state_dict`` (torch tensors / numpy arrays keyed
+    ``model.layers.{i}.self_attn.q_proj.weight`` …) → this module's
+    stacked-layer params. torch Linear stores [out, in], so projection
+    weights transpose; HF checkpoints already carry the rotate-half RoPE
+    layout this module uses, so no head permutation is needed."""
+    c = config
+    import re as _re
+
+    ckpt_layers = {int(m.group(1)) for k in state_dict
+                   for m in [_re.match(r"model\.layers\.(\d+)\.", str(k))]
+                   if m}
+    if ckpt_layers and max(ckpt_layers) + 1 != c.num_layers:
+        raise ValueError(
+            f"checkpoint has {max(ckpt_layers) + 1} layers but "
+            f"config.num_layers={c.num_layers} — a truncated load would "
+            "silently produce garbage")
+
+    def arr(name):
+        v = state_dict[name]
+        if hasattr(v, "detach"):
+            # .float() first: torch bf16/f16 tensors reject .numpy()
+            v = v.detach().cpu().float().numpy()
+        return jnp.asarray(np.asarray(v), jnp.float32)
+
+    def stacked(fmt, transpose=True):
+        mats = [arr(fmt.format(i=i)) for i in range(c.num_layers)]
+        if transpose:
+            mats = [m.T for m in mats]
+        return jnp.stack(mats)
+
+    embed = arr("model.embed_tokens.weight")
+    if embed.shape != (c.vocab_size, c.hidden_size):
+        raise ValueError(
+            f"checkpoint embed {embed.shape} vs config "
+            f"(vocab={c.vocab_size}, hidden={c.hidden_size})")
+    params = {
+        "embed": embed,
+        "layers": {
+            "attn_norm": stacked(
+                "model.layers.{i}.input_layernorm.weight", transpose=False),
+            "wq": stacked("model.layers.{i}.self_attn.q_proj.weight"),
+            "wk": stacked("model.layers.{i}.self_attn.k_proj.weight"),
+            "wv": stacked("model.layers.{i}.self_attn.v_proj.weight"),
+            "wo": stacked("model.layers.{i}.self_attn.o_proj.weight"),
+            "mlp_norm": stacked(
+                "model.layers.{i}.post_attention_layernorm.weight",
+                transpose=False),
+            "w_gate": stacked("model.layers.{i}.mlp.gate_proj.weight"),
+            "w_up": stacked("model.layers.{i}.mlp.up_proj.weight"),
+            "w_down": stacked("model.layers.{i}.mlp.down_proj.weight"),
+        },
+        "final_norm": arr("model.norm.weight"),
+    }
+    if not c.tie_embeddings:
+        key = ("lm_head.weight" if "lm_head.weight" in state_dict
+               else "model.embed_tokens.weight")  # tied checkpoints
+        params["lm_head"] = arr(key).T
+    return params
+
+
+def to_hf_state_dict(params, config: LlamaConfig):
+    """Inverse of ``convert_hf_state_dict`` (numpy values, HF names)."""
+    c = config
+    out = {"model.embed_tokens.weight": np.asarray(params["embed"]),
+           "model.norm.weight": np.asarray(params["final_norm"])}
+    lay = params["layers"]
+    names = [("input_layernorm.weight", "attn_norm", False),
+             ("self_attn.q_proj.weight", "wq", True),
+             ("self_attn.k_proj.weight", "wk", True),
+             ("self_attn.v_proj.weight", "wv", True),
+             ("self_attn.o_proj.weight", "wo", True),
+             ("post_attention_layernorm.weight", "mlp_norm", False),
+             ("mlp.gate_proj.weight", "w_gate", True),
+             ("mlp.up_proj.weight", "w_up", True),
+             ("mlp.down_proj.weight", "w_down", True)]
+    for i in range(c.num_layers):
+        for hf, ours, transpose in names:
+            m = np.asarray(lay[ours][i])
+            out[f"model.layers.{i}.{hf}"] = m.T if transpose else m
+    if not c.tie_embeddings:
+        out["lm_head.weight"] = np.asarray(params["lm_head"]).T
+    return out

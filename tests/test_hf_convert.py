@@ -1,0 +1,41 @@
+"""HF/torch Llama checkpoint interchange: convert_hf_state_dict must
+reproduce transformers' forward logits exactly (RoPE layout, GQA head
+mapping, projection transposes), and to_hf_state_dict is its inverse."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models import llama
+
+
+def test_hf_llama_logits_match_transformers():
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False, attention_bias=False, mlp_bias=False)
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+    sd = hf.state_dict()
+
+    cfg = llama.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=16, max_seq_len=64,
+        rope_theta=10000.0, rms_eps=1e-5, dtype=jnp.float32, remat=False,
+        use_flash=False)
+    params = llama.convert_hf_state_dict(sd, cfg)
+
+    ids = np.random.default_rng(0).integers(0, 128, (2, 16))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    ours = np.asarray(llama.forward(params, jnp.asarray(ids), cfg))
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
+
+    back = llama.to_hf_state_dict(params, cfg)
+    for k in sd:
+        np.testing.assert_allclose(back[k], sd[k].numpy(), atol=1e-6)
