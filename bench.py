@@ -1,11 +1,13 @@
-"""Round benchmark: Llama pretrain train-step throughput on the local chip.
+"""Round benchmark: train-step throughput on the local chip, multi-metric.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-value = tokens/sec/chip on a ~1.2B-param Llama train step (fwd+bwd+AdamW,
-bf16 compute / f32 master, remat on). vs_baseline = achieved MFU / 0.40
-(the BASELINE.json north-star: >=40% MFU — no reference-published numbers
-exist, see BASELINE.md).
+Prints ONE JSON line. Top-level fields are the headline metric (dense Llama
+pretrain tokens/s/chip — comparable across rounds); "metrics" carries the
+full list: dense 2k, long-context 8k, and MoE (dropless ragged_dot
+dispatch). Each entry: {"metric", "value", "unit", "vs_baseline"} with
+vs_baseline = achieved MFU / 0.40 (the BASELINE.json north-star: >=40% MFU
+— no reference-published numbers exist, see BASELINE.md).
 """
+import gc
 import json
 import sys
 import time
@@ -51,15 +53,13 @@ def _hbm_bytes(dev) -> float:
     return 95e9
 
 
-def _configs():
+def _dense_configs():
     from paddle_tpu.models import llama
     # largest first; each entry carries its optimizer memory mode and a
     # peak-bytes/param estimate for the HBM pre-check.
     # 2.6B on a 16GB v5e: bf16 params + factored-second-moment adafactor
-    # (optimizer/functional.py) ≈ 2(p) + 2(g) + ~0(nu) + f32 update temps.
-    # peak ≈ 2 (bf16 params) + 2 (bf16 grads, transient) B/param; factored
-    # second moment and f32 update temps are noise at this scale (measured
-    # on v5e: 2.62B params trains in ~11GB)
+    # (optimizer/functional.py) ≈ 2(p) + 2(g) + ~0(nu) + f32 update temps
+    # (measured on v5e: 2.62B params trains in ~11GB).
     adafactor_bf16 = {"optimizer": "adafactor",
                       "param_dtype": jnp.bfloat16, "bpp": 4}
     adamw_f32 = {"optimizer": "adamw", "param_dtype": jnp.float32, "bpp": 16}
@@ -93,58 +93,139 @@ def _sync(x):
     return v
 
 
-def main():
-    from paddle_tpu.models import llama
+def _release():
+    gc.collect()
+    jax.clear_caches()
 
-    dev = jax.devices()[0]
+
+def _time_train(module, cfg, batch, seq, opt, n_steps=5, **step_kw):
+    """Init → compile → warm → time n_steps of module.train_step. Returns
+    tokens/s. Frees the state before returning."""
+    state = module.init_train_state(
+        cfg, jax.random.PRNGKey(0), optimizer=opt["optimizer"],
+        param_dtype=opt["param_dtype"])
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size)
+    step = jax.jit(
+        lambda s, t: module.train_step(s, t, cfg,
+                                       optimizer=opt["optimizer"], **step_kw),
+        donate_argnums=0)
+    try:
+        for _ in range(2):  # compile + warmup
+            state, loss = step(state, tokens)
+        _sync(loss)
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            state, loss = step(state, tokens)
+        _sync(loss)
+        dt = time.perf_counter() - t0
+        return batch * seq * n_steps / dt
+    finally:
+        state = tokens = step = loss = None
+        _release()
+
+
+def bench_dense(dev, results):
+    """Dense-llama ladder: largest config that fits wins; it is the round
+    headline."""
+    from paddle_tpu.models import llama
     last_err = None
-    for name, cfg, batch, seq, opt in _configs():
-        # pre-check this config's optimizer-mode footprint against HBM so an
-        # OOM attempt can't poison the allocator for the fallback configs
+    for name, cfg, batch, seq, opt in _dense_configs():
         n_params = llama.num_params(llama._abstract_params(cfg))
         if n_params * opt["bpp"] > 0.8 * _hbm_bytes(dev) \
                 and dev.platform != "cpu":
             continue
         try:
-            state = llama.init_train_state(
-                cfg, jax.random.PRNGKey(0), optimizer=opt["optimizer"],
-                param_dtype=opt["param_dtype"])
-            tokens = jax.random.randint(
-                jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size)
-            step = jax.jit(
-                lambda s, t: llama.train_step(s, t, cfg,
-                                              optimizer=opt["optimizer"]),
-                donate_argnums=0)
-            for _ in range(2):  # compile + warmup
-                state, loss = step(state, tokens)
-            _sync(loss)
-            n_steps = 5
-            t0 = time.perf_counter()
-            for _ in range(n_steps):
-                state, loss = step(state, tokens)
-            _sync(loss)
-            dt = time.perf_counter() - t0
-            tokens_per_sec = batch * seq * n_steps / dt
-            mfu = (llama.flops_per_token(cfg, seq) * tokens_per_sec
-                   / _peak_flops(dev))
-            print(json.dumps({
+            tps = _time_train(llama, cfg, batch, seq, opt)
+            mfu = llama.flops_per_token(cfg, seq) * tps / _peak_flops(dev)
+            results.append({
                 "metric": f"{name}_pretrain_tokens_per_sec_per_chip",
-                "value": round(tokens_per_sec, 1),
+                "value": round(tps, 1),
                 "unit": "tokens/s",
                 "vs_baseline": round(mfu / 0.40, 4),
-            }))
-            return 0
-        except Exception as e:  # OOM etc. — try the next smaller config
+            })
+            return
+        except Exception as e:
             last_err = e
-            state = tokens = step = loss = None  # release device buffers
-            import gc
-            gc.collect()
-            jax.clear_caches()
-            continue
-    print(json.dumps({
-        "metric": "bench_failed", "value": 0.0, "unit": "tokens/s",
-        "vs_baseline": 0.0, "error": str(last_err)[:200]}))
-    return 1
+            _release()
+    results.append({"metric": "dense_bench_failed", "value": 0.0,
+                    "unit": "tokens/s", "vs_baseline": 0.0,
+                    "error": str(last_err)[:200]})
+
+
+def bench_long_context(dev, results):
+    """Same 2.6B model at 8k sequence — the long-context lane (flash
+    attention + remat keep the 8k activations inside HBM)."""
+    from paddle_tpu.models import llama
+    if dev.platform == "cpu":
+        return  # chip-only section
+    cfg = llama.LlamaConfig(
+        vocab_size=32768, hidden_size=3072, intermediate_size=8192,
+        num_layers=24, num_heads=24, num_kv_heads=8, head_dim=128,
+        max_seq_len=8192, remat=True)
+    opt = {"optimizer": "adafactor", "param_dtype": jnp.bfloat16}
+    try:
+        tps = _time_train(llama, cfg, 2, 8192, opt)
+        mfu = llama.flops_per_token(cfg, 8192) * tps / _peak_flops(dev)
+        results.append({
+            "metric": "llama-2.6b@8k_pretrain_tokens_per_sec_per_chip",
+            "value": round(tps, 1),
+            "unit": "tokens/s",
+            "vs_baseline": round(mfu / 0.40, 4),
+        })
+    except Exception as e:
+        results.append({"metric": "long_context_bench_failed", "value": 0.0,
+                        "unit": "tokens/s", "vs_baseline": 0.0,
+                        "error": str(e)[:200]})
+        _release()
+
+
+def bench_moe(dev, results):
+    """Dropless MoE (sort + ragged_dot grouped-GEMM dispatch,
+    kernels/moe_dispatch.py) — BASELINE config 5's capability measured on
+    chip. MFU uses active params per token."""
+    from paddle_tpu.models import moe
+    if dev.platform == "cpu":
+        return  # chip-only section
+    cfg = moe.MoEConfig(
+        vocab_size=32768, hidden_size=2048, intermediate_size=6144,
+        moe_intermediate_size=1408, num_layers=12, num_heads=16,
+        num_kv_heads=8, head_dim=128, num_experts=16, top_k=2,
+        n_shared_experts=2, first_dense_layers=1, max_seq_len=2048,
+        remat=True)
+    opt = {"optimizer": "adafactor", "param_dtype": jnp.bfloat16}
+    try:
+        tps = _time_train(moe, cfg, 8, 2048, opt)
+        mfu = moe.flops_per_token(cfg, 2048) * tps / _peak_flops(dev)
+        n_total = moe.num_params(jax.eval_shape(
+            lambda k: moe.init_params(cfg, k), jax.random.PRNGKey(0)))
+        results.append({
+            "metric": "moe-dropless_pretrain_tokens_per_sec_per_chip",
+            "value": round(tps, 1),
+            "unit": "tokens/s",
+            "vs_baseline": round(mfu / 0.40, 4),
+            "total_params": n_total,
+            "active_params_per_token": moe.active_params_per_token(cfg),
+        })
+    except Exception as e:
+        results.append({"metric": "moe_bench_failed", "value": 0.0,
+                        "unit": "tokens/s", "vs_baseline": 0.0,
+                        "error": str(e)[:200]})
+        _release()
+
+
+def main():
+    dev = jax.devices()[0]
+    results = []
+    bench_dense(dev, results)
+    bench_long_context(dev, results)
+    bench_moe(dev, results)
+
+    headline = results[0]
+    out = dict(headline)
+    out["metrics"] = results
+    print(json.dumps(out))
+    return 0 if headline.get("value", 0.0) > 0 else 1
 
 
 if __name__ == "__main__":

@@ -24,7 +24,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 __all__ = ["pipeline_apply", "pipeline_apply_interleaved",
-           "pipeline_train_1f1b", "make_1f1b_schedule"]
+           "pipeline_train_1f1b", "make_1f1b_schedule",
+           "pipeline_train_zb", "make_zb_schedule"]
 
 
 def _pipeline_body(stage_params, microbatches, stage_fn: Callable,
@@ -234,19 +235,16 @@ def pipeline_apply_interleaved(stage_fn: Callable, stacked_params, x,
 #     inputs are token ids (tiny) and nothing O(M * hidden) is ever
 #     replicated or broadcast — the two traffic problems of the GPipe path.
 #
-# ZeroBubble note (pipeline_zero_bubble.py:62): ZB splits backward into a
-# B (input-grad) slot and a W (weight-grad) slot so W fills the cooldown
-# bubble. The table generator extends naturally (act ∈ {idle,F,B,W}), but
-# ZB's win requires the B slot to REUSE stored forward residuals — under
-# this recompute-based design each split slot would recompute the stage
-# forward, and one jax.vjp already yields dx and dw together, so the split
-# costs a full extra recompute per microbatch·stage and nets out negative
-# on TPU (MXU-bound stages). A stored-residual ZB variant needs scan-carry
-# residual buffers (S-deep, stage-activation sized) — the memory 1F1B
-# exists to avoid. Documented trade: 1F1B is the memory-shaped schedule;
-# ZB is intentionally not implemented.
+# ZeroBubble (pipeline_zero_bubble.py:62,151): ZB splits backward into a
+# B (input-grad) slot and a W (weight-grad) slot so W fills the warmup and
+# cooldown bubbles — see make_zb_schedule / pipeline_train_zb below. Under
+# this recompute-based design each split slot recomputes the stage forward
+# (one jax.vjp yields dx and dw together, so splitting costs an extra
+# recompute per microbatch·stage); the trade is documented on
+# pipeline_train_zb — ZB-H1 wins when the bubble fraction (S-1)/M exceeds
+# the ~1/3 slot-cost overhead, i.e. microbatch-starved pipelines.
 
-_IDLE, _FWD, _BWD = 0, 1, 2
+_IDLE, _FWD, _BWD, _WGT = 0, 1, 2, 3
 
 
 def make_1f1b_schedule(num_microbatches: int, n_stages: int):
@@ -474,6 +472,309 @@ def pipeline_train_1f1b(first_fn: Callable, stage_fn: Callable,
         inv_m = 1.0 / M
         # f32 psums only (XLA CPU AllReducePromotion miscompiles bf16
         # all-reduces from partial-manual regions)
+        gf = jax.tree_util.tree_map(
+            lambda a: jax.lax.psum(a * inv_m, axis_name), gf)
+        gl = jax.tree_util.tree_map(
+            lambda a: jax.lax.psum(a * inv_m, axis_name), gl)
+        loss = jax.lax.psum(loss_sum, axis_name) * inv_m
+        gs = jax.tree_util.tree_map(lambda a: (a * inv_m)[None], gs)
+        return loss, gf, gs, gl
+
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(rspec, pspec, lspec, P(), P()),
+        out_specs=(P(), rspec, pspec, lspec),
+        axis_names={axis_name}, check_vma=False)
+    loss, gf, gs, gl = fn(first_params, staged, last_params, mb_in, mb_tg)
+    g_stacked = jax.tree_util.tree_map(
+        lambda a: a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:]), gs)
+    return loss, (gf, g_stacked, gl)
+
+
+# ---------------------------------------------------------------------------
+# ZeroBubble (ZB-H1) — W slots fill the 1F1B bubbles
+# ---------------------------------------------------------------------------
+#
+# Parity target: passes/pipeline_scheduler_pass/pipeline_zero_bubble.py:62
+# (backward split into dgrad "B" and wgrad "W" ops; :151 schedules W into
+# the cooldown bubble). Same TPU re-design substrate as 1F1B: the timetable
+# is host-simulated into a static action table, the program is one lax.scan
+# over ppermute hops. B slots produce only the input cotangent (dx) and
+# immediately forward it downstream — the latency-critical chain; W slots
+# produce the weight grads later, in steps that 1F1B would leave idle.
+
+
+def make_zb_schedule(num_microbatches: int, n_stages: int):
+    """Simulate the ZB-H1 timetable. Returns int32 numpy arrays, all [T, S]:
+    act (0 idle / 1 fwd / 2 bwd-dgrad / 3 wgrad), mb, arr_f, arr_b (wire
+    arrivals, -1 if none) — the same wire semantics as make_1f1b_schedule
+    (W is local: it reads saved buffers, sends nothing).
+
+    Policy per stage s (ZB-H1): 1F1B's F/B cadence — warmup (pp-1-s)
+    forwards, strict alternation, cooldown — with W slots woven in two ways:
+    every slot where neither F nor B can run retires the oldest pending W
+    (bubble filling), and an F or B whose mod-S ring slot still holds an
+    unconsumed W payload yields to that W first (ring-capacity pressure —
+    this is what keeps the deferred-wgrad state O(pp) boundary tensors, the
+    paper's ZB-H1 memory bound, instead of O(M)). Asserts: per-stage counts
+    F==B==W==M; idle slots strictly fewer than the 1F1B table's; the S-deep
+    x/g rings are never overwritten before their W consumes them."""
+    import numpy as np
+
+    M, S = num_microbatches, n_stages
+    next_f = [0] * S
+    next_b = [0] * S
+    next_w = [0] * S
+    f_time = [[None] * S for _ in range(M)]
+    b_time = [[None] * S for _ in range(M)]
+    w_time = [[None] * S for _ in range(M)]
+    act_rows, mb_rows = [], []
+    t = 0
+    while any(nw < M for nw in next_w):
+        assert t < 6 * (M + S) + 16, "zb schedule failed to converge"
+        ra, rm = [_IDLE] * S, [0] * S
+        for s in range(S):
+            warmup = min(S - 1 - s, M)
+            fm, bm, wm = next_f[s], next_b[s], next_w[s]
+            can_f = fm < M and (
+                s == 0 or (f_time[fm][s - 1] is not None
+                           and f_time[fm][s - 1] < t))
+            can_b = bm < M and (
+                (s == S - 1 and f_time[bm][s] is not None
+                 and f_time[bm][s] < t)
+                or (s < S - 1 and b_time[bm][s + 1] is not None
+                    and b_time[bm][s + 1] < t))
+            f_turn = fm < M and (fm < warmup or fm - warmup == bm)
+            # ring-capacity pressure: an F (or B) about to overwrite the
+            # mod-S x (or g) ring slot of a still-pending W yields to it
+            f_ring_ok = fm < S or wm > fm - S
+            b_ring_ok = bm < S or wm > bm - S
+            w_ready = (wm < M and b_time[wm][s] is not None
+                       and b_time[wm][s] < t)
+            if f_turn and can_f and f_ring_ok:
+                ra[s], rm[s] = _FWD, fm
+                f_time[fm][s] = t
+                next_f[s] += 1
+            elif not f_turn and can_b and b_ring_ok:
+                ra[s], rm[s] = _BWD, bm
+                b_time[bm][s] = t
+                next_b[s] += 1
+            elif w_ready:
+                ra[s], rm[s] = _WGT, wm       # fill the bubble with wgrad
+                w_time[wm][s] = t
+                next_w[s] += 1
+        act_rows.append(ra)
+        mb_rows.append(rm)
+        t += 1
+
+    act = np.asarray(act_rows, np.int32)
+    mbt = np.asarray(mb_rows, np.int32)
+    T = act.shape[0]
+    for s in range(S):
+        for a, times in ((_FWD, f_time), (_BWD, b_time), (_WGT, w_time)):
+            assert int((act[:, s] == a).sum()) == M, (s, a)
+        # ring safety: W(m) must consume x/g before F(m+S)/B(m+S) overwrite
+        # the mod-S ring slot
+        for m in range(M):
+            if m + S < M:
+                assert w_time[m][s] < f_time[m + S][s], (s, m)
+                assert w_time[m][s] < b_time[m + S][s], (s, m)
+
+    arr_f = -np.ones((T, S), np.int32)
+    arr_b = -np.ones((T, S), np.int32)
+    for tt in range(1, T):
+        for s in range(S):
+            if s > 0 and act[tt - 1, s - 1] == _FWD:
+                arr_f[tt, s] = mbt[tt - 1, s - 1]
+            if s < S - 1 and act[tt - 1, s + 1] == _BWD:
+                arr_b[tt, s] = mbt[tt - 1, s + 1]
+
+    # parity-ring safety (same 2-slot wire rings as 1F1B, and ZB's
+    # yield-to-W rules delay F/B consumption): payload m must be consumed
+    # strictly before payload m+2 (same ring slot) arrives
+    for s in range(S):
+        for wire, times in (
+                (arr_f, {m: f_time[m][s] for m in range(M)} if s else None),
+                (arr_b, {m: b_time[m][s] for m in range(M)} if s < S - 1
+                 else None)):
+            if times is None:
+                continue
+            arrive = {int(wire[tt, s]): tt for tt in range(T)
+                      if wire[tt, s] >= 0}
+            for m, tt in arrive.items():
+                if m + 2 in arrive:
+                    assert times[m] < arrive[m + 2], (s, m, times[m], arrive)
+
+    # the point of ZB: fewer idle slots than 1F1B on the same problem
+    # (S == 1 has no bubble to fill; M == 1 has no cross-microbatch work
+    # to fill it with — both degenerate cases keep the 1F1B profile)
+    if S > 1 and M > 1:
+        act_1f1b = make_1f1b_schedule(M, S)[0]
+        idle_zb = int((act == _IDLE).sum())
+        idle_1f1b = int((act_1f1b == _IDLE).sum())
+        assert idle_zb < idle_1f1b, (idle_zb, idle_1f1b)
+    return act, mbt, arr_f, arr_b
+
+
+def pipeline_train_zb(first_fn: Callable, stage_fn: Callable,
+                      last_fn: Callable, first_params, stacked_params,
+                      last_params, inputs, targets, mesh: Mesh,
+                      num_microbatches: int, axis_name: str = "pp",
+                      hidden_dtype=jnp.bfloat16):
+    """Fused ZB-H1 pipeline train pass — same contract as
+    pipeline_train_1f1b.
+
+    Slot semantics (recompute design): B recomputes the stage forward and
+    takes grads w.r.t. the boundary input only (dx — the cotangent chain
+    other stages wait on), stashing the incoming cotangent in an S-deep
+    ring; W recomputes again and takes the weight grads. Each microbatch
+    thus costs one extra stage recompute vs 1F1B (~+1/3 slot work), bought
+    back from the (S-1)-slot warmup/cooldown bubbles — net win when M is
+    small relative to S (microbatch-starved), documented loss when M >> S.
+    Memory stays O(S) boundary tensors: the x ring (as 1F1B) plus the g
+    ring ZB needs to defer W."""
+    S = dict(mesh.shape)[axis_name]
+    M = num_microbatches
+    B = inputs.shape[0]
+    assert B % M == 0, (B, M)
+    mb_in = inputs.reshape((M, B // M) + inputs.shape[1:])
+    mb_tg = targets.reshape((M, B // M) + targets.shape[1:])
+
+    act, mbt, arr_f, arr_b = make_zb_schedule(M, S)
+    T = act.shape[0]
+
+    def split_stages(a):
+        L = a.shape[0]
+        assert L % S == 0, (L, S)
+        return a.reshape((S, L // S) + a.shape[1:])
+
+    staged = jax.tree_util.tree_map(split_stages, stacked_params)
+    pspec = jax.tree_util.tree_map(
+        lambda a: P(axis_name, *([None] * (a.ndim - 1))), staged)
+    rspec = jax.tree_util.tree_map(lambda a: P(), first_params)
+    lspec = jax.tree_util.tree_map(lambda a: P(), last_params)
+
+    mb_abs = jax.eval_shape(lambda a: a[0], mb_in)
+    h_shape = jax.eval_shape(first_fn, first_params, mb_abs)
+    h_like = jnp.zeros(h_shape.shape, hidden_dtype)
+
+    perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+    perm_bwd = [((i + 1) % S, i) for i in range(S)]
+
+    act_t = jnp.asarray(act)
+    mbt_t = jnp.asarray(mbt)
+    arrf_t = jnp.asarray(arr_f)
+    arrb_t = jnp.asarray(arr_b)
+
+    f32 = jnp.float32
+
+    def body(first_p, staged_p, last_p, tok, tgt):
+        stage = jax.lax.axis_index(axis_name)
+        is_first = stage == 0
+        is_last = stage == S - 1
+        sp_local = jax.tree_util.tree_map(lambda a: a[0], staged_p)
+
+        gf0 = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, f32), first_p)
+        gs0 = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, f32), sp_local)
+        gl0 = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, f32), last_p)
+
+        def make_obj(tok_m, tgt_m, g_in):
+            def obj(fp, sp_, lp, x_s):
+                x_in = jax.lax.cond(
+                    is_first,
+                    lambda: first_fn(fp, tok_m).astype(hidden_dtype),
+                    lambda: x_s)
+                y = stage_fn(sp_, x_in)
+                return jax.lax.cond(
+                    is_last,
+                    lambda: last_fn(lp, y, tgt_m).astype(f32),
+                    lambda: jnp.vdot(y.astype(f32), g_in.astype(f32)))
+            return obj
+
+        def step(carry, t):
+            (wire_f, wire_b, ring_f, ring_b, in_buf, g_buf,
+             gf, gs, gl, loss_sum) = carry
+            af = arrf_t[t][stage]
+            ab = arrb_t[t][stage]
+            ring_f = jax.lax.cond(
+                af >= 0,
+                lambda: jax.lax.dynamic_update_index_in_dim(
+                    ring_f, wire_f, jnp.mod(af, 2), 0),
+                lambda: ring_f)
+            ring_b = jax.lax.cond(
+                ab >= 0,
+                lambda: jax.lax.dynamic_update_index_in_dim(
+                    ring_b, wire_b, jnp.mod(ab, 2), 0),
+                lambda: ring_b)
+            a = act_t[t][stage]
+            m = mbt_t[t][stage]
+
+            def br_idle():
+                return (in_buf, g_buf, gf, gs, gl, loss_sum,
+                        jnp.zeros_like(h_like), jnp.zeros_like(h_like))
+
+            def br_fwd():
+                x_in = jax.lax.cond(
+                    is_first,
+                    lambda: first_fn(first_p, tok[m]).astype(hidden_dtype),
+                    lambda: ring_f[jnp.mod(m, 2)])
+                y = stage_fn(sp_local, x_in).astype(hidden_dtype)
+                buf = jax.lax.dynamic_update_index_in_dim(
+                    in_buf, x_in, jnp.mod(m, S), 0)
+                return (buf, g_buf, gf, gs, gl, loss_sum, y,
+                        jnp.zeros_like(h_like))
+
+            def br_bwd():
+                # dgrad only: recompute forward, cotangent w.r.t. x; stash
+                # the incoming cotangent for this microbatch's later W slot
+                x_saved = in_buf[jnp.mod(m, S)]
+                g_in = ring_b[jnp.mod(m, 2)]
+                obj = make_obj(tok[m], tgt[m], g_in)
+                val, gx = jax.value_and_grad(obj, argnums=3)(
+                    first_p, sp_local, last_p, x_saved)
+                gbuf2 = jax.lax.dynamic_update_index_in_dim(
+                    g_buf, g_in, jnp.mod(m, S), 0)
+                return (in_buf, gbuf2, gf, gs, gl,
+                        loss_sum + jnp.where(is_last, val, 0.0),
+                        jnp.zeros_like(h_like), gx.astype(hidden_dtype))
+
+            def br_wgt():
+                # wgrad: recompute forward again, weight cotangents only
+                x_saved = in_buf[jnp.mod(m, S)]
+                g_in = g_buf[jnp.mod(m, S)]
+                obj = make_obj(tok[m], tgt[m], g_in)
+                gfp, gsp, glp = jax.grad(obj, argnums=(0, 1, 2))(
+                    first_p, sp_local, last_p, x_saved)
+                add = lambda t1, t2: jax.tree_util.tree_map(
+                    lambda x, y: x + y.astype(f32), t1, t2)
+                return (in_buf, g_buf, add(gf, gfp), add(gs, gsp),
+                        add(gl, glp), loss_sum,
+                        jnp.zeros_like(h_like), jnp.zeros_like(h_like))
+
+            (in_buf2, g_buf2, gf2, gs2, gl2, loss2, send_f,
+             send_b) = jax.lax.switch(a, [br_idle, br_fwd, br_bwd, br_wgt])
+            wire_f2 = jax.lax.ppermute(send_f, axis_name, perm_fwd)
+            wire_b2 = jax.lax.ppermute(send_b, axis_name, perm_bwd)
+            return (wire_f2, wire_b2, ring_f, ring_b, in_buf2, g_buf2,
+                    gf2, gs2, gl2, loss2), None
+
+        zero_h = jnp.zeros_like(h_like)
+        carry0 = (zero_h, zero_h,
+                  jnp.zeros((2,) + h_like.shape, hidden_dtype),
+                  jnp.zeros((2,) + h_like.shape, hidden_dtype),
+                  jnp.zeros((S,) + h_like.shape, hidden_dtype),
+                  jnp.zeros((S,) + h_like.shape, hidden_dtype),
+                  gf0, gs0, gl0, jnp.zeros((), f32))
+        carry, _ = jax.lax.scan(step, carry0, jnp.arange(T))
+        gf, gs, gl, loss_sum = carry[6], carry[7], carry[8], carry[9]
+
+        inv_m = 1.0 / M
+        # f32 psums only (XLA CPU AllReducePromotion miscompiles bf16
+        # all-reduces from partial-manual regions — same constraint as the
+        # 1F1B epilogue above)
         gf = jax.tree_util.tree_map(
             lambda a: jax.lax.psum(a * inv_m, axis_name), gf)
         gl = jax.tree_util.tree_map(

@@ -79,9 +79,11 @@ class LlamaConfig:
     # >1 switches to the circular interleaved (VPP) schedule with this many
     # chunks per stage (requires num_layers % (pp * chunks) == 0)
     pipeline_chunks: int = 1
-    # "gpipe" (fwd pipeline, XLA-derived bwd) or "1f1b" (fused fwd+bwd with
+    # "gpipe" (fwd pipeline, XLA-derived bwd), "1f1b" (fused fwd+bwd with
     # O(pp) live activations — the reference's default hybrid schedule,
-    # pipeline_parallel.py:684). 1f1b applies to train_step only.
+    # pipeline_parallel.py:684), or "zb" (ZeroBubble ZB-H1: backward split
+    # into dgrad/wgrad slots that fill the bubbles —
+    # pipeline_zero_bubble.py:62). 1f1b/zb apply to train_step only.
     pipeline_schedule: str = "gpipe"
     # >1 computes the training cross-entropy in sequence chunks under
     # jax.checkpoint, so the [B, S, vocab] f32 logits tensor is never
@@ -475,11 +477,12 @@ def loss_fn(params, tokens, config: LlamaConfig):
 
 
 def _loss_and_grads_1f1b(params, tokens, config: LlamaConfig, mesh: Mesh):
-    """Fused 1F1B loss+grad pass (distributed/pipeline.pipeline_train_1f1b):
-    embed runs on stage 0, final-norm+head+CE inside the last stage, so only
-    token ids and one boundary activation per in-flight microbatch exist
-    per device — the reference 1F1B memory profile."""
-    from ..distributed.pipeline import pipeline_train_1f1b
+    """Fused 1F1B/ZB loss+grad pass (distributed/pipeline.pipeline_train_1f1b
+    or pipeline_train_zb by config.pipeline_schedule): embed runs on stage 0,
+    final-norm+head+CE inside the last stage, so only token ids and one
+    boundary activation per in-flight microbatch exist per device — the
+    reference 1F1B memory profile (ZB-H1 adds the deferred-wgrad ring)."""
+    from ..distributed.pipeline import pipeline_train_1f1b, pipeline_train_zb
 
     c = config
     assert not c.tie_embeddings, "1f1b schedule requires untied embeddings"
@@ -512,7 +515,9 @@ def _loss_and_grads_1f1b(params, tokens, config: LlamaConfig, mesh: Mesh):
     first_params = {"embed": params["embed"]}
     last_params = {"final_norm": params["final_norm"],
                    "lm_head": params["lm_head"]}
-    loss, (gf, gs, gl) = pipeline_train_1f1b(
+    train = (pipeline_train_zb if c.pipeline_schedule == "zb"
+             else pipeline_train_1f1b)
+    loss, (gf, gs, gl) = train(
         first_fn, stage_fn, last_fn, first_params, params["layers"],
         last_params, inputs, targets, mesh, c.pipeline_microbatches,
         axis_name="pp", hidden_dtype=c.dtype)
@@ -613,15 +618,16 @@ def train_step(state: TrainState, tokens, config,
     mesh = _ACT_MESH
     pp = dict(mesh.shape).get("pp", 1) if mesh is not None else 1
     if (loss_function is None and pp > 1 and config.pipeline_microbatches > 0
-            and config.pipeline_schedule == "1f1b"):
+            and config.pipeline_schedule in ("1f1b", "zb")):
         if accum_steps > 1:
             raise ValueError(
-                "accum_steps>1 is redundant under the 1f1b schedule — raise "
-                "pipeline_microbatches instead (it already slices the batch)")
+                "accum_steps>1 is redundant under the 1f1b/zb schedules — "
+                "raise pipeline_microbatches instead (it already slices the "
+                "batch)")
         if config.pipeline_chunks > 1:
             raise NotImplementedError(
-                "interleaved chunks are a gpipe-schedule feature; 1f1b runs "
-                "one chunk per stage (set pipeline_chunks=1)")
+                "interleaved chunks are a gpipe-schedule feature; 1f1b/zb "
+                "run one chunk per stage (set pipeline_chunks=1)")
         loss, grads = _loss_and_grads_1f1b(state.params, tokens, config, mesh)
     elif accum_steps > 1:
         lf = loss_function or loss_fn

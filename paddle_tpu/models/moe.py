@@ -58,6 +58,18 @@ class MoEConfig:
     top_k: int = 6
     n_shared_experts: int = 2
     first_dense_layers: int = 1          # DeepSeekMoE: layer 0 stays dense
+    # "dropless": capacity-less sort + ragged_dot grouped GEMM (reference
+    # global_scatter/gather semantics — nothing dropped); "capacity": GShard
+    # fixed-capacity einsum dispatch (tokens beyond capacity_factor*T*k/E
+    # dropped, the documented trade kept as a flag).
+    routing: str = "dropless"
+    # expert-parallel dispatch under an ep>1 mesh (dropless only):
+    # "a2a"  — ragged all-to-all token exchange (reference global_scatter/
+    #          gather; ~T*k/ep GEMM rows per rank; TPU backends only),
+    # "psum" — ep-replicated tokens, local-expert GEMM + psum combine
+    #          (runs everywhere incl. XLA:CPU, T*k GEMM rows per rank),
+    # "auto" — a2a on TPU, psum elsewhere.
+    ep_strategy: str = "auto"
     capacity_factor: float = 1.25
     router_aux_coef: float = 0.01
     max_seq_len: int = 4096
@@ -128,6 +140,32 @@ def num_params(params) -> int:
     return int(sum(np.prod(p.shape) for p in jax.tree_util.tree_leaves(params)))
 
 
+def active_params_per_token(config: MoEConfig) -> int:
+    """Matmul-visible parameters touched per token: attention + shared
+    experts every layer, router + top_k routed experts on MoE layers, and
+    the lm_head. (The MoE analogue of total-N in dense MFU accounting —
+    matches how the reference reports active params for its MoE configs.)"""
+    c = config
+    d = c.head_dim
+    attn = (c.hidden_size * (c.num_heads * d + 2 * c.num_kv_heads * d)
+            + c.num_heads * d * c.hidden_size)
+    shared = 3 * c.hidden_size * c.n_shared_experts * c.moe_intermediate_size
+    router = c.hidden_size * c.num_experts
+    routed = 3 * c.hidden_size * c.moe_intermediate_size * c.top_k
+    n_moe = c.num_layers - c.first_dense_layers
+    return (c.num_layers * (attn + shared) + n_moe * (router + routed)
+            + c.hidden_size * c.vocab_size)
+
+
+def flops_per_token(config: MoEConfig, seq_len: int) -> float:
+    """Fwd+bwd matmul FLOPs per trained token (6*N_active + the causal
+    attention term, PaLM appendix accounting — same convention as
+    llama.flops_per_token so MoE MFU is comparable)."""
+    c = config
+    return (6.0 * active_params_per_token(c)
+            + 12.0 * c.num_layers * c.hidden_size * seq_len)
+
+
 def param_specs(config: MoEConfig, fsdp: bool = True) -> Dict[str, Any]:
     """'ep' shards the expert axis; 'tp' the Megatron axis of each expert and
     of the dense sublayers; fsdp ('dp') the remaining matrix axis."""
@@ -184,18 +222,48 @@ def top_k_gating(logits, top_k: int):
 
 
 def moe_ffn(x, router_w, e_gate, e_up, e_down, config: MoEConfig):
-    """Routed-expert FFN over flattened tokens.
-    x: [T, h]; experts [E, h, f]/[E, f, h]. Fixed-capacity one-hot dispatch:
-      dispatch [T, E, C] (bool-ish f32), combine = dispatch * gate weight.
-    All compute is einsum → MXU; 'ep' sharding of the E axis makes XLA emit
-    the all-to-alls (reference: global_scatter/global_gather —
-    moe_layer.py:105-188)."""
+    """Routed-expert FFN over flattened tokens — dispatch by config.routing.
+
+    "dropless" (default): capacity-less sort-based dispatch + ragged_dot
+    grouped GEMMs (kernels/moe_dispatch.py) — the MXU analogue of the
+    reference's global_scatter/gather + cutlass grouped GEMM
+    (moe_layer.py:105-188, fusion/cutlass_kernels/moe_gemm/). Under a mesh
+    with ep>1 it runs the explicit shard_map expert-parallel form.
+
+    "capacity": GShard fixed-capacity one-hot einsum dispatch [T,E,C];
+    tokens past capacity are dropped. 'ep' sharding of the E axis makes
+    GSPMD emit the all-to-alls."""
     c = config
+    weights, idx, aux = top_k_gating(
+        x.astype(jnp.float32) @ router_w.astype(jnp.float32), c.top_k)
+    if c.routing == "dropless":
+        from ..kernels import moe_dispatch as _md
+        mesh = _llama._ACT_MESH
+        if mesh is not None and dict(mesh.shape).get("ep", 1) > 1:
+            strategy = c.ep_strategy
+            if strategy == "auto":
+                strategy = ("a2a" if jax.default_backend() == "tpu"
+                            else "psum")
+            if strategy == "a2a":
+                y = _md.dropless_moe_ffn_a2a(
+                    x, weights, idx, e_gate, e_up, e_down, mesh,
+                    token_axes=("dp", "sp", "ep"))
+            elif strategy == "psum":
+                y = _md.dropless_moe_ffn_ep(
+                    x, weights, idx, e_gate, e_up, e_down, mesh,
+                    token_axes=("dp", "sp"))
+            else:
+                raise ValueError(f"ep_strategy={strategy!r}: expected "
+                                 "'auto', 'a2a', or 'psum'")
+        else:
+            y = _md.dropless_moe_ffn(x, weights, idx, e_gate, e_up, e_down)
+        return y, aux
+    if c.routing != "capacity":
+        raise ValueError(f"routing={c.routing!r}: expected 'dropless' or "
+                         "'capacity'")
     T, h = x.shape
     E, k = c.num_experts, c.top_k
     C = max(1, int(c.capacity_factor * T * k / E))
-
-    weights, idx, aux = top_k_gating(x.astype(jnp.float32) @ router_w.astype(jnp.float32), k)
 
     # position of each (token, slot) within its expert's capacity buffer
     onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)              # [T,k,E]
