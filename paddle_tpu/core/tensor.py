@@ -22,6 +22,11 @@ import numpy as np
 from ..device import Place, current_jax_device, place_of_array
 from ..framework import dtype as dtypes
 
+# populated by jit.branch_capture while a branch oracle is active (kept here
+# as a plain list so the core layer never imports jit); each entry is a
+# callable(value) -> Optional[bool]
+_branch_oracle_hook = []
+
 
 class Tensor:
     __slots__ = (
@@ -132,6 +137,14 @@ class Tensor:
         )
 
     def __bool__(self):
+        # under jit branch capture, a traced scalar condition becomes a
+        # lax.cond decision point instead of a ConcretizationTypeError;
+        # the hook list is registered by jit.branch_capture only while an
+        # oracle is active, so eager `if tensor:` stays one empty-list check
+        if _branch_oracle_hook:
+            decided = _branch_oracle_hook[-1](self._value)
+            if decided is not None:
+                return decided
         return bool(self._value)
 
     def __int__(self):

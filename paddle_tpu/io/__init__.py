@@ -11,6 +11,7 @@ from __future__ import annotations
 import itertools
 import queue
 import threading
+import time
 from typing import Iterable, List, Optional
 
 import numpy as np
@@ -331,19 +332,44 @@ def get_worker_info():
 
 # -- loader -------------------------------------------------------------------
 class DataLoader:
-    """parity: python/paddle/io/reader.py:262 DataLoader."""
+    """parity: python/paddle/io/reader.py:262 DataLoader.
+
+    ``num_workers > 0`` spawns real worker PROCESSES with shared-memory
+    batch transport (io/mp_loader.py — the analogue of the reference's
+    dataloader/worker.py + shared-memory LoDTensor path); workers collate in
+    numpy (GIL-free transforms, no forked TPU client) and the parent does
+    the single host→device copy. ``in_order=False`` yields batches in
+    arrival order instead of sampler order. ``worker_mode="thread"`` keeps
+    the in-process prefetch pool (for transforms that must touch device
+    tensors)."""
+
+    _default_collate = staticmethod(default_collate_fn)
 
     def __init__(self, dataset, feed_list=None, places=None, return_list=True,
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
                  collate_fn=None, num_workers=0, use_buffer_reader=True,
                  prefetch_factor=2, use_shared_memory=True, timeout=0,
-                 worker_init_fn=None, persistent_workers=False):
+                 worker_init_fn=None, persistent_workers=False,
+                 in_order=True, worker_mode="process"):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
+        self.use_shared_memory = use_shared_memory
+        self.persistent_workers = persistent_workers
+        self.in_order = in_order
+        if worker_mode not in ("process", "thread"):
+            raise ValueError(
+                f"worker_mode must be 'process' or 'thread', got "
+                f"{worker_mode!r}")
+        self.worker_mode = worker_mode
+        self._pool = None
+        # loader-vs-consumer utilization probe, refreshed per epoch:
+        # wait_s = time the consumer blocked on the loader; busy_s = time
+        # the consumer spent between batches (its own step time)
+        self.last_epoch_stats = None
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -435,11 +461,74 @@ class DataLoader:
         if exc:
             raise exc[0]
 
+    def _iter_mp(self):
+        from .mp_loader import WorkerPool
+
+        pool = self._pool
+        if pool is None or not pool.alive or pool.in_use:
+            # a second live iterator over the same loader must not share
+            # queues with the first (interleaved epochs would cross-deliver
+            # batches) — it gets its own pool, torn down at exhaustion
+            pool = WorkerPool(self)
+            if self._pool is None or not self._pool.alive:
+                self._pool = pool
+        pool.in_use = True
+        if self._iterable_mode:
+            gen = pool.run_iterable_epoch()
+        else:
+            gen = pool.run_map_epoch(iter(self.batch_sampler), self.in_order)
+        clean = False
+        try:
+            for batch in gen:
+                yield batch
+            clean = True
+        finally:
+            gen.close()
+            pool.in_use = False
+            if not clean or not self.persistent_workers or pool is not self._pool:
+                # an abandoned epoch leaves stale batches in the result
+                # queue — a partially-consumed pool cannot be reused
+                pool.shutdown()
+                if pool is self._pool:
+                    self._pool = None
+
+    def _timed(self, gen):
+        """Wrap an epoch iterator with the utilization probe."""
+        wait_s = 0.0
+        busy_s = 0.0
+        n = 0
+        try:
+            while True:
+                t0 = time.monotonic()
+                try:
+                    item = next(gen)
+                except StopIteration:
+                    break
+                t1 = time.monotonic()
+                wait_s += t1 - t0
+                n += 1
+                yield item          # consumer runs while suspended here
+                busy_s += time.monotonic() - t1
+        finally:
+            total = wait_s + busy_s
+            self.last_epoch_stats = {
+                "batches": n, "wait_s": wait_s, "busy_s": busy_s,
+                "input_bound_frac": (wait_s / total) if total > 0 else 0.0,
+            }
+
     def __iter__(self):
-        if self.num_workers > 0 and not self._iterable_mode and \
-                self.batch_sampler is not None:
-            return self._iter_threaded()
-        return self._iter_sync()
+        if self.num_workers > 0:
+            if self.worker_mode == "process" and (
+                    self._iterable_mode or self.batch_sampler is not None):
+                return self._timed(self._iter_mp())
+            if not self._iterable_mode and self.batch_sampler is not None:
+                return self._timed(self._iter_threaded())
+        return self._timed(self._iter_sync())
+
+    def __del__(self):
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            pool.shutdown()
 
     def __call__(self):
         return self.__iter__()

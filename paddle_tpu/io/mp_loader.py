@@ -1,0 +1,383 @@
+"""Multiprocess DataLoader workers with shared-memory batch transport.
+
+Parity: python/paddle/io/reader.py:262 (DataLoader num_workers>0),
+python/paddle/io/dataloader/worker.py (_worker_loop: index queue in, data
+queue out, worker_init_fn, error propagation) and the reference's
+shared-memory LoDTensor transport (core._convert_to_shared_memory /
+fluid/framework/data_feed shared-memory path).
+
+TPU-native re-design: the device is fed by the HOST, so the worker contract
+is numpy-only — forked workers never touch the JAX/TPU client (forking a
+process with a live TPU client risks deadlock on copied XLA mutexes; the
+child therefore does decode/augment/collate in numpy, which is also where
+the GIL win lives). Batches travel as POSIX shared-memory segments
+(multiprocessing.shared_memory): the worker writes the collated arrays,
+passes (name, shape, dtype) through the result queue, and the PARENT does
+the single host→HBM copy (Tensor() == jnp.asarray → device_put), so arrays
+cross process boundaries without pickling and touch the device exactly once.
+
+Reassembly is sequence-tagged: map-style epochs emit batches in sampler
+order (a heap-free dict buffer keyed by seq), or in arrival order when
+``in_order=False`` — the unordered mode trades determinism for zero
+head-of-line blocking when per-batch transform cost is skewed.
+"""
+from __future__ import annotations
+
+import multiprocessing
+import os
+import queue as _queue
+import time
+import traceback
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+_SHM_MIN_BYTES = 1 << 14  # below 16 KiB the queue pickle is cheaper than shm
+
+
+class _ShmRef:
+    """Pickled placeholder for an array parked in shared memory."""
+
+    __slots__ = ("name", "shape", "dtype", "as_tensor")
+
+    def __init__(self, name, shape, dtype, as_tensor):
+        self.name = name
+        self.shape = shape
+        self.dtype = dtype
+        self.as_tensor = as_tensor
+
+
+class _ArrLeaf:
+    """Small array sent inline through the queue."""
+
+    __slots__ = ("array", "as_tensor")
+
+    def __init__(self, array, as_tensor):
+        self.array = array
+        self.as_tensor = as_tensor
+
+
+def _numpy_collate(batch):
+    """default_collate_fn with numpy leaves (workers must not build device
+    Tensors); the parent converts tagged leaves into Tensors."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(s._value) for s in batch])
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    if isinstance(sample, dict):
+        return {k: _numpy_collate([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(_numpy_collate(list(items))
+                            for items in zip(*batch))
+    return batch
+
+
+def _shm_export(a: np.ndarray, use_shm: bool, as_tensor: bool):
+    a = np.ascontiguousarray(a)
+    if not use_shm or a.nbytes < _SHM_MIN_BYTES:
+        return _ArrLeaf(a, as_tensor)
+    from multiprocessing import shared_memory
+    from multiprocessing.resource_tracker import unregister
+
+    shm = shared_memory.SharedMemory(create=True, size=a.nbytes)
+    np.ndarray(a.shape, a.dtype, buffer=shm.buf)[...] = a
+    ref = _ShmRef(shm.name, a.shape, str(a.dtype), as_tensor)
+    # ownership transfers to the parent (it unlinks after the device copy);
+    # without this the worker's resource tracker would destroy the segment
+    # when the worker exits
+    try:
+        unregister(shm._name, "shared_memory")
+    except Exception:
+        pass
+    shm.close()
+    return ref
+
+
+def _pack_tree(obj, use_shm: bool, default_collated: bool):
+    if isinstance(obj, Tensor):  # custom collate built a Tensor in-worker
+        return _shm_export(np.asarray(obj._value), use_shm, as_tensor=True)
+    if isinstance(obj, np.ndarray):
+        return _shm_export(obj, use_shm, as_tensor=default_collated)
+    if isinstance(obj, dict):
+        return {k: _pack_tree(v, use_shm, default_collated)
+                for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_pack_tree(v, use_shm, default_collated)
+                         for v in obj)
+    return obj
+
+
+def _unpack_tree(obj):
+    if isinstance(obj, _ShmRef):
+        from multiprocessing import shared_memory
+
+        shm = shared_memory.SharedMemory(name=obj.name)
+        try:
+            arr = np.ndarray(obj.shape, np.dtype(obj.dtype), buffer=shm.buf)
+            if obj.as_tensor:
+                import jax
+
+                if jax.default_backend() == "cpu":
+                    # CPU jnp.asarray is zero-copy: the device array would
+                    # alias the segment we are about to unlink
+                    out = Tensor(arr.copy())
+                else:
+                    # single host→HBM copy; block so the (possibly async)
+                    # transfer finishes before the segment is unlinked
+                    out = Tensor(arr)
+                    out._value.block_until_ready()
+            else:
+                out = arr.copy()
+        finally:
+            shm.close()
+            try:
+                shm.unlink()
+            except FileNotFoundError:
+                pass
+        return out
+    if isinstance(obj, _ArrLeaf):
+        return Tensor(obj.array) if obj.as_tensor else obj.array
+    if isinstance(obj, dict):
+        return {k: _unpack_tree(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unpack_tree(v) for v in obj)
+    return obj
+
+
+def _discard_tree(obj):
+    """Unlink shm segments of a batch the consumer abandoned."""
+    if isinstance(obj, _ShmRef):
+        from multiprocessing import shared_memory
+
+        try:
+            shm = shared_memory.SharedMemory(name=obj.name)
+            shm.close()
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+    elif isinstance(obj, dict):
+        for v in obj.values():
+            _discard_tree(v)
+    elif isinstance(obj, (list, tuple)):
+        for v in obj:
+            _discard_tree(v)
+
+
+# ---------------------------------------------------------------------------
+# worker process body (module-level: importable under any start method)
+# ---------------------------------------------------------------------------
+def _worker_loop(dataset, index_q, result_q, collate_fn, wid, num_workers,
+                 worker_init_fn, use_shm, iterable_mode, batch_size,
+                 drop_last):
+    from . import _WorkerInfo, _worker_info, default_collate_fn
+
+    _worker_info.info = _WorkerInfo(wid, num_workers, dataset)
+    default_collated = collate_fn is None
+    collate = _numpy_collate if collate_fn is None else collate_fn
+    try:
+        if worker_init_fn is not None:
+            worker_init_fn(wid)
+        while True:
+            task = index_q.get()
+            if task is None:
+                break
+            kind = task[0]
+            if kind == "epoch" and iterable_mode:
+                # each worker sees the full stream; users shard it with
+                # get_worker_info() — reference worker.py IterableDataset
+                # contract
+                seq = 0
+                try:
+                    batch: List[Any] = []
+                    for item in dataset:
+                        batch.append(item)
+                        if len(batch) == batch_size:
+                            payload = _pack_tree(collate(batch), use_shm,
+                                                 default_collated)
+                            result_q.put(("batch", (wid, seq), payload))
+                            seq += 1
+                            batch = []
+                    if batch and not drop_last:
+                        payload = _pack_tree(collate(batch), use_shm,
+                                             default_collated)
+                        result_q.put(("batch", (wid, seq), payload))
+                except Exception:
+                    result_q.put(("error", None, traceback.format_exc()))
+                result_q.put(("done", wid, None))
+            elif kind == "task":
+                _, seq, indices = task
+                try:
+                    samples = [dataset[i] for i in indices]
+                    payload = _pack_tree(collate(samples), use_shm,
+                                         default_collated)
+                    result_q.put(("batch", seq, payload))
+                except Exception:
+                    result_q.put(("error", seq, traceback.format_exc()))
+            elif kind == "epoch_end":
+                result_q.put(("done", wid, None))
+    except (KeyboardInterrupt, BrokenPipeError, EOFError):
+        pass
+
+
+class WorkerPool:
+    """A set of live worker processes plus the epoch protocol.
+
+    One pool serves many epochs when ``persistent_workers=True`` (workers
+    park on the index queue between epochs); otherwise the loader builds a
+    pool per epoch and tears it down at exhaustion.
+    """
+
+    def __init__(self, loader):
+        self._loader = loader
+        ctx_name = "fork" if "fork" in multiprocessing.get_all_start_methods() \
+            else None
+        self._ctx = multiprocessing.get_context(ctx_name)
+        n = loader.num_workers
+        self.index_q = self._ctx.Queue()
+        # bounded: backpressure keeps shm residency O(prefetch), not O(epoch)
+        self.result_q = self._ctx.Queue(
+            maxsize=max(2, loader.prefetch_factor * n))
+        custom_collate = None if loader.collate_fn is loader._default_collate \
+            else loader.collate_fn
+        self.procs = []
+        for wid in range(n):
+            p = self._ctx.Process(
+                target=_worker_loop,
+                args=(loader.dataset, self.index_q, self.result_q,
+                      custom_collate, wid, n, loader.worker_init_fn,
+                      loader.use_shared_memory, loader._iterable_mode,
+                      loader.batch_size if loader._iterable_mode else 0,
+                      loader.drop_last if loader._iterable_mode else False),
+                daemon=True)
+            p.start()
+            self.procs.append(p)
+        self.alive = True
+        self.in_use = False  # an epoch generator is actively driving it
+
+    # -- epoch drivers ------------------------------------------------------
+    def _get(self):
+        timeout = self._loader.timeout or None
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            try:
+                return self.result_q.get(timeout=1.0 if timeout is None
+                                         else max(0.01, deadline - time.monotonic()))
+            except _queue.Empty:
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise RuntimeError(
+                        f"DataLoader timed out after {timeout}s waiting on "
+                        "workers")
+                if not any(p.is_alive() for p in self.procs):
+                    raise RuntimeError(
+                        "DataLoader workers exited unexpectedly")
+
+    def run_map_epoch(self, batches, in_order: bool):
+        n = self._loader.num_workers
+        inflight = 0
+        seq_out = 0
+        pending = {}
+        it = iter(enumerate(batches))
+        exhausted = False
+
+        def feed():
+            nonlocal inflight, exhausted
+            budget = max(2, self._loader.prefetch_factor) * n
+            while not exhausted and inflight < budget:
+                try:
+                    seq, indices = next(it)
+                except StopIteration:
+                    exhausted = True
+                    for _ in range(n):
+                        self.index_q.put(("epoch_end",))
+                    return
+                self.index_q.put(("task", seq, indices))
+                inflight += 1
+
+        feed()
+        done = 0
+        try:
+            while done < n or inflight > 0:
+                kind, seq, payload = self._get()
+                if kind == "done":
+                    done += 1
+                    continue
+                if kind == "error":
+                    raise RuntimeError(
+                        f"DataLoader worker failed on batch {seq}:\n{payload}")
+                inflight -= 1
+                feed()
+                if not in_order:
+                    yield _unpack_tree(payload)
+                    continue
+                pending[seq] = payload
+                while seq_out in pending:
+                    yield _unpack_tree(pending.pop(seq_out))
+                    seq_out += 1
+        finally:
+            for p in pending.values():
+                _discard_tree(p)
+
+    def run_iterable_epoch(self):
+        n = self._loader.num_workers
+        for _ in range(n):
+            self.index_q.put(("epoch",))
+        done = 0
+        while done < n:
+            kind, seq, payload = self._get()
+            if kind == "done":
+                done += 1
+            elif kind == "error":
+                raise RuntimeError(f"DataLoader worker failed:\n{payload}")
+            else:
+                yield _unpack_tree(payload)
+
+    # -- teardown -----------------------------------------------------------
+    def shutdown(self):
+        if not self.alive:
+            return
+        self.alive = False
+        def drain():
+            while True:
+                try:
+                    kind, _, payload = self.result_q.get_nowait()
+                    if kind == "batch":
+                        _discard_tree(payload)
+                except (_queue.Empty, OSError):
+                    return
+
+        try:
+            for _ in self.procs:
+                self.index_q.put(None)
+            # drain stragglers so bounded result_q can't deadlock a join,
+            # and reclaim their shm segments
+            t_end = time.monotonic() + 2.0
+            for p in self.procs:
+                p.join(timeout=max(0.1, t_end - time.monotonic()))
+            drain()
+            for p in self.procs:
+                if p.is_alive():
+                    p.terminate()
+            for p in self.procs:
+                p.join(timeout=1.0)
+            # a worker unblocked by the first drain may have enqueued one
+            # more payload before terminate() — reclaim those segments too
+            time.sleep(0.05)
+            drain()
+            self.index_q.cancel_join_thread()
+            self.result_q.cancel_join_thread()
+            self.index_q.close()
+            self.result_q.close()
+        except Exception:
+            pass
+
+    def __del__(self):
+        self.shutdown()

@@ -32,6 +32,7 @@ from ..framework import dtype as dtypes
 from ..framework.random import next_key, rng_context
 from ..nn.layer.layers import Layer
 from ..ops.dispatch import apply
+from .branch_capture import GraphBreak as _BranchGraphBreak
 
 __all__ = ["to_static", "InputSpec", "save", "load", "not_to_static",
            "ignore_module", "enable_to_static", "TranslatedLayer",
@@ -153,6 +154,9 @@ class StaticFunction:
         self._build_strategy = build_strategy or BuildStrategy()
         self._eager_keys = set()  # signatures that graph-broke to eager
         self._warned_break = False
+        # observability: compiles = traced programs; cond_branches = Python
+        # ifs converted to lax.cond; eager_calls = graph-break fallbacks
+        self._stats = {"compiles": 0, "cond_branches": 0, "eager_calls": 0}
         functools.update_wrapper(self, function)
 
     @property
@@ -164,34 +168,47 @@ class StaticFunction:
 
     def _build(self, skel_args, skel_kwargs, n_args, out_box):
         from ..framework.capture import capture_buffer_updates
+        from .branch_capture import capture_branches, combine_tensor_leaves
 
         layer = self._layer
         fn = self._fn
+        stats = self._stats
 
         def pure(params, bufs, key_data, *arg_vals):
             key = jax.random.wrap_key_data(key_data)
             wrap = lambda v: Tensor(v, stop_gradient=True)
-            args = _rebuild(skel_args, arg_vals, wrap)
-            kwargs = _rebuild(skel_kwargs, arg_vals, wrap)
-            new_bufs = {}
-            with rng_context(key), no_grad():
-                if layer is not None:
-                    # buffer mutations (BN running stats) land on the bound
-                    # traced values and ride out as extra outputs, so
-                    # to_static(model) trains running stats correctly
-                    with layer.bind_state(params, bufs), \
-                            capture_buffer_updates():
+
+            def body():
+                # re-runnable per branch path: state binding and the RNG
+                # stream both reset at entry, so every arm of a captured
+                # lax.cond sees identical starting state
+                args = _rebuild(skel_args, arg_vals, wrap)
+                kwargs = _rebuild(skel_kwargs, arg_vals, wrap)
+                new_bufs = {}
+                with rng_context(key), no_grad():
+                    if layer is not None:
+                        # buffer mutations (BN running stats) land on the
+                        # bound traced values and ride out as extra outputs,
+                        # so to_static(model) trains running stats correctly
+                        with layer.bind_state(params, bufs), \
+                                capture_buffer_updates():
+                            out = fn(*args, **kwargs)
+                            new_bufs = {k: b._value
+                                        for k, b in layer.named_buffers()}
+                    else:
                         out = fn(*args, **kwargs)
-                        new_bufs = {k: b._value
-                                    for k, b in layer.named_buffers()}
-                else:
-                    out = fn(*args, **kwargs)
-            tensors: List[Tensor] = []
-            skel_out = _split_tensors(out, tensors)
+                tensors: List[Tensor] = []
+                skel_out = _split_tensors(out, tensors)
+                return skel_out, [t._value for t in tensors], new_bufs
+
+            (skel_out, vals, new_bufs), n_cond = capture_branches(
+                body, combine_tensor_leaves)
+            stats["compiles"] += 1
+            stats["cond_branches"] += n_cond
             out_box["skel"] = skel_out
-            out_box["n_real"] = len(tensors)
+            out_box["n_real"] = len(vals)
             out_box["buf_names"] = sorted(new_bufs)
-            return tuple(t._value for t in tensors) + tuple(
+            return tuple(vals) + tuple(
                 new_bufs[k] for k in out_box["buf_names"])
 
         return jax.jit(pure)
@@ -208,6 +225,7 @@ class StaticFunction:
         except TypeError:
             key = None  # unhashable guard state → uncacheable: run eager
         if key is None or key in self._eager_keys:
+            self._stats["eager_calls"] += 1
             return self._fn(*args, **kwargs)
         arg_tensors: List[Tensor] = []
         skel_args = _split_tensors(args, arg_tensors)
@@ -239,8 +257,10 @@ class StaticFunction:
             outs = apply("jit::" + getattr(self._fn, "__name__", "fn"),
                          lambda pvals, avals: runner(pvals, avals),
                          list(ptensors), list(arg_tensors))
-        except _GRAPH_BREAK_ERRORS as e:
-            # data-dependent Python control flow inside the traced body —
+        except _GRAPH_BREAK_ERRORS + (_BranchGraphBreak,) as e:
+            # data-dependent Python control flow the branch-capture oracle
+            # could not convert to lax.cond (int/float/item concretization,
+            # mismatched arm structures, tensor while-loops, >MAX depth) —
             # the reference's SOT would break the frame here; we fall back
             # to eager for this signature and cache the decision
             if not self._build_strategy.allow_graph_break:
@@ -252,10 +272,13 @@ class StaticFunction:
                 import warnings
                 warnings.warn(
                     f"to_static({getattr(self._fn, '__name__', 'fn')}): "
-                    f"graph break ({type(e).__name__}) — running this input "
-                    "signature eagerly. Use lax.cond-style ops or "
+                    f"graph break ({type(e).__name__}: {e}) — running this "
+                    "input signature eagerly. Scalar-tensor ifs with "
+                    "matching arms stay compiled automatically; use "
+                    "lax.cond-style ops for the rest, or "
                     "BuildStrategy(allow_graph_break=False) to make this an "
                     "error.", stacklevel=2)
+            self._stats["eager_calls"] += 1
             return self._fn(*args, **kwargs)
         if not isinstance(outs, tuple):
             outs = (outs,)

@@ -1,0 +1,160 @@
+"""Scalar-tensor branch capture: keep the program compiled across
+data-dependent Python ``if``s.
+
+Parity surface: the reference's SOT breaks the CPython frame at a
+data-dependent branch and keeps compiled segments on both sides
+(python/paddle/jit/sot/opcode_translator/eval_frame_callback.py:54), and its
+AST dy2static mode rewrites tensor ``if``/``while`` into cond/while ops
+(python/paddle/jit/dy2static/convert_operators.py convert_ifelse).
+
+TPU-native re-design: neither a bytecode translator nor an AST rewrite.
+During jax tracing, ``Tensor.__bool__`` on a traced scalar consults a
+*branch oracle* instead of raising. The oracle enumerates the reachable
+decision paths (re-running the traced body with each branch forced), and —
+when every sibling pair of arms produces outputs of identical structure,
+shape, and dtype — stitches them together with ``lax.cond``. The whole call
+stays ONE compiled XLA program; the Python ``if`` becomes a compiled
+conditional, which is exactly what dy2static's convert_ifelse produces via
+the cond op, done at trace time instead of AST time.
+
+Bounds: path enumeration is exponential in the number of *dynamic* branch
+points on a path, so capture is capped at ``MAX_BRANCH_POINTS`` (deeper
+nesting, and tensor ``while`` loops, re-raise and take the eager graph-break
+fallback in jit/__init__.py). Arms are both traced unconditionally —
+``lax.cond`` on TPU typically compiles to a fused select when arms are
+cheap, the right trade for scalar guards like loss-scale checks.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+MAX_BRANCH_POINTS = 4  # ≤ 2**4 = 16 path traces per capture
+
+_tls = threading.local()
+
+
+class GraphBreak(Exception):
+    """Raised when branch capture cannot keep the program whole (arms
+    disagree on structure/shape/dtype, or too many dynamic branches).
+    jit.StaticFunction treats it like a ConcretizationTypeError: fall back
+    to eager for the signature."""
+
+
+class _NeedDecision(Exception):
+    """Internal: tracing hit a dynamic branch beyond the forced prefix."""
+
+    def __init__(self, cond_value):
+        self.cond_value = cond_value
+
+
+class _Oracle:
+    def __init__(self, forced: Tuple[bool, ...]):
+        self.forced = forced
+        self.idx = 0
+
+    def decide(self, value) -> bool:
+        i = self.idx
+        self.idx += 1
+        if i < len(self.forced):
+            return self.forced[i]
+        raise _NeedDecision(value)
+
+
+def _stack() -> List[_Oracle]:
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
+
+
+def maybe_decide(value):
+    """Called from ``Tensor.__bool__``. Returns a concrete bool when a
+    branch-capture oracle is active and ``value`` is a traced scalar;
+    returns None (caller proceeds normally) otherwise."""
+    s = _stack()
+    if not s or not isinstance(value, jax.core.Tracer):
+        return None
+    if value.size != 1:
+        # mirror eager semantics: bool() of a multi-element array is an
+        # error, not a branch — let the normal path raise it
+        return None
+    return s[-1].decide(value)
+
+
+def capture_branches(body: Callable[[], Any], combine_leaves):
+    """Run ``body`` under the oracle; at each dynamic branch, trace both
+    arms and merge them with ``lax.cond``.
+
+    ``body`` must be re-runnable (idempotent per run: it re-binds all state
+    itself). ``combine_leaves(pred, true_leaf, false_leaf)`` merges two leaf
+    results into one (raising GraphBreak on mismatch).
+
+    Returns ``(leaf_result, n_branch_points)``.
+    """
+    n_points = 0
+
+    from ..core import tensor as _tensor_mod
+
+    def eval_path(prefix: Tuple[bool, ...]):
+        nonlocal n_points
+        oracle = _Oracle(prefix)
+        _stack().append(oracle)
+        _tensor_mod._branch_oracle_hook.append(maybe_decide)
+        try:
+            out = body()
+            return out
+        except _NeedDecision as nd:
+            if len(prefix) >= MAX_BRANCH_POINTS:
+                raise GraphBreak(
+                    f"more than {MAX_BRANCH_POINTS} data-dependent branch "
+                    "points on one path; use lax.cond/lax.while_loop "
+                    "explicitly or accept the eager fallback")
+            n_points += 1
+            pred = jnp.reshape(nd.cond_value, ()).astype(jnp.bool_)
+            t_out = eval_path(prefix + (True,))
+            f_out = eval_path(prefix + (False,))
+            return combine_leaves(pred, t_out, f_out)
+        finally:
+            _stack().pop()
+            _tensor_mod._branch_oracle_hook.pop()
+    # all decisions trace inside the caller's jit: conds stay traced values
+    result = eval_path(())
+    return result, n_points
+
+
+def combine_tensor_leaves(pred, t_leaf, f_leaf):
+    """Leaf combiner for jit capture leaves of the form
+    ``(skeleton, [jax values], {buffer name: jax value})``."""
+    t_skel, t_vals, t_bufs = t_leaf
+    f_skel, f_vals, f_bufs = f_leaf
+    if t_skel != f_skel:
+        raise GraphBreak(
+            "branch arms return different structures; cannot merge with "
+            "lax.cond — returning the same pytree shape from both arms "
+            "keeps the program compiled")
+    if sorted(t_bufs) != sorted(f_bufs):
+        raise GraphBreak("branch arms update different buffer sets")
+    buf_names = sorted(t_bufs)
+    t_flat = list(t_vals) + [t_bufs[k] for k in buf_names]
+    f_flat = list(f_vals) + [f_bufs[k] for k in buf_names]
+    if len(t_flat) != len(f_flat):
+        raise GraphBreak("branch arms return different numbers of tensors")
+    for a, b in zip(t_flat, f_flat):
+        a_ = jnp.asarray(a)
+        b_ = jnp.asarray(b)
+        if a_.shape != b_.shape or a_.dtype != b_.dtype:
+            raise GraphBreak(
+                f"branch arm outputs disagree on shape/dtype "
+                f"({a_.shape}/{a_.dtype} vs {b_.shape}/{b_.dtype}); "
+                "lax.cond requires identical output avals")
+    merged = jax.lax.cond(pred,
+                          lambda: tuple(jnp.asarray(v) for v in t_flat),
+                          lambda: tuple(jnp.asarray(v) for v in f_flat))
+    n_vals = len(t_vals)
+    vals = list(merged[:n_vals])
+    bufs = dict(zip(buf_names, merged[n_vals:]))
+    return t_skel, vals, bufs
