@@ -103,8 +103,15 @@ def _shm_export(a: np.ndarray, use_shm: bool, as_tensor: bool):
 
 
 def _pack_tree(obj, use_shm: bool, default_collated: bool):
-    if isinstance(obj, Tensor):  # custom collate built a Tensor in-worker
-        return _shm_export(np.asarray(obj._value), use_shm, as_tensor=True)
+    if isinstance(obj, Tensor):
+        # a custom collate_fn built a device Tensor inside a forked worker —
+        # that touches the JAX client the parent already initialized (copied
+        # XLA mutex state: deadlock risk). Enforce the numpy-only contract.
+        raise RuntimeError(
+            "custom collate_fn returned a Tensor inside a DataLoader worker "
+            "process; process workers must stay numpy-only (return numpy "
+            "arrays — the parent converts them), or use "
+            "worker_mode='thread'")
     if isinstance(obj, np.ndarray):
         return _shm_export(obj, use_shm, as_tensor=default_collated)
     if isinstance(obj, dict):
@@ -242,7 +249,9 @@ class WorkerPool:
             else None
         self._ctx = multiprocessing.get_context(ctx_name)
         n = loader.num_workers
-        self.index_q = self._ctx.Queue()
+        # one index queue PER worker (the reference's worker protocol):
+        # epoch/shutdown signals are addressed, never stolen by a sibling
+        self.index_qs = [self._ctx.Queue() for _ in range(n)]
         # bounded: backpressure keeps shm residency O(prefetch), not O(epoch)
         self.result_q = self._ctx.Queue(
             maxsize=max(2, loader.prefetch_factor * n))
@@ -252,7 +261,7 @@ class WorkerPool:
         for wid in range(n):
             p = self._ctx.Process(
                 target=_worker_loop,
-                args=(loader.dataset, self.index_q, self.result_q,
+                args=(loader.dataset, self.index_qs[wid], self.result_q,
                       custom_collate, wid, n, loader.worker_init_fn,
                       loader.use_shared_memory, loader._iterable_mode,
                       loader.batch_size if loader._iterable_mode else 0,
@@ -287,19 +296,21 @@ class WorkerPool:
         pending = {}
         it = iter(enumerate(batches))
         exhausted = False
+        rr = 0  # round-robin worker assignment (reference worker protocol)
 
         def feed():
-            nonlocal inflight, exhausted
+            nonlocal inflight, exhausted, rr
             budget = max(2, self._loader.prefetch_factor) * n
             while not exhausted and inflight < budget:
                 try:
                     seq, indices = next(it)
                 except StopIteration:
                     exhausted = True
-                    for _ in range(n):
-                        self.index_q.put(("epoch_end",))
+                    for q in self.index_qs:
+                        q.put(("epoch_end",))
                     return
-                self.index_q.put(("task", seq, indices))
+                self.index_qs[rr % n].put(("task", seq, indices))
+                rr += 1
                 inflight += 1
 
         feed()
@@ -328,8 +339,8 @@ class WorkerPool:
 
     def run_iterable_epoch(self):
         n = self._loader.num_workers
-        for _ in range(n):
-            self.index_q.put(("epoch",))
+        for q in self.index_qs:
+            q.put(("epoch",))
         done = 0
         while done < n:
             kind, seq, payload = self._get()
@@ -355,8 +366,8 @@ class WorkerPool:
                     return
 
         try:
-            for _ in self.procs:
-                self.index_q.put(None)
+            for q in self.index_qs:
+                q.put(None)
             # drain stragglers so bounded result_q can't deadlock a join,
             # and reclaim their shm segments
             t_end = time.monotonic() + 2.0
@@ -372,9 +383,10 @@ class WorkerPool:
             # more payload before terminate() — reclaim those segments too
             time.sleep(0.05)
             drain()
-            self.index_q.cancel_join_thread()
+            for q in self.index_qs:
+                q.cancel_join_thread()
+                q.close()
             self.result_q.cancel_join_thread()
-            self.index_q.close()
             self.result_q.close()
         except Exception:
             pass

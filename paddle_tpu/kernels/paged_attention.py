@@ -65,19 +65,27 @@ def paged_append(cache: PagedKVCache, k_new, v_new) -> PagedKVCache:
 
 def paged_attention(q, cache: PagedKVCache) -> jax.Array:
     """Decode attention for one query token per sequence.
-    q: [B, H, D] → [B, H, D]. Keys beyond each sequence's length are masked.
-    """
-    B, H, D = q.shape
-    nb, bs = cache.k_pool.shape[0], cache.k_pool.shape[1]
+    q: [B, Hq, D] → [B, Hq, D]. Keys beyond each sequence's length are
+    masked. GQA-native: Hq may be G * Hkv (pool heads); query heads are
+    grouped against their kv head in the einsum, so the paged pool is never
+    materialized repeated (decode is KV-bandwidth-bound — same design as
+    models/llama._cached_attention)."""
+    B, Hq, D = q.shape
+    nb, bs, Hkv = cache.k_pool.shape[0], cache.k_pool.shape[1], \
+        cache.k_pool.shape[2]
     mb = cache.block_table.shape[1]
+    assert Hq % Hkv == 0, (Hq, Hkv)
+    G = Hq // Hkv
 
-    # gather each sequence's blocks: [B, mb, bs, H, D] → [B, mb*bs, H, D]
-    k = cache.k_pool[cache.block_table].reshape(B, mb * bs, H, D)
-    v = cache.v_pool[cache.block_table].reshape(B, mb * bs, H, D)
+    # gather each sequence's blocks: [B, mb, bs, Hkv, D] → [B, mb*bs, Hkv, D]
+    k = cache.k_pool[cache.block_table].reshape(B, mb * bs, Hkv, D)
+    v = cache.v_pool[cache.block_table].reshape(B, mb * bs, Hkv, D)
 
-    s = jnp.einsum("bhd,bkhd->bhk", q, k,
+    qg = q.reshape(B, Hkv, G, D)
+    s = jnp.einsum("bhgd,bkhd->bhgk", qg, k,
                    preferred_element_type=jnp.float32) / math.sqrt(D)
     valid = jnp.arange(mb * bs)[None, :] < cache.lengths[:, None]  # [B, K]
-    s = jnp.where(valid[:, None, :], s, -1e30)
+    s = jnp.where(valid[:, None, None, :], s, -1e30)
     p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
-    return jnp.einsum("bhk,bkhd->bhd", p, v)
+    out = jnp.einsum("bhgk,bkhd->bhgd", p, v)
+    return out.reshape(B, Hq, D)

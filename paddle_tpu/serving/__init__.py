@@ -1,0 +1,15 @@
+"""paddle_tpu.serving — continuous-batching LLM serving over paged KV.
+
+Parity: the reference's blocked serving surface —
+incubate/nn/functional/block_multihead_attention (python) over
+phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu, i.e. a paged
+KV cache with per-sequence block tables driven by an external serving loop.
+
+TPU-native re-design (engine.py): instead of a fused CUDA kernel called from
+user-managed buffers, the engine owns ONE jit-compiled decode step over a
+static slot batch (any mix of live requests recompiles nothing), a host-side
+block allocator with admission/preemption, and bucketed prefill programs.
+"""
+from .engine import LLMEngine, Request
+
+__all__ = ["LLMEngine", "Request"]

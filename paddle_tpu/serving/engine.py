@@ -1,0 +1,472 @@
+"""Continuous-batching paged-KV serving engine for llama-family models.
+
+Parity surface: the reference wires its paged decode kernel into serving via
+incubate/nn/functional/block_multihead_attention (block tables + per-seq
+lengths updated by an external loop); vLLM-style continuous batching is the
+behavioral model its serving stacks build on top.
+
+TPU-native design — everything the chip executes has STATIC shapes:
+
+- ONE compiled decode step over ``max_slots`` sequence slots. A slot is a
+  row of the batch; requests come and go, the program never retraces. Idle
+  slots write their K/V to a reserved trash block and are masked out of
+  sampling — XLA sees the same program every step.
+- Bucketed prefill: prompts pad to the smallest configured bucket, one
+  compiled program per bucket (the guard-cache analogue of the reference's
+  shape-bucketed serving graphs). Prefill K/V is scattered straight into
+  the slot's pool blocks; blocks past the true length are handed back.
+- Host-side block allocator: a free list over a
+  ``[L, num_blocks, block_size, Hkv, D]`` pool pair. Admission reserves
+  ceil(bucket/bs) blocks; decode allocates one block per slot whenever the
+  next token crosses a block boundary; EOS/max-len frees the slot. When the
+  pool runs dry mid-decode the newest-admitted request is preempted (blocks
+  freed, request re-queued for a fresh prefill) — forward progress for the
+  rest, vLLM's recompute-preemption policy.
+- Per-request sampling knobs (temperature/top-k/top-p) ride as traced
+  vectors through the compiled step: varying them never recompiles.
+- Pools are donated through both prefill and decode (jax donate_argnums),
+  so the multi-GB cache is updated in place, never copied per token.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from collections import deque
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..kernels.paged_attention import PagedKVCache, paged_attention
+from ..models.llama import (LlamaConfig, _apply_rope, _attention, _rms_norm)
+
+__all__ = ["LLMEngine", "Request"]
+
+
+@dataclasses.dataclass
+class Request:
+    req_id: int
+    prompt: List[int]
+    max_new_tokens: int = 64
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_token_id: Optional[int] = None
+    # tokens generated before a preemption; a re-admission prefills
+    # prompt+generated so already-streamed tokens are never re-emitted
+    # (vLLM recompute semantics)
+    generated: List[int] = dataclasses.field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# device programs
+# ---------------------------------------------------------------------------
+def _sample_rows(logits, key, temps, top_ks, top_ps):
+    """Vectorized per-row sampling: every knob is a traced [N] vector, so
+    one compiled program serves any mix of greedy/sampled requests.
+    temps<=0 → greedy; top_k<=0 → disabled; top_p>=1 → disabled."""
+    N, vocab = logits.shape
+    lg = logits / jnp.maximum(temps, 1e-6)[:, None]
+    # top-k: mask below the per-row kth value (disabled rows use k=vocab)
+    eff_k = jnp.where(top_ks > 0, top_ks, vocab)
+    srt = jnp.sort(lg, axis=-1)                          # ascending
+    kth_idx = jnp.clip(vocab - eff_k, 0, vocab - 1).astype(jnp.int32)
+    kth = jnp.take_along_axis(srt, kth_idx[:, None], axis=-1)
+    lg = jnp.where(lg < kth, -1e30, lg)
+    # top-p: drop tokens outside the smallest prefix with mass >= p
+    sort_idx = jnp.argsort(-lg, axis=-1)
+    sort_p = jnp.take_along_axis(jax.nn.softmax(lg, axis=-1), sort_idx,
+                                 axis=-1)
+    cum = jnp.cumsum(sort_p, axis=-1)
+    eff_p = jnp.where(top_ps < 1.0, top_ps, 1.0)
+    drop_sorted = cum - sort_p >= eff_p[:, None]
+    drop = jnp.zeros_like(drop_sorted).at[
+        jnp.arange(N)[:, None], sort_idx].set(drop_sorted)
+    lg = jnp.where(drop, -1e30, lg)
+    sampled = jax.random.categorical(key, lg, axis=-1)
+    greedy = jnp.argmax(logits, axis=-1)
+    return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+
+
+def _paged_prefill(params, tokens, blk_ids, true_len, k_pool, v_pool,
+                   *, config: LlamaConfig):
+    """Prefill ONE request: causal forward over the padded prompt, K/V
+    scattered into the slot's pool blocks.
+
+    tokens: [1, S_bucket]; blk_ids: [S_bucket // bs] physical block ids;
+    true_len: scalar int32. Returns (logits_at_last [vocab], k_pool, v_pool).
+    Pad positions beyond true_len land in blocks the host frees afterwards,
+    and causality keeps them out of the true-last-token's context.
+    """
+    c = config
+    dt = c.dtype
+    B, S = tokens.shape
+    bs = k_pool.shape[2]
+    x = params["embed"].astype(dt)[tokens]
+    pos = jnp.arange(S, dtype=jnp.float32)
+    freq = c.rope_theta ** (-jnp.arange(0, c.head_dim, 2, jnp.float32)
+                            / c.head_dim)
+    ang = pos[:, None] * freq[None, :]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+
+    for l in range(c.num_layers):
+        p = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
+        hn = _rms_norm(x, p["attn_norm"], c.rms_eps)
+        q = (hn @ p["wq"].astype(dt)).reshape(B, S, c.num_heads, c.head_dim)
+        k = (hn @ p["wk"].astype(dt)).reshape(B, S, c.num_kv_heads,
+                                              c.head_dim)
+        v = (hn @ p["wv"].astype(dt)).reshape(B, S, c.num_kv_heads,
+                                              c.head_dim)
+        q = _apply_rope(q, cos, sin)
+        k = _apply_rope(k, cos, sin)
+        k_pool = k_pool.at[l, blk_ids].set(
+            k[0].reshape(S // bs, bs, c.num_kv_heads, c.head_dim)
+            .astype(k_pool.dtype))
+        v_pool = v_pool.at[l, blk_ids].set(
+            v[0].reshape(S // bs, bs, c.num_kv_heads, c.head_dim)
+            .astype(v_pool.dtype))
+        # plain causal GQA attention — the model's own core (llama._attention)
+        att = _attention(q, k, v, c).reshape(B, S,
+                                             c.num_heads * c.head_dim)
+        x = x + att @ p["wo"].astype(dt)
+        hn = _rms_norm(x, p["mlp_norm"], c.rms_eps)
+        gate = jax.nn.silu(hn @ p["w_gate"].astype(dt))
+        x = x + (gate * (hn @ p["w_up"].astype(dt))) @ p["w_down"].astype(dt)
+
+    x = _rms_norm(x, params["final_norm"], c.rms_eps)
+    head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
+    logits = (x[0, true_len - 1] @ head.astype(dt)).astype(jnp.float32)
+    return logits, k_pool, v_pool
+
+
+def _paged_decode(params, last_tokens, lengths, active, block_table,
+                  k_pool, v_pool, temps, top_ks, top_ps, key,
+                  *, config: LlamaConfig):
+    """One decode step for ALL slots.
+
+    last_tokens/lengths/active: [N]; block_table: [N, MB];
+    pools: [L, NB, bs, Hkv, D]. Inactive slots write K/V to the reserved
+    trash block 0 and their sampled token is ignored by the host.
+    Returns (next_tokens [N], k_pool, v_pool).
+    """
+    c = config
+    dt = c.dtype
+    N = last_tokens.shape[0]
+    bs = k_pool.shape[2]
+
+    x = params["embed"].astype(dt)[last_tokens][:, None]      # [N, 1, h]
+    # per-slot rope at each slot's own position (ragged decode)
+    posf = lengths.astype(jnp.float32)
+    freq = c.rope_theta ** (-jnp.arange(0, c.head_dim, 2, jnp.float32)
+                            / c.head_dim)
+    ang = posf[:, None] * freq[None, :]                       # [N, D/2]
+    cos = jnp.cos(ang)[:, None, None, :]                      # [N,1,1,D/2]
+    sin = jnp.sin(ang)[:, None, None, :]
+
+    def rope(t):                                              # [N,1,H,D]
+        d2 = t.shape[-1] // 2
+        t1, t2 = t[..., :d2], t[..., d2:]
+        cc, ss = cos.astype(t.dtype), sin.astype(t.dtype)
+        return jnp.concatenate([t1 * cc - t2 * ss, t2 * cc + t1 * ss], -1)
+
+    blk_logical = lengths // bs
+    offset = lengths % bs
+    blk_phys = jnp.take_along_axis(block_table, blk_logical[:, None],
+                                   axis=1)[:, 0]
+    blk_phys = jnp.where(active, blk_phys, 0)                 # trash block
+
+    for l in range(c.num_layers):
+        p = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
+        hn = _rms_norm(x, p["attn_norm"], c.rms_eps)
+        q = (hn @ p["wq"].astype(dt)).reshape(N, 1, c.num_heads, c.head_dim)
+        k = (hn @ p["wk"].astype(dt)).reshape(N, 1, c.num_kv_heads,
+                                              c.head_dim)
+        v = (hn @ p["wv"].astype(dt)).reshape(N, 1, c.num_kv_heads,
+                                              c.head_dim)
+        q, k = rope(q), rope(k)
+        k_pool = k_pool.at[l, blk_phys, offset].set(
+            k[:, 0].astype(k_pool.dtype))
+        v_pool = v_pool.at[l, blk_phys, offset].set(
+            v[:, 0].astype(v_pool.dtype))
+        # the paged decode core (kernels/paged_attention, GQA-grouped);
+        # lengths+1 counts the token just appended
+        att = paged_attention(
+            q[:, 0].astype(dt),
+            PagedKVCache(k_pool[l], v_pool[l], block_table, lengths + 1))
+        att = att.reshape(N, 1, c.num_heads * c.head_dim).astype(dt)
+        x = x + att @ p["wo"].astype(dt)
+        hn = _rms_norm(x, p["mlp_norm"], c.rms_eps)
+        gate = jax.nn.silu(hn @ p["w_gate"].astype(dt))
+        x = x + (gate * (hn @ p["w_up"].astype(dt))) @ p["w_down"].astype(dt)
+
+    x = _rms_norm(x, params["final_norm"], c.rms_eps)
+    head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
+    logits = (x[:, 0] @ head.astype(dt)).astype(jnp.float32)  # [N, vocab]
+    nxt = _sample_rows(logits, key, temps, top_ks, top_ps)
+    return nxt, k_pool, v_pool
+
+
+# ---------------------------------------------------------------------------
+# host engine
+# ---------------------------------------------------------------------------
+class LLMEngine:
+    """Continuous-batching serving loop.
+
+    >>> eng = LLMEngine(params, config, max_slots=4)
+    >>> eng.add_request([1, 2, 3], max_new_tokens=32)
+    >>> outputs = eng.run()          # {req_id: [generated tokens...]}
+
+    ``step()`` advances one decode step (admitting queued requests first)
+    and returns the (req_id, token) pairs emitted — the streaming hook.
+    """
+
+    def __init__(self, params, config: LlamaConfig, max_slots: int = 4,
+                 block_size: int = 16, max_model_len: int = 512,
+                 num_blocks: Optional[int] = None,
+                 prompt_buckets: Optional[List[int]] = None, seed: int = 0):
+        c = config
+        assert max_model_len % block_size == 0
+        self.params = params
+        self.config = config
+        self.N = max_slots
+        self.bs = block_size
+        self.mb = max_model_len // block_size      # logical blocks per slot
+        self.max_model_len = max_model_len
+        # +1: physical block 0 is the trash block for idle slots
+        self.nb = (num_blocks if num_blocks is not None
+                   else max_slots * self.mb) + 1
+        self.buckets = sorted(prompt_buckets or
+                              [b for b in (64, 128, 256, 512)
+                               if b <= max_model_len] or [max_model_len])
+        if self.buckets[-1] < max_model_len:
+            # re-admission after preemption prefills prompt+generated, which
+            # can reach max_model_len — it must always have a bucket
+            self.buckets.append(max_model_len)
+        for b in self.buckets:
+            if b % block_size:
+                raise ValueError(
+                    f"prompt bucket {b} is not a multiple of "
+                    f"block_size {block_size}")
+        pool_shape = (c.num_layers, self.nb, block_size, c.num_kv_heads,
+                      c.head_dim)
+        self.k_pool = jnp.zeros(pool_shape, c.dtype)
+        self.v_pool = jnp.zeros(pool_shape, c.dtype)
+        self.free_blocks = deque(range(1, self.nb))
+        self.table = np.zeros((self.N, self.mb), np.int32)
+        self.n_alloc = np.zeros(self.N, np.int64)  # backed logical blocks
+        self.lengths = np.zeros(self.N, np.int64)
+        self.slot_req: List[Optional[Request]] = [None] * self.N
+        self.slot_out: List[List[int]] = [[] for _ in range(self.N)]
+        self.admit_order: List[int] = []           # slots, oldest first
+        self.queue: deque = deque()
+        self.results: Dict[int, List[int]] = {}
+        self._next_id = 0
+        self._key = jax.random.PRNGKey(seed)
+        self._prefill = {}
+        self._decode = jax.jit(
+            functools.partial(_paged_decode, config=config),
+            donate_argnums=(5, 6))
+        self._table_dirty = True
+        self._table_dev = None
+
+    # -- public api ---------------------------------------------------------
+    def add_request(self, prompt: List[int], **kw) -> int:
+        rid = self._next_id
+        self._next_id += 1
+        req = Request(req_id=rid, prompt=list(prompt), **kw)
+        if len(req.prompt) + req.max_new_tokens > self.max_model_len:
+            raise ValueError(
+                f"request {rid}: prompt({len(req.prompt)}) + "
+                f"max_new_tokens({req.max_new_tokens}) exceeds "
+                f"max_model_len({self.max_model_len})")
+        if len(req.prompt) > self.buckets[-1]:
+            raise ValueError(
+                f"request {rid}: prompt length {len(req.prompt)} exceeds "
+                f"the largest prompt bucket {self.buckets[-1]}")
+        self.queue.append(req)
+        return rid
+
+    def has_work(self) -> bool:
+        return bool(self.queue) or any(r is not None for r in self.slot_req)
+
+    def run(self) -> Dict[int, List[int]]:
+        while self.has_work():
+            self.step()
+        return self.results
+
+    # -- internals ----------------------------------------------------------
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"prompt length {n} exceeds largest bucket "
+                         f"{self.buckets[-1]}")
+
+    def _prefill_fn(self, bucket: int):
+        fn = self._prefill.get(bucket)
+        if fn is None:
+            fn = jax.jit(functools.partial(_paged_prefill,
+                                           config=self.config),
+                         donate_argnums=(4, 5))
+            self._prefill[bucket] = fn
+        return fn
+
+    def _free_slot(self, slot: int, requeue: bool = False):
+        req = self.slot_req[slot]
+        for j in range(int(self.n_alloc[slot])):
+            self.free_blocks.append(int(self.table[slot, j]))
+        self.table[slot, :] = 0
+        self.n_alloc[slot] = 0
+        self.lengths[slot] = 0
+        self.slot_req[slot] = None
+        if slot in self.admit_order:
+            self.admit_order.remove(slot)
+        out = self.slot_out[slot]
+        self.slot_out[slot] = []
+        self._table_dirty = True
+        if requeue and req is not None:
+            # recompute-preemption: carry generated tokens so re-admission
+            # prefills prompt+generated — streamed tokens stay valid and
+            # are never re-emitted
+            req.generated.extend(out)
+            self.queue.appendleft(req)
+        elif req is not None:
+            self.results[req.req_id] = req.generated + out
+
+    def _admit(self):
+        emitted = []
+        while self.queue:
+            slot = next((i for i in range(self.N)
+                         if self.slot_req[i] is None), None)
+            if slot is None:
+                return emitted
+            req = self.queue[0]
+            ctx = req.prompt + req.generated   # re-admission continues
+            bucket = self._bucket_for(len(ctx))
+            true_len = len(ctx)
+            # only the blocks the true prompt occupies; the bucket's pad
+            # tail scatters into the trash block (never read: causality)
+            need = max(1, -(-true_len // self.bs))
+            if len(self.free_blocks) < need:
+                if not any(r is not None for r in self.slot_req):
+                    raise RuntimeError(
+                        f"request {req.req_id}: prefill needs {need} blocks "
+                        f"but the pool only has {self.nb - 1} usable — the "
+                        "block pool is too small for this request")
+                return emitted               # blocks busy: wait for frees
+            self.queue.popleft()
+            blocks = [self.free_blocks.popleft() for _ in range(need)]
+            blk_ids = blocks + [0] * (bucket // self.bs - need)
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :true_len] = ctx
+            logits, self.k_pool, self.v_pool = self._prefill_fn(bucket)(
+                self.params, jnp.asarray(toks),
+                jnp.asarray(blk_ids, jnp.int32),
+                jnp.asarray(true_len, jnp.int32),
+                self.k_pool, self.v_pool)
+            self.table[slot, :len(blocks)] = blocks
+            self.n_alloc[slot] = len(blocks)
+            self.lengths[slot] = true_len
+            self.slot_req[slot] = req
+            self.admit_order.append(slot)
+            self._table_dirty = True
+            # sample the first generated token from the prefill logits
+            self._key, sub = jax.random.split(self._key)
+            tok = int(_sample_rows(
+                logits[None], sub,
+                jnp.asarray([req.temperature], jnp.float32),
+                jnp.asarray([req.top_k], jnp.int32),
+                jnp.asarray([req.top_p], jnp.float32))[0])
+            emitted.append((req.req_id, tok))
+            self._emit(slot, tok)
+        return emitted
+
+    def _emit(self, slot: int, tok: int) -> bool:
+        """Record a generated token; free the slot when the request is done.
+        Returns True if the request finished."""
+        req = self.slot_req[slot]
+        self.slot_out[slot].append(tok)
+        n_gen = len(req.generated) + len(self.slot_out[slot])
+        done = (req.eos_token_id is not None and tok == req.eos_token_id) \
+            or n_gen >= req.max_new_tokens
+        if done:
+            self._free_slot(slot)
+        return done
+
+    def _ensure_backed(self, slot: int) -> bool:
+        """Make sure the block for this slot's next write position exists.
+        Returns False if the pool is exhausted (caller preempts)."""
+        need_blk = int(self.lengths[slot]) // self.bs
+        if need_blk < int(self.n_alloc[slot]):
+            return True
+        if not self.free_blocks:
+            return False
+        self.table[slot, need_blk] = self.free_blocks.popleft()
+        self.n_alloc[slot] = need_blk + 1
+        self._table_dirty = True
+        return True
+
+    def step(self):
+        """Admit queued requests, run one decode step, route tokens.
+        Returns the list of (req_id, token) emitted this step."""
+        emitted = self._admit()
+        active_slots = [i for i in range(self.N)
+                        if self.slot_req[i] is not None]
+        if not active_slots:
+            return emitted
+        # back the next write position for every active slot; preempt the
+        # newest admissions while the pool is short (vLLM recompute policy)
+        for slot in list(active_slots):
+            if self.slot_req[slot] is None:
+                continue                      # already preempted as a victim
+            while not self._ensure_backed(slot):
+                victim = self.admit_order[-1]
+                if victim == slot and len(self.admit_order) == 1:
+                    # alone and starved: nothing else will ever free a
+                    # block — preempting ourselves would livelock
+                    raise RuntimeError(
+                        f"request {self.slot_req[slot].req_id}: the block "
+                        f"pool ({self.nb - 1} usable blocks) is too small "
+                        "to decode this request any further")
+                self._free_slot(victim, requeue=True)
+                if victim == slot:
+                    break
+        active_slots = [i for i in range(self.N)
+                        if self.slot_req[i] is not None]
+        if not active_slots:
+            return emitted
+
+        last = np.zeros(self.N, np.int32)
+        temps = np.zeros(self.N, np.float32)
+        top_ks = np.zeros(self.N, np.int32)
+        top_ps = np.ones(self.N, np.float32)
+        active = np.zeros(self.N, bool)
+        for i in active_slots:
+            req = self.slot_req[i]
+            last[i] = self.slot_out[i][-1] if self.slot_out[i] else \
+                req.prompt[-1]
+            temps[i] = req.temperature
+            top_ks[i] = req.top_k
+            top_ps[i] = req.top_p
+            active[i] = True
+
+        if self._table_dirty or self._table_dev is None:
+            self._table_dev = jnp.asarray(self.table)
+            self._table_dirty = False
+        self._key, sub = jax.random.split(self._key)
+        nxt, self.k_pool, self.v_pool = self._decode(
+            self.params, jnp.asarray(last),
+            jnp.asarray(self.lengths, jnp.int32), jnp.asarray(active),
+            self._table_dev, self.k_pool, self.v_pool,
+            jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
+            sub)
+        nxt_host = np.asarray(jax.device_get(nxt))
+        for i in active_slots:
+            self.lengths[i] += 1           # the token just appended
+            rid = self.slot_req[i].req_id
+            tok = int(nxt_host[i])
+            emitted.append((rid, tok))
+            self._emit(i, tok)
+        return emitted
