@@ -19,8 +19,8 @@ import dataclasses
 import math
 from typing import List, Optional, Tuple
 
-__all__ = ["ModelSpec", "ClusterSpec", "TuneResult", "tune",
-           "best_mesh_shape"]
+__all__ = ["ModelSpec", "ClusterSpec", "TuneResult", "MeasuredResult",
+           "tune", "tune_measured", "best_mesh_shape", "llama_step_builder"]
 
 
 @dataclasses.dataclass
@@ -117,6 +117,107 @@ def tune(model: ModelSpec, cluster: ClusterSpec,
                                   _comm_score(model, pp, dp, sp, tp), fits))
     results.sort(key=lambda r: (not r.fits, r.comm_score))
     return results[:max_candidates] if max_candidates else results
+
+
+@dataclasses.dataclass
+class MeasuredResult:
+    analytic: TuneResult
+    step_time_s: float
+
+    @property
+    def shape(self) -> Tuple[int, int, int, int]:
+        return self.analytic.shape
+
+
+def _sync(tree):
+    """Reliable device sync: a d2h readback of one leaf (on some backends
+    block_until_ready returns before the computation drains)."""
+    import jax
+    import numpy as np
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    jax.block_until_ready(tree)
+    if leaves:
+        np.asarray(jax.device_get(leaves[0])).ravel()[:1]
+
+
+def tune_measured(model: ModelSpec, cluster: ClusterSpec, step_builder,
+                  topk: int = 3, warmup: int = 1, iters: int = 3,
+                  ) -> List[MeasuredResult]:
+    """Trial pass after analytic ranking (parity: auto_tuner/tuner.py:21 —
+    the reference launches candidate configs as real jobs with pruning; here
+    each surviving candidate is compiled and TIMED on the local device set,
+    typically the virtual CPU mesh for planning or the chips themselves).
+
+    ``step_builder((pp, dp, sp, tp))`` must return ``(step_fn, args)`` for
+    that mesh shape, or raise ValueError for shapes it cannot build locally
+    (those candidates are skipped, like the reference's pruned trials).
+    Only HBM-model-fitting candidates are measured; ranked by measured step
+    time — the analytic model proposes, the stopwatch disposes.
+    """
+    import time as _time
+
+    measured: List[MeasuredResult] = []
+    for r in [c for c in tune(model, cluster) if c.fits][:topk]:
+        try:
+            step, args = step_builder(r.shape)
+        except ValueError:
+            continue
+        try:
+            out = step(*args)         # compile + first run (not timed)
+            _sync(out)
+            for _ in range(max(0, warmup - 1)):
+                _sync(step(*args))
+            t0 = _time.perf_counter()
+            for _ in range(iters):
+                _sync(step(*args))
+            dt = (_time.perf_counter() - t0) / iters
+        except Exception:
+            continue                   # candidate fails to compile/run
+        measured.append(MeasuredResult(r, dt))
+    measured.sort(key=lambda m: m.step_time_s)
+    return measured
+
+
+def llama_step_builder(config, batch: int, seq: int, fsdp: bool = True):
+    """Default trial builder: a sharded llama train step on the local
+    devices (mirrors the driver's dryrun path). Returns a ``step_builder``
+    for :func:`tune_measured`."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ..models import llama
+
+    def build(shape):
+        pp, dp, sp, tp = shape
+        n = pp * dp * sp * tp
+        devs = jax.devices()
+        if n != len(devs):
+            raise ValueError(f"shape {shape} needs {n} devices, "
+                             f"have {len(devs)}")
+        if config.num_layers % pp or batch % max(dp, 1) or seq % max(sp, 1):
+            raise ValueError(f"shape {shape} does not divide the model")
+        mesh = Mesh(np.asarray(devs).reshape(pp, dp, sp, tp),
+                    ("pp", "dp", "sp", "tp"))
+        state = llama.init_train_state(config, jax.random.PRNGKey(0))
+        state = llama.put_train_state(
+            state, llama.make_shardings(config, mesh, fsdp=fsdp))
+        tokens = jax.device_put(
+            jax.random.randint(jax.random.PRNGKey(1), (batch, seq + 1), 0,
+                               config.vocab_size),
+            NamedSharding(mesh, P("dp", None)))
+
+        def step(state, tokens):
+            with llama.activation_mesh(mesh):
+                return jax.jit(
+                    lambda s, t: llama.train_step(s, t, config))(state,
+                                                                 tokens)
+
+        return step, (state, tokens)
+
+    return build
 
 
 def best_mesh_shape(model: ModelSpec, cluster: ClusterSpec):

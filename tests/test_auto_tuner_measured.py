@@ -1,0 +1,84 @@
+"""Trial-based auto-tuner pass (parity: auto_tuner/tuner.py:21 — the
+reference launches measured candidate trials after pruning; here candidates
+compile + time on the local virtual mesh)."""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401  (conftest: 8 virtual CPU devices)
+import jax
+
+from paddle_tpu.distributed.auto_tuner import (
+    ClusterSpec, MeasuredResult, ModelSpec, llama_step_builder, tune,
+    tune_measured)
+
+
+def _spec():
+    return (ModelSpec(num_params=1e8, hidden_size=128, num_layers=4,
+                      seq_len=64, global_batch=8, vocab_size=512,
+                      remat=False),
+            ClusterSpec(num_chips=8))
+
+
+def test_measured_argmin_beats_analytic_misranking():
+    """The stopwatch overrides the analytic order: feed trials whose real
+    cost is the REVERSE of the analytic ranking and assert the tuner
+    returns the measured argmin."""
+    model, cluster = _spec()
+    ranked = [r for r in tune(model, cluster) if r.fits]
+    assert len(ranked) >= 2
+    # make the analytically-best candidate slow and the runner-up fast
+    slow_shape = ranked[0].shape
+    delays = {slow_shape: 0.05}
+
+    def builder(shape):
+        delay = delays.get(shape, 0.0)
+
+        def step():
+            time.sleep(delay)
+            return jax.numpy.zeros(())
+
+        return step, ()
+
+    measured = tune_measured(model, cluster, builder, topk=2, iters=2)
+    assert len(measured) == 2
+    assert measured[0].shape != slow_shape          # misranking corrected
+    assert measured[0].step_time_s < measured[1].step_time_s
+    assert measured[1].shape == slow_shape
+
+
+def test_unbuildable_candidates_skipped():
+    model, cluster = _spec()
+
+    def builder(shape):
+        pp, dp, sp, tp = shape
+        if tp != 1:
+            raise ValueError("tp unsupported on this host")
+        return (lambda: jax.numpy.zeros(())), ()
+
+    measured = tune_measured(model, cluster, builder, topk=4)
+    assert measured
+    assert all(m.shape[3] == 1 for m in measured)
+
+
+def test_llama_trial_on_virtual_mesh():
+    """End to end: real sharded llama train-step trials on the 8-device
+    CPU mesh — compile, run, rank by measured time."""
+    from paddle_tpu.models import llama
+
+    cfg = llama.tiny_llama(vocab=128, hidden=64, layers=2, heads=4,
+                           kv_heads=2, seq=32, ffn=128)
+    model = ModelSpec(num_params=2e5, hidden_size=64, num_layers=2,
+                      seq_len=32, global_batch=8, vocab_size=128,
+                      remat=False)
+    cluster = ClusterSpec(num_chips=8)
+    builder = llama_step_builder(cfg, batch=8, seq=32)
+    measured = tune_measured(model, cluster, builder, topk=2, iters=1)
+    assert measured, "no candidate compiled"
+    for m in measured:
+        assert m.step_time_s > 0
+        assert int(np.prod(m.shape)) == 8
+    # ranked ascending by measured time
+    times = [m.step_time_s for m in measured]
+    assert times == sorted(times)
