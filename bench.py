@@ -16,53 +16,59 @@ import jax
 import jax.numpy as jnp
 
 
-# bf16 peak FLOPs / HBM bytes per chip by device kind (public spec sheets)
-_PEAK = {
-    "v4": 275e12,
-    "v5p": 459e12,
-    "v5e": 197e12,
-    "v5 lite": 197e12,
-    "v6e": 918e12,
-    "trillium": 918e12,
+# per-device-kind spec sheet: bf16 peak FLOPs / HBM bytes / HBM bandwidth
+_SPECS = {
+    #             flops    hbm    hbm B/s
+    "v4":        (275e12, 32e9, 1.20e12),
+    "v5p":       (459e12, 95e9, 2.77e12),
+    "v5e":       (197e12, 16e9, 8.19e11),
+    "v5 lite":   (197e12, 16e9, 8.19e11),
+    "v6e":       (918e12, 32e9, 1.64e12),
+    "trillium":  (918e12, 32e9, 1.64e12),
 }
-_HBM = {
-    "v4": 32e9,
-    "v5p": 95e9,
-    "v5e": 16e9,
-    "v5 lite": 16e9,
-    "v6e": 32e9,
-    "trillium": 32e9,
-}
+
+
+def _spec(dev, idx: int, default: float) -> float:
+    kind = getattr(dev, "device_kind", "").lower()
+    for key, vals in _SPECS.items():
+        if key in kind:
+            return vals[idx]
+    return default
 
 
 def _peak_flops(dev) -> float:
-    kind = getattr(dev, "device_kind", "").lower()
-    for key, val in _PEAK.items():
-        if key in kind:
-            return val
     if dev.platform == "cpu":
         return 1e12  # nominal, so MFU is defined everywhere
-    return 459e12  # assume v5p-class
+    return _spec(dev, 0, 459e12)  # assume v5p-class
 
 
 def _hbm_bytes(dev) -> float:
-    kind = getattr(dev, "device_kind", "").lower()
-    for key, val in _HBM.items():
-        if key in kind:
-            return val
-    return 95e9
+    return _spec(dev, 1, 95e9)
+
+
+def _hbm_bw(dev) -> float:
+    return _spec(dev, 2, 8.19e11)
 
 
 def _dense_configs():
     from paddle_tpu.models import llama
     # largest first; each entry carries its optimizer memory mode and a
     # peak-bytes/param estimate for the HBM pre-check.
-    # 2.6B on a 16GB v5e: bf16 params + factored-second-moment adafactor
-    # (optimizer/functional.py) ≈ 2(p) + 2(g) + ~0(nu) + f32 update temps
-    # (measured on v5e: 2.62B params trains in ~11GB).
+    # 4B on a 16GB v5e: bf16 params + adafactor + LAYER-WISE
+    # optimizer-in-backward (optimizer/offload.make_layerwise_train_step):
+    # one layer's grads exist at a time, so params(8G) and the grad
+    # tree(8G) never coexist in HBM — the plain fused step OOMs by 1.5G at
+    # this size (measured r3: 17.25G used of 15.75G).
     adafactor_bf16 = {"optimizer": "adafactor",
                       "param_dtype": jnp.bfloat16, "bpp": 4}
+    layerwise_bf16 = {"optimizer": "adafactor",
+                      "param_dtype": jnp.bfloat16, "bpp": 3,
+                      "layerwise": True}
     adamw_f32 = {"optimizer": "adamw", "param_dtype": jnp.float32, "bpp": 16}
+    yield "llama-4b-layerwise", llama.LlamaConfig(
+        vocab_size=32768, hidden_size=3584, intermediate_size=9728,
+        num_layers=28, num_heads=28, num_kv_heads=4, head_dim=128,
+        max_seq_len=2048, remat=True), 4, 2048, layerwise_bf16
     yield "llama-2.6b", llama.LlamaConfig(
         vocab_size=32768, hidden_size=3072, intermediate_size=8192,
         num_layers=24, num_heads=24, num_kv_heads=8, head_dim=128,
@@ -101,15 +107,24 @@ def _release():
 def _time_train(module, cfg, batch, seq, opt, n_steps=5, **step_kw):
     """Init → compile → warm → time n_steps of module.train_step. Returns
     tokens/s. Frees the state before returning."""
-    state = module.init_train_state(
-        cfg, jax.random.PRNGKey(0), optimizer=opt["optimizer"],
-        param_dtype=opt["param_dtype"])
+    if opt.get("layerwise"):
+        from paddle_tpu.optimizer.offload import (
+            init_layerwise_train_state, make_layerwise_train_step)
+        state = init_layerwise_train_state(
+            cfg, jax.random.PRNGKey(0), param_dtype=opt["param_dtype"])
+        step = make_layerwise_train_step(cfg, optimizer=opt["optimizer"],
+                                         **step_kw)
+    else:
+        state = module.init_train_state(
+            cfg, jax.random.PRNGKey(0), optimizer=opt["optimizer"],
+            param_dtype=opt["param_dtype"])
+        step = jax.jit(
+            lambda s, t: module.train_step(s, t, cfg,
+                                           optimizer=opt["optimizer"],
+                                           **step_kw),
+            donate_argnums=0)
     tokens = jax.random.randint(
         jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size)
-    step = jax.jit(
-        lambda s, t: module.train_step(s, t, cfg,
-                                       optimizer=opt["optimizer"], **step_kw),
-        donate_argnums=0)
     try:
         for _ in range(2):  # compile + warmup
             state, loss = step(state, tokens)
@@ -214,12 +229,70 @@ def bench_moe(dev, results):
         _release()
 
 
+def bench_decode(dev, results):
+    """Decode throughput on the 2.6B config, bf16 vs int8 weight-only
+    (models/llama.quantize_params — inline-dequant fused into the matmul).
+    Decode is weight-bandwidth-bound: vs_baseline = measured / (40% of the
+    HBM roofline B*BW/weight_bytes), mirroring the train-side 40%-MFU
+    baseline convention."""
+    from paddle_tpu.models import llama
+    if dev.platform == "cpu":
+        return  # chip-only section
+    import numpy as np
+    cfg = llama.LlamaConfig(
+        vocab_size=32768, hidden_size=3072, intermediate_size=8192,
+        num_layers=24, num_heads=24, num_kv_heads=8, head_dim=128,
+        max_seq_len=2048, remat=False, dtype=jnp.bfloat16)
+    B, prompt_len, new = 8, 128, 128
+
+    def run(params, tag, wbytes):
+        # generate_fused: ONE compiled program (module-level jit cache) —
+        # the python-loop generate pays a tunnel dispatch per token and
+        # would measure host overhead, not the chip
+        prompt = jax.random.randint(jax.random.PRNGKey(1),
+                                    (B, prompt_len), 0, cfg.vocab_size)
+        out = llama.generate_fused(params, prompt, cfg, max_new_tokens=new)
+        _ = np.asarray(out)            # compile + warm, full sync
+        t0 = time.perf_counter()
+        out = llama.generate_fused(params, prompt, cfg, max_new_tokens=new)
+        _ = np.asarray(out)
+        dt = time.perf_counter() - t0
+        tps = B * new / dt
+        roofline = B * _hbm_bw(dev) / wbytes
+        results.append({
+            "metric": f"llama-2.6b_decode_{tag}_tokens_per_sec",
+            "value": round(tps, 1),
+            "unit": "tokens/s",
+            "vs_baseline": round(tps / (0.40 * roofline), 4),
+        })
+        return tps
+
+    try:
+        params = jax.jit(lambda k: jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.bfloat16),
+            llama.init_params(cfg, k)))(jax.random.PRNGKey(0))
+        n = llama.num_params(params)
+        t_bf16 = run(params, "bf16", 2.0 * n)
+        qp = jax.jit(llama.quantize_params)(params)
+        params = None
+        _release()
+        t_int8 = run(qp, "int8", 1.0 * n)
+        results[-1]["speedup_vs_bf16"] = round(t_int8 / t_bf16, 3)
+    except Exception as e:
+        results.append({"metric": "decode_bench_failed", "value": 0.0,
+                        "unit": "tokens/s", "vs_baseline": 0.0,
+                        "error": str(e)[:200]})
+    finally:
+        _release()
+
+
 def main():
     dev = jax.devices()[0]
     results = []
     bench_dense(dev, results)
     bench_long_context(dev, results)
     bench_moe(dev, results)
+    bench_decode(dev, results)
 
     headline = results[0]
     out = dict(headline)

@@ -158,10 +158,12 @@ def tune_measured(model: ModelSpec, cluster: ClusterSpec, step_builder,
     import time as _time
 
     measured: List[MeasuredResult] = []
+    errors: List[str] = []
     for r in [c for c in tune(model, cluster) if c.fits][:topk]:
         try:
             step, args = step_builder(r.shape)
-        except ValueError:
+        except ValueError as e:
+            errors.append(f"{r.shape}: {e}")
             continue
         try:
             out = step(*args)         # compile + first run (not timed)
@@ -172,9 +174,16 @@ def tune_measured(model: ModelSpec, cluster: ClusterSpec, step_builder,
             for _ in range(iters):
                 _sync(step(*args))
             dt = (_time.perf_counter() - t0) / iters
-        except Exception:
-            continue                   # candidate fails to compile/run
+        except Exception as e:         # candidate fails to compile/run
+            errors.append(f"{r.shape}: {type(e).__name__}: {e}")
+            continue
         measured.append(MeasuredResult(r, dt))
+    # a broken builder raises the same way for EVERY candidate — surface
+    # that instead of returning a silently-empty ranking
+    if not measured and errors:
+        raise RuntimeError(
+            "tune_measured: no candidate ran; per-candidate errors:\n  "
+            + "\n  ".join(errors[:5]))
     measured.sort(key=lambda m: m.step_time_s)
     return measured
 
@@ -199,21 +208,29 @@ def llama_step_builder(config, batch: int, seq: int, fsdp: bool = True):
                              f"have {len(devs)}")
         if config.num_layers % pp or batch % max(dp, 1) or seq % max(sp, 1):
             raise ValueError(f"shape {shape} does not divide the model")
+        if pp > 1 and not config.pipeline_microbatches:
+            # without a schedule the pp axis would sit idle and the trial
+            # would time a non-pipelined program — a meaningless number
+            raise ValueError(
+                f"shape {shape}: pp>1 needs config.pipeline_microbatches")
         mesh = Mesh(np.asarray(devs).reshape(pp, dp, sp, tp),
                     ("pp", "dp", "sp", "tp"))
-        state = llama.init_train_state(config, jax.random.PRNGKey(0))
-        state = llama.put_train_state(
-            state, llama.make_shardings(config, mesh, fsdp=fsdp))
+        # sharded init: never materializes the unsharded f32 state on one
+        # device (the near-HBM-limit configs are the ones worth trialing)
+        state = llama.init_sharded_train_state(
+            config, jax.random.PRNGKey(0),
+            llama.make_shardings(config, mesh, fsdp=fsdp))
         tokens = jax.device_put(
             jax.random.randint(jax.random.PRNGKey(1), (batch, seq + 1), 0,
                                config.vocab_size),
             NamedSharding(mesh, P("dp", None)))
+        jitted = jax.jit(lambda s, t: llama.train_step(s, t, config))
 
         def step(state, tokens):
+            # the mesh context matters at trace time (first call); later
+            # calls hit the jit cache — timed iterations never recompile
             with llama.activation_mesh(mesh):
-                return jax.jit(
-                    lambda s, t: llama.train_step(s, t, config))(state,
-                                                                 tokens)
+                return jitted(state, tokens)
 
         return step, (state, tokens)
 

@@ -146,6 +146,47 @@ def num_params(params) -> int:
 
 
 # ---------------------------------------------------------------------------
+# int8 weight-only quantization for the decode/serving path
+# (parity: nn/quant/quantized_linear.py weight_only_linear over the cutlass
+#  fpA_intB GEMMs — phi/kernels/fusion/cutlass_kernels/. TPU-native: weights
+#  stay int8 in HBM; XLA fuses the convert+scale into the matmul read, so
+#  bandwidth-bound decode moves half the bytes.)
+# ---------------------------------------------------------------------------
+_QUANT_KEYS = frozenset(
+    {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"})
+
+
+def quantize_params(params, include_lm_head: bool = True):
+    """Per-output-channel absmax int8 quantization of the matmul weights
+    ([L, K, N] stacked leaves → {"q": int8 [L, K, N], "s": bf16 [L, N]}).
+    Norms and the embedding stay full precision (gathers, not matmuls)."""
+    def q(w):
+        wf = w.astype(jnp.float32)
+        scale = jnp.max(jnp.abs(wf), axis=-2) / 127.0
+        qv = jnp.clip(
+            jnp.round(wf / jnp.maximum(scale[..., None, :], 1e-9)),
+            -128, 127).astype(jnp.int8)
+        return {"q": qv, "s": scale.astype(jnp.bfloat16)}
+
+    out = dict(params)
+    out["layers"] = {k: (q(v) if k in _QUANT_KEYS else v)
+                     for k, v in params["layers"].items()}
+    if include_lm_head and "lm_head" in params:
+        out["lm_head"] = q(params["lm_head"])
+    return out
+
+
+def _wmat(p, name, dt):
+    """Weight leaf → dense matmul operand in ``dt``; dequantizes int8
+    weight-only leaves inline (XLA fuses it into the matmul)."""
+    w = p[name] if isinstance(name, str) else name
+    if isinstance(w, dict) and "q" in w:
+        return (w["q"].astype(jnp.float32)
+                * w["s"].astype(jnp.float32)[..., None, :]).astype(dt)
+    return w.astype(dt)
+
+
+# ---------------------------------------------------------------------------
 # sharding recipe  (mesh axes: 'dp' data, 'sp' sequence, 'tp' model)
 # ---------------------------------------------------------------------------
 
@@ -744,22 +785,23 @@ def forward_with_cache(params, tokens, cache, config: LlamaConfig):
     for l in range(c.num_layers):
         p = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
         hn = _rms_norm(x, p["attn_norm"], c.rms_eps)
-        q = (hn @ p["wq"].astype(dt)).reshape(B, S, c.num_heads, c.head_dim)
-        k = (hn @ p["wk"].astype(dt)).reshape(B, S, c.num_kv_heads, c.head_dim)
-        v = (hn @ p["wv"].astype(dt)).reshape(B, S, c.num_kv_heads, c.head_dim)
+        q = (hn @ _wmat(p, "wq", dt)).reshape(B, S, c.num_heads, c.head_dim)
+        k = (hn @ _wmat(p, "wk", dt)).reshape(B, S, c.num_kv_heads, c.head_dim)
+        v = (hn @ _wmat(p, "wv", dt)).reshape(B, S, c.num_kv_heads, c.head_dim)
         q = _apply_rope(q, cos, sin)
         k = _apply_rope(k, cos, sin)
         ck = jax.lax.dynamic_update_slice(ck, k[None], (l, 0, pos, 0, 0))
         cv = jax.lax.dynamic_update_slice(cv, v[None], (l, 0, pos, 0, 0))
         att = _cached_attention(q, ck[l], cv[l], pos, c)
-        x = x + att.reshape(B, S, c.num_heads * c.head_dim) @ p["wo"].astype(dt)
+        x = x + att.reshape(B, S, c.num_heads * c.head_dim) @ _wmat(p, "wo", dt)
         hn = _rms_norm(x, p["mlp_norm"], c.rms_eps)
-        gate = jax.nn.silu(hn @ p["w_gate"].astype(dt))
-        x = x + (gate * (hn @ p["w_up"].astype(dt))) @ p["w_down"].astype(dt)
+        gate = jax.nn.silu(hn @ _wmat(p, "w_gate", dt))
+        x = x + (gate * (hn @ _wmat(p, "w_up", dt))) @ _wmat(p, "w_down", dt)
 
     x = _rms_norm(x, params["final_norm"], c.rms_eps)
-    head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
-    logits = (x[:, -1] @ head.astype(dt)).astype(jnp.float32)
+    head = (params["embed"].astype(dt).T if c.tie_embeddings
+            else _wmat(params, "lm_head", dt))
+    logits = (x[:, -1] @ head).astype(jnp.float32)
     cache = {"k": ck, "v": cv, "pos": pos + S}
     return logits, cache
 

@@ -40,7 +40,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..kernels.paged_attention import PagedKVCache, paged_attention
-from ..models.llama import (LlamaConfig, _apply_rope, _attention, _rms_norm)
+from ..models.llama import (LlamaConfig, _apply_rope, _attention,
+                            _rms_norm, _wmat)
 
 __all__ = ["LLMEngine", "Request"]
 
@@ -114,10 +115,10 @@ def _paged_prefill(params, tokens, blk_ids, true_len, k_pool, v_pool,
     for l in range(c.num_layers):
         p = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
         hn = _rms_norm(x, p["attn_norm"], c.rms_eps)
-        q = (hn @ p["wq"].astype(dt)).reshape(B, S, c.num_heads, c.head_dim)
-        k = (hn @ p["wk"].astype(dt)).reshape(B, S, c.num_kv_heads,
+        q = (hn @ _wmat(p, "wq", dt)).reshape(B, S, c.num_heads, c.head_dim)
+        k = (hn @ _wmat(p, "wk", dt)).reshape(B, S, c.num_kv_heads,
                                               c.head_dim)
-        v = (hn @ p["wv"].astype(dt)).reshape(B, S, c.num_kv_heads,
+        v = (hn @ _wmat(p, "wv", dt)).reshape(B, S, c.num_kv_heads,
                                               c.head_dim)
         q = _apply_rope(q, cos, sin)
         k = _apply_rope(k, cos, sin)
@@ -130,14 +131,15 @@ def _paged_prefill(params, tokens, blk_ids, true_len, k_pool, v_pool,
         # plain causal GQA attention — the model's own core (llama._attention)
         att = _attention(q, k, v, c).reshape(B, S,
                                              c.num_heads * c.head_dim)
-        x = x + att @ p["wo"].astype(dt)
+        x = x + att @ _wmat(p, "wo", dt)
         hn = _rms_norm(x, p["mlp_norm"], c.rms_eps)
-        gate = jax.nn.silu(hn @ p["w_gate"].astype(dt))
-        x = x + (gate * (hn @ p["w_up"].astype(dt))) @ p["w_down"].astype(dt)
+        gate = jax.nn.silu(hn @ _wmat(p, "w_gate", dt))
+        x = x + (gate * (hn @ _wmat(p, "w_up", dt))) @ _wmat(p, "w_down", dt)
 
     x = _rms_norm(x, params["final_norm"], c.rms_eps)
-    head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
-    logits = (x[0, true_len - 1] @ head.astype(dt)).astype(jnp.float32)
+    head = (params["embed"].astype(dt).T if c.tie_embeddings
+            else _wmat(params, "lm_head", dt))
+    logits = (x[0, true_len - 1] @ head).astype(jnp.float32)
     return logits, k_pool, v_pool
 
 
@@ -180,10 +182,10 @@ def _paged_decode(params, last_tokens, lengths, active, block_table,
     for l in range(c.num_layers):
         p = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
         hn = _rms_norm(x, p["attn_norm"], c.rms_eps)
-        q = (hn @ p["wq"].astype(dt)).reshape(N, 1, c.num_heads, c.head_dim)
-        k = (hn @ p["wk"].astype(dt)).reshape(N, 1, c.num_kv_heads,
+        q = (hn @ _wmat(p, "wq", dt)).reshape(N, 1, c.num_heads, c.head_dim)
+        k = (hn @ _wmat(p, "wk", dt)).reshape(N, 1, c.num_kv_heads,
                                               c.head_dim)
-        v = (hn @ p["wv"].astype(dt)).reshape(N, 1, c.num_kv_heads,
+        v = (hn @ _wmat(p, "wv", dt)).reshape(N, 1, c.num_kv_heads,
                                               c.head_dim)
         q, k = rope(q), rope(k)
         k_pool = k_pool.at[l, blk_phys, offset].set(
@@ -196,14 +198,15 @@ def _paged_decode(params, last_tokens, lengths, active, block_table,
             q[:, 0].astype(dt),
             PagedKVCache(k_pool[l], v_pool[l], block_table, lengths + 1))
         att = att.reshape(N, 1, c.num_heads * c.head_dim).astype(dt)
-        x = x + att @ p["wo"].astype(dt)
+        x = x + att @ _wmat(p, "wo", dt)
         hn = _rms_norm(x, p["mlp_norm"], c.rms_eps)
-        gate = jax.nn.silu(hn @ p["w_gate"].astype(dt))
-        x = x + (gate * (hn @ p["w_up"].astype(dt))) @ p["w_down"].astype(dt)
+        gate = jax.nn.silu(hn @ _wmat(p, "w_gate", dt))
+        x = x + (gate * (hn @ _wmat(p, "w_up", dt))) @ _wmat(p, "w_down", dt)
 
     x = _rms_norm(x, params["final_norm"], c.rms_eps)
-    head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
-    logits = (x[:, 0] @ head.astype(dt)).astype(jnp.float32)  # [N, vocab]
+    head = (params["embed"].astype(dt).T if c.tie_embeddings
+            else _wmat(params, "lm_head", dt))
+    logits = (x[:, 0] @ head).astype(jnp.float32)         # [N, vocab]
     nxt = _sample_rows(logits, key, temps, top_ks, top_ps)
     return nxt, k_pool, v_pool
 
