@@ -1,0 +1,92 @@
+"""Continuous-batching LLM serving (BASELINE config 4's serving side).
+
+Drives ``paddle_tpu.serving.LLMEngine``: a paged-KV, slot-static compiled
+decode loop with bucketed prefill, mid-decode admission, EOS reclamation,
+and recompute-preemption — the TPU-native counterpart of the reference's
+block_multihead_attention serving surface.
+
+Hermetic: random weights, synthetic prompts. Flags scale it up/down.
+
+    JAX_PLATFORMS=cpu python examples/serve_llm.py --slots 2 --requests 6
+    python examples/serve_llm.py --hidden 2048 --layers 16 --int8
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _common  # noqa: E402
+
+_common.setup()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--vocab", type=int, default=2048)
+    ap.add_argument("--hidden", type=int, default=256)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--kv-heads", type=int, default=2)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--max-len", type=int, default=512)
+    ap.add_argument("--requests", type=int, default=8)
+    ap.add_argument("--max-new", type=int, default=48)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--int8", action="store_true",
+                    help="weight-only int8 decode (quantize_params)")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import llama
+    from paddle_tpu.serving import LLMEngine
+
+    cfg = llama.LlamaConfig(
+        vocab_size=args.vocab, hidden_size=args.hidden,
+        intermediate_size=args.hidden * 2, num_layers=args.layers,
+        num_heads=args.heads, num_kv_heads=args.kv_heads,
+        head_dim=args.hidden // args.heads, max_seq_len=args.max_len,
+        remat=False, use_flash=False)
+    params = jax.jit(lambda k: jax.tree_util.tree_map(
+        lambda p: p.astype(jnp.bfloat16),
+        llama.init_params(cfg, k)))(jax.random.PRNGKey(0))
+    if args.int8:
+        params = jax.jit(llama.quantize_params)(params)
+        print("int8 weight-only decode enabled")
+
+    eng = LLMEngine(params, cfg, max_slots=args.slots,
+                    block_size=args.block_size, max_model_len=args.max_len)
+    rng = np.random.default_rng(0)
+    lens = rng.integers(4, args.max_len - args.max_new,
+                        size=args.requests)
+    ids = [eng.add_request(rng.integers(1, args.vocab, size=n).tolist(),
+                           max_new_tokens=args.max_new,
+                           temperature=args.temperature)
+           for n in lens]
+    print(f"{args.requests} requests (prompt lens {lens.tolist()}) on "
+          f"{args.slots} slots, pool {eng.nb - 1} blocks × "
+          f"{args.block_size} tokens")
+
+    t0 = time.perf_counter()
+    n_tokens = 0
+    steps = 0
+    while eng.has_work():
+        emitted = eng.step()
+        n_tokens += len(emitted)
+        steps += 1
+    dt = time.perf_counter() - t0
+    results = eng.results
+    for rid in ids:
+        toks = results[rid]
+        print(f"  req {rid}: {len(toks)} tokens  head={toks[:8]}")
+    print(f"{n_tokens} tokens in {steps} engine steps, {dt:.2f}s "
+          f"→ {n_tokens / dt:.0f} tok/s aggregate")
+
+
+if __name__ == "__main__":
+    main()
