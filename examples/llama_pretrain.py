@@ -59,9 +59,33 @@ def main():
                     choices=["adamw", "adafactor"])
     ap.add_argument("--bf16-params", action="store_true",
                     help="bf16 parameter memory mode (fits 2.6b on 16GB)")
+    ap.add_argument("--layerwise", action="store_true",
+                    help="layer-wise optimizer-in-backward: no full grad "
+                         "tree ever exists (fits 4b on one 16GB chip; "
+                         "single-device, adafactor)")
     args = ap.parse_args()
 
     cfg = SIZES[args.size]()
+    if args.layerwise:
+        from paddle_tpu.optimizer.offload import (
+            init_layerwise_train_state, make_layerwise_train_step)
+        seq = args.seq or cfg.max_seq_len
+        state = init_layerwise_train_state(cfg, jax.random.PRNGKey(0))
+        step = make_layerwise_train_step(cfg)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (args.batch_size, seq + 1), 0,
+            cfg.vocab_size)
+        state, loss = step(state, tokens)   # compile + first step
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            state, loss = step(state, tokens)
+        print(f"loss {float(loss):.4f}")
+        dt = time.perf_counter() - t0
+        tps = args.batch_size * seq * args.steps / dt
+        print(f"{tps:,.0f} tokens/s (layer-wise optimizer-in-backward)")
+        return
+
     if args.microbatches > 0:
         cfg = dataclasses.replace(cfg, pipeline_microbatches=args.microbatches,
                                   pipeline_schedule="1f1b")

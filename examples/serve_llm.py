@@ -36,6 +36,10 @@ def main():
     ap.add_argument("--requests", type=int, default=8)
     ap.add_argument("--max-new", type=int, default=48)
     ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--decode-steps", type=int, default=1,
+                    help="decode iterations fused per device call "
+                         "(8-16 amortizes the host round-trip on "
+                         "remote chips)")
     ap.add_argument("--int8", action="store_true",
                     help="weight-only int8 decode (quantize_params)")
     args = ap.parse_args()
@@ -60,7 +64,8 @@ def main():
         print("int8 weight-only decode enabled")
 
     eng = LLMEngine(params, cfg, max_slots=args.slots,
-                    block_size=args.block_size, max_model_len=args.max_len)
+                    block_size=args.block_size, max_model_len=args.max_len,
+                    decode_steps=args.decode_steps)
     rng = np.random.default_rng(0)
     lens = rng.integers(4, args.max_len - args.max_new,
                         size=args.requests)

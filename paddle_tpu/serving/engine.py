@@ -143,14 +143,14 @@ def _paged_prefill(params, tokens, blk_ids, true_len, k_pool, v_pool,
     return logits, k_pool, v_pool
 
 
-def _paged_decode(params, last_tokens, lengths, active, block_table,
-                  k_pool, v_pool, temps, top_ks, top_ps, key,
-                  *, config: LlamaConfig):
+def _decode_core(params, last_tokens, lengths, active, block_table,
+                 k_pool, v_pool, temps, top_ks, top_ps, key,
+                 *, config: LlamaConfig):
     """One decode step for ALL slots.
 
     last_tokens/lengths/active: [N]; block_table: [N, MB];
     pools: [L, NB, bs, Hkv, D]. Inactive slots write K/V to the reserved
-    trash block 0 and their sampled token is ignored by the host.
+    trash block 0 and their sampled token is ignored.
     Returns (next_tokens [N], k_pool, v_pool).
     """
     c = config
@@ -211,6 +211,47 @@ def _paged_decode(params, last_tokens, lengths, active, block_table,
     return nxt, k_pool, v_pool
 
 
+def _paged_decode(params, last_tokens, lengths, budgets, key, active,
+                  block_table, k_pool, v_pool, temps, top_ks, top_ps,
+                  eos_ids, *, config: LlamaConfig, n_steps: int):
+    """``n_steps`` decode iterations in ONE compiled program (multi-step
+    scheduling): the host loop syncs once per call instead of once per
+    token — through a remote-attached chip the per-step d2h round-trip
+    costs ~10x the decode math itself. Slots that hit their eos or budget
+    mid-scan flip to done (their K/V writes divert to the trash block and
+    their emitted entries read -1).
+
+    The (last, lengths, budgets, key) quartet is a device-resident carry:
+    the engine feeds each call the previous call's outputs untouched while
+    the slot composition is unchanged, so steady-state decode performs no
+    h2d transfers at all.
+
+    eos_ids: [N] (-1 = no eos); budgets: [N] tokens each slot may still
+    emit. Returns (emitted [n_steps, N] int32 with -1 padding, last,
+    lengths, budgets, key, k_pool, v_pool).
+    """
+    def body(carry, _):
+        last, lens, done, rem, kp, vp, k = carry
+        k, sub = jax.random.split(k)
+        act = active & ~done
+        nxt, kp, vp = _decode_core(params, last, lens, act, block_table,
+                                   kp, vp, temps, top_ks, top_ps, sub,
+                                   config=config)
+        emitted = jnp.where(act, nxt, -1)
+        lens = lens + act.astype(lens.dtype)
+        rem = rem - act.astype(rem.dtype)
+        done = done | (act & (eos_ids >= 0) & (nxt == eos_ids)) \
+            | (act & (rem <= 0))
+        last = jnp.where(act, nxt, last)
+        return (last, lens, done, rem, kp, vp, k), emitted
+
+    init = (last_tokens, lengths, jnp.zeros_like(active), budgets,
+            k_pool, v_pool, key)
+    (last_tokens, lengths, _, budgets, k_pool, v_pool, key), emitted = \
+        jax.lax.scan(body, init, None, length=n_steps)
+    return emitted, last_tokens, lengths, budgets, key, k_pool, v_pool
+
+
 # ---------------------------------------------------------------------------
 # host engine
 # ---------------------------------------------------------------------------
@@ -228,7 +269,18 @@ class LLMEngine:
     def __init__(self, params, config: LlamaConfig, max_slots: int = 4,
                  block_size: int = 16, max_model_len: int = 512,
                  num_blocks: Optional[int] = None,
-                 prompt_buckets: Optional[List[int]] = None, seed: int = 0):
+                 prompt_buckets: Optional[List[int]] = None, seed: int = 0,
+                 mesh=None, decode_steps: int = 1):
+        """``mesh``: an optional jax Mesh with a 'tp' axis — weights take
+        the model's Megatron shardings (llama.param_specs), the KV pools
+        shard their kv-head dim over 'tp', and GSPMD inserts the serving
+        collectives (the reference's multi-GPU serving via mp_degree).
+
+        ``decode_steps``: decode iterations fused into one compiled call
+        (multi-step scheduling). 1 = a host sync per token (exact
+        admission granularity); 8-16 amortizes the host/tunnel round-trip
+        ~an order of magnitude on remote-attached chips — admission and
+        slot reclamation then happen every K tokens."""
         c = config
         assert max_model_len % block_size == 0
         self.params = params
@@ -256,6 +308,26 @@ class LLMEngine:
                       c.head_dim)
         self.k_pool = jnp.zeros(pool_shape, c.dtype)
         self.v_pool = jnp.zeros(pool_shape, c.dtype)
+        self.mesh = mesh
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            from ..models import llama as _llama
+
+            tp = dict(mesh.shape).get("tp", 1)
+            if c.num_kv_heads % max(tp, 1):
+                raise ValueError(
+                    f"tp={tp} must divide num_kv_heads={c.num_kv_heads}")
+            if isinstance(params["layers"].get("wq"), dict):
+                raise NotImplementedError(
+                    "tp-sharded serving of int8 weight-only params is not "
+                    "wired yet — pass dense (bf16) params with a mesh")
+            self.params = params = jax.device_put(
+                params, _llama.make_shardings(c, mesh, fsdp=False))
+            pool_sh = NamedSharding(mesh, P(None, None, None, "tp", None))
+            self.k_pool = jax.device_put(self.k_pool, pool_sh)
+            self.v_pool = jax.device_put(self.v_pool, pool_sh)
         self.free_blocks = deque(range(1, self.nb))
         self.table = np.zeros((self.N, self.mb), np.int32)
         self.n_alloc = np.zeros(self.N, np.int64)  # backed logical blocks
@@ -268,9 +340,16 @@ class LLMEngine:
         self._next_id = 0
         self._key = jax.random.PRNGKey(seed)
         self._prefill = {}
+        self.decode_steps = max(1, int(decode_steps))
         self._decode = jax.jit(
-            functools.partial(_paged_decode, config=config),
-            donate_argnums=(5, 6))
+            functools.partial(_paged_decode, config=config,
+                              n_steps=self.decode_steps),
+            donate_argnums=(7, 8))
+        # device-resident decode carry (last/lengths/budgets/key) + static
+        # per-slot vectors; rebuilt only when slot composition changes
+        self._carry = None
+        self._slot_vecs = None
+        self._slots_dirty = True
         self._table_dirty = True
         self._table_dev = None
 
@@ -329,6 +408,7 @@ class LLMEngine:
         out = self.slot_out[slot]
         self.slot_out[slot] = []
         self._table_dirty = True
+        self._slots_dirty = True
         if requeue and req is not None:
             # recompute-preemption: carry generated tokens so re-admission
             # prefills prompt+generated — streamed tokens stay valid and
@@ -375,6 +455,7 @@ class LLMEngine:
             self.slot_req[slot] = req
             self.admit_order.append(slot)
             self._table_dirty = True
+            self._slots_dirty = True
             # sample the first generated token from the prefill logits
             self._key, sub = jax.random.split(self._key)
             tok = int(_sample_rows(
@@ -399,16 +480,23 @@ class LLMEngine:
         return done
 
     def _ensure_backed(self, slot: int) -> bool:
-        """Make sure the block for this slot's next write position exists.
-        Returns False if the pool is exhausted (caller preempts)."""
-        need_blk = int(self.lengths[slot]) // self.bs
-        if need_blk < int(self.n_alloc[slot]):
-            return True
-        if not self.free_blocks:
-            return False
-        self.table[slot, need_blk] = self.free_blocks.popleft()
-        self.n_alloc[slot] = need_blk + 1
-        self._table_dirty = True
+        """Back every block this slot's next ``decode_steps`` writes can
+        touch (clamped to its remaining token budget — a near-finished slot
+        must not reserve blocks it can never write). Returns False if the
+        pool is exhausted (caller preempts)."""
+        req = self.slot_req[slot]
+        remaining = req.max_new_tokens - len(req.generated) \
+            - len(self.slot_out[slot])
+        steps = max(1, min(self.decode_steps, remaining))
+        horizon = int(self.lengths[slot]) + steps - 1
+        last_blk = min(horizon, self.max_model_len - 1) // self.bs
+        while int(self.n_alloc[slot]) <= last_blk:
+            if not self.free_blocks:
+                return False
+            self.table[slot, int(self.n_alloc[slot])] = \
+                self.free_blocks.popleft()
+            self.n_alloc[slot] += 1
+            self._table_dirty = True
         return True
 
     def step(self):
@@ -441,35 +529,54 @@ class LLMEngine:
         if not active_slots:
             return emitted
 
-        last = np.zeros(self.N, np.int32)
-        temps = np.zeros(self.N, np.float32)
-        top_ks = np.zeros(self.N, np.int32)
-        top_ps = np.ones(self.N, np.float32)
-        active = np.zeros(self.N, bool)
-        for i in active_slots:
-            req = self.slot_req[i]
-            last[i] = self.slot_out[i][-1] if self.slot_out[i] else \
-                req.prompt[-1]
-            temps[i] = req.temperature
-            top_ks[i] = req.top_k
-            top_ps[i] = req.top_p
-            active[i] = True
+        if self._slots_dirty or self._carry is None:
+            last = np.zeros(self.N, np.int32)
+            temps = np.zeros(self.N, np.float32)
+            top_ks = np.zeros(self.N, np.int32)
+            top_ps = np.ones(self.N, np.float32)
+            eos_ids = np.full(self.N, -1, np.int32)
+            budgets = np.zeros(self.N, np.int32)
+            active = np.zeros(self.N, bool)
+            for i in active_slots:
+                req = self.slot_req[i]
+                last[i] = self.slot_out[i][-1] if self.slot_out[i] else \
+                    req.prompt[-1]
+                temps[i] = req.temperature
+                top_ks[i] = req.top_k
+                top_ps[i] = req.top_p
+                if req.eos_token_id is not None:
+                    eos_ids[i] = req.eos_token_id
+                budgets[i] = req.max_new_tokens - len(req.generated) \
+                    - len(self.slot_out[i])
+                active[i] = True
+            self._key, sub = jax.random.split(self._key)
+            self._carry = (jnp.asarray(last),
+                           jnp.asarray(self.lengths, jnp.int32),
+                           jnp.asarray(budgets), sub)
+            self._slot_vecs = (jnp.asarray(active), jnp.asarray(temps),
+                               jnp.asarray(top_ks), jnp.asarray(top_ps),
+                               jnp.asarray(eos_ids))
+            self._slots_dirty = False
 
         if self._table_dirty or self._table_dev is None:
             self._table_dev = jnp.asarray(self.table)
             self._table_dirty = False
-        self._key, sub = jax.random.split(self._key)
-        nxt, self.k_pool, self.v_pool = self._decode(
-            self.params, jnp.asarray(last),
-            jnp.asarray(self.lengths, jnp.int32), jnp.asarray(active),
-            self._table_dev, self.k_pool, self.v_pool,
-            jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
-            sub)
-        nxt_host = np.asarray(jax.device_get(nxt))
+        c_last, c_len, c_bud, c_key = self._carry
+        v_act, v_t, v_k, v_p, v_eos = self._slot_vecs
+        toks, c_last, c_len, c_bud, c_key, self.k_pool, self.v_pool = \
+            self._decode(self.params, c_last, c_len, c_bud, c_key, v_act,
+                         self._table_dev, self.k_pool, self.v_pool,
+                         v_t, v_k, v_p, v_eos)
+        self._carry = (c_last, c_len, c_bud, c_key)
+        toks_host = np.asarray(jax.device_get(toks))    # [K, N], -1 pad
         for i in active_slots:
-            self.lengths[i] += 1           # the token just appended
             rid = self.slot_req[i].req_id
-            tok = int(nxt_host[i])
-            emitted.append((rid, tok))
-            self._emit(i, tok)
+            for k in range(toks_host.shape[0]):
+                tok = int(toks_host[k, i])
+                if tok < 0:
+                    break          # slot went done mid-scan
+                self.lengths[i] += 1        # its K/V was appended
+                emitted.append((rid, tok))
+                if self._emit(i, tok):
+                    break          # freed: later entries are -1 anyway
         return emitted

@@ -159,6 +159,72 @@ def test_oversized_prompt_rejected_at_submission(model):
         eng.add_request(list(range(62)), max_new_tokens=4)
 
 
+def test_multistep_decode_matches_single_step(model):
+    """decode_steps=K fuses K decode iterations into one device call
+    (multi-step scheduling); greedy outputs must equal the step-by-step
+    engine, including EOS and budget stops landing mid-scan."""
+    cfg, params = model
+    rng = np.random.default_rng(9)
+    prompts = [rng.integers(1, 64, size=n).tolist() for n in (3, 10, 18)]
+    ref = LLMEngine(params, cfg, max_slots=2, block_size=8,
+                    max_model_len=64, prompt_buckets=[8, 32])
+    multi = LLMEngine(params, cfg, max_slots=2, block_size=8,
+                      max_model_len=64, prompt_buckets=[8, 32],
+                      decode_steps=4)
+    ids_r = [ref.add_request(p, max_new_tokens=7) for p in prompts]
+    ids_m = [multi.add_request(p, max_new_tokens=7) for p in prompts]
+    out_r = ref.run()
+    out_m = multi.run()
+    for a, b in zip(ids_r, ids_m):
+        assert out_r[a] == out_m[b], (out_r[a], out_m[b])
+    # eos mid-scan
+    ref_toks = out_r[ids_r[1]]
+    j = next((i for i in range(1, len(ref_toks))
+              if ref_toks[i] not in ref_toks[:i]), None)
+    if j is not None:
+        eng = LLMEngine(params, cfg, max_slots=2, block_size=8,
+                        max_model_len=64, prompt_buckets=[8, 32],
+                        decode_steps=4)
+        rid = eng.add_request(prompts[1], max_new_tokens=7,
+                              eos_token_id=ref_toks[j])
+        assert eng.run()[rid] == ref_toks[:j + 1]
+
+
+def test_multistep_horizon_clamped_to_budget(model):
+    """A near-finished slot must not reserve blocks beyond its remaining
+    budget: decode_steps=16 on a tight pool where the last tokens fit the
+    already-backed block must complete, not raise/preempt."""
+    cfg, params = model
+    eng = LLMEngine(params, cfg, max_slots=1, block_size=16,
+                    max_model_len=64, num_blocks=1, prompt_buckets=[16],
+                    decode_steps=16)
+    rid = eng.add_request(list(range(1, 11)), max_new_tokens=5)
+    out = eng.run()[rid]     # positions 10-14 all live in block 0
+    assert len(out) == 5
+    ref = _dense_reference(params, cfg, list(range(1, 11)), 5)
+    assert out == ref
+
+
+def test_tp_sharded_engine_matches_dense(model):
+    """Serving over a 'tp' mesh: weights take Megatron shardings, KV pools
+    shard kv-heads, GSPMD inserts the collectives — tokens must equal the
+    unsharded engine/dense path (reference: multi-GPU serving, mp_degree)."""
+    import jax
+    from jax.sharding import Mesh
+
+    cfg, params = model
+    devs = np.asarray(jax.devices()[:2])
+    mesh = Mesh(devs, ("tp",))
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(1, 64, size=n).tolist() for n in (4, 11)]
+    eng = LLMEngine(params, cfg, max_slots=2, block_size=8,
+                    max_model_len=64, prompt_buckets=[8, 32], mesh=mesh)
+    ids = [eng.add_request(p, max_new_tokens=6) for p in prompts]
+    results = eng.run()
+    for rid, p in zip(ids, prompts):
+        assert results[rid] == _dense_reference(params, cfg, p, 6), rid
+
+
 def test_per_request_sampling_knobs_no_retrace(model):
     cfg, params = model
     rng = np.random.default_rng(4)
