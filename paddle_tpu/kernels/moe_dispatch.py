@@ -58,12 +58,48 @@ def sort_by_expert(idx: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
     return order, tok, flat_e
 
 
+def _gmm_tiling(m: int, k: int, n: int):
+    """Tiling for the Mosaic grouped matmul: whole-K tiles and the largest
+    N tile that fits scoped VMEM with the kernel's double buffering
+    (measured on v5e: (256, K, N) runs ~2x ragged_dot's utilization at MoE
+    shapes; the 512-cubed default loses to N%512 != 0 padding)."""
+    tm = 256 if m % 256 == 0 else (128 if m % 128 == 0 else None)
+    if tm is None or k % 128 or n % 128:
+        return None     # odd shapes: let ragged_dot take them
+
+    def fits(tk, tn):  # double-buffered bf16 inputs + f32 accumulator
+        return 2 * 2 * (tm * tk + tk * tn) + 4 * tm * tn \
+            <= 11 * 1024 * 1024
+
+    for tn in [t for t in range(n, 127, -128) if n % t == 0]:
+        if fits(k, tn):
+            return (tm, k, tn)
+    return (tm, min(k, 512), min(n, 512))
+
+
+def grouped_matmul(xs, w, gs):
+    """[m, k] @ per-group [E, k, n] over expert-sorted rows. On TPU this is
+    the Mosaic block-sparse grouped matmul (MegaBlocks-style: only row
+    blocks that exist are computed — the analogue of the reference's
+    cutlass moe_gemm); elsewhere jax.lax.ragged_dot."""
+    m, k = xs.shape
+    n = w.shape[-1]
+    if jax.default_backend() == "tpu":
+        tiling = _gmm_tiling(m, k, n)
+        if tiling is not None:
+            from jax.experimental.pallas.ops.tpu.megablox import gmm
+
+            return gmm(xs, w, gs, preferred_element_type=xs.dtype,
+                       tiling=tiling)
+    return jax.lax.ragged_dot(xs, w, gs)
+
+
 def _expert_ffn(xs, gs, e_gate, e_up, e_down, dt):
     """Grouped-GEMM SwiGLU over expert-sorted rows (rows ≥ sum(gs) are
     don't-care — the caller masks their combine weight to zero)."""
-    gate = jax.nn.silu(jax.lax.ragged_dot(xs, e_gate.astype(dt), gs))
-    up = jax.lax.ragged_dot(xs, e_up.astype(dt), gs)
-    return jax.lax.ragged_dot(gate * up, e_down.astype(dt), gs)
+    gate = jax.nn.silu(grouped_matmul(xs, e_gate.astype(dt), gs))
+    up = grouped_matmul(xs, e_up.astype(dt), gs)
+    return grouped_matmul(gate * up, e_down.astype(dt), gs)
 
 
 def dropless_moe_ffn(x, weights, idx, e_gate, e_up, e_down):
