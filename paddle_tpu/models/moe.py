@@ -363,8 +363,12 @@ def hidden_states_with_aux(params, tokens, config: MoEConfig):
                 inner = jax.checkpoint(
                     fn, policy=jax.checkpoint_policies.
                     save_only_these_names("attn_out", "routed_out"))
-            else:
+            elif c.remat_policy == "full":
                 inner = jax.checkpoint(fn)
+            else:
+                raise ValueError(
+                    f"MoEConfig.remat_policy={c.remat_policy!r}: expected "
+                    "'full' or 'outs'")
             return lambda carry, lp: (inner(carry, lp), None)
         return body
 

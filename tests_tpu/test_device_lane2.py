@@ -248,3 +248,38 @@ def test_grouped_matmul_matches_ragged_dot_on_chip():
         v = np.asarray(v, np.float32)
         denom = np.abs(v).max() + 1e-6
         assert np.abs(u - v).max() / denom < 2e-2, np.abs(u - v).max()
+
+
+def test_grouped_matmul_zeroes_tail_rows_on_chip():
+    """sum(gs) < m (the EP-local shape: foreign assignments sort to the
+    tail): rows past the last group must be ZEROS like ragged_dot's, not
+    uninitialized Pallas output memory — in the value AND in the lhs grad
+    (the take-vjp scatter-add would mix garbage into real token grads)."""
+    from paddle_tpu.kernels.moe_dispatch import grouped_matmul
+
+    m, k, n, E = 1024, 256, 384, 8
+    ks = jax.random.split(jax.random.PRNGKey(1), 2)
+    x = jax.random.normal(ks[0], (m, k), jnp.bfloat16)
+    w = jax.random.normal(ks[1], (E, k, n), jnp.bfloat16)
+    gs = jnp.asarray([100, 0, 300, 1, 128, 16, 64, 32], jnp.int32)
+    valid = int(gs.sum())
+    assert valid < m
+
+    a = jax.jit(lambda x, w: grouped_matmul(x, w, gs))(x, w)
+    b = jax.jit(lambda x, w: jax.lax.ragged_dot(x, w, gs))(x, w)
+    np.testing.assert_array_equal(np.asarray(a[valid:], np.float32), 0.0)
+    np.testing.assert_array_equal(
+        np.asarray(a, np.float32)[:valid], np.asarray(b, np.float32)[:valid])
+
+    # full-array loss (no valid-slice): tail cotangents flow through both
+    def loss(f):
+        return lambda x, w: jnp.sum(f(x, w, gs).astype(jnp.float32) ** 2)
+
+    g1 = jax.jit(jax.grad(loss(grouped_matmul), argnums=(0, 1)))(x, w)
+    g2 = jax.jit(jax.grad(loss(jax.lax.ragged_dot), argnums=(0, 1)))(x, w)
+    np.testing.assert_array_equal(np.asarray(g1[0][valid:], np.float32), 0.0)
+    for u, v in zip(g1, g2):
+        u = np.asarray(u, np.float32)
+        v = np.asarray(v, np.float32)
+        denom = np.abs(v).max() + 1e-6
+        assert np.abs(u - v).max() / denom < 2e-2, np.abs(u - v).max()
