@@ -1,0 +1,82 @@
+"""Parameter-server recommender training (SURVEY D19 capability).
+
+A CTR-style model whose embedding table lives on host parameter servers
+(unbounded vocabulary — only touched ids materialize), while the chip does
+the dense math. One process runs with role PSERVER (table service), the
+rest as TRAINER (reference workflow: fleet.init(role) → run_server() /
+init_worker(), the_one_ps.py).
+
+Single-process demo (server on a thread):
+    python examples/ps_recommender.py
+Two-role demo:
+    TRAINING_ROLE=PSERVER PADDLE_PORT=8500 python examples/ps_recommender.py
+    TRAINING_ROLE=TRAINER PADDLE_PSERVERS_IP_PORT_LIST=127.0.0.1:8500 \
+        python examples/ps_recommender.py
+"""
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.distributed import fleet as fm
+from paddle_tpu.distributed import ps
+
+DIM, SLOTS, BATCH, STEPS = 16, 8, 256, 60
+TABLES = [{"table_id": 0, "type": "sparse", "dim": DIM,
+           "optimizer": "adagrad", "lr": 0.05}]
+
+
+def run_server():
+    fm.fleet.init(fm.PaddleCloudRoleMaker(is_collective=False),
+                  is_collective=False)
+    fm.fleet.init_server(tables=TABLES)
+    print(f"ps server on port {fm.fleet._ps_server.port}", flush=True)
+    fm.fleet.run_server()
+
+
+def run_trainer(endpoints=None):
+    client = fm.fleet.init_worker(endpoints)
+    emb = ps.DistributedEmbedding(client, table_id=0, dim=DIM, pad_to=512)
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((SLOTS * DIM,)) * 0.1,
+                    jnp.float32)
+
+    @jax.jit
+    def step(rows, inv, y, w):
+        def loss_fn(rows, w):
+            x = rows[inv].reshape(BATCH, SLOTS * DIM)
+            logit = x @ w
+            return jnp.mean(jnp.maximum(logit, 0) - logit * y
+                            + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+        loss, (d_rows, d_w) = jax.value_and_grad(loss_fn, (0, 1))(rows, w)
+        return loss, d_rows, w - 0.05 * d_w
+
+    for i in range(STEPS):
+        ids = rng.zipf(1.5, size=(BATCH, SLOTS)) % 100_000  # power-law ids
+        y = jnp.asarray((ids[:, 0] % 2).astype(np.float32))
+        rows, uniq, inv = emb.pull(ids)
+        loss, d_rows, w = step(jnp.asarray(rows), jnp.asarray(inv), y, w)
+        emb.push(uniq, np.asarray(d_rows))
+        if i % 20 == 0 or i == STEPS - 1:
+            print(f"step {i:3d} loss {float(loss):.4f} "
+                  f"table_rows {client.stats()[0]}", flush=True)
+
+
+def main():
+    role = os.environ.get("TRAINING_ROLE", "").upper()
+    if role == "PSERVER":
+        run_server()
+    elif role == "TRAINER":
+        run_trainer()
+        fm.fleet.stop_worker()
+    else:  # single-process demo
+        srv = fm.fleet.init_server(tables=TABLES, host="127.0.0.1",
+                                   port=0).start()
+        run_trainer([f"127.0.0.1:{srv.port}"])
+        srv.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -40,6 +40,7 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
         "(one process per host; chips are driven SPMD)")
 
 from . import rpc  # noqa: F401,E402
+from . import ps  # noqa: F401,E402
 from .store import TCPStore  # noqa: F401,E402
 from . import checkpoint  # noqa: F401,E402
 from .checkpoint import load_state_dict, save_state_dict  # noqa: F401,E402

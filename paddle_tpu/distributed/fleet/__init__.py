@@ -60,7 +60,18 @@ class _Fleet:
         self._user_defined_optimizer = None
 
     def init(self, role_maker=None, is_collective=True, strategy=None, log_level=0):
-        """parity: fleet.fleet.init (fleet.py:218)."""
+        """parity: fleet.fleet.init (fleet.py:218). With a PS-mode role
+        maker (is_collective=False), no collective env is initialized —
+        servers run the table service, workers connect a PSClient
+        (reference: the_one_ps.py TheOnePSRuntime)."""
+        self._role_maker = role_maker
+        if role_maker is not None and not getattr(
+                role_maker, "_is_collective", True):
+            is_collective = False
+        if not is_collective:
+            self._strategy = strategy or DistributedStrategy()
+            self._is_initialized = True
+            return self
         init_parallel_env()
         self._strategy = strategy or DistributedStrategy()
         hc = self._strategy.hybrid_configs
@@ -131,6 +142,71 @@ class _Fleet:
 
         return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
 
+    # -- parameter-server mode (reference: fleet.py init_server/run_server/
+    #    init_worker/stop_worker over the_one_ps.py) --------------------------
+    def is_server(self):
+        rm = getattr(self, "_role_maker", None)
+        return rm is not None and rm._is_server()
+
+    def is_worker(self):
+        rm = getattr(self, "_role_maker", None)
+        return rm is None or rm._is_worker()
+
+    def init_server(self, dirname=None, tables=None, host="0.0.0.0",
+                    port=None, shard_index=None):
+        """Create this process's PSServer and register its tables.
+        ``tables``: iterable of dicts — {"table_id", "type": "sparse"|
+        "dense", then SparseTable/DenseTable kwargs}. Port defaults to the
+        PADDLE_PORT env (the reference's server port contract).
+        ``dirname``: warm-start path saved by PSClient.save (reference:
+        fleet.init_server(dirname) loads the model before serving); this
+        server loads ``{dirname}.shard{shard_index}``, the index defaulting
+        to the PADDLE_PSERVER_ID env."""
+        import os
+
+        from ..ps import PSServer
+
+        if port is None:
+            port = int(os.environ.get("PADDLE_PORT", "0") or 0)
+        srv = PSServer(host=host, port=port)
+        for cfg in tables or []:
+            cfg = dict(cfg)
+            tid = cfg.pop("table_id")
+            kind = cfg.pop("type", "sparse")
+            if kind == "sparse":
+                srv.register_sparse_table(tid, **cfg)
+            elif kind == "dense":
+                srv.register_dense_table(tid, **cfg)
+            else:
+                raise ValueError(f"init_server: table type {kind!r}")
+        if dirname is not None:
+            if shard_index is None:
+                shard_index = int(os.environ.get("PADDLE_PSERVER_ID", "0"))
+            srv.load_local(f"{dirname}.shard{shard_index}")
+        self._ps_server = srv
+        return srv
+
+    def run_server(self):
+        """Blocking service loop (parity: fleet.run_server)."""
+        if getattr(self, "_ps_server", None) is None:
+            raise RuntimeError("fleet.run_server: call init_server first")
+        self._ps_server.run()
+
+    def init_worker(self, endpoints=None):
+        """Connect this trainer to the PS pool (parity: fleet.init_worker;
+        endpoints default to PADDLE_PSERVERS_IP_PORT_LIST)."""
+        from .. import ps
+
+        self._ps_client = ps.init_worker(endpoints)
+        return self._ps_client
+
+    def stop_worker(self):
+        """parity: fleet.stop_worker — workers signal servers to exit."""
+        client = getattr(self, "_ps_client", None)
+        if client is not None:
+            client.stop_servers()
+            self._ps_client = None
+
 
 def _spmd_world_size():
     import jax
@@ -190,8 +266,19 @@ class RoleMakerBase:
 
 class PaddleCloudRoleMaker(RoleMakerBase):
     """parity: fleet/base/role_maker.py PaddleCloudRoleMaker — roles from
-    the PADDLE_* env contract. Collective (TPU) jobs have workers only; the
-    PS roles exist for API compat (D19 documented skip)."""
+    the PADDLE_* env contract. Collective (TPU) jobs have workers only;
+    PS jobs set TRAINING_ROLE=PSERVER|TRAINER (+ PADDLE_PORT /
+    PADDLE_PSERVERS_IP_PORT_LIST) and route through distributed.ps.
+    Defaults is_collective=False like the reference (role_maker.py) — the
+    collective entry point passes is_collective=True explicitly."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        import os
+
+        super().__init__(is_collective, **kwargs)
+        role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        if not is_collective and role == "PSERVER":
+            self._role = Role.SERVER
 
 
 class UserDefinedRoleMaker(RoleMakerBase):

@@ -1,0 +1,118 @@
+"""Parameter-server training (the reference's PS mode, re-designed for TPU).
+
+Reference architecture (python/paddle/distributed/ps/the_one_ps.py over
+paddle/fluid/distributed/ps/ — brpc servers holding sparse/dense tables,
+trainers pulling rows and pushing gradients): the PS exists so that
+unbounded embedding tables (CTR/recommender vocabularies) never have to fit
+in accelerator memory.
+
+TPU-native re-design:
+
+* **Servers are host processes** (CPU, host RAM) holding sharded
+  ``SparseTable``/``DenseTable`` objects with server-side per-row
+  optimizers (``table.py``).
+* **Workers are the TPU processes.** Per step, OUTSIDE jit: pull the rows
+  the batch touches (deduped — a few KB); INSIDE jit: the dense math over
+  the pulled block on the MXU; OUTSIDE: push the per-row gradient block
+  back. ``DistributedEmbedding`` packages that pull/compute/push cycle.
+* Sharding is ``id % num_servers`` with client-side duplicate merging
+  (``service.py``), mirroring brpc_ps_client's request batching.
+
+Role wiring mirrors fleet PS mode (fleet.init(role) → is_server? →
+run_server() : init_worker(); reference fleet/base/role_maker.py):
+
+    srv = ps.PSServer(port=8500).register_sparse_table(0, dim=16)
+    srv.run()                                  # server process, blocking
+
+    client = ps.PSClient(["10.0.0.1:8500", "10.0.0.2:8500"])
+    emb = ps.DistributedEmbedding(client, table_id=0, dim=16)
+    rows, uniq, inv = emb.pull(batch_ids)      # host → device block
+    ...jit: loss, d_rows = train_step(rows[inv], ...)
+    emb.push(uniq, d_rows)                     # device block → servers
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from .table import DenseTable, SparseTable
+from .service import PSClient, PSServer
+
+__all__ = ["SparseTable", "DenseTable", "PSServer", "PSClient",
+           "DistributedEmbedding", "init_worker", "get_client",
+           "server_endpoints_from_env"]
+
+_client: Optional[PSClient] = None
+
+
+def server_endpoints_from_env() -> list:
+    """Reference env contract: PADDLE_PSERVERS_IP_PORT_LIST (comma list,
+    collective.py:126-241 analogue for PS jobs)."""
+    import os
+
+    raw = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+    return [e for e in raw.split(",") if e]
+
+
+def init_worker(endpoints: Optional[Sequence[str]] = None) -> PSClient:
+    """parity: fleet.init_worker() — connect this trainer to the server
+    pool. Endpoints default to the PADDLE_PSERVERS_IP_PORT_LIST env."""
+    global _client
+    _client = PSClient(list(endpoints or server_endpoints_from_env()))
+    return _client
+
+
+def get_client() -> PSClient:
+    if _client is None:
+        raise RuntimeError("paddle_tpu.distributed.ps: call init_worker() "
+                           "(or pass endpoints) before using the client")
+    return _client
+
+
+class DistributedEmbedding:
+    """The worker-side embedding view of one sparse table (parity:
+    paddle.static.nn.sparse_embedding + the pull/push the reference
+    generates around it).
+
+    The pull returns the deduped row block plus the inverse map — gather
+    ``rows[inv]`` INSIDE jit (static shapes: the block is [n_unique, dim]
+    per batch; pad n_unique to a bucket size with ``pad_to`` to avoid
+    retraces across batches)."""
+
+    def __init__(self, client: PSClient, table_id: int, dim: int,
+                 pad_to: int = 0):
+        self.client = client
+        self.table_id = table_id
+        self.dim = dim
+        self.pad_to = pad_to
+
+    def pull(self, ids) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """ids [any shape] → (rows [U, dim], uniq [U], inv [ids.size])
+        with U padded to the bucket size (padding rows are id -1 → zeros,
+        never pushed)."""
+        flat = np.asarray(ids, np.int64).reshape(-1)
+        if flat.size == 0:
+            n = max(self.pad_to, 0)
+            return (np.zeros((n, self.dim), np.float32),
+                    np.full((n,), -1, np.int64),
+                    np.zeros((0,), np.int64))
+        uniq, inv = np.unique(flat, return_inverse=True)
+        rows = self.client.pull_sparse(self.table_id, uniq)
+        if self.pad_to:
+            U = len(uniq)
+            bucket = -(-U // self.pad_to) * self.pad_to
+            if bucket > U:
+                rows = np.concatenate(
+                    [rows, np.zeros((bucket - U, self.dim), np.float32)])
+                uniq = np.concatenate(
+                    [uniq, np.full((bucket - U,), -1, np.int64)])
+        return rows, uniq, inv
+
+    def push(self, uniq, grad_rows) -> None:
+        """Push the gradient block from jit back to the servers (padding
+        rows, id -1, are dropped)."""
+        uniq = np.asarray(uniq, np.int64)
+        grad_rows = np.asarray(grad_rows, np.float32)
+        keep = uniq >= 0
+        self.client.push_sparse(self.table_id, uniq[keep], grad_rows[keep])

@@ -1,0 +1,249 @@
+"""Parameter-server service: PSServer / PSClient over pickle-TCP.
+
+Capability parity with the reference's brpc PS service
+(paddle/fluid/distributed/ps/service/brpc_ps_server.cc /
+brpc_ps_client.cc — PullSparse/PushSparse/PullDense/PushDense RPCs,
+table sharding across servers): ids are sharded ``id % num_servers``
+(the reference's default hash), each request batches one server's shard,
+and the client fans requests out on threads and reassembles row order.
+
+The transport is the framing helper of ``distributed.rpc`` with an 8-byte
+length prefix (row-block payloads; the rpc control plane keeps 4 bytes).
+The training data plane stays XLA collectives — PS traffic is only the few
+KB of embedding rows a batch touches.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import functools
+import pickle
+import socket
+import socketserver
+import threading
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from ..rpc import _recv_msg, _send_msg
+from .table import DenseTable, SparseTable
+
+__all__ = ["PSServer", "PSClient"]
+
+_send = functools.partial(_send_msg, fmt="<Q")
+_recv = functools.partial(_recv_msg, fmt="<Q")
+
+
+class PSServer:
+    """One parameter-server process/thread. Tables are registered by id;
+    every server in a job registers the same table ids (each holds its
+    shard of the id space)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._tables: Dict[int, object] = {}
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        req = _recv(self.request)
+                        _send(self.request, outer._dispatch(req))
+                except ConnectionError:
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread = None
+
+    # -- table registry ----------------------------------------------------
+    def register_sparse_table(self, table_id: int, dim: int, **kw):
+        self._tables[table_id] = SparseTable(dim, **kw)
+        return self
+
+    def register_dense_table(self, table_id: int, shape=None, init=None, **kw):
+        self._tables[table_id] = DenseTable(shape if shape is not None
+                                            else np.shape(init), init=init,
+                                            **kw)
+        return self
+
+    # -- service -----------------------------------------------------------
+    def _dispatch(self, req):
+        op, args = req[0], req[1:]
+        try:
+            with self._lock:
+                if op == "pull_sparse":
+                    tid, ids = args
+                    return (True, self._tables[tid].pull(ids))
+                if op == "push_sparse":
+                    tid, ids, grads = args
+                    self._tables[tid].push(ids, grads)
+                    return (True, None)
+                if op == "pull_dense":
+                    (tid,) = args
+                    return (True, self._tables[tid].pull())
+                if op == "push_dense":
+                    tid, grad = args
+                    self._tables[tid].push(grad)
+                    return (True, None)
+                if op == "save":
+                    (path,) = args
+                    with open(path, "wb") as f:
+                        pickle.dump({tid: t.state_dict()
+                                     for tid, t in self._tables.items()}, f)
+                    return (True, None)
+                if op == "load":
+                    (path,) = args
+                    with open(path, "rb") as f:
+                        state = pickle.load(f)
+                    for tid, s in state.items():
+                        self._tables[tid].load_state_dict(s)
+                    return (True, None)
+                if op == "stats":
+                    return (True, {tid: len(t) for tid, t in
+                                   self._tables.items()
+                                   if isinstance(t, SparseTable)})
+                if op == "stop":
+                    threading.Thread(target=self._server.shutdown,
+                                     daemon=True).start()
+                    return (True, None)
+                return (False, ValueError(f"unknown PS op {op!r}"))
+        except Exception as e:           # deliver server errors to caller
+            return (False, e)
+
+    def load_local(self, path: str) -> None:
+        """Load this server's shard file directly (warm start before
+        serving — fleet.init_server(dirname))."""
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        with self._lock:
+            for tid, s in state.items():
+                self._tables[tid].load_state_dict(s)
+
+    def start(self):
+        """Serve on a daemon thread (in-process server — tests, notebooks)."""
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def run(self):
+        """Serve on the calling thread until a client sends 'stop' (parity:
+        fleet.run_server() blocking loop)."""
+        self._server.serve_forever()
+
+    def stop(self):
+        self._server.shutdown()
+
+
+class _Conn:
+    """One persistent connection + lock (requests are serialized per
+    server; cross-server parallelism comes from the client's thread pool)."""
+
+    def __init__(self, endpoint: str, timeout: float):
+        host, port = endpoint.rsplit(":", 1)
+        self.sock = socket.create_connection((host, int(port)), timeout)
+        self.lock = threading.Lock()
+
+    def call(self, req):
+        with self.lock:
+            _send(self.sock, req)
+            ok, payload = _recv(self.sock)
+        if not ok:
+            raise payload
+        return payload
+
+
+class PSClient:
+    """Worker-side client: shards sparse ids over the server list, dedups
+    and pre-sums duplicate-id gradients (the reference's push merge), and
+    reassembles pulls into the caller's row order."""
+
+    def __init__(self, endpoints: Sequence[str], timeout: float = 60.0):
+        if not endpoints:
+            raise ValueError("PSClient: empty server endpoint list")
+        self._conns: List[_Conn] = [_Conn(e, timeout) for e in endpoints]
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max(4, len(self._conns)))
+
+    @property
+    def num_servers(self):
+        return len(self._conns)
+
+    def _shard(self, ids: np.ndarray):
+        return np.asarray(ids, np.int64) % self.num_servers
+
+    def pull_sparse(self, table_id: int, ids) -> np.ndarray:
+        """ids [n] (duplicates fine) → rows [n, dim]. n must be > 0 — the
+        row width is server-side state, so an empty pull has no shape
+        (DistributedEmbedding, which knows its dim, short-circuits this)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        if ids.size == 0:
+            raise ValueError("pull_sparse: empty id list (use "
+                             "DistributedEmbedding.pull for empty batches)")
+        shard = self._shard(ids)
+        futs = {}
+        for s in np.unique(shard):
+            sel = np.nonzero(shard == s)[0]
+            futs[int(s)] = (sel, self._pool.submit(
+                self._conns[int(s)].call,
+                ("pull_sparse", table_id, ids[sel])))
+        out = None
+        for s, (sel, fut) in futs.items():
+            rows = fut.result()
+            if out is None:
+                out = np.empty((len(ids), rows.shape[1]), np.float32)
+            out[sel] = rows
+        return out
+
+    def push_sparse(self, table_id: int, ids, grads) -> None:
+        """Sum-merge duplicate ids locally, then push each server's shard.
+        Empty id lists are a no-op (an all-padding batch)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        if ids.size == 0:
+            return
+        grads = np.asarray(grads, np.float32).reshape(len(ids), -1)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        merged = np.zeros((len(uniq), grads.shape[1]), np.float32)
+        np.add.at(merged, inv, grads)
+        shard = self._shard(uniq)
+        futs = [self._pool.submit(
+            self._conns[int(s)].call,
+            ("push_sparse", table_id, uniq[shard == s], merged[shard == s]))
+            for s in np.unique(shard)]
+        for f in futs:
+            f.result()
+
+    def pull_dense(self, table_id: int) -> np.ndarray:
+        return self._conns[table_id % self.num_servers].call(
+            ("pull_dense", table_id))
+
+    def push_dense(self, table_id: int, grad) -> None:
+        self._conns[table_id % self.num_servers].call(
+            ("push_dense", table_id, np.asarray(grad, np.float32)))
+
+    def save(self, path_prefix: str) -> None:
+        for i, c in enumerate(self._conns):
+            c.call(("save", f"{path_prefix}.shard{i}"))
+
+    def load(self, path_prefix: str) -> None:
+        for i, c in enumerate(self._conns):
+            c.call(("load", f"{path_prefix}.shard{i}"))
+
+    def stats(self) -> dict:
+        totals: Dict[int, int] = {}
+        for c in self._conns:
+            for tid, n in c.call(("stats",)).items():
+                totals[tid] = totals.get(tid, 0) + n
+        return totals
+
+    def stop_servers(self) -> None:
+        for c in self._conns:
+            try:
+                c.call(("stop",))
+            except ConnectionError:
+                pass

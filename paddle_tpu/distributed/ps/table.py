@@ -1,0 +1,167 @@
+"""Parameter-server tables: host-RAM parameter storage with per-row
+server-side optimizers.
+
+Capability parity with the reference's PS tables
+(paddle/fluid/distributed/ps/table/ — memory_sparse_table.cc,
+common_dense_table.cc; python config in
+python/paddle/distributed/ps/the_one_ps.py): a sparse table lazily creates
+rows on first access (the CTR-embedding pattern — vocabulary unbounded,
+only touched ids materialize), applies the optimizer on the server at push
+time, and supports save/load and shrink. The TPU re-design keeps tables in
+host RAM on CPU server processes; accelerator workers pull the few rows a
+batch touches and push back per-row gradients — the chip never holds the
+table.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+__all__ = ["SparseTable", "DenseTable"]
+
+
+def _make_optimizer(name: str, lr: float):
+    """Per-row update rules (reference: ps/table/sparse_sgd_rule.cc —
+    SparseNaiveSGDRule / SparseAdaGradSGDRule / SparseAdamSGDRule)."""
+    if name == "sgd":
+        def init_slots(row):
+            return ()
+
+        def update(row, grad, slots):
+            row -= lr * grad
+            return slots
+    elif name == "adagrad":
+        def init_slots(row):
+            return (np.zeros((), np.float32),)
+
+        def update(row, grad, slots):
+            (g2,) = slots
+            g2 = g2 + float(np.mean(grad * grad))
+            row -= lr * grad / np.sqrt(g2 + 1e-10)
+            return (g2,)
+    elif name == "adam":
+        b1, b2, eps = 0.9, 0.999, 1e-8
+
+        def init_slots(row):
+            return (np.zeros_like(row), np.zeros_like(row),
+                    np.zeros((), np.float32))
+
+        def update(row, grad, slots):
+            m, v, t = slots
+            t = t + 1.0
+            m[:] = b1 * m + (1 - b1) * grad
+            v[:] = b2 * v + (1 - b2) * grad * grad
+            mh = m / (1 - b1 ** t)
+            vh = v / (1 - b2 ** t)
+            row -= lr * mh / (np.sqrt(vh) + eps)
+            return (m, v, t)
+    else:
+        raise ValueError(f"SparseTable optimizer={name!r}: expected "
+                         "'sgd', 'adagrad', or 'adam'")
+    return init_slots, update
+
+
+class SparseTable:
+    """id → row store with lazy row init and a server-side optimizer.
+
+    parity: memory_sparse_table.cc pull_sparse/push_sparse semantics —
+    unseen ids initialize on first pull; push applies the optimizer (the
+    worker sends gradients, never raw values)."""
+
+    def __init__(self, dim: int, optimizer: str = "adagrad",
+                 lr: float = 0.05,
+                 initializer: Optional[Callable[[int, int], np.ndarray]] = None,
+                 seed: int = 0):
+        self.dim = dim
+        self._rows: Dict[int, np.ndarray] = {}
+        self._slots: Dict[int, tuple] = {}
+        self._touch: Dict[int, int] = {}     # push-count, for shrink()
+        self._init_slots, self._update = _make_optimizer(optimizer, lr)
+        self._optimizer = optimizer
+        self._lr = lr
+        self._seed = seed
+        self._initializer = initializer or self._default_init
+
+    def _default_init(self, key: int, dim: int) -> np.ndarray:
+        # deterministic per-id init so every server/restart agrees
+        rng = np.random.default_rng((self._seed << 32) ^ (key & 0xFFFFFFFF))
+        return (rng.standard_normal(dim) * 0.01).astype(np.float32)
+
+    def __len__(self):
+        return len(self._rows)
+
+    def _row(self, key: int) -> np.ndarray:
+        row = self._rows.get(key)
+        if row is None:
+            row = np.asarray(self._initializer(key, self.dim), np.float32)
+            self._rows[key] = row
+            self._slots[key] = self._init_slots(row)
+            self._touch[key] = 0
+        return row
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        out = np.empty((len(ids), self.dim), np.float32)
+        for i, key in enumerate(ids):
+            out[i] = self._row(int(key))
+        return out
+
+    def push(self, ids: np.ndarray, grads: np.ndarray) -> None:
+        """ids must be unique (the client dedups + pre-sums duplicates)."""
+        for key, grad in zip(ids, np.asarray(grads, np.float32)):
+            key = int(key)
+            row = self._row(key)
+            self._slots[key] = self._update(row, grad, self._slots[key])
+            self._touch[key] += 1
+
+    def shrink(self, min_pushes: int = 1) -> int:
+        """Drop rows pushed fewer than ``min_pushes`` times (reference:
+        memory_sparse_table.cc Shrink — evict stale CTR features). Returns
+        the number of evicted rows."""
+        dead = [k for k, c in self._touch.items() if c < min_pushes]
+        for k in dead:
+            del self._rows[k], self._slots[k], self._touch[k]
+        return len(dead)
+
+    def state_dict(self) -> dict:
+        return {"dim": self.dim, "optimizer": self._optimizer,
+                "lr": self._lr, "rows": dict(self._rows),
+                "slots": dict(self._slots), "touch": dict(self._touch)}
+
+    def load_state_dict(self, state: dict) -> None:
+        if state["dim"] != self.dim:
+            raise ValueError(f"SparseTable.load: dim {state['dim']} != "
+                             f"{self.dim}")
+        self._rows = dict(state["rows"])
+        self._slots = dict(state["slots"])
+        self._touch = dict(state["touch"])
+
+
+class DenseTable:
+    """Dense parameter block with a server-side optimizer (parity:
+    common_dense_table.cc — the PS-mode home of small dense params)."""
+
+    def __init__(self, shape, optimizer: str = "sgd", lr: float = 0.05,
+                 init: Optional[np.ndarray] = None):
+        self.value = (np.zeros(shape, np.float32) if init is None
+                      else np.asarray(init, np.float32).copy())
+        self._init_slots, self._update = _make_optimizer(optimizer, lr)
+        self._slots = self._init_slots(self.value.reshape(-1))
+        self._optimizer = optimizer
+
+    def pull(self) -> np.ndarray:
+        # copy under the caller's lock: the response is pickled after the
+        # server lock is released, and push_dense mutates value in place
+        return self.value.copy()
+
+    def push(self, grad: np.ndarray) -> None:
+        flat = self.value.reshape(-1)
+        self._slots = self._update(flat, np.asarray(grad, np.float32)
+                                   .reshape(-1), self._slots)
+
+    def state_dict(self) -> dict:
+        return {"value": self.value, "slots": self._slots}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.value = np.asarray(state["value"], np.float32).copy()
+        self._slots = state["slots"]

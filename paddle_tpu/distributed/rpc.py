@@ -34,26 +34,31 @@ class WorkerInfo:
 _state: Dict[str, Any] = {}
 
 
-def _send_msg(sock, obj):
+def _send_msg(sock, obj, fmt: str = "<I"):
+    """Length-prefixed pickle framing (shared with distributed.ps, which
+    passes fmt='<Q' for row-block payloads past 4 GiB)."""
     data = pickle.dumps(obj)
-    sock.sendall(struct.pack("<I", len(data)) + data)
+    sock.sendall(struct.pack(fmt, len(data)) + data)
 
 
-def _recv_msg(sock):
+def _recv_msg(sock, fmt: str = "<I"):
+    width = struct.calcsize(fmt)
     hdr = b""
-    while len(hdr) < 4:
-        c = sock.recv(4 - len(hdr))
+    while len(hdr) < width:
+        c = sock.recv(width - len(hdr))
         if not c:
             raise ConnectionError("closed")
         hdr += c
-    (n,) = struct.unpack("<I", hdr)
-    buf = b""
-    while len(buf) < n:
-        c = sock.recv(min(65536, n - len(buf)))
+    (n,) = struct.unpack(fmt, hdr)
+    chunks = []
+    got = 0
+    while got < n:
+        c = sock.recv(min(1 << 20, n - got))
         if not c:
             raise ConnectionError("closed")
-        buf += c
-    return pickle.loads(buf)
+        chunks.append(c)
+        got += len(c)
+    return pickle.loads(b"".join(chunks))
 
 
 class _Handler(socketserver.BaseRequestHandler):
