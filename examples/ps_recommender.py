@@ -70,6 +70,7 @@ def main():
         run_server()
     elif role == "TRAINER":
         run_trainer()
+        fm.fleet.shutdown_servers()   # sole trainer: tear the pool down too
         fm.fleet.stop_worker()
     else:  # single-process demo
         srv = fm.fleet.init_server(tables=TABLES, host="127.0.0.1",

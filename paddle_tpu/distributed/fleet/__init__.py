@@ -201,11 +201,22 @@ class _Fleet:
         return self._ps_client
 
     def stop_worker(self):
-        """parity: fleet.stop_worker — workers signal servers to exit."""
+        """parity: fleet.stop_worker — tear down THIS trainer's client.
+        Servers keep serving (other trainers may still be mid-epoch);
+        shutting the pool down is a separate, deliberate call
+        (shutdown_servers, typically from trainer 0 after a barrier)."""
+        self._ps_client = None
+
+    def shutdown_servers(self):
+        """Signal every parameter server to exit its serve loop. Call from
+        ONE trainer once all trainers are done."""
         client = getattr(self, "_ps_client", None)
-        if client is not None:
-            client.stop_servers()
-            self._ps_client = None
+        if client is None:
+            from .. import ps
+
+            client = ps.get_client()
+        client.stop_servers()
+        self._ps_client = None
 
 
 def _spmd_world_size():

@@ -66,6 +66,8 @@ class PSServer:
         return self
 
     def register_dense_table(self, table_id: int, shape=None, init=None, **kw):
+        if shape is None and init is None:
+            raise ValueError("register_dense_table: pass shape= or init=")
         self._tables[table_id] = DenseTable(shape if shape is not None
                                             else np.shape(init), init=init,
                                             **kw)

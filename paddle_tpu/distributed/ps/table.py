@@ -132,6 +132,11 @@ class SparseTable:
         if state["dim"] != self.dim:
             raise ValueError(f"SparseTable.load: dim {state['dim']} != "
                              f"{self.dim}")
+        if state["optimizer"] != self._optimizer:
+            raise ValueError(
+                f"SparseTable.load: checkpoint has optimizer="
+                f"{state['optimizer']!r} slot state, table is configured "
+                f"{self._optimizer!r}")
         self._rows = dict(state["rows"])
         self._slots = dict(state["slots"])
         self._touch = dict(state["touch"])

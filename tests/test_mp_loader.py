@@ -236,3 +236,42 @@ def test_utilization_probe_flags_input_bound_training():
         time.sleep(0.02)
     assert fast.last_epoch_stats["input_bound_frac"] < 0.5
     assert fast.last_epoch_stats["batches"] == 4
+
+
+class _GilBoundDataset(Dataset):
+    """Pure-python transform: holds the GIL the whole item, so thread
+    workers serialize while process workers parallelize (the reason the
+    reference uses real worker processes — io/dataloader/worker.py)."""
+
+    def __len__(self):
+        return 24
+
+    def __getitem__(self, i):
+        acc = 0
+        for j in range(150_000):
+            acc += j * j
+        return np.asarray([i, acc % 7], np.int64)
+
+
+@pytest.mark.skipif((os.cpu_count() or 1) < 4,
+                    reason="needs >=4 cores for process-pool speedup")
+def test_process_workers_beat_threads_on_gil_bound_transforms():
+    def epoch_time(mode):
+        loader = DataLoader(_GilBoundDataset(), batch_size=4, num_workers=4,
+                            worker_mode=mode, persistent_workers=True)
+        ids = []
+        for b in loader:          # warm epoch: pool spawn + first batches
+            pass
+        t0 = time.perf_counter()
+        for b in loader:
+            ids.append(np.asarray(b.numpy() if isinstance(b, Tensor)
+                                  else b)[:, 0])
+        dt = time.perf_counter() - t0
+        assert sorted(np.concatenate(ids).tolist()) == list(range(24))
+        return dt
+
+    t_thread = epoch_time("thread")
+    t_proc = epoch_time("process")
+    # 4 GIL-bound thread workers ≈ serial; 4 processes ≈ 4x. Assert a
+    # conservative margin so shared CI hosts don't flake.
+    assert t_proc < 0.75 * t_thread, (t_proc, t_thread)

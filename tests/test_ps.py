@@ -203,7 +203,25 @@ def test_fleet_ps_role_workflow(tmp_path):
     assert fleet.is_worker()
     client = fleet.init_worker([f"127.0.0.1:{srv.port}"])
     assert client.pull_sparse(0, np.array([3])).shape == (1, 2)
-    fleet.stop_worker()  # signals the server loop to exit
+    # stop_worker only drops THIS trainer's client — servers keep serving
+    # for other trainers (reference fleet.stop_worker semantics)
+    fleet.stop_worker()
+    probe = ps.PSClient([f"127.0.0.1:{srv.port}"])
+    assert probe.pull_sparse(0, np.array([4])).shape == (1, 2)
+    probe.stop_servers()
+
+
+def test_load_rejects_optimizer_mismatch(tmp_path):
+    t = ps.SparseTable(dim=2, optimizer="adagrad")
+    t.pull(np.array([0]))
+    t2 = ps.SparseTable(dim=2, optimizer="adam")
+    with pytest.raises(ValueError, match="optimizer"):
+        t2.load_state_dict(t.state_dict())
+
+
+def test_dense_registration_requires_shape_or_init():
+    with pytest.raises(ValueError, match="shape"):
+        ps.PSServer().register_dense_table(0)
 
 
 def test_multiprocess_server_worker(tmp_path):
