@@ -217,7 +217,7 @@ def bench_moe(dev, results):
         remat=True)
     opt = {"optimizer": "adafactor", "param_dtype": jnp.bfloat16}
     try:
-        tps = _time_train(moe, cfg, 8, 2048, opt)
+        tps = _time_train(moe, cfg, 8, 2048, opt, n_steps=10)
         mfu = moe.flops_per_token(cfg, 2048) * tps / _peak_flops(dev)
         n_total = moe.num_params(jax.eval_shape(
             lambda k: moe.init_params(cfg, k), jax.random.PRNGKey(0)))
@@ -236,6 +236,23 @@ def bench_moe(dev, results):
         _release()
 
 
+def _decode_cfg_2p6b():
+    """The 2.6B decode/serving model — ONE definition so bench_decode and
+    bench_serving stay the same model."""
+    from paddle_tpu.models import llama
+    return llama.LlamaConfig(
+        vocab_size=32768, hidden_size=3072, intermediate_size=8192,
+        num_layers=24, num_heads=24, num_kv_heads=8, head_dim=128,
+        max_seq_len=2048, remat=False, dtype=jnp.bfloat16)
+
+
+def _init_bf16_params(cfg):
+    from paddle_tpu.models import llama
+    return jax.jit(lambda k: jax.tree_util.tree_map(
+        lambda p: p.astype(jnp.bfloat16),
+        llama.init_params(cfg, k)))(jax.random.PRNGKey(0))
+
+
 def bench_decode(dev, results):
     """Decode throughput on the 2.6B config, bf16 vs int8 weight-only
     (models/llama.quantize_params — inline-dequant fused into the matmul).
@@ -246,10 +263,7 @@ def bench_decode(dev, results):
     if dev.platform == "cpu":
         return  # chip-only section
     import numpy as np
-    cfg = llama.LlamaConfig(
-        vocab_size=32768, hidden_size=3072, intermediate_size=8192,
-        num_layers=24, num_heads=24, num_kv_heads=8, head_dim=128,
-        max_seq_len=2048, remat=False, dtype=jnp.bfloat16)
+    cfg = _decode_cfg_2p6b()
     B, prompt_len, new = 8, 128, 128
 
     def run(params, tag, wbytes):
@@ -275,9 +289,7 @@ def bench_decode(dev, results):
         return tps
 
     try:
-        params = jax.jit(lambda k: jax.tree_util.tree_map(
-            lambda p: p.astype(jnp.bfloat16),
-            llama.init_params(cfg, k)))(jax.random.PRNGKey(0))
+        params = _init_bf16_params(cfg)
         n = llama.num_params(params)
         t_bf16 = run(params, "bf16", 2.0 * n)
         qp = jax.jit(llama.quantize_params)(params)
@@ -293,6 +305,63 @@ def bench_decode(dev, results):
         _release()
 
 
+def bench_serving(dev, results):
+    """Continuous-batching serving-engine throughput: mixed prompt lengths
+    through the paged-KV LLMEngine (slot admission, multi-step decode) —
+    the serving-layer number on top of bench_decode's fixed-batch loop.
+    vs_baseline uses the same weight-bandwidth roofline at full slot
+    occupancy as the decode metric."""
+    from paddle_tpu.models import llama
+    from paddle_tpu.serving import LLMEngine
+    if dev.platform == "cpu":
+        return  # chip-only section
+    import numpy as np
+    cfg = llama.LlamaConfig(
+        vocab_size=32768, hidden_size=3072, intermediate_size=8192,
+        num_layers=24, num_heads=24, num_kv_heads=8, head_dim=128,
+        max_seq_len=2048, remat=False, dtype=jnp.bfloat16)
+    SLOTS, NEW = 8, 128
+    try:
+        params = _init_bf16_params(cfg)
+        n = llama.num_params(params)
+        # decode_steps=64: one compiled call per 64 tokens/slot — measured
+        # +30% engine throughput over 16 on the tunnel-attached chip
+        # (admission granularity coarsens to 64, fine for throughput)
+        eng = LLMEngine(params, cfg, max_slots=SLOTS, block_size=64,
+                        max_model_len=1024,
+                        prompt_buckets=[128, 512, 1024], decode_steps=64)
+        rng = np.random.default_rng(0)
+        # warm: compile the touched prompt buckets + the decode program
+        for ln in (100, 400):
+            eng.add_request(rng.integers(1, 32768, size=ln).tolist(),
+                            max_new_tokens=17, temperature=0.0)
+        eng.run()
+        reqs = [rng.integers(1, 32768, size=int(ln)).tolist()
+                for ln in rng.integers(64, 512, size=2 * SLOTS)]
+        t0 = time.perf_counter()
+        rids = [eng.add_request(p, max_new_tokens=NEW, temperature=0.0)
+                for p in reqs]
+        out = eng.run()
+        dt = time.perf_counter() - t0
+        # engine.results is cumulative — count only the timed requests
+        gen = sum(len(out[r]) for r in rids)
+        tps = gen / dt
+        roofline = SLOTS * _hbm_bw(dev) / (2.0 * n)
+        results.append({
+            "metric": "llama-2.6b_serving_engine_tokens_per_sec",
+            "value": round(tps, 1),
+            "unit": "tokens/s",
+            "vs_baseline": round(tps / (0.40 * roofline), 4),
+            "requests": len(reqs),
+        })
+    except Exception as e:
+        results.append({"metric": "serving_bench_failed", "value": 0.0,
+                        "unit": "tokens/s", "vs_baseline": 0.0,
+                        "error": str(e)[:200]})
+    finally:
+        _release()
+
+
 def main():
     dev = jax.devices()[0]
     results = []
@@ -300,6 +369,7 @@ def main():
     bench_long_context(dev, results)
     bench_moe(dev, results)
     bench_decode(dev, results)
+    bench_serving(dev, results)
 
     headline = results[0]
     out = dict(headline)

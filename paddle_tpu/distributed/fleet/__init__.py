@@ -205,7 +205,10 @@ class _Fleet:
         Servers keep serving (other trainers may still be mid-epoch);
         shutting the pool down is a separate, deliberate call
         (shutdown_servers, typically from trainer 0 after a barrier)."""
+        from .. import ps
+
         self._ps_client = None
+        ps._client = None          # ps.get_client() must stop vending it
 
     def shutdown_servers(self):
         """Signal every parameter server to exit its serve loop. Call from

@@ -97,8 +97,9 @@ def init_rpc(name: str, rank: Optional[int] = None,
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
 
-    store.set(f"rpc/worker/{name}",
-              pickle.dumps(WorkerInfo(name, rank or 0, "127.0.0.1", sport)))
+    info = WorkerInfo(name, rank or 0, "127.0.0.1", sport)
+    store.set(f"rpc/worker/{name}", pickle.dumps(info))
+    store.set(f"rpc/rank/{rank or 0}", pickle.dumps(info))
     store.add("rpc/registered", 1)
 
     _state.update(dict(name=name, rank=rank or 0,
@@ -113,8 +114,11 @@ def get_worker_info(name: str) -> WorkerInfo:
 
 
 def get_all_worker_infos():
-    # best effort: workers register under known names only
-    return [get_worker_info(_state["name"])]
+    """parity: rpc.py get_all_worker_infos — every registered worker,
+    rank order (each init_rpc also registers under its rank key)."""
+    store = _state["store"]
+    return [pickle.loads(store.wait(f"rpc/rank/{r}"))
+            for r in range(_state["world_size"])]
 
 
 def rpc_sync(to: str, fn, args=(), kwargs=None, timeout=30.0):

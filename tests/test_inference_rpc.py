@@ -71,5 +71,8 @@ def test_rpc_roundtrip():
         assert fut.result(10) == 10
         with pytest.raises(ValueError, match="remote boom"):
             rpc.rpc_sync("worker0", _boom)
+        infos = rpc.get_all_worker_infos()
+        assert [w.name for w in infos] == ["worker0"]
+        assert rpc.get_current_worker_info().rank == 0
     finally:
         rpc.shutdown()
