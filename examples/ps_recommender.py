@@ -31,7 +31,11 @@ TABLES = [{"table_id": 0, "type": "sparse", "dim": DIM,
 def run_server():
     fm.fleet.init(fm.PaddleCloudRoleMaker(is_collective=False),
                   is_collective=False)
-    fm.fleet.init_server(tables=TABLES)
+    # init_server binds loopback by default (the PS wire format is pickle);
+    # multi-host jobs must bind the cluster interface explicitly — POD_IP is
+    # the launcher's this-host address in the reference env contract.
+    fm.fleet.init_server(tables=TABLES,
+                         host=os.environ.get("POD_IP", "127.0.0.1"))
     print(f"ps server on port {fm.fleet._ps_server.port}", flush=True)
     fm.fleet.run_server()
 

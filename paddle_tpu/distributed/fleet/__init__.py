@@ -152,12 +152,19 @@ class _Fleet:
         rm = getattr(self, "_role_maker", None)
         return rm is None or rm._is_worker()
 
-    def init_server(self, dirname=None, tables=None, host="0.0.0.0",
+    def init_server(self, dirname=None, tables=None, host="127.0.0.1",
                     port=None, shard_index=None):
         """Create this process's PSServer and register its tables.
         ``tables``: iterable of dicts — {"table_id", "type": "sparse"|
         "dense", then SparseTable/DenseTable kwargs}. Port defaults to the
         PADDLE_PORT env (the reference's server port contract).
+
+        SECURITY: the PS wire format is pickle — anyone who can reach the
+        port can execute code in the server process. The default bind is
+        loopback; to serve a real multi-host job pass the pod/cluster
+        interface address explicitly (e.g. ``host=os.environ["POD_IP"]``)
+        and ensure the port is reachable only inside the trusted cluster
+        network.
         ``dirname``: warm-start path saved by PSClient.save (reference:
         fleet.init_server(dirname) loads the model before serving); this
         server loads ``{dirname}.shard{shard_index}``, the index defaulting
@@ -207,19 +214,22 @@ class _Fleet:
         (shutdown_servers, typically from trainer 0 after a barrier)."""
         from .. import ps
 
+        client = getattr(self, "_ps_client", None)
+        if client is not None:
+            client.close()
         self._ps_client = None
         ps._client = None          # ps.get_client() must stop vending it
 
     def shutdown_servers(self):
         """Signal every parameter server to exit its serve loop. Call from
         ONE trainer once all trainers are done."""
-        client = getattr(self, "_ps_client", None)
-        if client is None:
-            from .. import ps
+        from .. import ps
 
-            client = ps.get_client()
+        client = getattr(self, "_ps_client", None) or ps.get_client()
         client.stop_servers()
+        client.close()
         self._ps_client = None
+        ps._client = None          # a closed client must not be vended
 
 
 def _spmd_world_size():

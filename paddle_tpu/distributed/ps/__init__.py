@@ -92,6 +92,11 @@ class DistributedEmbedding:
         with U padded to the bucket size (padding rows are id -1 → zeros,
         never pushed)."""
         flat = np.asarray(ids, np.int64).reshape(-1)
+        if flat.size and flat.min() < 0:
+            raise ValueError(
+                "DistributedEmbedding.pull: negative ids are reserved as "
+                "the padding sentinel (their gradients would be silently "
+                "dropped by push); remap real ids to >= 0")
         if flat.size == 0:
             n = max(self.pad_to, 0)
             return (np.zeros((n, self.dim), np.float32),

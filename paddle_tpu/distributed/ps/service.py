@@ -105,6 +105,9 @@ class PSServer:
                     for tid, s in state.items():
                         self._tables[tid].load_state_dict(s)
                     return (True, None)
+                if op == "shrink":
+                    tid, min_pushes = args
+                    return (True, self._tables[tid].shrink(min_pushes))
                 if op == "stats":
                     return (True, {tid: len(t) for tid, t in
                                    self._tables.items()
@@ -236,6 +239,13 @@ class PSClient:
         for i, c in enumerate(self._conns):
             c.call(("load", f"{path_prefix}.shard{i}"))
 
+    def shrink(self, table_id: int, min_pushes: int = 1) -> int:
+        """Evict stale rows on every server shard (reference: the Shrink
+        RPC over memory_sparse_table.cc). Returns total rows evicted."""
+        futs = [self._pool.submit(c.call, ("shrink", table_id, min_pushes))
+                for c in self._conns]
+        return sum(f.result() for f in futs)
+
     def stats(self) -> dict:
         totals: Dict[int, int] = {}
         for c in self._conns:
@@ -248,4 +258,15 @@ class PSClient:
             try:
                 c.call(("stop",))
             except ConnectionError:
+                pass
+
+    def close(self) -> None:
+        """Release client-held resources (thread pool + sockets). The
+        pool's threads are non-daemon, so a client that is merely dropped
+        can hang interpreter exit; fleet.stop_worker calls this."""
+        self._pool.shutdown(wait=False)
+        for c in self._conns:
+            try:
+                c.sock.close()
+            except OSError:
                 pass

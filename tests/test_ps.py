@@ -85,6 +85,31 @@ def test_shrink_evicts_untouched_rows():
     assert len(t) == 3
 
 
+def test_shrink_over_rpc_spans_servers(servers):
+    """Trainers can shrink a deployed pool (the reference's Shrink RPC):
+    the client fans out to every shard and sums evictions."""
+    client, srvs = servers
+    client.pull_sparse(0, np.arange(10))          # 10 rows, 0 pushes
+    client.push_sparse(0, np.arange(4), np.ones((4, 4), np.float32))
+    assert client.shrink(0, min_pushes=1) == 6
+    assert client.stats() == {0: 4}
+
+
+def test_client_close_releases_pool(servers):
+    client, _ = servers
+    client.close()
+    assert client._pool._shutdown
+    with pytest.raises(Exception):
+        client.pull_sparse(0, np.array([1]))
+
+
+def test_embedding_rejects_negative_ids(servers):
+    client, _ = servers
+    emb = ps.DistributedEmbedding(client, table_id=0, dim=4)
+    with pytest.raises(ValueError, match="negative ids"):
+        emb.pull(np.array([3, -1, 5]))
+
+
 def test_adagrad_server_optimizer_math():
     t = ps.SparseTable(dim=2, optimizer="adagrad", lr=0.1)
     row0 = t.pull(np.array([0]))[0].copy()
