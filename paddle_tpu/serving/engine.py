@@ -39,9 +39,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..kernels.paged_attention import PagedKVCache, paged_attention
+from ..kernels.paged_attention import (PagedKVCache, paged_append_blocks,
+                                       paged_append_token,
+                                       paged_decode_attention)
 from ..models.llama import (LlamaConfig, _apply_rope, _attention,
-                            _rms_norm, _wmat)
+                            _rms_norm, _wmat)  # noqa: F401
 
 __all__ = ["LLMEngine", "Request"]
 
@@ -92,14 +94,22 @@ def _sample_rows(logits, key, temps, top_ks, top_ps):
 
 
 def _paged_prefill(params, tokens, blk_ids, true_len, k_pool, v_pool,
-                   *, config: LlamaConfig):
+                   temp, top_k, top_p, key, *, config: LlamaConfig):
     """Prefill ONE request: causal forward over the padded prompt, K/V
-    scattered into the slot's pool blocks.
+    scattered into the slot's pool blocks, and the FIRST generated token
+    sampled in-program.
 
     tokens: [1, S_bucket]; blk_ids: [S_bucket // bs] physical block ids;
-    true_len: scalar int32. Returns (logits_at_last [vocab], k_pool, v_pool).
-    Pad positions beyond true_len land in blocks the host frees afterwards,
-    and causality keeps them out of the true-last-token's context.
+    true_len: scalar int32; temp/top_k/top_p/key: this request's sampling
+    knobs. Returns (first_token scalar int32, k_pool, v_pool).
+
+    Sampling lives inside the compiled program because the host loop may
+    sit behind a high-latency tunnel: the eager ~15-op sampling pipeline
+    plus a blocking int() per admission cost more wall-clock than the
+    prefill math itself (measured r3: the serving engine lost ~45% of its
+    roofline to exactly this). Pad positions beyond true_len land in
+    blocks the host frees afterwards, and causality keeps them out of the
+    true-last-token's context.
     """
     c = config
     dt = c.dtype
@@ -122,12 +132,14 @@ def _paged_prefill(params, tokens, blk_ids, true_len, k_pool, v_pool,
                                               c.head_dim)
         q = _apply_rope(q, cos, sin)
         k = _apply_rope(k, cos, sin)
-        k_pool = k_pool.at[l, blk_ids].set(
-            k[0].reshape(S // bs, bs, c.num_kv_heads, c.head_dim)
-            .astype(k_pool.dtype))
-        v_pool = v_pool.at[l, blk_ids].set(
-            v[0].reshape(S // bs, bs, c.num_kv_heads, c.head_dim)
-            .astype(v_pool.dtype))
+        # Pallas block scatter: XLA lowers the vector-indexed .at[].set to
+        # a generic scatter (~0.5 ms/layer on v5e); the kernel is a plain
+        # per-block DMA straight into the 5D pool's layer plane
+        k_pool, v_pool = paged_append_blocks(
+            k_pool, v_pool,
+            k[0].reshape(S // bs, bs, c.num_kv_heads, c.head_dim),
+            v[0].reshape(S // bs, bs, c.num_kv_heads, c.head_dim),
+            blk_ids, layer=l)
         # plain causal GQA attention — the model's own core (llama._attention)
         att = _attention(q, k, v, c).reshape(B, S,
                                              c.num_heads * c.head_dim)
@@ -140,7 +152,9 @@ def _paged_prefill(params, tokens, blk_ids, true_len, k_pool, v_pool,
     head = (params["embed"].astype(dt).T if c.tie_embeddings
             else _wmat(params, "lm_head", dt))
     logits = (x[0, true_len - 1] @ head).astype(jnp.float32)
-    return logits, k_pool, v_pool
+    tok = _sample_rows(logits[None], key, temp[None], top_k[None],
+                       top_p[None])[0]
+    return tok, k_pool, v_pool
 
 
 def _decode_core(params, last_tokens, lengths, active, block_table,
@@ -188,15 +202,16 @@ def _decode_core(params, last_tokens, lengths, active, block_table,
         v = (hn @ _wmat(p, "wv", dt)).reshape(N, 1, c.num_kv_heads,
                                               c.head_dim)
         q, k = rope(q), rope(k)
-        k_pool = k_pool.at[l, blk_phys, offset].set(
-            k[:, 0].astype(k_pool.dtype))
-        v_pool = v_pool.at[l, blk_phys, offset].set(
-            v[:, 0].astype(v_pool.dtype))
-        # the paged decode core (kernels/paged_attention, GQA-grouped);
+        # Pallas in-place row DMA + block-table-streamed attention — the
+        # XLA scatter/gather forms of these cost ~0.5 ms per layer each on
+        # a v5e (generic scatter/gather lowering for vector block indices)
+        k_pool, v_pool = paged_append_token(
+            k_pool, v_pool, k[:, 0], v[:, 0], blk_phys, offset, layer=l)
         # lengths+1 counts the token just appended
-        att = paged_attention(
+        att = paged_decode_attention(
             q[:, 0].astype(dt),
-            PagedKVCache(k_pool[l], v_pool[l], block_table, lengths + 1))
+            PagedKVCache(k_pool, v_pool, block_table, lengths + 1),
+            layer=l)
         att = att.reshape(N, 1, c.num_heads * c.head_dim).astype(dt)
         x = x + att @ _wmat(p, "wo", dt)
         hn = _rms_norm(x, p["mlp_norm"], c.rms_eps)
@@ -211,7 +226,7 @@ def _decode_core(params, last_tokens, lengths, active, block_table,
     return nxt, k_pool, v_pool
 
 
-def _paged_decode(params, last_tokens, lengths, budgets, key, active,
+def _paged_decode(params, last_tokens, lengths, done0, budgets, key, active,
                   block_table, k_pool, v_pool, temps, top_ks, top_ps,
                   eos_ids, *, config: LlamaConfig, n_steps: int):
     """``n_steps`` decode iterations in ONE compiled program (multi-step
@@ -221,14 +236,18 @@ def _paged_decode(params, last_tokens, lengths, budgets, key, active,
     mid-scan flip to done (their K/V writes divert to the trash block and
     their emitted entries read -1).
 
-    The (last, lengths, budgets, key) quartet is a device-resident carry:
-    the engine feeds each call the previous call's outputs untouched while
-    the slot composition is unchanged, so steady-state decode performs no
-    h2d transfers at all.
+    The (last, lengths, done, budgets, key) quintet is a device-resident
+    carry: the engine feeds each call the previous call's outputs
+    untouched while the slot composition is unchanged, so steady-state
+    decode performs no h2d transfers at all. ``done`` PERSISTS across
+    calls — that is what makes it safe for the engine to dispatch call
+    k+1 before reading call k's tokens (speculative chaining): a slot
+    that finished mid-call-k stays done in call k+1 and emits -1 padding
+    instead of garbage.
 
     eos_ids: [N] (-1 = no eos); budgets: [N] tokens each slot may still
     emit. Returns (emitted [n_steps, N] int32 with -1 padding, last,
-    lengths, budgets, key, k_pool, v_pool).
+    lengths, done, budgets, key, k_pool, v_pool).
     """
     def body(carry, _):
         last, lens, done, rem, kp, vp, k = carry
@@ -245,11 +264,11 @@ def _paged_decode(params, last_tokens, lengths, budgets, key, active,
         last = jnp.where(act, nxt, last)
         return (last, lens, done, rem, kp, vp, k), emitted
 
-    init = (last_tokens, lengths, jnp.zeros_like(active), budgets,
-            k_pool, v_pool, key)
-    (last_tokens, lengths, _, budgets, k_pool, v_pool, key), emitted = \
+    init = (last_tokens, lengths, done0, budgets, k_pool, v_pool, key)
+    (last_tokens, lengths, done0, budgets, k_pool, v_pool, key), emitted = \
         jax.lax.scan(body, init, None, length=n_steps)
-    return emitted, last_tokens, lengths, budgets, key, k_pool, v_pool
+    return (emitted, last_tokens, lengths, done0, budgets, key,
+            k_pool, v_pool)
 
 
 # ---------------------------------------------------------------------------
@@ -280,7 +299,15 @@ class LLMEngine:
         (multi-step scheduling). 1 = a host sync per token (exact
         admission granularity); 8-16 amortizes the host/tunnel round-trip
         ~an order of magnitude on remote-attached chips — admission and
-        slot reclamation then happen every K tokens."""
+        slot reclamation then happen every K tokens.
+
+        Pipelining caveat: the engine dispatches call k+1 before reading
+        call k's tokens only when every in-flight slot is GUARANTEED
+        alive through call k (``_spec_safe``) — which requires
+        ``eos_token_id`` unset, since an eos can finish a slot at any
+        step. Workloads where every request carries an eos therefore run
+        with a synchronous readback between calls (today's r3 behavior);
+        ``decode_steps`` remains the amortization lever there."""
         c = config
         assert max_model_len % block_size == 0
         self.params = params
@@ -344,14 +371,21 @@ class LLMEngine:
         self._decode = jax.jit(
             functools.partial(_paged_decode, config=config,
                               n_steps=self.decode_steps),
-            donate_argnums=(7, 8))
-        # device-resident decode carry (last/lengths/budgets/key) + static
-        # per-slot vectors; rebuilt only when slot composition changes
+            donate_argnums=(8, 9))
+        # device-resident decode carry (last/lengths/done/budgets/key) +
+        # static per-slot vectors; the carry chains from call to call and
+        # is only rebuilt from host state when the pipeline is drained
         self._carry = None
         self._slot_vecs = None
         self._slots_dirty = True
         self._table_dirty = True
         self._table_dev = None
+        # the dispatched-but-unread decode call (pipeline depth 1): its
+        # tokens are fetched while the NEXT call occupies the chip
+        self._inflight = None
+        # admissions whose in-program-sampled first token has not yet been
+        # read back; attached to the next dispatch record
+        self._pending_adm: List = []
 
     # -- public api ---------------------------------------------------------
     def add_request(self, prompt: List[int], **kw) -> int:
@@ -376,6 +410,8 @@ class LLMEngine:
     def run(self) -> Dict[int, List[int]]:
         while self.has_work():
             self.step()
+        if self._inflight is not None:      # defensive: step() drains first
+            self._process_inflight()
         return self.results
 
     # -- internals ----------------------------------------------------------
@@ -409,6 +445,9 @@ class LLMEngine:
         self.slot_out[slot] = []
         self._table_dirty = True
         self._slots_dirty = True
+        # an admission whose first token was never read back dies with the
+        # slot (recompute semantics: re-admission prefills and re-samples)
+        self._pending_adm = [e for e in self._pending_adm if e[0] != slot]
         if requeue and req is not None:
             # recompute-preemption: carry generated tokens so re-admission
             # prefills prompt+generated — streamed tokens stay valid and
@@ -419,12 +458,15 @@ class LLMEngine:
             self.results[req.req_id] = req.generated + out
 
     def _admit(self):
-        emitted = []
+        """Dispatch a prefill program for every queued request a free slot
+        and free blocks can take. NO host sync: the first generated token
+        is sampled inside the prefill program and rides to the host one
+        decode call later (``_pending_adm`` → the next dispatch record)."""
         while self.queue:
             slot = next((i for i in range(self.N)
                          if self.slot_req[i] is None), None)
             if slot is None:
-                return emitted
+                return
             req = self.queue[0]
             ctx = req.prompt + req.generated   # re-admission continues
             bucket = self._bucket_for(len(ctx))
@@ -438,17 +480,21 @@ class LLMEngine:
                         f"request {req.req_id}: prefill needs {need} blocks "
                         f"but the pool only has {self.nb - 1} usable — the "
                         "block pool is too small for this request")
-                return emitted               # blocks busy: wait for frees
+                return                       # blocks busy: wait for frees
             self.queue.popleft()
             blocks = [self.free_blocks.popleft() for _ in range(need)]
             blk_ids = blocks + [0] * (bucket // self.bs - need)
             toks = np.zeros((1, bucket), np.int32)
             toks[0, :true_len] = ctx
-            logits, self.k_pool, self.v_pool = self._prefill_fn(bucket)(
+            self._key, sub = jax.random.split(self._key)
+            tok_dev, self.k_pool, self.v_pool = self._prefill_fn(bucket)(
                 self.params, jnp.asarray(toks),
                 jnp.asarray(blk_ids, jnp.int32),
                 jnp.asarray(true_len, jnp.int32),
-                self.k_pool, self.v_pool)
+                self.k_pool, self.v_pool,
+                jnp.asarray(req.temperature, jnp.float32),
+                jnp.asarray(req.top_k, jnp.int32),
+                jnp.asarray(req.top_p, jnp.float32), sub)
             self.table[slot, :len(blocks)] = blocks
             self.n_alloc[slot] = len(blocks)
             self.lengths[slot] = true_len
@@ -456,16 +502,7 @@ class LLMEngine:
             self.admit_order.append(slot)
             self._table_dirty = True
             self._slots_dirty = True
-            # sample the first generated token from the prefill logits
-            self._key, sub = jax.random.split(self._key)
-            tok = int(_sample_rows(
-                logits[None], sub,
-                jnp.asarray([req.temperature], jnp.float32),
-                jnp.asarray([req.top_k], jnp.int32),
-                jnp.asarray([req.top_p], jnp.float32))[0])
-            emitted.append((req.req_id, tok))
-            self._emit(slot, tok)
-        return emitted
+            self._pending_adm.append((slot, req.req_id, tok_dev))
 
     def _emit(self, slot: int, tok: int) -> bool:
         """Record a generated token; free the slot when the request is done.
@@ -479,15 +516,18 @@ class LLMEngine:
             self._free_slot(slot)
         return done
 
-    def _ensure_backed(self, slot: int) -> bool:
+    def _ensure_backed(self, slot: int, lag: int = 0) -> bool:
         """Back every block this slot's next ``decode_steps`` writes can
         touch (clamped to its remaining token budget — a near-finished slot
-        must not reserve blocks it can never write). Returns False if the
-        pool is exhausted (caller preempts)."""
+        must not reserve blocks it can never write). ``lag``: tokens the
+        unread in-flight call may already have appended beyond the host's
+        view of the length (pipelined dispatch); the horizon covers them
+        too, since under-backing silently diverts K/V to the trash block.
+        Returns False if the pool is exhausted (caller preempts)."""
         req = self.slot_req[slot]
         remaining = req.max_new_tokens - len(req.generated) \
             - len(self.slot_out[slot])
-        steps = max(1, min(self.decode_steps, remaining))
+        steps = max(1, min(self.decode_steps + lag, remaining + lag))
         horizon = int(self.lengths[slot]) + steps - 1
         last_blk = min(horizon, self.max_model_len - 1) // self.bs
         while int(self.n_alloc[slot]) <= last_blk:
@@ -499,20 +539,49 @@ class LLMEngine:
             self._table_dirty = True
         return True
 
-    def step(self):
-        """Admit queued requests, run one decode step, route tokens.
-        Returns the list of (req_id, token) emitted this step."""
-        emitted = self._admit()
-        active_slots = [i for i in range(self.N)
-                        if self.slot_req[i] is not None]
-        if not active_slots:
-            return emitted
-        # back the next write position for every active slot; preempt the
-        # newest admissions while the pool is short (vLLM recompute policy)
-        for slot in list(active_slots):
+    def _active_slots(self):
+        return [i for i in range(self.N) if self.slot_req[i] is not None]
+
+    def _spec_safe(self) -> bool:
+        """True iff dispatching the next decode call BEFORE reading the
+        in-flight one cannot waste work: every slot in the in-flight
+        snapshot is guaranteed still alive when it ends — no eos token to
+        trip on, and budget strictly beyond the call's horizon. Otherwise
+        the engine syncs first (cheaper than risking an all-done call or
+        starving admission of a freed slot)."""
+        rec = self._inflight
+        for slot, rid in rec["snapshot"]:
+            req = self.slot_req[slot]
+            if req is None or req.req_id != rid:
+                return False
+            if req.eos_token_id is not None:
+                return False
+            if rec["rem_start"][slot] - self.decode_steps <= 0:
+                return False
+        return True
+
+    def _back_or_preempt(self):
+        """Back upcoming writes for every active slot; preempt the newest
+        admissions while the pool is short (vLLM recompute policy). With
+        an unread call in flight the host length lags by up to
+        decode_steps — if generous backing fails, the pipeline is drained
+        so preemption decisions see exact state."""
+        emitted = []
+        for slot in list(self._active_slots()):
             if self.slot_req[slot] is None:
                 continue                      # already preempted as a victim
-            while not self._ensure_backed(slot):
+            while True:
+                in_snap = self._inflight is not None and any(
+                    s == slot for s, _ in self._inflight["snapshot"])
+                if self._ensure_backed(slot,
+                                       self.decode_steps if in_snap else 0):
+                    break
+                if self._inflight is not None:
+                    # exact lengths before evicting anyone
+                    emitted += self._process_inflight()
+                    if self.slot_req[slot] is None:
+                        break
+                    continue
                 victim = self.admit_order[-1]
                 if victim == slot and len(self.admit_order) == 1:
                     # alone and starved: nothing else will ever free a
@@ -524,59 +593,167 @@ class LLMEngine:
                 self._free_slot(victim, requeue=True)
                 if victim == slot:
                     break
-        active_slots = [i for i in range(self.N)
-                        if self.slot_req[i] is not None]
-        if not active_slots:
-            return emitted
+        return emitted
 
-        if self._slots_dirty or self._carry is None:
+    def _refresh_carry(self, active_slots):
+        """Bring the device carry and per-slot vectors up to date.
+
+        The carry CHAINS on device from call to call; host state is only
+        injected where it is exact: a full rebuild when no call is unread
+        (carry is None), or a per-slot scatter for freshly admitted slots
+        (whose first token exists only on device). Freed slots are simply
+        masked out via the active vector — their stale carry lanes are
+        never read."""
+        if self._carry is None:
+            assert self._inflight is None, \
+                "carry rebuild requires a drained pipeline"
             last = np.zeros(self.N, np.int32)
+            budgets = np.zeros(self.N, np.int32)
+            pend = {s for s, _, _ in self._pending_adm}
+            for i in active_slots:
+                req = self.slot_req[i]
+                last[i] = self.slot_out[i][-1] if self.slot_out[i] else \
+                    req.prompt[-1]            # placeholder for pend slots
+                budgets[i] = req.max_new_tokens - len(req.generated) \
+                    - len(self.slot_out[i]) - (1 if i in pend else 0)
+            self._key, sub = jax.random.split(self._key)
+            self._carry = (jnp.asarray(last),
+                           jnp.asarray(self.lengths, jnp.int32),
+                           jnp.zeros(self.N, bool),
+                           jnp.asarray(budgets), sub)
+        if self._pending_adm:
+            idx = jnp.asarray([s for s, _, _ in self._pending_adm],
+                              jnp.int32)
+            toks = jnp.stack([t for _, _, t in self._pending_adm])
+            lens = jnp.asarray([int(self.lengths[s])
+                                for s, _, _ in self._pending_adm],
+                               jnp.int32)
+            rems = jnp.asarray(
+                [self.slot_req[s].max_new_tokens
+                 - len(self.slot_req[s].generated) - 1
+                 for s, _, _ in self._pending_adm], jnp.int32)
+            c_last, c_len, c_done, c_rem, c_key = self._carry
+            self._carry = (c_last.at[idx].set(toks.astype(c_last.dtype)),
+                           c_len.at[idx].set(lens),
+                           c_done.at[idx].set(False),
+                           c_rem.at[idx].set(rems), c_key)
+        if self._slots_dirty or self._slot_vecs is None:
             temps = np.zeros(self.N, np.float32)
             top_ks = np.zeros(self.N, np.int32)
             top_ps = np.ones(self.N, np.float32)
             eos_ids = np.full(self.N, -1, np.int32)
-            budgets = np.zeros(self.N, np.int32)
             active = np.zeros(self.N, bool)
             for i in active_slots:
                 req = self.slot_req[i]
-                last[i] = self.slot_out[i][-1] if self.slot_out[i] else \
-                    req.prompt[-1]
                 temps[i] = req.temperature
                 top_ks[i] = req.top_k
                 top_ps[i] = req.top_p
                 if req.eos_token_id is not None:
                     eos_ids[i] = req.eos_token_id
-                budgets[i] = req.max_new_tokens - len(req.generated) \
-                    - len(self.slot_out[i])
                 active[i] = True
-            self._key, sub = jax.random.split(self._key)
-            self._carry = (jnp.asarray(last),
-                           jnp.asarray(self.lengths, jnp.int32),
-                           jnp.asarray(budgets), sub)
             self._slot_vecs = (jnp.asarray(active), jnp.asarray(temps),
                                jnp.asarray(top_ks), jnp.asarray(top_ps),
                                jnp.asarray(eos_ids))
             self._slots_dirty = False
 
+    def _dispatch_decode(self, active_slots):
+        """Enqueue one multi-step decode call and record it as in-flight.
+        rem_start tracks each slot's EXACT remaining budget at the start
+        of the call (host bookkeeping lags; this chains from the previous
+        record when pipelined)."""
+        prev = self._inflight
+        pend = {s for s, _, _ in self._pending_adm}
+        rem_start = {}
+        for i in active_slots:
+            req = self.slot_req[i]
+            if i in pend:
+                rem_start[i] = req.max_new_tokens - len(req.generated) - 1
+            elif prev is not None and i in prev["rem_start"]:
+                rem_start[i] = prev["rem_start"][i] - self.decode_steps
+            else:
+                rem_start[i] = req.max_new_tokens - len(req.generated) \
+                    - len(self.slot_out[i])
         if self._table_dirty or self._table_dev is None:
             self._table_dev = jnp.asarray(self.table)
             self._table_dirty = False
-        c_last, c_len, c_bud, c_key = self._carry
+        c_last, c_len, c_done, c_rem, c_key = self._carry
         v_act, v_t, v_k, v_p, v_eos = self._slot_vecs
-        toks, c_last, c_len, c_bud, c_key, self.k_pool, self.v_pool = \
-            self._decode(self.params, c_last, c_len, c_bud, c_key, v_act,
-                         self._table_dev, self.k_pool, self.v_pool,
-                         v_t, v_k, v_p, v_eos)
-        self._carry = (c_last, c_len, c_bud, c_key)
-        toks_host = np.asarray(jax.device_get(toks))    # [K, N], -1 pad
-        for i in active_slots:
-            rid = self.slot_req[i].req_id
+        (toks, c_last, c_len, c_done, c_rem, c_key, self.k_pool,
+         self.v_pool) = self._decode(
+            self.params, c_last, c_len, c_done, c_rem, c_key, v_act,
+            self._table_dev, self.k_pool, self.v_pool, v_t, v_k, v_p,
+            v_eos)
+        self._carry = (c_last, c_len, c_done, c_rem, c_key)
+        self._inflight = {
+            "toks": toks,
+            "snapshot": [(i, self.slot_req[i].req_id)
+                         for i in active_slots],
+            "adm": self._pending_adm,
+            "rem_start": rem_start,
+        }
+        self._pending_adm = []
+        return prev
+
+    def _process(self, rec):
+        """Read back one decode record (first tokens of its admissions,
+        then its emitted grid) and update host bookkeeping. Slots whose
+        request changed since dispatch (finished or preempted) are
+        skipped — their lanes are -1 padding or discarded speculation."""
+        emitted = []
+        if rec["adm"]:
+            first = jax.device_get([t for _, _, t in rec["adm"]])
+            for (slot, rid, _), tok in zip(rec["adm"], first):
+                req = self.slot_req[slot]
+                if req is None or req.req_id != rid:
+                    continue              # preempted before its call ran
+                tok = int(tok)
+                emitted.append((rid, tok))
+                self._emit(slot, tok)
+        toks_host = np.asarray(jax.device_get(rec["toks"]))  # [K, N]
+        for slot, rid in rec["snapshot"]:
+            req = self.slot_req[slot]
+            if req is None or req.req_id != rid:
+                continue
             for k in range(toks_host.shape[0]):
-                tok = int(toks_host[k, i])
+                tok = int(toks_host[k, slot])
                 if tok < 0:
                     break          # slot went done mid-scan
-                self.lengths[i] += 1        # its K/V was appended
+                self.lengths[slot] += 1     # its K/V was appended
                 emitted.append((rid, tok))
-                if self._emit(i, tok):
+                if self._emit(slot, tok):
                     break          # freed: later entries are -1 anyway
+        return emitted
+
+    def _process_inflight(self):
+        rec, self._inflight = self._inflight, None
+        return self._process(rec)
+
+    def step(self):
+        """Admit queued requests, keep the chip fed, and return the
+        (req_id, token) pairs that became host-visible this call.
+
+        Pipelined: decode call k+1 is dispatched BEFORE call k's tokens
+        are read whenever no in-flight slot can finish mid-call
+        (``_spec_safe``), so the readback latency — the dominant cost on
+        a remote-attached chip — overlaps the next call's compute. The
+        token stream therefore lags the chip by up to one call
+        (decode_steps tokens per slot)."""
+        emitted = []
+        self._admit()
+        if self._inflight is not None and not self._spec_safe():
+            emitted += self._process_inflight()
+            self._admit()          # freed slots: refill before dispatching
+        active = self._active_slots()
+        if not active:
+            if self._inflight is not None:
+                emitted += self._process_inflight()
+            return emitted
+        emitted += self._back_or_preempt()
+        active = self._active_slots()
+        if not active:
+            return emitted
+        self._refresh_carry(active)
+        prev = self._dispatch_decode(active)
+        if prev is not None:
+            emitted += self._process(prev)
         return emitted
