@@ -39,9 +39,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..kernels.paged_attention import (PagedKVCache, paged_append_blocks,
-                                       paged_append_token,
-                                       paged_decode_attention)
 from ..models.llama import (LlamaConfig, _apply_rope, _attention,
                             _rms_norm, _wmat)  # noqa: F401
 
@@ -66,55 +63,100 @@ class Request:
 # ---------------------------------------------------------------------------
 # device programs
 # ---------------------------------------------------------------------------
-def _sample_rows(logits, key, temps, top_ks, top_ps):
+def _sample_rows(logits, key, temps, top_ks, top_ps, any_sampled=True,
+                 use_top_k=True, use_top_p=True):
     """Vectorized per-row sampling: every knob is a traced [N] vector, so
     one compiled program serves any mix of greedy/sampled requests.
-    temps<=0 → greedy; top_k<=0 → disabled; top_p>=1 → disabled."""
+    temps<=0 → greedy; top_k<=0 → disabled; top_p>=1 → disabled.
+
+    The three ``*_`` flags are STATIC: they prune program branches the
+    current slot mix provably doesn't need. The full-vocab ``sort`` /
+    ``argsort`` behind top-k/top-p cost ~1.5 ms each per step on a v5e —
+    as much as an entire 510M decode layer stack — so an all-greedy batch
+    (the common serving state) must compile to a bare argmax. The engine
+    derives the flags from its active requests and keeps one compiled
+    decode variant per flag tuple (≤8)."""
     N, vocab = logits.shape
-    lg = logits / jnp.maximum(temps, 1e-6)[:, None]
-    # top-k: mask below the per-row kth value (disabled rows use k=vocab)
-    eff_k = jnp.where(top_ks > 0, top_ks, vocab)
-    srt = jnp.sort(lg, axis=-1)                          # ascending
-    kth_idx = jnp.clip(vocab - eff_k, 0, vocab - 1).astype(jnp.int32)
-    kth = jnp.take_along_axis(srt, kth_idx[:, None], axis=-1)
-    lg = jnp.where(lg < kth, -1e30, lg)
-    # top-p: drop tokens outside the smallest prefix with mass >= p
-    sort_idx = jnp.argsort(-lg, axis=-1)
-    sort_p = jnp.take_along_axis(jax.nn.softmax(lg, axis=-1), sort_idx,
-                                 axis=-1)
-    cum = jnp.cumsum(sort_p, axis=-1)
-    eff_p = jnp.where(top_ps < 1.0, top_ps, 1.0)
-    drop_sorted = cum - sort_p >= eff_p[:, None]
-    drop = jnp.zeros_like(drop_sorted).at[
-        jnp.arange(N)[:, None], sort_idx].set(drop_sorted)
-    lg = jnp.where(drop, -1e30, lg)
-    sampled = jax.random.categorical(key, lg, axis=-1)
     greedy = jnp.argmax(logits, axis=-1)
+    if not any_sampled:
+        return greedy.astype(jnp.int32)
+    lg = logits / jnp.maximum(temps, 1e-6)[:, None]
+    if use_top_k:
+        # top-k: mask below the per-row kth value (disabled rows: k=vocab)
+        eff_k = jnp.where(top_ks > 0, top_ks, vocab)
+        srt = jnp.sort(lg, axis=-1)                      # ascending
+        kth_idx = jnp.clip(vocab - eff_k, 0, vocab - 1).astype(jnp.int32)
+        kth = jnp.take_along_axis(srt, kth_idx[:, None], axis=-1)
+        lg = jnp.where(lg < kth, -1e30, lg)
+    if use_top_p:
+        # top-p: drop tokens outside the smallest prefix with mass >= p
+        sort_idx = jnp.argsort(-lg, axis=-1)
+        sort_p = jnp.take_along_axis(jax.nn.softmax(lg, axis=-1), sort_idx,
+                                     axis=-1)
+        cum = jnp.cumsum(sort_p, axis=-1)
+        eff_p = jnp.where(top_ps < 1.0, top_ps, 1.0)
+        drop_sorted = cum - sort_p >= eff_p[:, None]
+        drop = jnp.zeros_like(drop_sorted).at[
+            jnp.arange(N)[:, None], sort_idx].set(drop_sorted)
+        lg = jnp.where(drop, -1e30, lg)
+    sampled = jax.random.categorical(key, lg, axis=-1)
     return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
 
 
-def _paged_prefill(params, tokens, blk_ids, true_len, k_pool, v_pool,
-                   temp, top_k, top_p, key, *, config: LlamaConfig):
-    """Prefill ONE request: causal forward over the padded prompt, K/V
-    scattered into the slot's pool blocks, and the FIRST generated token
-    sampled in-program.
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+def _apply_admissions(c_last, c_len, c_done, c_rem, wave_toks, slot_of_row,
+                      lens_new, rems_new, upd_mask):
+    """Scatter one admission wave into the decode carry — a SINGLE
+    compiled program with shapes fixed at [max_slots], whatever the
+    admission count (pad rows carry slot_of_row == N, dropped by the
+    out-of-bounds scatter mode). The eager .at[].set chain this replaces
+    re-specialized per wave size: on a remote-compile backend each new
+    size cost ~1 s of compile inside the serving hot path (measured r4:
+    7.2 s on the first full wave)."""
+    N = c_last.shape[0]
+    scattered = jnp.zeros((N,), c_last.dtype).at[slot_of_row].set(
+        wave_toks.astype(c_last.dtype), mode="drop")
+    c_last = jnp.where(upd_mask, scattered, c_last)
+    c_len = jnp.where(upd_mask, lens_new.astype(c_len.dtype), c_len)
+    c_done = jnp.where(upd_mask, False, c_done)
+    c_rem = jnp.where(upd_mask, rems_new.astype(c_rem.dtype), c_rem)
+    return c_last, c_len, c_done, c_rem
 
-    tokens: [1, S_bucket]; blk_ids: [S_bucket // bs] physical block ids;
-    true_len: scalar int32; temp/top_k/top_p/key: this request's sampling
-    knobs. Returns (first_token scalar int32, k_pool, v_pool).
+
+def _paged_prefill(params, tokens, blk_ids, true_len, k_pool, v_pool,
+                   temps, top_ks, top_ps, key, *, config: LlamaConfig,
+                   sample_flags=(True, True, True)):
+    """Prefill a WAVE of admissions in one compiled program: causal
+    forward over the padded prompt batch, every layer's K/V written into
+    the slots' pool blocks by ONE batched scatter, and each request's
+    FIRST generated token sampled in-program.
+
+    tokens: [B, S_bucket]; blk_ids: [B, S_bucket // bs] physical block
+    ids (0 = trash block for pad rows / the pad tail); true_len: [B];
+    temps/top_ks/top_ps: [B] sampling knobs. Returns
+    (first_tokens [B] int32, k_pool, v_pool).
+
+    The engine pads every multi-admission wave to ``max_slots`` rows
+    (single admissions use a dedicated B=1 variant — steady-state churn
+    must not pay max_slots× the prefill FLOPs) and to the largest bucket
+    the wave needs, so TWO compiled variants per (bucket, flags) serve
+    any admission mix — batch-size-shaped recompiles can never land
+    inside a serving burst. Pad rows point all their blocks at the trash
+    block and sample a discarded token.
 
     Sampling lives inside the compiled program because the host loop may
     sit behind a high-latency tunnel: the eager ~15-op sampling pipeline
     plus a blocking int() per admission cost more wall-clock than the
     prefill math itself (measured r3: the serving engine lost ~45% of its
-    roofline to exactly this). Pad positions beyond true_len land in
-    blocks the host frees afterwards, and causality keeps them out of the
-    true-last-token's context.
+    roofline to exactly this). Pad positions beyond true_len land in the
+    trash block, and causality keeps them out of the true-last-token's
+    context.
     """
     c = config
     dt = c.dtype
     B, S = tokens.shape
     bs = k_pool.shape[2]
+    nb = S // bs
     x = params["embed"].astype(dt)[tokens]
     pos = jnp.arange(S, dtype=jnp.float32)
     freq = c.rope_theta ** (-jnp.arange(0, c.head_dim, 2, jnp.float32)
@@ -122,6 +164,7 @@ def _paged_prefill(params, tokens, blk_ids, true_len, k_pool, v_pool,
     ang = pos[:, None] * freq[None, :]
     cos, sin = jnp.cos(ang), jnp.sin(ang)
 
+    k_all, v_all = [], []
     for l in range(c.num_layers):
         p = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
         hn = _rms_norm(x, p["attn_norm"], c.rms_eps)
@@ -132,14 +175,8 @@ def _paged_prefill(params, tokens, blk_ids, true_len, k_pool, v_pool,
                                               c.head_dim)
         q = _apply_rope(q, cos, sin)
         k = _apply_rope(k, cos, sin)
-        # Pallas block scatter: XLA lowers the vector-indexed .at[].set to
-        # a generic scatter (~0.5 ms/layer on v5e); the kernel is a plain
-        # per-block DMA straight into the 5D pool's layer plane
-        k_pool, v_pool = paged_append_blocks(
-            k_pool, v_pool,
-            k[0].reshape(S // bs, bs, c.num_kv_heads, c.head_dim),
-            v[0].reshape(S // bs, bs, c.num_kv_heads, c.head_dim),
-            blk_ids, layer=l)
+        k_all.append(k)
+        v_all.append(v)
         # plain causal GQA attention — the model's own core (llama._attention)
         att = _attention(q, k, v, c).reshape(B, S,
                                              c.num_heads * c.head_dim)
@@ -148,93 +185,47 @@ def _paged_prefill(params, tokens, blk_ids, true_len, k_pool, v_pool,
         gate = jax.nn.silu(hn @ _wmat(p, "w_gate", dt))
         x = x + (gate * (hn @ _wmat(p, "w_up", dt))) @ _wmat(p, "w_down", dt)
 
-    x = _rms_norm(x, params["final_norm"], c.rms_eps)
-    head = (params["embed"].astype(dt).T if c.tie_embeddings
-            else _wmat(params, "lm_head", dt))
-    logits = (x[0, true_len - 1] @ head).astype(jnp.float32)
-    tok = _sample_rows(logits[None], key, temp[None], top_k[None],
-                       top_p[None])[0]
-    return tok, k_pool, v_pool
-
-
-def _decode_core(params, last_tokens, lengths, active, block_table,
-                 k_pool, v_pool, temps, top_ks, top_ps, key,
-                 *, config: LlamaConfig):
-    """One decode step for ALL slots.
-
-    last_tokens/lengths/active: [N]; block_table: [N, MB];
-    pools: [L, NB, bs, Hkv, D]. Inactive slots write K/V to the reserved
-    trash block 0 and their sampled token is ignored.
-    Returns (next_tokens [N], k_pool, v_pool).
-    """
-    c = config
-    dt = c.dtype
-    N = last_tokens.shape[0]
-    bs = k_pool.shape[2]
-
-    x = params["embed"].astype(dt)[last_tokens][:, None]      # [N, 1, h]
-    # per-slot rope at each slot's own position (ragged decode)
-    posf = lengths.astype(jnp.float32)
-    freq = c.rope_theta ** (-jnp.arange(0, c.head_dim, 2, jnp.float32)
-                            / c.head_dim)
-    ang = posf[:, None] * freq[None, :]                       # [N, D/2]
-    cos = jnp.cos(ang)[:, None, None, :]                      # [N,1,1,D/2]
-    sin = jnp.sin(ang)[:, None, None, :]
-
-    def rope(t):                                              # [N,1,H,D]
-        d2 = t.shape[-1] // 2
-        t1, t2 = t[..., :d2], t[..., d2:]
-        cc, ss = cos.astype(t.dtype), sin.astype(t.dtype)
-        return jnp.concatenate([t1 * cc - t2 * ss, t2 * cc + t1 * ss], -1)
-
-    blk_logical = lengths // bs
-    offset = lengths % bs
-    blk_phys = jnp.take_along_axis(block_table, blk_logical[:, None],
-                                   axis=1)[:, 0]
-    blk_phys = jnp.where(active, blk_phys, 0)                 # trash block
-
-    for l in range(c.num_layers):
-        p = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
-        hn = _rms_norm(x, p["attn_norm"], c.rms_eps)
-        q = (hn @ _wmat(p, "wq", dt)).reshape(N, 1, c.num_heads, c.head_dim)
-        k = (hn @ _wmat(p, "wk", dt)).reshape(N, 1, c.num_kv_heads,
-                                              c.head_dim)
-        v = (hn @ _wmat(p, "wv", dt)).reshape(N, 1, c.num_kv_heads,
-                                              c.head_dim)
-        q, k = rope(q), rope(k)
-        # Pallas in-place row DMA + block-table-streamed attention — the
-        # XLA scatter/gather forms of these cost ~0.5 ms per layer each on
-        # a v5e (generic scatter/gather lowering for vector block indices)
-        k_pool, v_pool = paged_append_token(
-            k_pool, v_pool, k[:, 0], v[:, 0], blk_phys, offset, layer=l)
-        # lengths+1 counts the token just appended
-        att = paged_decode_attention(
-            q[:, 0].astype(dt),
-            PagedKVCache(k_pool, v_pool, block_table, lengths + 1),
-            layer=l)
-        att = att.reshape(N, 1, c.num_heads * c.head_dim).astype(dt)
-        x = x + att @ _wmat(p, "wo", dt)
-        hn = _rms_norm(x, p["mlp_norm"], c.rms_eps)
-        gate = jax.nn.silu(hn @ _wmat(p, "w_gate", dt))
-        x = x + (gate * (hn @ _wmat(p, "w_up", dt))) @ _wmat(p, "w_down", dt)
+    # hoisted writeback: all layers' K/V in ONE scatter per pool (the
+    # per-layer Pallas/XLA block appends cost ~0.6 ms of launch overhead
+    # each — 2L calls/prefill dwarfed the prefill math itself)
+    L = c.num_layers
+    flat = blk_ids.reshape(B * nb)
+    k_stack = jnp.stack(k_all).reshape(L, B * nb, bs, c.num_kv_heads,
+                                       c.head_dim)
+    v_stack = jnp.stack(v_all).reshape(L, B * nb, bs, c.num_kv_heads,
+                                       c.head_dim)
+    k_pool = k_pool.at[:, flat].set(k_stack)
+    v_pool = v_pool.at[:, flat].set(v_stack)
 
     x = _rms_norm(x, params["final_norm"], c.rms_eps)
     head = (params["embed"].astype(dt).T if c.tie_embeddings
             else _wmat(params, "lm_head", dt))
-    logits = (x[:, 0] @ head).astype(jnp.float32)         # [N, vocab]
-    nxt = _sample_rows(logits, key, temps, top_ks, top_ps)
-    return nxt, k_pool, v_pool
+    last_h = x[jnp.arange(B), jnp.maximum(true_len - 1, 0)]
+    logits = (last_h @ head).astype(jnp.float32)
+    toks = _sample_rows(logits, key, temps, top_ks, top_ps, *sample_flags)
+    return toks, k_pool, v_pool
 
 
 def _paged_decode(params, last_tokens, lengths, done0, budgets, key, active,
                   block_table, k_pool, v_pool, temps, top_ks, top_ps,
-                  eos_ids, *, config: LlamaConfig, n_steps: int):
+                  eos_ids, *, config: LlamaConfig, n_steps: int,
+                  sample_flags=(True, True, True)):
     """``n_steps`` decode iterations in ONE compiled program (multi-step
     scheduling): the host loop syncs once per call instead of once per
     token — through a remote-attached chip the per-step d2h round-trip
     costs ~10x the decode math itself. Slots that hit their eos or budget
-    mid-scan flip to done (their K/V writes divert to the trash block and
-    their emitted entries read -1).
+    mid-scan flip to done (their ring entries are masked and never written
+    back; their emitted entries read -1).
+
+    Hoisted-dense structure (r4; the per-step Pallas paged-append +
+    paged-attention variant measured ~0.6 ms of launch overhead per call
+    × 24 calls/step — 4-5× the decode math): the slot prefixes are frozen
+    for the whole call, so the pools are GATHERED ONCE into dense
+    [L, N, P, Hkv, D] arrays up front, the scan body runs pure fused XLA
+    (dense GQA attention over prefix + an in-call ring buffer written at
+    the uniform step index — no scatter), and the ring is written back to
+    the pools in ONE batched scatter at call end. Zero kernel launches
+    inside the scan; per-step cost matches the fixed-batch fused loop.
 
     The (last, lengths, done, budgets, key) quintet is a device-resident
     carry: the engine feeds each call the previous call's outputs
@@ -243,31 +234,113 @@ def _paged_decode(params, last_tokens, lengths, done0, budgets, key, active,
     calls — that is what makes it safe for the engine to dispatch call
     k+1 before reading call k's tokens (speculative chaining): a slot
     that finished mid-call-k stays done in call k+1 and emits -1 padding
-    instead of garbage.
+    instead of garbage. Call k+1's prefix gather reads call k's pool
+    writeback through the donated-pool data dependency.
 
     eos_ids: [N] (-1 = no eos); budgets: [N] tokens each slot may still
     emit. Returns (emitted [n_steps, N] int32 with -1 padding, last,
     lengths, done, budgets, key, k_pool, v_pool).
     """
-    def body(carry, _):
-        last, lens, done, rem, kp, vp, k = carry
+    c = config
+    dt = c.dtype
+    Lc = c.num_layers
+    N, MB = block_table.shape
+    bs = k_pool.shape[2]
+    Hkv, D = k_pool.shape[3], k_pool.shape[4]
+    G = c.num_heads // c.num_kv_heads
+    P = MB * bs
+    S = n_steps
+    lens0 = lengths                       # frozen prefix lengths
+    scale = 1.0 / math.sqrt(D)
+
+    # ---- hoist: one dense gather of every slot's (frozen) prefix --------
+    kd = k_pool[:, block_table].reshape(Lc, N, P, Hkv, D)
+    vd = v_pool[:, block_table].reshape(Lc, N, P, Hkv, D)
+    pre_mask = (jnp.arange(P)[None, :]
+                < lens0[:, None])[:, None, None, :]       # [N,1,1,P]
+
+    freq = c.rope_theta ** (-jnp.arange(0, c.head_dim, 2, jnp.float32)
+                            / c.head_dim)
+
+    def rope1(t, ang):                    # t: [N, H, D]; ang: [N, D/2]
+        d2 = t.shape[-1] // 2
+        t1, t2 = t[..., :d2], t[..., d2:]
+        cc = jnp.cos(ang)[:, None, :].astype(t.dtype)
+        ss = jnp.sin(ang)[:, None, :].astype(t.dtype)
+        return jnp.concatenate([t1 * cc - t2 * ss, t2 * cc + t1 * ss], -1)
+
+    head_w = (params["embed"].astype(dt).T if c.tie_embeddings
+              else _wmat(params, "lm_head", dt))
+
+    def body(carry, t):
+        last, lens, done, rem, rk, rv, k = carry
         k, sub = jax.random.split(k)
         act = active & ~done
-        nxt, kp, vp = _decode_core(params, last, lens, act, block_table,
-                                   kp, vp, temps, top_ks, top_ps, sub,
-                                   config=config)
+        x = params["embed"].astype(dt)[last][:, None]      # [N, 1, h]
+        ang = lens.astype(jnp.float32)[:, None] * freq[None, :]
+        ring_mask = (jnp.arange(S) <= t)[None, None, None, :]  # [1,1,1,S]
+        for l in range(Lc):
+            p = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
+            hn = _rms_norm(x, p["attn_norm"], c.rms_eps)
+            q = (hn[:, 0] @ _wmat(p, "wq", dt)).reshape(N, Hkv * G, D)
+            kk = (hn[:, 0] @ _wmat(p, "wk", dt)).reshape(N, Hkv, D)
+            vv = (hn[:, 0] @ _wmat(p, "wv", dt)).reshape(N, Hkv, D)
+            q, kk = rope1(q, ang), rope1(kk, ang)
+            # uniform step index: dynamic_update_slice, never a scatter
+            rk = jax.lax.dynamic_update_slice(
+                rk, kk[None, :, None], (l, 0, t, 0, 0))
+            rv = jax.lax.dynamic_update_slice(
+                rv, vv[None, :, None], (l, 0, t, 0, 0))
+            qg = q.reshape(N, Hkv, G, D)
+            s_pre = jnp.einsum("nhgd,nphd->nhgp", qg, kd[l],
+                               preferred_element_type=jnp.float32) * scale
+            s_rng = jnp.einsum("nhgd,nshd->nhgs", qg, rk[l],
+                               preferred_element_type=jnp.float32) * scale
+            s_pre = jnp.where(pre_mask, s_pre, -1e30)
+            s_rng = jnp.where(ring_mask, s_rng, -1e30)
+            probs = jax.nn.softmax(
+                jnp.concatenate([s_pre, s_rng], axis=-1), axis=-1)
+            p_pre = probs[..., :P].astype(dt)
+            p_rng = probs[..., P:].astype(dt)
+            att = (jnp.einsum("nhgp,nphd->nhgd", p_pre, vd[l])
+                   + jnp.einsum("nhgs,nshd->nhgd", p_rng, rv[l]))
+            att = att.reshape(N, 1, Hkv * G * D).astype(dt)
+            x = x + att @ _wmat(p, "wo", dt)
+            hn = _rms_norm(x, p["mlp_norm"], c.rms_eps)
+            gate = jax.nn.silu(hn @ _wmat(p, "w_gate", dt))
+            x = x + (gate * (hn @ _wmat(p, "w_up", dt))) @ _wmat(
+                p, "w_down", dt)
+
+        xf = _rms_norm(x, params["final_norm"], c.rms_eps)
+        logits = (xf[:, 0] @ head_w).astype(jnp.float32)
+        nxt = _sample_rows(logits, sub, temps, top_ks, top_ps,
+                           *sample_flags)
         emitted = jnp.where(act, nxt, -1)
         lens = lens + act.astype(lens.dtype)
         rem = rem - act.astype(rem.dtype)
         done = done | (act & (eos_ids >= 0) & (nxt == eos_ids)) \
             | (act & (rem <= 0))
         last = jnp.where(act, nxt, last)
-        return (last, lens, done, rem, kp, vp, k), emitted
+        return (last, lens, done, rem, rk, rv, k), emitted
 
-    init = (last_tokens, lengths, done0, budgets, k_pool, v_pool, key)
-    (last_tokens, lengths, done0, budgets, k_pool, v_pool, key), emitted = \
-        jax.lax.scan(body, init, None, length=n_steps)
-    return (emitted, last_tokens, lengths, done0, budgets, key,
+    ring_k = jnp.zeros((Lc, N, S, Hkv, D), dt)
+    ring_v = jnp.zeros((Lc, N, S, Hkv, D), dt)
+    init = (last_tokens, lengths, done0, budgets, ring_k, ring_v, key)
+    (last_tokens, lens_end, done0, budgets, ring_k, ring_v, key), emitted = \
+        jax.lax.scan(body, init, jnp.arange(S))
+
+    # ---- writeback: the ring's valid entries → pools, one scatter -------
+    cnt = lens_end - lens0                                # [N]
+    j = jnp.arange(S)[None, :]
+    valid = (j < cnt[:, None]) & active[:, None]          # [N, S]
+    pos = jnp.minimum(lens0[:, None] + j, P - 1)
+    log_blk = pos // bs
+    phys = jnp.take_along_axis(block_table, log_blk, axis=1)
+    phys = jnp.where(valid, phys, 0)                      # trash block 0
+    off = pos % bs
+    k_pool = k_pool.at[:, phys, off].set(ring_k)
+    v_pool = v_pool.at[:, phys, off].set(ring_v)
+    return (emitted, last_tokens, lens_end, done0, budgets, key,
             k_pool, v_pool)
 
 
@@ -368,10 +441,9 @@ class LLMEngine:
         self._key = jax.random.PRNGKey(seed)
         self._prefill = {}
         self.decode_steps = max(1, int(decode_steps))
-        self._decode = jax.jit(
-            functools.partial(_paged_decode, config=config,
-                              n_steps=self.decode_steps),
-            donate_argnums=(8, 9))
+        # one compiled decode variant per sampling-feature tuple (≤8): an
+        # all-greedy slot mix must not pay top-k/top-p's full-vocab sorts
+        self._decode_cache: Dict = {}
         # device-resident decode carry (last/lengths/done/budgets/key) +
         # static per-slot vectors; the carry chains from call to call and
         # is only rebuilt from host state when the pipeline is drained
@@ -422,13 +494,15 @@ class LLMEngine:
         raise ValueError(f"prompt length {n} exceeds largest bucket "
                          f"{self.buckets[-1]}")
 
-    def _prefill_fn(self, bucket: int):
-        fn = self._prefill.get(bucket)
+    def _prefill_fn(self, bucket: int, B: int, flags):
+        key = (bucket, B, flags)
+        fn = self._prefill.get(key)
         if fn is None:
             fn = jax.jit(functools.partial(_paged_prefill,
-                                           config=self.config),
+                                           config=self.config,
+                                           sample_flags=flags),
                          donate_argnums=(4, 5))
-            self._prefill[bucket] = fn
+            self._prefill[key] = fn
         return fn
 
     def _free_slot(self, slot: int, requeue: bool = False):
@@ -458,18 +532,22 @@ class LLMEngine:
             self.results[req.req_id] = req.generated + out
 
     def _admit(self):
-        """Dispatch a prefill program for every queued request a free slot
-        and free blocks can take. NO host sync: the first generated token
-        is sampled inside the prefill program and rides to the host one
-        decode call later (``_pending_adm`` → the next dispatch record)."""
-        while self.queue:
+        """Admit every queued request a free slot and free blocks can
+        take, then dispatch ONE batched prefill program for the whole
+        wave (padded to max_slots rows and the wave's largest bucket, so
+        the compiled-variant set is one per bucket — a serving burst can
+        never hit a batch-size-shaped recompile). NO host sync: each
+        first generated token is sampled inside the prefill program and
+        rides to the host one decode call later (``_pending_adm`` → the
+        next dispatch record)."""
+        wave = []           # (slot, req, true_len, ctx, blocks)
+        while self.queue and len(wave) < self.N:
             slot = next((i for i in range(self.N)
                          if self.slot_req[i] is None), None)
             if slot is None:
-                return
+                break
             req = self.queue[0]
             ctx = req.prompt + req.generated   # re-admission continues
-            bucket = self._bucket_for(len(ctx))
             true_len = len(ctx)
             # only the blocks the true prompt occupies; the bucket's pad
             # tail scatters into the trash block (never read: causality)
@@ -480,21 +558,9 @@ class LLMEngine:
                         f"request {req.req_id}: prefill needs {need} blocks "
                         f"but the pool only has {self.nb - 1} usable — the "
                         "block pool is too small for this request")
-                return                       # blocks busy: wait for frees
+                break                        # blocks busy: wait for frees
             self.queue.popleft()
             blocks = [self.free_blocks.popleft() for _ in range(need)]
-            blk_ids = blocks + [0] * (bucket // self.bs - need)
-            toks = np.zeros((1, bucket), np.int32)
-            toks[0, :true_len] = ctx
-            self._key, sub = jax.random.split(self._key)
-            tok_dev, self.k_pool, self.v_pool = self._prefill_fn(bucket)(
-                self.params, jnp.asarray(toks),
-                jnp.asarray(blk_ids, jnp.int32),
-                jnp.asarray(true_len, jnp.int32),
-                self.k_pool, self.v_pool,
-                jnp.asarray(req.temperature, jnp.float32),
-                jnp.asarray(req.top_k, jnp.int32),
-                jnp.asarray(req.top_p, jnp.float32), sub)
             self.table[slot, :len(blocks)] = blocks
             self.n_alloc[slot] = len(blocks)
             self.lengths[slot] = true_len
@@ -502,7 +568,46 @@ class LLMEngine:
             self.admit_order.append(slot)
             self._table_dirty = True
             self._slots_dirty = True
-            self._pending_adm.append((slot, req.req_id, tok_dev))
+            wave.append((slot, req, true_len, ctx, blocks))
+        if not wave:
+            return
+        bucket = self._bucket_for(max(tl for _, _, tl, _, _ in wave))
+        # two batch variants only: 1 (steady-state churn admits one slot
+        # at a time — full-width padding would pay max_slots× the prefill
+        # FLOPs) and max_slots (bursts). Bounded compiles, bounded waste.
+        B = 1 if len(wave) == 1 else self.N
+        nb = bucket // self.bs
+        toks = np.zeros((B, bucket), np.int32)
+        blk_ids = np.zeros((B, nb), np.int32)   # pad rows: all trash
+        true_lens = np.ones(B, np.int32)
+        temps = np.zeros(B, np.float32)
+        top_ks = np.zeros(B, np.int32)
+        top_ps = np.ones(B, np.float32)
+        for i, (slot, req, tl, ctx, blocks) in enumerate(wave):
+            toks[i, :tl] = ctx
+            blk_ids[i, :len(blocks)] = blocks
+            true_lens[i] = tl
+            temps[i] = req.temperature
+            top_ks[i] = req.top_k
+            top_ps[i] = req.top_p
+        sampled = any(r.temperature > 0 for _, r, _, _, _ in wave)
+        flags = (sampled,
+                 sampled and any(r.top_k > 0 for _, r, _, _, _ in wave
+                                 if r.temperature > 0),
+                 sampled and any(r.top_p < 1.0 for _, r, _, _, _ in wave
+                                 if r.temperature > 0))
+        self._key, sub = jax.random.split(self._key)
+        tok_dev, self.k_pool, self.v_pool = self._prefill_fn(
+            bucket, B, flags)(
+            self.params, jnp.asarray(toks), jnp.asarray(blk_ids),
+            jnp.asarray(true_lens), self.k_pool, self.v_pool,
+            jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
+            sub)
+        for i, (slot, req, _, _, _) in enumerate(wave):
+            # reference the WHOLE [B] first-token array + row index: the
+            # readback then fetches one array per wave, not one tiny
+            # transfer per admission (8 tunnel RTTs measured per wave)
+            self._pending_adm.append((slot, req.req_id, tok_dev, i))
 
     def _emit(self, slot: int, tok: int) -> bool:
         """Record a generated token; free the slot when the request is done.
@@ -609,7 +714,7 @@ class LLMEngine:
                 "carry rebuild requires a drained pipeline"
             last = np.zeros(self.N, np.int32)
             budgets = np.zeros(self.N, np.int32)
-            pend = {s for s, _, _ in self._pending_adm}
+            pend = {s for s, _, _, _ in self._pending_adm}
             for i in active_slots:
                 req = self.slot_req[i]
                 last[i] = self.slot_out[i][-1] if self.slot_out[i] else \
@@ -622,21 +727,31 @@ class LLMEngine:
                            jnp.zeros(self.N, bool),
                            jnp.asarray(budgets), sub)
         if self._pending_adm:
-            idx = jnp.asarray([s for s, _, _ in self._pending_adm],
-                              jnp.int32)
-            toks = jnp.stack([t for _, _, t in self._pending_adm])
-            lens = jnp.asarray([int(self.lengths[s])
-                                for s, _, _ in self._pending_adm],
-                               jnp.int32)
-            rems = jnp.asarray(
-                [self.slot_req[s].max_new_tokens
-                 - len(self.slot_req[s].generated) - 1
-                 for s, _, _ in self._pending_adm], jnp.int32)
+            # one _apply_admissions call per wave array (usually one):
+            # every operand shape is pinned to [max_slots], so nothing
+            # here can ever compile inside the serving loop
+            groups: Dict = {}
+            for s, rid, arr, i in self._pending_adm:
+                groups.setdefault(id(arr), (arr, []))[1].append((s, i))
             c_last, c_len, c_done, c_rem, c_key = self._carry
-            self._carry = (c_last.at[idx].set(toks.astype(c_last.dtype)),
-                           c_len.at[idx].set(lens),
-                           c_done.at[idx].set(False),
-                           c_rem.at[idx].set(rems), c_key)
+            for arr, items in groups.values():
+                B = arr.shape[0]
+                slot_of_row = np.full(B, self.N, np.int32)  # N → dropped
+                upd = np.zeros(self.N, bool)
+                lens_new = np.zeros(self.N, np.int32)
+                rems_new = np.zeros(self.N, np.int32)
+                for s, i in items:
+                    slot_of_row[i] = s
+                    upd[s] = True
+                    lens_new[s] = int(self.lengths[s])
+                    req = self.slot_req[s]
+                    rems_new[s] = (req.max_new_tokens
+                                   - len(req.generated) - 1)
+                c_last, c_len, c_done, c_rem = _apply_admissions(
+                    c_last, c_len, c_done, c_rem, arr,
+                    jnp.asarray(slot_of_row), jnp.asarray(lens_new),
+                    jnp.asarray(rems_new), jnp.asarray(upd))
+            self._carry = (c_last, c_len, c_done, c_rem, c_key)
         if self._slots_dirty or self._slot_vecs is None:
             temps = np.zeros(self.N, np.float32)
             top_ks = np.zeros(self.N, np.int32)
@@ -662,7 +777,7 @@ class LLMEngine:
         of the call (host bookkeeping lags; this chains from the previous
         record when pipelined)."""
         prev = self._inflight
-        pend = {s for s, _, _ in self._pending_adm}
+        pend = {s for s, _, _, _ in self._pending_adm}
         rem_start = {}
         for i in active_slots:
             req = self.slot_req[i]
@@ -678,8 +793,22 @@ class LLMEngine:
             self._table_dirty = False
         c_last, c_len, c_done, c_rem, c_key = self._carry
         v_act, v_t, v_k, v_p, v_eos = self._slot_vecs
+        reqs = [self.slot_req[i] for i in active_slots]
+        sampled = any(r.temperature > 0 for r in reqs)
+        flags = (sampled,
+                 sampled and any(r.top_k > 0 for r in reqs
+                                 if r.temperature > 0),
+                 sampled and any(r.top_p < 1.0 for r in reqs
+                                 if r.temperature > 0))
+        decode = self._decode_cache.get(flags)
+        if decode is None:
+            decode = self._decode_cache[flags] = jax.jit(
+                functools.partial(_paged_decode, config=self.config,
+                                  n_steps=self.decode_steps,
+                                  sample_flags=flags),
+                donate_argnums=(8, 9))
         (toks, c_last, c_len, c_done, c_rem, c_key, self.k_pool,
-         self.v_pool) = self._decode(
+         self.v_pool) = decode(
             self.params, c_last, c_len, c_done, c_rem, c_key, v_act,
             self._table_dev, self.k_pool, self.v_pool, v_t, v_k, v_p,
             v_eos)
@@ -701,8 +830,15 @@ class LLMEngine:
         skipped — their lanes are -1 padding or discarded speculation."""
         emitted = []
         if rec["adm"]:
-            first = jax.device_get([t for _, _, t in rec["adm"]])
-            for (slot, rid, _), tok in zip(rec["adm"], first):
+            # one readback per distinct wave array, not per admission
+            uniq = {}
+            for slot, rid, arr, i in rec["adm"]:
+                uniq.setdefault(id(arr), (arr, []))[1].append(
+                    (slot, rid, i))
+            host = {aid: np.asarray(jax.device_get(arr))
+                    for aid, (arr, _) in uniq.items()}
+            first = [int(host[id(arr)][i]) for _, _, arr, i in rec["adm"]]
+            for (slot, rid, _, _), tok in zip(rec["adm"], first):
                 req = self.slot_req[slot]
                 if req is None or req.req_id != rid:
                     continue              # preempted before its call ran

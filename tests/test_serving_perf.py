@@ -78,12 +78,23 @@ def _time_raw(params, cfg, prompts):
         eng._back_or_preempt()
         eng._refresh_carry(active)
         eng._table_dev = jax.numpy.asarray(eng.table)
+        import functools
+
+        from paddle_tpu.serving.engine import _paged_decode
+        flags = (False, False, False)          # all-greedy workload
+        decode = eng._decode_cache.get(flags)
+        if decode is None:
+            decode = eng._decode_cache[flags] = jax.jit(
+                functools.partial(_paged_decode, config=eng.config,
+                                  n_steps=eng.decode_steps,
+                                  sample_flags=flags),
+                donate_argnums=(8, 9))
         grids = []
         for _ in range(CALLS):
             c_last, c_len, c_done, c_rem, c_key = eng._carry
             v_act, v_t, v_k, v_p, v_eos = eng._slot_vecs
             (toks, c_last, c_len, c_done, c_rem, c_key, eng.k_pool,
-             eng.v_pool) = eng._decode(
+             eng.v_pool) = decode(
                 eng.params, c_last, c_len, c_done, c_rem, c_key, v_act,
                 eng._table_dev, eng.k_pool, eng.v_pool, v_t, v_k, v_p,
                 v_eos)

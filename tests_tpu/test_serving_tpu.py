@@ -39,9 +39,11 @@ def model():
 
 
 def test_pallas_paged_kernels_match_xla_oracle_on_chip():
-    """Compiled-Mosaic (interpret=False) numerics for the three serving
+    """Compiled-Mosaic (interpret=False) numerics for the three paged-KV
     kernels vs the XLA reference path — the CPU lane only ever exercises
-    the Pallas INTERPRETER, whose semantics can diverge from Mosaic."""
+    the Pallas INTERPRETER, whose semantics can diverge from Mosaic.
+    (The engine's hot path uses the hoisted-dense decode since r4; these
+    kernels remain the public block-granular API in kernels/.)"""
     from paddle_tpu.kernels.paged_attention import (
         PagedKVCache, paged_append, paged_append_blocks, paged_append_token,
         paged_attention, paged_decode_attention)
