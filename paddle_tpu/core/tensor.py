@@ -38,7 +38,14 @@ class Tensor:
     def __init__(self, value, stop_gradient: bool = True, name: Optional[str] = None):
         if isinstance(value, Tensor):
             value = value._value
-        if not isinstance(value, jax.Array) and not isinstance(value, jax.core.Tracer):
+        if getattr(value, "_is_segment_lazy", False):
+            # aliasing a segment-deferred value: register as an owner so
+            # the flush binds the computed array here too (jit/segments)
+            from ..jit.segments import note_lazy_ref
+
+            note_lazy_ref(value, self)
+        elif not isinstance(value, jax.Array) and not isinstance(
+                value, jax.core.Tracer):
             value = jnp.asarray(value)
         self._value = value
         self.stop_gradient = stop_gradient
@@ -182,6 +189,10 @@ class Tensor:
         """Adopt another tensor's value and autograd position (used by the
         in-place op variants: the reference's inplace kernels + version
         counting, here expressed as out-of-place + identity rebind)."""
+        if getattr(result._value, "_is_segment_lazy", False):
+            from ..jit.segments import note_lazy_ref
+
+            note_lazy_ref(result._value, self)
         self._value = result._value
         self._grad_node = result._grad_node
         self._output_index = result._output_index

@@ -152,11 +152,16 @@ class StaticFunction:
         self._input_spec = input_spec
         self._cache = {}
         self._build_strategy = build_strategy or BuildStrategy()
-        self._eager_keys = set()  # signatures that graph-broke to eager
+        self._segment_keys = set()  # graph-broke: segment-compiled mode
         self._warned_break = False
-        # observability: compiles = traced programs; cond_branches = Python
-        # ifs converted to lax.cond; eager_calls = graph-break fallbacks
-        self._stats = {"compiles": 0, "cond_branches": 0, "eager_calls": 0}
+        # observability: compiles = traced whole-graph programs;
+        # cond_branches = Python ifs converted to lax.cond; eager_calls =
+        # uncacheable-signature fallbacks; segment_runs = calls executed
+        # in segment-compiled mode; segments = compiled-segment
+        # executions; segment_compiles = segments that newly compiled
+        self._stats = {"compiles": 0, "cond_branches": 0, "eager_calls": 0,
+                       "segment_runs": 0, "segments": 0,
+                       "segment_compiles": 0}
         functools.update_wrapper(self, function)
 
     @property
@@ -224,9 +229,11 @@ class StaticFunction:
             hash(key)
         except TypeError:
             key = None  # unhashable guard state → uncacheable: run eager
-        if key is None or key in self._eager_keys:
+        if key is None:             # unhashable guard state: uncacheable
             self._stats["eager_calls"] += 1
             return self._fn(*args, **kwargs)
+        if key in self._segment_keys:
+            return self._run_segmented(args, kwargs)
         arg_tensors: List[Tensor] = []
         skel_args = _split_tensors(args, arg_tensors)
         skel_kwargs = _split_tensors(kwargs, arg_tensors)
@@ -266,20 +273,21 @@ class StaticFunction:
             if not self._build_strategy.allow_graph_break:
                 raise
             self._cache.pop(key, None)
-            self._eager_keys.add(key)
+            self._segment_keys.add(key)
             if not self._warned_break:
                 self._warned_break = True
                 import warnings
                 warnings.warn(
                     f"to_static({getattr(self._fn, '__name__', 'fn')}): "
-                    f"graph break ({type(e).__name__}: {e}) — running this "
-                    "input signature eagerly. Scalar-tensor ifs with "
-                    "matching arms stay compiled automatically; use "
-                    "lax.cond-style ops for the rest, or "
-                    "BuildStrategy(allow_graph_break=False) to make this an "
+                    f"graph break ({type(e).__name__}: {e}) — this input "
+                    "signature now runs SEGMENT-COMPILED: ops between "
+                    "value materializations execute as cached jitted "
+                    "segments, the break itself runs eagerly (the SOT "
+                    "subgraph fallback). Scalar-tensor ifs with matching "
+                    "arms stay whole-graph automatically; "
+                    "BuildStrategy(allow_graph_break=False) makes this an "
                     "error.", stacklevel=2)
-            self._stats["eager_calls"] += 1
-            return self._fn(*args, **kwargs)
+            return self._run_segmented(args, kwargs)
         if not isinstance(outs, tuple):
             outs = (outs,)
         n_real = out_box.get("n_real", len(outs))
@@ -292,6 +300,20 @@ class StaticFunction:
                         named_b[k]._replace_value(t._value)
         wrapped = _rebuild(out_box["skel"], list(outs[:n_real]), lambda t: t)
         return wrapped
+
+    def _run_segmented(self, args, kwargs):
+        """Graph-broken path: re-execute the python (so value-dependent
+        control flow is exact) with every op deferred into cached compiled
+        segments — jit/segments.py, the reference SOT's
+        compile-prefix/resume-after-break semantics in trace-based form."""
+        from .segments import segment_scope
+
+        with segment_scope() as rec:
+            out = self._fn(*args, **kwargs)
+        self._stats["segment_runs"] += 1
+        self._stats["segments"] += rec.flushes
+        self._stats["segment_compiles"] += rec.compiles
+        return out
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,

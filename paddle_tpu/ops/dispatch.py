@@ -81,6 +81,16 @@ def _apply_impl(name, fn, args, kwargs):
     if _amp._amp_active():
         args, kwargs = _amp._amp_transform(name, args, kwargs)
 
+    # segment-compiled mode (jit/segments.py): an active recorder defers
+    # the op onto its tape; None return = op needs concrete values, the
+    # recorder flushed, run it eagerly below
+    from ..jit import segments as _segments
+    rec = _segments.current_recorder()
+    if rec is not None:
+        res = rec.record(name, fn, args, kwargs)
+        if res is not None:
+            return res
+
     tensors: List[Tensor] = []
     s_args = _scan(args, tensors)
     s_kwargs = _scan(kwargs, tensors)

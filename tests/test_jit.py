@@ -135,8 +135,9 @@ def test_recompute_sequential_segments():
 
 def test_to_static_graph_break_fallback():
     """Data-dependent Python control flow (tensor.item()) inside forward
-    falls back to eager per-signature and still trains (parity semantics:
-    SOT eval_frame fallback — jit/sot/.../eval_frame_callback.py:54)."""
+    falls back to segment-compiled execution per-signature and still
+    trains (parity semantics: SOT eval_frame fallback —
+    jit/sot/.../eval_frame_callback.py:54)."""
     import warnings
 
     class Branchy(nn.Layer):
@@ -163,11 +164,14 @@ def test_to_static_graph_break_fallback():
     loss.backward()
     opt.step()
     assert np.abs(model.lin.weight.numpy() - w0).max() > 0  # trained eagerly
-    # decision is cached: repeated calls don't re-trace/re-warn
+    # decision is cached: repeated calls don't re-trace/re-warn; since r4
+    # the broken signature runs SEGMENT-COMPILED (jit/segments.py), not
+    # whole-call eager
     sf = model._static_function
-    assert len(sf._eager_keys) == 1
+    assert len(sf._segment_keys) == 1
     _ = model(x)
-    assert len(sf._eager_keys) == 1
+    assert len(sf._segment_keys) == 1
+    assert sf._stats["segments"] >= 2
 
 
 def test_to_static_graph_break_strict_mode_raises():
