@@ -19,7 +19,7 @@ import sys
 import time
 from typing import List, Optional
 
-__all__ = ["launch", "main"]
+__all__ = ["launch", "launch_elastic", "ElasticController", "main"]
 
 
 def _env_for_rank(rank: int, nproc: int, master: str, port: int):
@@ -38,6 +38,50 @@ def _env_for_rank(rank: int, nproc: int, master: str, port: int):
     return env
 
 
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_round(procs: List[subprocess.Popen], poll: float = 0.05,
+                term_grace: float = 10.0) -> List[int]:
+    """Supervise one round of worker processes: the first nonzero exit
+    drains the rest with SIGTERM, escalating to SIGKILL after
+    ``term_grace`` seconds — a worker whose SIGTERM handler hangs (e.g.
+    checkpointing while blocked on a collective whose peer just died —
+    exactly the dead-pod case) must not wedge the controller. Returns all
+    exit codes."""
+    codes: List[int] = []
+    term_at: Optional[float] = None
+    try:
+        while procs:
+            for p in list(procs):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                procs.remove(p)
+                codes.append(rc)
+                if rc != 0 and term_at is None:
+                    term_at = time.time()
+                    for q in procs:
+                        q.send_signal(signal.SIGTERM)
+            if term_at is not None and time.time() - term_at > term_grace:
+                for q in procs:
+                    q.kill()
+                term_at = float("inf")   # escalate once
+            time.sleep(poll)
+    except KeyboardInterrupt:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        raise
+    return codes
+
+
 def launch(script: str, script_args: Optional[List[str]] = None,
            nproc_per_node: int = 1, master: str = "127.0.0.1",
            port: int = 0, max_restarts: int = 0) -> int:
@@ -47,42 +91,113 @@ def launch(script: str, script_args: Optional[List[str]] = None,
     fleet/elastic/manager.py)."""
     script_args = script_args or []
     if port == 0:
-        import socket
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-        s.close()
+        port = _free_port()
 
+    codes: List[int] = []
     for attempt in range(max_restarts + 1):
         procs = []
         for rank in range(nproc_per_node):
             env = _env_for_rank(rank, nproc_per_node, master, port)
             procs.append(subprocess.Popen(
                 [sys.executable, script, *script_args], env=env))
-        codes = []
-        failed = False
-        try:
-            while procs:
-                for p in list(procs):
-                    rc = p.poll()
-                    if rc is None:
-                        continue
-                    procs.remove(p)
-                    codes.append(rc)
-                    if rc != 0:
-                        failed = True
-                        for q in procs:
-                            q.send_signal(signal.SIGTERM)
-                time.sleep(0.05)
-        except KeyboardInterrupt:
-            for p in procs:
-                p.send_signal(signal.SIGTERM)
-            raise
-        if not failed:
+        codes = _wait_round(procs)
+        if all(c == 0 for c in codes):
             return 0
         if attempt < max_restarts:
             time.sleep(1.0)
     return next((c for c in codes if c != 0), 1)
+
+
+class ElasticController:
+    """np-range elastic job controller (parity:
+    fleet/elastic/manager.py:125 ElasticManager np range + fault-level
+    restart tiers; launch/controllers/master.py:59,253 dead-pod watcher +
+    restart_peer).
+
+    Policy, in the reference's restart tiers:
+
+    1. **fault-level**: a worker dies → kill the stragglers, rebuild the
+       env contract, relaunch at the SAME world size — up to
+       ``fault_restarts`` times per world size;
+    2. **elastic scale-down**: fault budget exhausted → relaunch at
+       world size − 1, as long as that stays ≥ min_np (the ``--np M:N``
+       range). The fault budget refreshes at each new size;
+    3. below min_np → the job fails (the reference's HOLD state is a
+       scheduler concern; a local controller can only stop).
+
+    Each relaunch gets a FRESH rendezvous port and an incremented
+    ``PADDLE_ELASTIC_RESTART`` so workers can resume from their own
+    checkpoints (framework.io / distributed.checkpoint reshard-on-load
+    covers the world-size change).
+
+    Dead workers are detected by process liveness (the single-host
+    analogue of missed heartbeats; multi-host pods layer
+    fleet.elastic.ElasticManager's TCPStore heartbeats on top).
+    """
+
+    def __init__(self, script: str, script_args: Optional[List[str]] = None,
+                 np_range=(1, 1), master: str = "127.0.0.1",
+                 fault_restarts: int = 1, poll: float = 0.05):
+        self.script = script
+        self.script_args = script_args or []
+        self.min_np, self.max_np = np_range
+        if self.min_np > self.max_np:
+            raise ValueError(f"--np {self.min_np}:{self.max_np}: min > max")
+        self.master = master
+        self.fault_restarts = fault_restarts
+        self.poll = poll
+        self.restart_count = 0
+        self.history: List[dict] = []    # [{"np": n, "codes": [...]}]
+
+    def _spawn(self, nproc: int):
+        port = _free_port()
+        procs = []
+        for rank in range(nproc):
+            env = _env_for_rank(rank, nproc, self.master, port)
+            env["PADDLE_ELASTIC_RESTART"] = str(self.restart_count)
+            env["PADDLE_ELASTIC_NP_RANGE"] = f"{self.min_np}:{self.max_np}"
+            procs.append(subprocess.Popen(
+                [sys.executable, self.script, *self.script_args], env=env))
+        return procs
+
+    def _run_once(self, nproc: int) -> List[int]:
+        """One job round at world size ``nproc``: returns exit codes (a
+        dead worker kills the round — collective programs cannot lose a
+        rank mid-flight; stragglers get SIGTERM then SIGKILL)."""
+        return _wait_round(self._spawn(nproc), self.poll)
+
+    def run(self) -> int:
+        nproc = self.max_np
+        budget = self.fault_restarts
+        while True:
+            codes = self._run_once(nproc)
+            self.history.append({"np": nproc, "codes": codes})
+            if all(c == 0 for c in codes):
+                return 0
+            if budget > 0:               # tier 1: same-size restart
+                budget -= 1
+            elif nproc - 1 >= self.min_np:  # tier 2: scale down
+                nproc -= 1
+                budget = self.fault_restarts
+            else:                        # tier 3: out of range
+                return next((c for c in codes if c != 0), 1)
+            self.restart_count += 1
+            time.sleep(0.2)
+
+
+def launch_elastic(script: str, script_args: Optional[List[str]] = None,
+                   np_range=(1, 1), master: str = "127.0.0.1",
+                   fault_restarts: int = 1) -> int:
+    return ElasticController(script, script_args, np_range, master,
+                             fault_restarts).run()
+
+
+def _parse_np(spec: str):
+    """'M:N' or 'N' → (min, max) — the reference's --np range syntax."""
+    if ":" in spec:
+        lo, hi = spec.split(":", 1)
+        return int(lo), int(hi)
+    return int(spec), int(spec)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -95,9 +210,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--master", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--max_restarts", type=int, default=0)
+    ap.add_argument("--np", dest="np_spec", default=None,
+                    help="elastic world-size range 'M:N' (or fixed 'N'): "
+                         "dead workers trigger fault-level restart, then "
+                         "scale-down within the range")
+    ap.add_argument("--elastic_fault_restarts", type=int, default=1)
     ap.add_argument("script")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     ns = ap.parse_args(argv)
+    if ns.np_spec is not None:
+        return launch_elastic(ns.script, ns.script_args,
+                              _parse_np(ns.np_spec), ns.master,
+                              ns.elastic_fault_restarts)
     return launch(ns.script, ns.script_args, ns.nproc_per_node, ns.master,
                   ns.port, ns.max_restarts)
 

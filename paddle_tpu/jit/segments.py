@@ -336,7 +336,7 @@ class SegmentRecorder:
             for sn in nd.in_snaps:
                 v = sn.value
                 if isinstance(v, _Lazy) and v.real is None:
-                    key_parts.append(("lz", lazy_pos[id(v)]))
+                    key_parts.append(("lz", lazy_pos[id(v)], sn.sg))
                 else:
                     k = sn.key()
                     if k not in in_index:
@@ -363,29 +363,46 @@ class SegmentRecorder:
             _SEGMENT_CACHE.clear()
         jitted = _SEGMENT_CACHE.get(key)
         if jitted is None:
-            snap_pos = {sn.key(): i for i, sn in enumerate(in_snaps)}
+            # the cached closure must reference ONLY the extracted plan —
+            # never nodes/snaps/lazies, which pin the first call's input
+            # arrays, results, and GradNode vjp residuals (activations)
+            # for the cache entry's lifetime
+            plan = []
+            for nd in nodes:
+                srcs = []
+                for sn in nd.in_snaps:
+                    v = sn.value
+                    if isinstance(v, _Lazy) and v.real is None:
+                        # sg at the USE site: a detached view of a lazy
+                        # intermediate resolves to the same traced value —
+                        # the stop_gradient must wrap this use
+                        srcs.append(("env",) + lazy_pos[id(v)] + (sn.sg,))
+                    else:
+                        srcs.append(("in", in_index[sn.key()]))
+                plan.append((nd.fn, nd.s_args, nd.s_kwargs, tuple(srcs),
+                             nd.grad_on, len(nd.out_lazies)))
 
             def seg_fn(*in_vals):
                 env: dict = {}
-                for nd in nodes:
+                for ni, (fn, sa, sk, srcs, grad_on, n_out) in enumerate(
+                        plan):
                     vals = []
-                    for sn in nd.in_snaps:
-                        v = sn.value
-                        if isinstance(v, _Lazy) and id(v) in env:
-                            vals.append(env[id(v)])
+                    for s in srcs:
+                        if s[0] == "env":
+                            v = env[s[1:3]]
+                            vals.append(jax.lax.stop_gradient(v)
+                                        if s[3] else v)
                         else:
-                            vals.append(in_vals[snap_pos[sn.key()]])
-                    out = nd.fn(*_fill(nd.s_args, vals),
-                                **_fill(nd.s_kwargs, vals))
+                            vals.append(in_vals[s[1]])
+                    out = fn(*_fill(sa, vals), **_fill(sk, vals))
                     outs = (tuple(out) if isinstance(out, (tuple, list))
                             else (out,))
-                    if not nd.grad_on:
+                    if not grad_on:
                         outs = tuple(jax.lax.stop_gradient(o)
                                      for o in outs)
                     for j, o in enumerate(outs):
-                        env[id(nd.out_lazies[j])] = o
-                return tuple(env[id(nodes[ni].out_lazies[j])]
-                             for ni, j in out_sel)
+                        env[(ni, j)] = o
+                return tuple(env[k] for k in out_sel)
 
             jitted = jax.jit(seg_fn)
             _SEGMENT_CACHE[key] = jitted
@@ -409,9 +426,13 @@ class SegmentRecorder:
             object.__setattr__(lz, "real", res._value)
             for t in self._live_owners(lz):
                 t._value = res._value
-                t._grad_node = res._grad_node
-                t._output_index = res._output_index
-                t.stop_gradient = res.stop_gradient
+                if not t.stop_gradient:
+                    # owners that detached (detach()/detach_() set
+                    # stop_gradient=True while sharing the lazy) keep
+                    # their detachment — no grad node reattached
+                    t._grad_node = res._grad_node
+                    t._output_index = res._output_index
+                    t.stop_gradient = res.stop_gradient
         for nd in nodes:
             for lz in nd.out_lazies:
                 self._owners.pop(id(lz), None)
@@ -508,10 +529,16 @@ class segment_scope:
 
     def __exit__(self, *exc):
         try:
-            if exc[0] is None:
+            try:
+                # flush even on error: escaped tensors (buffers rebound by
+                # in-place ops) must not be left referencing a dropped
+                # tape — the recorded ops are valid regardless of why the
+                # python after them raised
                 self.rec.flush()
-            else:
-                self.rec.nodes.clear()   # error: drop the pending tape
+            except Exception:
+                if exc[0] is None:
+                    raise
+                self.rec.nodes.clear()   # already unwinding: best effort
         finally:
             _tls.rec = self._prev
         return False

@@ -234,3 +234,36 @@ def test_inner_compiled_static_function_not_cache_poisoned():
             _ = st(x)
         n1 = len(S._SEGMENT_CACHE)
     assert n1 == n0, f"segment cache grew {n0}->{n1} on replay"
+
+
+def test_detached_lazy_intermediate_stays_detached():
+    """detach() of a LAZY intermediate must not get a grad node reattached
+    at flush (r4 review round 2: grads doubled through detach)."""
+    from paddle_tpu.jit.segments import segment_scope
+
+    p = paddle.to_tensor(np.full((2,), 3.0, np.float32),
+                         stop_gradient=False)
+    with segment_scope():
+        h = p * 3.0                      # lazy intermediate
+        d = h.detach()
+        loss = (h * d).sum()
+    loss.backward()
+    # d/dp (h * sg(h)) = 3 * d = 27; NOT 2*9p = 54
+    np.testing.assert_allclose(p.grad.numpy(), [27.0, 27.0])
+    assert d.stop_gradient
+
+
+def test_exception_in_scope_still_binds_escaped_tensors():
+    """An exception inside a segment scope must flush the valid pending
+    tape so escaped tensors stay usable (r4 review round 2: in-place
+    rebinding + error bricked module buffers)."""
+    from paddle_tpu.jit.segments import segment_scope
+
+    x = paddle.to_tensor(np.ones((3,), np.float32))
+    try:
+        with segment_scope():
+            y = x * 2.0
+            raise ValueError("user error after recording")
+    except ValueError:
+        pass
+    np.testing.assert_allclose(y.numpy(), [2.0, 2.0, 2.0])
