@@ -101,7 +101,7 @@ def launch(script: str, script_args: Optional[List[str]] = None,
             procs.append(subprocess.Popen(
                 [sys.executable, script, *script_args], env=env))
         codes = _wait_round(procs)
-        if all(c == 0 for c in codes):
+        if codes and all(c == 0 for c in codes):
             return 0
         if attempt < max_restarts:
             time.sleep(1.0)
@@ -143,6 +143,9 @@ class ElasticController:
         self.min_np, self.max_np = np_range
         if self.min_np > self.max_np:
             raise ValueError(f"--np {self.min_np}:{self.max_np}: min > max")
+        if self.min_np < 1:
+            # scale-down to 0 workers would vacuously "succeed"
+            raise ValueError(f"--np {self.min_np}:{self.max_np}: min < 1")
         self.master = master
         self.fault_restarts = fault_restarts
         self.poll = poll
@@ -172,7 +175,7 @@ class ElasticController:
         while True:
             codes = self._run_once(nproc)
             self.history.append({"np": nproc, "codes": codes})
-            if all(c == 0 for c in codes):
+            if codes and all(c == 0 for c in codes):
                 return 0
             if budget > 0:               # tier 1: same-size restart
                 budget -= 1
@@ -214,14 +217,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="elastic world-size range 'M:N' (or fixed 'N'): "
                          "dead workers trigger fault-level restart, then "
                          "scale-down within the range")
-    ap.add_argument("--elastic_fault_restarts", type=int, default=1)
+    ap.add_argument("--elastic_fault_restarts", type=int, default=None)
     ap.add_argument("script")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     ns = ap.parse_args(argv)
     if ns.np_spec is not None:
+        if ns.port:
+            ap.error("--np is incompatible with --port: each elastic "
+                     "round needs a fresh rendezvous port")
+        # --max_restarts maps onto the per-size fault budget so an
+        # explicit restart request is never silently dropped
+        fault = ns.elastic_fault_restarts
+        if fault is None:
+            fault = ns.max_restarts if ns.max_restarts else 1
         return launch_elastic(ns.script, ns.script_args,
-                              _parse_np(ns.np_spec), ns.master,
-                              ns.elastic_fault_restarts)
+                              _parse_np(ns.np_spec), ns.master, fault)
     return launch(ns.script, ns.script_args, ns.nproc_per_node, ns.master,
                   ns.port, ns.max_restarts)
 

@@ -440,8 +440,13 @@ def load(path, **configs) -> TranslatedLayer:
         exported = jax.export.deserialize(f.read())
     with open(path + ".pdiparams", "rb") as f:
         state = _from_serializable(pickle.load(f))
-    params = {k: v._value for k, v in state["params"].items()}
-    buffers = {k: v._value for k, v in state["buffers"].items()}
+    unwrap = lambda tree: jax.tree_util.tree_map(
+        lambda v: v._value if isinstance(v, Tensor) else v, tree,
+        is_leaf=lambda v: isinstance(v, Tensor))
+    # params may be a NESTED tree (llama.export_for_inference int8
+    # exports carry {"q","s"} leaves per weight), not just a flat dict
+    params = unwrap(state["params"])
+    buffers = unwrap(state["buffers"])
     return TranslatedLayer(exported, params, buffers)
 
 

@@ -1044,3 +1044,51 @@ def to_hf_state_dict(params, config: LlamaConfig):
     if not c.tie_embeddings:
         out["lm_head.weight"] = np.asarray(params["lm_head"]).T
     return out
+
+
+def export_for_inference(params, config: LlamaConfig, path: str,
+                         prompt_len: int, max_new_tokens: int,
+                         batch: int = 1, quantize: bool = False):
+    """Export a serving-ready greedy generation program in the
+    ``paddle.jit.save`` artifact format (``.pdmodel`` StableHLO +
+    ``.pdiparams``), optionally with int8 weight-only parameters — the
+    end-to-end path from a trained model to ``paddle.inference``.
+
+    Parity: the reference's save_optimized_model / AnalysisPredictor
+    pipeline with a quant pass
+    (paddle/fluid/inference/api/analysis_predictor.cc:1574); TPU-native,
+    the "optimization pass" is quantize_params (the dequant fuses into
+    the XLA matmuls) + jax.export ahead-of-time lowering of the fused
+    prefill+decode while_loop.
+
+    The artifact loads through ``paddle.jit.load`` /
+    ``paddle.inference.create_predictor``: one input ``[batch,
+    prompt_len]`` int32 prompt, one output ``[batch, prompt_len +
+    max_new_tokens]`` generated ids (greedy, no eos early-exit so the
+    program shape is static).
+    """
+    import pickle
+
+    from ..framework.io import _to_serializable
+    from ..core.tensor import Tensor
+
+    p_exp = jax.jit(quantize_params)(params) if quantize else params
+
+    def pure(p, bufs, prompt):
+        out, _ = _generate_fused_jit(
+            p, prompt, jax.random.PRNGKey(0), jnp.float32(1e-6),
+            jnp.int32(0), jnp.float32(1.0), jnp.asarray(0, jnp.int32),
+            config, max_new_tokens, sampled=False, use_top_k=False,
+            use_top_p=False, has_eos=False)
+        return (out,)
+
+    example = jnp.zeros((batch, prompt_len), jnp.int32)
+    exported = jax.export.export(jax.jit(pure))(p_exp, {}, example)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    wrap = lambda v: Tensor(v, stop_gradient=True)
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(_to_serializable(
+            {"params": jax.tree_util.tree_map(wrap, p_exp),
+             "buffers": {}}), f)
+    return exported

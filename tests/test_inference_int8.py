@@ -1,0 +1,101 @@
+"""End-to-end quantized inference export (VERDICT r3 #7): int8
+weight-only llama exported via llama.export_for_inference, loaded and
+served through paddle.inference.create_predictor, matching the
+quantize_params eager path exactly. Parity shape: save_optimized_model →
+AnalysisPredictor with a quant pass
+(paddle/fluid/inference/api/analysis_predictor.cc:1574).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models import llama
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = llama.tiny_llama(vocab=128, hidden=64, layers=2, heads=4,
+                           kv_heads=2, seq=64)
+    params = jax.jit(lambda k: jax.tree_util.tree_map(
+        lambda p: p.astype(jnp.bfloat16),
+        llama.init_params(cfg, k)))(jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _prompt(cfg, batch=2, n=8, seed=1):
+    return jax.random.randint(jax.random.PRNGKey(seed), (batch, n), 1,
+                              cfg.vocab_size)
+
+
+def test_int8_export_predictor_matches_eager(tmp_path, tiny):
+    cfg, params = tiny
+    path = str(tmp_path / "llama_int8")
+    llama.export_for_inference(params, cfg, path, prompt_len=8,
+                               max_new_tokens=6, batch=2, quantize=True)
+
+    prompt = _prompt(cfg)
+    qp = llama.quantize_params(params)
+    ref = np.asarray(llama.generate_fused(qp, prompt, cfg,
+                                          max_new_tokens=6))
+
+    from paddle_tpu import inference
+
+    config = inference.Config(path)
+    pred = inference.create_predictor(config)
+    outs = pred.run([np.asarray(prompt)])
+    np.testing.assert_array_equal(outs[0], ref)
+
+
+def test_int8_export_artifact_is_quantized(tmp_path, tiny):
+    cfg, params = tiny
+    path = str(tmp_path / "llama_int8b")
+    llama.export_for_inference(params, cfg, path, prompt_len=8,
+                               max_new_tokens=2, quantize=True)
+    import pickle
+
+    from paddle_tpu.framework.io import _from_serializable
+
+    with open(path + ".pdiparams", "rb") as f:
+        state = _from_serializable(pickle.load(f))
+    wq = state["params"]["layers"]["wq"]
+    assert set(wq) == {"q", "s"}
+    assert "int8" in str(wq["q"].dtype)
+    # int8 payload ≈ half the bf16 bytes for the quantized leaves
+    assert np.asarray(wq["q"]._value).nbytes == np.prod(wq["q"].shape)
+
+
+def test_bf16_export_predictor_matches_eager(tmp_path, tiny):
+    cfg, params = tiny
+    path = str(tmp_path / "llama_bf16")
+    llama.export_for_inference(params, cfg, path, prompt_len=8,
+                               max_new_tokens=4, batch=1, quantize=False)
+    prompt = _prompt(cfg, batch=1)
+    ref = np.asarray(llama.generate_fused(params, prompt, cfg,
+                                          max_new_tokens=4))
+    from paddle_tpu import jit as pjit
+
+    layer = pjit.load(path)
+    out = layer(prompt)
+    np.testing.assert_array_equal(np.asarray(out._value), ref)
+
+
+def test_serving_engine_runs_int8(tiny):
+    """The continuous-batching engine serves int8 weight-only params and
+    matches the eager quantized generate path (the bench's int8 serving
+    row exercises the same wiring)."""
+    from paddle_tpu.serving import LLMEngine
+
+    cfg, params = tiny
+    qp = jax.jit(llama.quantize_params)(params)
+    prompt = _prompt(cfg, batch=1, n=8)
+    ref = np.asarray(llama.generate_fused(qp, prompt, cfg,
+                                          max_new_tokens=6))[0, 8:]
+
+    eng = LLMEngine(qp, cfg, max_slots=2, block_size=16, max_model_len=64,
+                    prompt_buckets=[16], decode_steps=4)
+    rid = eng.add_request([int(t) for t in np.asarray(prompt)[0]],
+                          max_new_tokens=6, temperature=0.0)
+    out = eng.run()
+    np.testing.assert_array_equal(np.asarray(out[rid]), ref)

@@ -1,0 +1,111 @@
+"""Elastic np-range controller: REAL worker processes, really killed
+(VERDICT r3 #6 — reference: fleet/elastic/manager.py:125,248-313 np-range +
+restart tiers, launch/controllers/master.py:59,253 dead-pod watcher +
+restart_peer). Pure-subprocess tests: no native runtime needed (unlike
+test_elastic.py's TCPStore membership tests)."""
+import time
+
+import pytest
+
+
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+
+
+def _worker_script(tmp_path, run_secs=1.2):
+    p = tmp_path / "worker.py"
+    p.write_text(textwrap.dedent(f"""
+        import os, time, pathlib
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        world = os.environ["PADDLE_TRAINERS_NUM"]
+        restart = os.environ["PADDLE_ELASTIC_RESTART"]
+        d = pathlib.Path({str(tmp_path)!r})
+        (d / f"pid_{{restart}}_{{rank}}").write_text(str(os.getpid()))
+        t0 = time.time()
+        while time.time() - t0 < {run_secs}:
+            time.sleep(0.05)
+        (d / f"done_{{restart}}_{{rank}}").write_text(world)
+    """))
+    return str(p)
+
+
+def _kill_rank(tmp_path, restart, rank, timeout=10.0):
+    """Wait for the worker's pid file, then SIGKILL it — a real pod death."""
+    f = tmp_path / f"pid_{restart}_{rank}"
+    deadline = time.time() + timeout
+    while not f.exists():
+        if time.time() > deadline:
+            raise TimeoutError(f"no pid file {f}")
+        time.sleep(0.02)
+    os.kill(int(f.read_text()), signal.SIGKILL)
+
+
+def test_elastic_scale_down_on_worker_kill(tmp_path):
+    """Kill one of three workers; fault budget 0 → the controller rebuilds
+    the env contract and the job RESUMES at world size 2 (the np range's
+    floor side) and completes there."""
+    from paddle_tpu.distributed.launch import ElasticController
+
+    ctl = ElasticController(_worker_script(tmp_path), np_range=(2, 3),
+                            fault_restarts=0)
+    killer = threading.Thread(target=_kill_rank, args=(tmp_path, 0, 1),
+                              daemon=True)
+    killer.start()
+    rc = ctl.run()
+    killer.join(5)
+    assert rc == 0
+    assert ctl.restart_count == 1
+    assert [h["np"] for h in ctl.history] == [3, 2]
+    # the resumed round really ran at the NEW world size
+    for rank in range(2):
+        f = tmp_path / f"done_1_{rank}"
+        assert f.exists(), f
+        assert f.read_text() == "2"
+    assert not (tmp_path / "done_1_2").exists()
+
+
+def test_elastic_fault_level_restart_same_size(tmp_path):
+    """With fault budget available, a killed worker restarts the job at
+    the SAME world size (tier-1 fault-level restart)."""
+    from paddle_tpu.distributed.launch import ElasticController
+
+    ctl = ElasticController(_worker_script(tmp_path), np_range=(2, 3),
+                            fault_restarts=1)
+    killer = threading.Thread(target=_kill_rank, args=(tmp_path, 0, 2),
+                              daemon=True)
+    killer.start()
+    rc = ctl.run()
+    killer.join(5)
+    assert rc == 0
+    assert [h["np"] for h in ctl.history] == [3, 3]
+    for rank in range(3):
+        assert (tmp_path / f"done_1_{rank}").read_text() == "3"
+
+
+def test_elastic_below_min_np_fails(tmp_path):
+    """A worker that always dies exhausts the range and the job fails."""
+    from paddle_tpu.distributed.launch import ElasticController
+
+    p = tmp_path / "bad.py"
+    p.write_text("import os, sys\n"
+                 "sys.exit(3 if os.environ['PADDLE_TRAINER_ID'] == '0' "
+                 "else 0)\n")
+    ctl = ElasticController(str(p), np_range=(1, 2), fault_restarts=0)
+    rc = ctl.run()
+    assert rc == 3
+    assert [h["np"] for h in ctl.history] == [2, 1]
+
+
+def test_np_range_validation():
+    from paddle_tpu.distributed.launch import ElasticController, _parse_np
+
+    with pytest.raises(ValueError, match="min < 1"):
+        ElasticController("x.py", np_range=(0, 3))
+    with pytest.raises(ValueError, match="min > max"):
+        ElasticController("x.py", np_range=(4, 2))
+    assert _parse_np("2:4") == (2, 4)
+    assert _parse_np("3") == (3, 3)
