@@ -358,14 +358,18 @@ def bench_decode(dev, results):
         })
         return tps
 
+    def tree_bytes(p):
+        # roofline from ACTUAL weight bytes (int8 q + bf16 scales/norms),
+        # matching bench_serving's denominator exactly
+        return sum(x.nbytes for x in jax.tree_util.tree_leaves(p))
+
     try:
         params = _init_bf16_params(cfg)
-        n = llama.num_params(params)
-        t_bf16 = _retry(lambda: run(params, "bf16", 2.0 * n))
+        t_bf16 = _retry(lambda: run(params, "bf16", tree_bytes(params)))
         qp = jax.jit(llama.quantize_params)(params)
         params = None
         _release()
-        t_int8 = _retry(lambda: run(qp, "int8", 1.0 * n))
+        t_int8 = _retry(lambda: run(qp, "int8", tree_bytes(qp)))
         results[-1]["speedup_vs_bf16"] = round(t_int8 / t_bf16, 3)
     except Exception as e:
         results.append({"metric": "decode_bench_failed", "value": 0.0,
@@ -388,9 +392,9 @@ def bench_serving(dev, results):
     import numpy as np
     cfg = _decode_cfg_2p6b()
     SLOTS, NEW = 8, 128
-    def attempt():
-        params = _init_bf16_params(cfg)
-        n = llama.num_params(params)
+
+    def attempt(tag, make_params):
+        params = make_params()
         # decode_steps=64: one compiled call per 64 tokens/slot — measured
         # +30% engine throughput over 16 on the tunnel-attached chip
         # (admission granularity coarsens to 64, fine for throughput)
@@ -413,9 +417,13 @@ def bench_serving(dev, results):
         # engine.results is cumulative — count only the timed requests
         gen = sum(len(out[r]) for r in rids)
         tps = gen / dt
-        roofline = SLOTS * _hbm_bw(dev) / (2.0 * n)
+        # decode is weight-bandwidth-bound: roofline from the ACTUAL
+        # weight bytes read per step (int8 quantization ~halves them)
+        wbytes = sum(x.nbytes
+                     for x in jax.tree_util.tree_leaves(params))
+        roofline = SLOTS * _hbm_bw(dev) / wbytes
         results.append({
-            "metric": "llama-2.6b_serving_engine_tokens_per_sec",
+            "metric": f"llama-2.6b_serving_engine_{tag}_tokens_per_sec",
             "value": round(tps, 1),
             "unit": "tokens/s",
             "vs_baseline": round(tps / (0.40 * roofline), 4),
@@ -423,7 +431,13 @@ def bench_serving(dev, results):
         })
 
     try:
-        _retry(attempt)
+        _retry(lambda: attempt("bf16", lambda: _init_bf16_params(cfg)))
+        _release()
+        # int8 weight-only serving (quantize_params / the inference-export
+        # precision path) — same engine, ~half the weight bytes per step
+        _retry(lambda: attempt(
+            "int8",
+            lambda: jax.jit(llama.quantize_params)(_init_bf16_params(cfg))))
     except Exception as e:
         results.append({"metric": "serving_bench_failed", "value": 0.0,
                         "unit": "tokens/s", "vs_baseline": 0.0,

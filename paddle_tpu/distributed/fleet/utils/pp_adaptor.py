@@ -1,0 +1,97 @@
+"""Pipeline-parallel checkpoint adaptor.
+
+Parity: ``python/paddle/distributed/fleet/utils/pp_parallel_adaptor.py``
+(PipeLineModelAdaptor) — the reference saves one ``model_state.pdparams``
+segment per pp rank with stage-local layer names and the adaptor
+re-segments them when the pp/vpp degree changes between save and resume.
+
+TPU-native position: the framework's OWN canonical layout never needs
+adapting — every pipeline schedule (GPipe / interleaved VPP / 1F1B /
+ZB-H1 in distributed/pipeline.py) consumes the flat layer-stacked
+``[L, ...]`` tree and splits stages INSIDE the compiled program, so a
+dist-checkpoint saved from a pp=2 run reshard-on-loads straight into a
+pp=4 mesh (distributed/checkpoint.py). This module covers the remaining
+parity surface: converting between that flat canonical form and
+reference-style PER-STAGE SEGMENT checkpoints (one subtree per pp rank,
+stage-local layer indices, contiguous or VPP-interleaved), and therefore
+between any two (pp, vpp) segmentations.
+
+Layer→stage maps mirror ``pipeline.py`` exactly:
+- contiguous (``vpp=1``, the 1F1B/ZB/GPipe ``split_stages``): stage ``s``
+  owns layers ``[s·L/pp, (s+1)·L/pp)``;
+- interleaved (``vpp>1``, ``split_chunks``): chunk ``c`` = layers
+  ``[c·per, (c+1)·per)`` with ``per = L/(pp·vpp)``; stage ``s`` owns
+  chunks ``c ≡ s (mod pp)`` in round order — the circular VPP placement.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["stage_layer_indices", "segment_state", "merge_segments",
+           "convert_segments"]
+
+
+def stage_layer_indices(num_layers: int, pp: int,
+                        vpp_chunks: int = 1) -> List[List[int]]:
+    """Global layer indices owned by each stage, in each stage's LOCAL
+    storage order (chunk-major for vpp — matching pipeline.split_chunks'
+    ``[n_stages, num_chunks, per, ...]`` layout)."""
+    L = num_layers
+    if L % (pp * vpp_chunks):
+        raise ValueError(
+            f"{L} layers do not split over pp={pp} x vpp={vpp_chunks}")
+    per = L // (pp * vpp_chunks)
+    out = []
+    for s in range(pp):
+        idx: List[int] = []
+        for r in range(vpp_chunks):
+            c = r * pp + s           # circular interleave: chunk c = r*pp+s
+            idx.extend(range(c * per, (c + 1) * per))
+        out.append(idx)
+    return out
+
+
+def segment_state(stacked_tree, pp: int, vpp_chunks: int = 1
+                  ) -> List[Any]:
+    """Flat layer-stacked tree (leaves ``[L, ...]``) → one subtree per pp
+    stage (leaves ``[L/pp, ...]`` in stage-local order)."""
+    leaves = jax.tree_util.tree_leaves(stacked_tree)
+    if not leaves:
+        return [stacked_tree for _ in range(pp)]
+    L = leaves[0].shape[0]
+    idxs = stage_layer_indices(L, pp, vpp_chunks)
+    return [jax.tree_util.tree_map(lambda a: jnp.take(a, jnp.asarray(ix),
+                                                      axis=0), stacked_tree)
+            for ix in idxs]
+
+
+def merge_segments(segments: List[Any], pp: int, vpp_chunks: int = 1):
+    """Per-stage segments → the flat layer-stacked canonical tree."""
+    if len(segments) != pp:
+        raise ValueError(f"expected {pp} segments, got {len(segments)}")
+    leaves = jax.tree_util.tree_leaves(segments[0])
+    per_stage = leaves[0].shape[0] if leaves else 0
+    L = per_stage * pp
+    idxs = stage_layer_indices(L, pp, vpp_chunks)
+    # inverse permutation: global layer g lives at (stage s, local j)
+    order = np.empty(L, np.int64)
+    for s, ix in enumerate(idxs):
+        for j, g in enumerate(ix):
+            order[g] = s * per_stage + j
+    cat = jax.tree_util.tree_map(
+        lambda *xs: jnp.concatenate(xs, axis=0), *segments)
+    return jax.tree_util.tree_map(
+        lambda a: jnp.take(a, jnp.asarray(order), axis=0), cat)
+
+
+def convert_segments(segments: List[Any], src: Tuple[int, int],
+                     dst: Tuple[int, int]) -> List[Any]:
+    """Re-segment a per-stage checkpoint from (pp, vpp) ``src`` to
+    ``dst`` — the reference adaptor's pp2↔pp4 / vpp conversion, through
+    the flat canonical form."""
+    flat = merge_segments(segments, src[0], src[1])
+    return segment_state(flat, dst[0], dst[1])

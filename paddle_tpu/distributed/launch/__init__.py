@@ -212,7 +212,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--nproc_per_node", "--nprocs", type=int, default=1)
     ap.add_argument("--master", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=0)
-    ap.add_argument("--max_restarts", type=int, default=0)
+    ap.add_argument("--max_restarts", type=int, default=None)
     ap.add_argument("--np", dest="np_spec", default=None,
                     help="elastic world-size range 'M:N' (or fixed 'N'): "
                          "dead workers trigger fault-level restart, then "
@@ -226,14 +226,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             ap.error("--np is incompatible with --port: each elastic "
                      "round needs a fresh rendezvous port")
         # --max_restarts maps onto the per-size fault budget so an
-        # explicit restart request is never silently dropped
+        # explicit restart request is never silently dropped (including
+        # an explicit 0 — hence the None default sentinel)
         fault = ns.elastic_fault_restarts
         if fault is None:
-            fault = ns.max_restarts if ns.max_restarts else 1
+            fault = ns.max_restarts if ns.max_restarts is not None else 1
         return launch_elastic(ns.script, ns.script_args,
                               _parse_np(ns.np_spec), ns.master, fault)
     return launch(ns.script, ns.script_args, ns.nproc_per_node, ns.master,
-                  ns.port, ns.max_restarts)
+                  ns.port, ns.max_restarts or 0)
 
 
 if __name__ == "__main__":

@@ -406,13 +406,27 @@ def save(layer, path, input_spec=None, **configs):
 
     jitted = jax.jit(pure)
     exported = jax.export.export(jitted)(params, bufs, *example_args)
-    blob = exported.serialize()
+    write_artifact(path, exported, params, bufs)
+
+
+def write_artifact(path: str, exported, params_tree, buffers_tree):
+    """THE writer of the ``.pdmodel``/``.pdiparams`` artifact pair —
+    shared by :func:`save` and model-level exporters
+    (llama.export_for_inference), so the format :func:`load` parses has
+    exactly one producer. Param trees may be nested (int8 exports carry
+    {"q","s"} leaves)."""
+    import pickle
+
+    from ..framework.io import _to_serializable
+
     with open(path + ".pdmodel", "wb") as f:
-        f.write(blob)
+        f.write(exported.serialize())
+    wrap = lambda v: v if isinstance(v, Tensor) else Tensor(
+        v, stop_gradient=True)
     with open(path + ".pdiparams", "wb") as f:
-        pickle.dump(_to_serializable({"params": {k: Tensor(v) for k, v in params.items()},
-                                      "buffers": {k: Tensor(v) for k, v in bufs.items()}}),
-                    f)
+        pickle.dump(_to_serializable(
+            {"params": jax.tree_util.tree_map(wrap, params_tree),
+             "buffers": jax.tree_util.tree_map(wrap, buffers_tree)}), f)
 
 
 class TranslatedLayer(Layer):

@@ -1067,10 +1067,7 @@ def export_for_inference(params, config: LlamaConfig, path: str,
     max_new_tokens]`` generated ids (greedy, no eos early-exit so the
     program shape is static).
     """
-    import pickle
-
-    from ..framework.io import _to_serializable
-    from ..core.tensor import Tensor
+    from ..jit import write_artifact
 
     p_exp = jax.jit(quantize_params)(params) if quantize else params
 
@@ -1084,11 +1081,5 @@ def export_for_inference(params, config: LlamaConfig, path: str,
 
     example = jnp.zeros((batch, prompt_len), jnp.int32)
     exported = jax.export.export(jax.jit(pure))(p_exp, {}, example)
-    with open(path + ".pdmodel", "wb") as f:
-        f.write(exported.serialize())
-    wrap = lambda v: Tensor(v, stop_gradient=True)
-    with open(path + ".pdiparams", "wb") as f:
-        pickle.dump(_to_serializable(
-            {"params": jax.tree_util.tree_map(wrap, p_exp),
-             "buffers": {}}), f)
+    write_artifact(path, exported, p_exp, {})
     return exported

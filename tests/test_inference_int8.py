@@ -13,6 +13,10 @@ import jax.numpy as jnp
 
 from paddle_tpu.models import llama
 
+# fused-generate exports are compile-heavy (~30 s total): full lane only,
+# like the analogous test_quant_generate engine test
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def tiny():

@@ -16,24 +16,29 @@ import textwrap
 import threading
 
 
-def _worker_script(tmp_path, run_secs=1.2):
+def _worker_script(tmp_path):
+    """Workers run until the test drops a stop_{restart} marker (or they
+    are killed) — no fixed time window, so a loaded CI machine cannot
+    race the kill against worker completion."""
     p = tmp_path / "worker.py"
     p.write_text(textwrap.dedent(f"""
-        import os, time, pathlib
+        import os, sys, time, pathlib
         rank = os.environ["PADDLE_TRAINER_ID"]
         world = os.environ["PADDLE_TRAINERS_NUM"]
         restart = os.environ["PADDLE_ELASTIC_RESTART"]
         d = pathlib.Path({str(tmp_path)!r})
         (d / f"pid_{{restart}}_{{rank}}").write_text(str(os.getpid()))
         t0 = time.time()
-        while time.time() - t0 < {run_secs}:
+        while not (d / f"stop_{{restart}}").exists():
+            if time.time() - t0 > 60:
+                sys.exit(7)          # safety: test forgot the marker
             time.sleep(0.05)
         (d / f"done_{{restart}}_{{rank}}").write_text(world)
     """))
     return str(p)
 
 
-def _kill_rank(tmp_path, restart, rank, timeout=10.0):
+def _kill_rank(tmp_path, restart, rank, timeout=45.0):
     """Wait for the worker's pid file, then SIGKILL it — a real pod death."""
     f = tmp_path / f"pid_{restart}_{rank}"
     deadline = time.time() + timeout
@@ -44,6 +49,16 @@ def _kill_rank(tmp_path, restart, rank, timeout=10.0):
     os.kill(int(f.read_text()), signal.SIGKILL)
 
 
+def _wait_pids(tmp_path, restart, n, timeout=45.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if all((tmp_path / f"pid_{restart}_{r}").exists()
+               for r in range(n)):
+            return
+        time.sleep(0.02)
+    raise TimeoutError(f"round {restart} never reached {n} workers")
+
+
 def test_elastic_scale_down_on_worker_kill(tmp_path):
     """Kill one of three workers; fault budget 0 → the controller rebuilds
     the env contract and the job RESUMES at world size 2 (the np range's
@@ -52,8 +67,13 @@ def test_elastic_scale_down_on_worker_kill(tmp_path):
 
     ctl = ElasticController(_worker_script(tmp_path), np_range=(2, 3),
                             fault_restarts=0)
-    killer = threading.Thread(target=_kill_rank, args=(tmp_path, 0, 1),
-                              daemon=True)
+
+    def orchestrate():
+        _kill_rank(tmp_path, 0, 1)        # round 0: kill rank 1
+        _wait_pids(tmp_path, 1, 2)        # round 1 up at np=2
+        (tmp_path / "stop_1").write_text("")
+
+    killer = threading.Thread(target=orchestrate, daemon=True)
     killer.start()
     rc = ctl.run()
     killer.join(5)
@@ -75,8 +95,13 @@ def test_elastic_fault_level_restart_same_size(tmp_path):
 
     ctl = ElasticController(_worker_script(tmp_path), np_range=(2, 3),
                             fault_restarts=1)
-    killer = threading.Thread(target=_kill_rank, args=(tmp_path, 0, 2),
-                              daemon=True)
+
+    def orchestrate():
+        _kill_rank(tmp_path, 0, 2)        # round 0: kill rank 2
+        _wait_pids(tmp_path, 1, 3)        # round 1 up at SAME np=3
+        (tmp_path / "stop_1").write_text("")
+
+    killer = threading.Thread(target=orchestrate, daemon=True)
     killer.start()
     rc = ctl.run()
     killer.join(5)

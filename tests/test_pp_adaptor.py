@@ -1,0 +1,114 @@
+"""PP checkpoint adaptor (VERDICT r3 #8): convert per-stage segmented
+checkpoints across pp/vpp degrees and resume training bit-compatibly.
+Parity: fleet/utils/pp_parallel_adaptor.py PipeLineModelAdaptor.
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from paddle_tpu.distributed.fleet.utils.pp_adaptor import (
+    convert_segments, merge_segments, segment_state, stage_layer_indices)
+from paddle_tpu.models import llama
+
+
+def test_stage_maps_match_pipeline_split():
+    # contiguous (split_stages): stage s owns a contiguous block
+    assert stage_layer_indices(8, 2) == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    assert stage_layer_indices(8, 4) == [[0, 1], [2, 3], [4, 5], [6, 7]]
+    # circular VPP (split_chunks): chunk c = r*pp + s
+    assert stage_layer_indices(8, 2, vpp_chunks=2) == [
+        [0, 1, 4, 5], [2, 3, 6, 7]]
+    with pytest.raises(ValueError):
+        stage_layer_indices(6, 4)
+
+
+def test_segment_merge_roundtrip_all_degrees():
+    tree = {"w": jnp.arange(8.0)[:, None] * jnp.ones((8, 3)),
+            "b": jnp.arange(8.0)}
+    for pp, vpp in [(2, 1), (4, 1), (2, 2), (4, 2), (8, 1)]:
+        segs = segment_state(tree, pp, vpp)
+        assert len(segs) == pp
+        assert segs[0]["w"].shape == (8 // pp, 3)
+        rt = merge_segments(segs, pp, vpp)
+        np.testing.assert_array_equal(np.asarray(rt["w"]),
+                                      np.asarray(tree["w"]))
+        np.testing.assert_array_equal(np.asarray(rt["b"]),
+                                      np.asarray(tree["b"]))
+
+
+def test_convert_pp2_to_pp4_contents():
+    tree = {"w": jnp.arange(8.0)}
+    segs2 = segment_state(tree, 2)
+    segs4 = convert_segments(segs2, src=(2, 1), dst=(4, 1))
+    got = [np.asarray(s["w"]).tolist() for s in segs4]
+    assert got == [[0, 1], [2, 3], [4, 5], [6, 7]]
+    # vpp re-interleave
+    segs_v = convert_segments(segs4, src=(4, 1), dst=(2, 2))
+    assert [np.asarray(s["w"]).tolist() for s in segs_v] == [
+        [0, 1, 4, 5], [2, 3, 6, 7]]
+
+
+def _mesh(pp):
+    devs = np.asarray(jax.devices()[:8])
+    return Mesh(devs.reshape(pp, 8 // pp // 2, 1, 2),
+                ("pp", "dp", "sp", "tp"))
+
+
+@pytest.mark.slow
+def test_pp2_to_pp4_resume_loss_curve():
+    """Save a pp=2 1F1B run's state as per-stage segments, convert to
+    pp=4, resume — the loss curve must match an uninterrupted run (the
+    schedule stages from the same flat tree, so the math is invariant to
+    the pp degree)."""
+    cfg = llama.tiny_llama(vocab=64, hidden=32, layers=8, heads=2,
+                           kv_heads=2, seq=16, ffn=64)
+    # f32 compute: the comparison is exact math equality across pp
+    # degrees; bf16 hidden states differ by stage-grouping reduction
+    # order (~2e-3 after 3 steps, measured) and would mask a real bug
+    cfg = dataclasses.replace(cfg, pipeline_microbatches=4,
+                              pipeline_schedule="1f1b", dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 17), 0,
+                                cfg.vocab_size)
+
+    def run(mesh, state, steps):
+        losses = []
+        with llama.activation_mesh(mesh):
+            step = jax.jit(lambda s, t: llama.train_step(s, t, cfg,
+                                                         lr=1e-2))
+            for _ in range(steps):
+                state, loss = step(state, tokens)
+                losses.append(float(loss))
+        return state, losses
+
+    # uninterrupted pp=2 reference
+    ref_state = llama.init_train_state(cfg, jax.random.PRNGKey(0))
+    _, ref_losses = run(_mesh(2), ref_state, 6)
+
+    # interrupted: 3 steps at pp=2 → segment(pp=2) → convert → pp=4 resume
+    state = llama.init_train_state(cfg, jax.random.PRNGKey(0))
+    state, losses_a = run(_mesh(2), state, 3)
+
+    segs = segment_state(state.params["layers"], pp=2)
+    nu_segs = segment_state(state.nu["layers"], pp=2)
+    segs4 = convert_segments(segs, src=(2, 1), dst=(4, 1))
+    nu4 = convert_segments(nu_segs, src=(2, 1), dst=(4, 1))
+
+    params = dict(state.params)
+    params["layers"] = merge_segments(segs4, pp=4)
+    nu = dict(state.nu)
+    nu["layers"] = merge_segments(nu4, pp=4)
+    resumed = llama.TrainState(params, state.mu, nu, state.step)
+    # canonical resume flow: re-place on the TARGET mesh's shardings
+    # (skipping this leaves stale pp=2 shardings on untouched leaves —
+    # shardy can crash on the mixed manual sub-axes)
+    m4 = _mesh(4)
+    resumed = llama.put_train_state(resumed, llama.make_shardings(cfg, m4))
+
+    _, losses_b = run(m4, resumed, 3)
+    np.testing.assert_allclose(losses_a + losses_b, ref_losses,
+                               rtol=2e-5, atol=2e-6)
