@@ -363,10 +363,6 @@ def ignore_module(modules):
 # fluid/pir/serialize_deserialize)
 # ---------------------------------------------------------------------------
 def save(layer, path, input_spec=None, **configs):
-    import pickle
-
-    from ..framework.io import _to_serializable
-
     if input_spec is None and getattr(layer, "_static_function", None):
         raise ValueError("input_spec is required to export")
     specs = input_spec or []
@@ -423,10 +419,13 @@ def write_artifact(path: str, exported, params_tree, buffers_tree):
         f.write(exported.serialize())
     wrap = lambda v: v if isinstance(v, Tensor) else Tensor(
         v, stop_gradient=True)
+    is_leaf = lambda v: isinstance(v, Tensor)   # Tensor is a pytree node
     with open(path + ".pdiparams", "wb") as f:
         pickle.dump(_to_serializable(
-            {"params": jax.tree_util.tree_map(wrap, params_tree),
-             "buffers": jax.tree_util.tree_map(wrap, buffers_tree)}), f)
+            {"params": jax.tree_util.tree_map(wrap, params_tree,
+                                              is_leaf=is_leaf),
+             "buffers": jax.tree_util.tree_map(wrap, buffers_tree,
+                                               is_leaf=is_leaf)}), f)
 
 
 class TranslatedLayer(Layer):

@@ -159,3 +159,76 @@ def test_activation_dtype_matrix(name, ref, dom, gradable):
     if gradable:
         check_grad(op, [rng.uniform(dom[0], dom[1],
                                     size=(4,)).astype(np.float32)])
+
+
+# ---------------------------------------------------------------------------
+# inplace `*_` variants (auto-discovered): numerics equal the out-of-place
+# op, the SAME tensor object is mutated, and the version counter bumps —
+# the OpTest inplace-variant check (reference: op_test.py check_inplace
+# family, legacy_test/op_test.py:2881)
+# ---------------------------------------------------------------------------
+_INPLACE_SKIP = {
+    # need non-float/special-domain inputs or extra operands; exercised by
+    # their own suites
+    "bernoulli_", "bitwise_and_", "bitwise_invert_", "bitwise_left_shift_",
+    "bitwise_not_", "bitwise_or_", "bitwise_right_shift_", "bitwise_xor_",
+    "cast_", "exponential_", "fill_", "fill_diagonal_", "flatten_",
+    "gamma_", "geometric_", "index_add_", "index_fill_", "index_put_",
+    "lcm_", "gcd_", "log_normal_", "normal_", "poisson_", "put_along_axis_",
+    "remainder_", "mod_", "floor_mod_", "floor_divide_", "renorm_",
+    "reshape_", "scatter_", "scatter_nd_add_", "squeeze_", "unsqueeze_",
+    "uniform_", "zero_", "masked_fill_", "masked_scatter_", "where_",
+    "set_value_", "t_", "transpose_", "lerp_", "apply_", "pow_",
+    "subtract_", "add_", "multiply_", "divide_", "clip_", "copysign_",
+    "cumprod_", "cumsum_", "equal_", "greater_equal_", "greater_than_",
+    "less_equal_", "less_than_", "not_equal_", "logical_and_",
+    "logical_not_", "logical_or_", "logical_xor_", "nan_to_num_",
+    "tril_", "triu_", "hypot_", "ldexp_", "logit_", "multigammaln_",
+    "i0_", "lgamma_", "digamma_", "erfinv_", "trunc_", "frac_",
+    # multi-operand signatures (this harness drives unary variants)
+    "addmm_", "gammainc_", "gammaincc_", "less_", "polygamma_",
+}
+
+
+def _unary_inplace_names():
+    import paddle_tpu as pt
+
+    names = []
+    for mod, ns in (("paddle", pt), ("F", F)):
+        for n in sorted(dir(ns)):
+            if (n.endswith("_") and not n.endswith("__")
+                    and n[:-1] in dir(ns) and callable(getattr(ns, n))
+                    and n not in _INPLACE_SKIP):
+                names.append((mod, n))
+    return names
+
+
+_INPLACE_NAMES = _unary_inplace_names()   # one collection-time scan
+
+
+_SAFE_DOMAIN = {
+    "acos_": (-0.9, 0.9), "asin_": (-0.9, 0.9), "atanh_": (-0.9, 0.9),
+    "acosh_": (1.1, 3.0), "log_": (0.2, 3.0), "log2_": (0.2, 3.0),
+    "log10_": (0.2, 3.0), "log1p_": (-0.5, 2.0), "rsqrt_": (0.3, 3.0),
+    "sqrt_": (0.3, 3.0), "reciprocal_": (0.5, 2.0),
+}
+
+
+@pytest.mark.parametrize("mod,name", _INPLACE_NAMES,
+                         ids=[f"{m}.{n}" for m, n in _INPLACE_NAMES])
+def test_inplace_variant_matches_outofplace(mod, name):
+    import paddle_tpu as pt
+
+    ns = pt if mod == "paddle" else F
+    op_ = getattr(ns, name)
+    op = getattr(ns, name[:-1])
+    lo, hi = _SAFE_DOMAIN.get(name, (-2.0, 2.0))
+    x_np = rng.uniform(lo, hi, size=(3, 5)).astype(np.float32)
+    ref = op(paddle.to_tensor(x_np))
+    t = paddle.to_tensor(x_np)
+    v0 = t._version
+    out = op_(t)
+    assert out is t, f"{name} must return the SAME tensor"
+    assert t._version > v0, f"{name} must bump the version counter"
+    np.testing.assert_allclose(t.numpy(), ref.numpy(), rtol=1e-6,
+                               atol=1e-6, err_msg=name)
