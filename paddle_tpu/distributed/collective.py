@@ -22,6 +22,7 @@ import numpy as np
 
 from ..core.tensor import Tensor
 from .env import get_rank, get_world_size
+from .watchdog import guarded as _guarded
 
 
 class ReduceOp:
@@ -100,7 +101,11 @@ def _multi_process(group: Optional[Group]) -> bool:
 def _allgather_arrays(value, group):
     from jax.experimental import multihost_utils
 
-    return multihost_utils.process_allgather(value, tiled=False)
+    # every eager rendezvous is watchdog-guarded here, one level below the
+    # public API, so all_reduce/all_gather/gather/reduce/scatter share the
+    # dead-peer teardown path (distributed/watchdog.py)
+    with _guarded("allgather_rendezvous"):
+        return multihost_utils.process_allgather(value, tiled=False)
 
 
 def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
@@ -140,8 +145,9 @@ def broadcast(tensor: Tensor, src: int = 0, group: Optional[Group] = None,
         return tensor
     from jax.experimental import multihost_utils
 
-    val = multihost_utils.broadcast_one_to_all(
-        tensor._value, is_source=get_rank() == src)
+    with _guarded("broadcast_rendezvous"):
+        val = multihost_utils.broadcast_one_to_all(
+            tensor._value, is_source=get_rank() == src)
     tensor._replace_value(val)
     return tensor
 
@@ -199,7 +205,10 @@ def barrier(group: Optional[Group] = None):
     if get_world_size() > 1:
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+        # an installed CommWatchdog (distributed/watchdog.py) tears the
+        # process down if a peer died and the rendezvous never completes
+        with _guarded("barrier"):
+            multihost_utils.sync_global_devices("paddle_tpu_barrier")
 
 
 def wait(tensor, group=None, use_calc_stream=True):
@@ -290,11 +299,13 @@ def broadcast_object_list(object_list, src=0, group=None):
     else:
         data = np.zeros(0, np.uint8)
         n = np.asarray([0], np.int64)
-    n = multihost_utils.broadcast_one_to_all(n, is_source=get_rank() == src)
-    buf = np.zeros(int(n[0]), np.uint8)
-    buf[:len(data)] = data
-    buf = multihost_utils.broadcast_one_to_all(buf,
-                                               is_source=get_rank() == src)
+    with _guarded("broadcast_object_rendezvous"):
+        n = multihost_utils.broadcast_one_to_all(
+            n, is_source=get_rank() == src)
+        buf = np.zeros(int(n[0]), np.uint8)
+        buf[:len(data)] = data
+        buf = multihost_utils.broadcast_one_to_all(
+            buf, is_source=get_rank() == src)
     got = pickle.loads(buf.tobytes())
     object_list[:] = got
 

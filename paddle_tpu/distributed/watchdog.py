@@ -1,0 +1,168 @@
+"""Communication / step watchdog — hang detection with teardown.
+
+Parity: the reference's CommTaskManager
+(paddle/phi/core/distributed/comm_task_manager.h:37) runs a background
+thread over enqueued NCCL comm tasks; a task that exceeds its timeout
+triggers ErrorHandlingMode::TearDown — the process aborts so the
+launcher-level watcher can restart the job.
+
+TPU-native shape: collectives live INSIDE compiled XLA programs, so the
+observable "comm task" granularity is the blocking host call — a step's
+device-to-host sync, an eager barrier/send/recv, a store rendezvous. The
+watchdog guards those: a monitor thread scans in-flight guarded regions,
+and one that exceeds its timeout logs a diagnostic and (in ``tear_down``
+mode) kills the process with a distinctive exit code. The elastic
+controller (distributed/launch ``--np M:N``) then sees a dead pod and
+restarts the job at the same or reduced world size — the full
+reference loop: watchdog → teardown → dead-pod watcher → restart tier.
+
+    wd = CommWatchdog(timeout=120.0)
+    with wd.task("allreduce-epoch3"):
+        loss = float(np.asarray(step(state, batch)))   # blocking sync
+
+``paddle.distributed``'s eager ``barrier``/``send``/``recv`` guard
+themselves automatically when a process-wide watchdog is installed
+(:func:`install`).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+__all__ = ["CommWatchdog", "install", "uninstall", "current", "guarded"]
+
+TEARDOWN_EXIT_CODE = 77     # distinctive: "watchdog killed me"
+
+_global: Optional["CommWatchdog"] = None
+
+
+class _Task:
+    __slots__ = ("name", "start", "timeout")
+
+    def __init__(self, name: str, timeout: float):
+        self.name = name
+        self.start = time.time()
+        self.timeout = timeout
+
+
+class CommWatchdog:
+    """Background monitor over guarded blocking regions.
+
+    mode:
+      - ``"tear_down"`` (reference ErrorHandlingMode::TearDown): print a
+        diagnostic and ``os._exit(TEARDOWN_EXIT_CODE)`` — the launcher's
+        dead-pod detection owns recovery;
+      - ``"log"``: report via ``on_timeout`` (default: stderr) and keep
+        running — the reference's NoHandling with logging.
+    """
+
+    def __init__(self, timeout: float = 300.0, mode: str = "tear_down",
+                 on_timeout: Optional[Callable[[str, float], None]] = None,
+                 poll: float = 0.2):
+        if mode not in ("tear_down", "log"):
+            raise ValueError(f"mode={mode!r}: 'tear_down' or 'log'")
+        self.timeout = timeout
+        self.mode = mode
+        self.on_timeout = on_timeout
+        self.poll = poll
+        self._tasks: Dict[int, _Task] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._fired = []               # (name, elapsed) of timeouts seen
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    # -- guarding ---------------------------------------------------------
+    def task(self, name: str, timeout: Optional[float] = None):
+        """Context manager marking one blocking region as watched."""
+        wd = self
+
+        class _Guard:
+            def __enter__(g):
+                g._t = _Task(name, timeout or wd.timeout)
+                with wd._lock:
+                    wd._tasks[id(g._t)] = g._t
+                return g._t
+
+            def __exit__(g, *exc):
+                with wd._lock:
+                    wd._tasks.pop(id(g._t), None)
+                return False
+
+        return _Guard()
+
+    # -- monitor ----------------------------------------------------------
+    def _loop(self):
+        while not self._stop.wait(self.poll):
+            now = time.time()
+            overdue = None
+            with self._lock:
+                for t in self._tasks.values():
+                    if now - t.start > t.timeout:
+                        overdue = t
+                        break
+                if overdue is not None:
+                    self._tasks.pop(id(overdue), None)
+            if overdue is None:
+                continue
+            elapsed = now - overdue.start
+            self._fired.append((overdue.name, elapsed))
+            msg = (f"[paddle_tpu watchdog] task '{overdue.name}' exceeded "
+                   f"{overdue.timeout:.0f}s (elapsed {elapsed:.0f}s) — ")
+            if self.mode == "tear_down":
+                sys.stderr.write(msg + "tearing down for restart\n")
+                sys.stderr.flush()
+                os._exit(TEARDOWN_EXIT_CODE)
+            if self.on_timeout is not None:
+                self.on_timeout(overdue.name, elapsed)
+            else:
+                sys.stderr.write(msg + "continuing (log mode)\n")
+                sys.stderr.flush()
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(2)
+
+
+def install(wd: Optional[CommWatchdog] = None, **kw) -> CommWatchdog:
+    """Install a process-wide watchdog; eager collectives auto-guard."""
+    global _global
+    if _global is not None:
+        _global.stop()
+    _global = wd or CommWatchdog(**kw)
+    return _global
+
+
+def uninstall():
+    global _global
+    if _global is not None:
+        _global.stop()
+    _global = None
+
+
+def current() -> Optional[CommWatchdog]:
+    return _global
+
+
+class guarded:
+    """Guard a region under the INSTALLED watchdog (no-op when absent) —
+    the hook eager collectives use."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._cm = None
+
+    def __enter__(self):
+        wd = _global
+        if wd is not None:
+            self._cm = wd.task(self.name)
+            self._cm.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        if self._cm is not None:
+            return self._cm.__exit__(*exc)
+        return False

@@ -1,0 +1,102 @@
+"""Comm/step watchdog (distributed/watchdog.py) — hang detection with
+teardown, closing the reference CommTaskManager loop
+(paddle/phi/core/distributed/comm_task_manager.h:37): watchdog →
+tear-down → launcher dead-pod detection → elastic restart.
+"""
+import textwrap
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.distributed.watchdog import (CommWatchdog,
+                                             TEARDOWN_EXIT_CODE, current,
+                                             guarded, install, uninstall)
+
+
+def test_timeout_fires_in_log_mode():
+    hits = []
+    wd = CommWatchdog(timeout=0.3, mode="log", poll=0.05,
+                      on_timeout=lambda n, e: hits.append((n, e)))
+    try:
+        with wd.task("hung-collective"):
+            deadline = time.time() + 5
+            while not hits and time.time() < deadline:
+                time.sleep(0.05)
+    finally:
+        wd.stop()
+    assert hits and hits[0][0] == "hung-collective"
+    assert hits[0][1] >= 0.3
+
+
+def test_completed_task_never_fires():
+    hits = []
+    wd = CommWatchdog(timeout=0.3, mode="log", poll=0.05,
+                      on_timeout=lambda n, e: hits.append(n))
+    try:
+        for _ in range(3):
+            with wd.task("fast"):
+                time.sleep(0.02)
+        time.sleep(0.6)
+    finally:
+        wd.stop()
+    assert hits == []
+
+
+def test_guarded_noop_without_install():
+    with guarded("nothing-installed"):
+        pass
+    assert current() is None
+
+
+def test_install_guard_fires():
+    hits = []
+    install(CommWatchdog(timeout=0.2, mode="log", poll=0.05,
+                         on_timeout=lambda n, e: hits.append(n)))
+    try:
+        with guarded("slow-region"):
+            deadline = time.time() + 5
+            while not hits and time.time() < deadline:
+                time.sleep(0.05)
+    finally:
+        uninstall()
+    assert hits == ["slow-region"]
+
+
+def test_teardown_feeds_elastic_restart(tmp_path):
+    """The full reference loop, with REAL processes: a worker hangs inside
+    a watched region, its own watchdog tears it down (exit 77), the
+    elastic controller sees the dead pod and the job resumes at the
+    reduced world size."""
+    from paddle_tpu.distributed.launch import ElasticController
+
+    import pathlib as _pl
+
+    import paddle_tpu
+
+    repo = str(_pl.Path(paddle_tpu.__file__).parent.parent)
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {repo!r})
+        import os, time, pathlib
+        from paddle_tpu.distributed.watchdog import CommWatchdog
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        world = os.environ["PADDLE_TRAINERS_NUM"]
+        restart = os.environ["PADDLE_ELASTIC_RESTART"]
+        d = pathlib.Path({str(tmp_path)!r})
+        wd = CommWatchdog(timeout=1.0, mode="tear_down", poll=0.05)
+        if restart == "0" and rank == "1":
+            with wd.task("dead-peer-collective"):
+                time.sleep(120)          # hung: the watchdog must kill us
+        time.sleep(0.3)
+        (d / f"done_{{restart}}_{{rank}}").write_text(world)
+    """))
+    ctl = ElasticController(str(script), np_range=(2, 3), fault_restarts=0)
+    rc = ctl.run()
+    assert rc == 0
+    assert [h["np"] for h in ctl.history] == [3, 2]
+    assert TEARDOWN_EXIT_CODE in [
+        c for h in ctl.history for c in h["codes"]]
+    for rank in range(2):
+        assert (tmp_path / f"done_1_{rank}").read_text() == "2"
