@@ -44,7 +44,7 @@ class _Task:
 
     def __init__(self, name: str, timeout: float):
         self.name = name
-        self.start = time.time()
+        self.start = time.monotonic()   # immune to wall-clock steps
         self.timeout = timeout
 
 
@@ -82,7 +82,8 @@ class CommWatchdog:
 
         class _Guard:
             def __enter__(g):
-                g._t = _Task(name, timeout or wd.timeout)
+                g._t = _Task(name, wd.timeout if timeout is None
+                             else timeout)
                 with wd._lock:
                     wd._tasks[id(g._t)] = g._t
                 return g._t
@@ -97,7 +98,7 @@ class CommWatchdog:
     # -- monitor ----------------------------------------------------------
     def _loop(self):
         while not self._stop.wait(self.poll):
-            now = time.time()
+            now = time.monotonic()
             overdue = None
             with self._lock:
                 for t in self._tasks.values():
@@ -117,7 +118,12 @@ class CommWatchdog:
                 sys.stderr.flush()
                 os._exit(TEARDOWN_EXIT_CODE)
             if self.on_timeout is not None:
-                self.on_timeout(overdue.name, elapsed)
+                try:
+                    self.on_timeout(overdue.name, elapsed)
+                except Exception as e:   # a raising alert hook must not
+                    sys.stderr.write(     # kill the monitor thread
+                        msg + f"on_timeout raised {e!r}\n")
+                    sys.stderr.flush()
             else:
                 sys.stderr.write(msg + "continuing (log mode)\n")
                 sys.stderr.flush()

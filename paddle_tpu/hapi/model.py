@@ -17,31 +17,114 @@ from .callbacks import CallbackList, ProgBarLogger
 class Model:
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
-        self._inputs = inputs
-        self._labels = labels
+        self._inputs = inputs if isinstance(inputs, (list, tuple)) or \
+            inputs is None else [inputs]
+        self._labels = labels if isinstance(labels, (list, tuple)) or \
+            labels is None else [labels]
         self._optimizer = None
         self._loss = None
         self._metrics = []
+        self._amp_level = None
+        self._scaler = None
         self.stop_training = False
 
-    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+    def _split(self, data):
+        """Split a loader batch into (inputs, labels) by the declared
+        InputSpec arity (reference hapi/model.py:1034 _update_inputs);
+        without specs, the last element is the label."""
+        if self._inputs is not None and isinstance(data, (list, tuple)):
+            n = len(self._inputs)
+            ins = list(data[:n])
+            labs = list(data[n:]) or None
+            return ins, labs
+        return _split_data(data)
+
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        """Configure the loops (reference hapi/model.py:1724): validates
+        the metric contract, wires amp ('O1'/'O2' or a dict with 'level')
+        into train_batch via auto_cast + GradScaler (bf16 — the TPU-native
+        mixed precision), and accepts loss callables or Layers."""
+        from ..metric import Metric
+
         self._optimizer = optimizer
+        if loss is not None and not callable(loss):
+            raise TypeError("'loss' must be a callable (function or "
+                            "paddle.nn loss Layer instance)")
         self._loss = loss
-        self._metrics = metrics if isinstance(metrics, (list, tuple)) else (
+        metrics = metrics if isinstance(metrics, (list, tuple)) else (
             [metrics] if metrics is not None else [])
+        for m in metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(
+                    f"{type(m).__name__} is not a paddle.metric.Metric: "
+                    "metrics must implement compute/update/accumulate/"
+                    "reset/name")
+        self._metrics = list(metrics)
+        level = None
+        scaler_kw = {}
+        if amp_configs is not None:
+            if isinstance(amp_configs, str):
+                level = amp_configs
+            elif isinstance(amp_configs, dict):
+                cfg = dict(amp_configs)
+                level = cfg.pop("level", "O1")
+                # GradScaler knobs pass through (reference amp_configs
+                # carries init_loss_scaling etc.); unknown keys raise so
+                # a typo can't be silently dropped
+                for k in ("init_loss_scaling", "incr_ratio", "decr_ratio",
+                          "incr_every_n_steps",
+                          "decr_every_n_nan_or_inf"):
+                    if k in cfg:
+                        scaler_kw[k] = cfg.pop(k)
+                cfg.pop("use_fp16_guard", None)   # accepted, no-op on TPU
+                cfg.pop("dtype", None)            # bf16 is the TPU dtype
+                if cfg:
+                    raise ValueError(
+                        f"amp_configs keys {sorted(cfg)} are not "
+                        "supported")
+            else:
+                raise TypeError(
+                    "amp_configs must be a level string ('O0'/'O1'/'O2') "
+                    f"or a dict, got {type(amp_configs).__name__}")
+            if level not in ("O0", "O1", "O2"):
+                raise ValueError(f"amp level {level!r}: expected O0/O1/O2")
+        self._amp_level = level if level not in (None, "O0") else None
+        if self._amp_level:
+            from ..amp import GradScaler
+
+            # TPU bf16 needs no loss scaling numerically, but the scaler
+            # keeps the reference training-loop contract (scale/minimize)
+            scaler_kw.setdefault("init_loss_scaling", 2.0 ** 15)
+            self._scaler = GradScaler(**scaler_kw)
+        else:
+            self._scaler = None
         return self
 
     # -- single-batch entry points ----------------------------------------
     def train_batch(self, inputs, labels=None, update=True):
         self.network.train()
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-        outputs = self.network(*inputs)
-        losses = self._compute_loss(outputs, labels)
-        total = losses if isinstance(losses, Tensor) else sum(losses)
-        total.backward()
-        if update:
-            self._optimizer.step()
-            self._optimizer.clear_grad()
+        if self._amp_level:
+            from ..amp import auto_cast
+
+            with auto_cast(level=self._amp_level):
+                outputs = self.network(*inputs)
+                losses = self._compute_loss(outputs, labels)
+                total = losses if isinstance(losses, Tensor) else sum(losses)
+            scaled = self._scaler.scale(total)
+            scaled.backward()
+            if update:
+                self._scaler.minimize(self._optimizer, scaled)
+                self._optimizer.clear_grad()
+        else:
+            outputs = self.network(*inputs)
+            losses = self._compute_loss(outputs, labels)
+            total = losses if isinstance(losses, Tensor) else sum(losses)
+            total.backward()
+            if update:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
         metrics = self._update_metrics(outputs, labels)
         return [float(total.item())] + metrics
 
@@ -114,7 +197,7 @@ class Model:
             logs = {}
             for step, data in enumerate(train_loader):
                 cbks.on_batch_begin("train", step, logs)
-                ins, labs = _split_data(data)
+                ins, labs = self._split(data)
                 res = self.train_batch(
                     ins, labs, update=(step + 1) % accumulate_grad_batches == 0)
                 logs = self._make_logs(res)
@@ -149,7 +232,7 @@ class Model:
             m.reset()
         losses = []
         for step, data in enumerate(loader):
-            ins, labs = _split_data(data)
+            ins, labs = self._split(data)
             res = self.eval_batch(ins, labs)
             losses.append(res[0])
             if num_iters is not None and step + 1 >= num_iters:
@@ -175,8 +258,22 @@ class Model:
             loader = test_data
         outputs = []
         for data in loader:
-            ins, _ = _split_data(data)
+            ins, _ = self._split(data)
             outputs.append(self.predict_batch(ins))
+        if stack_outputs:
+            # reference semantics: concatenate along the batch dim, per
+            # output position (hapi/model.py predict stack_outputs)
+            def to_np(o):
+                return np.asarray(o._value) if isinstance(o, Tensor) \
+                    else np.asarray(o)
+
+            if not outputs:
+                return []
+            if isinstance(outputs[0], (list, tuple)):
+                n_out = len(outputs[0])
+                return [np.concatenate([to_np(b[i]) for b in outputs])
+                        for i in range(n_out)]
+            return [np.concatenate([to_np(b) for b in outputs])]
         return outputs
 
     # -- persistence --------------------------------------------------------

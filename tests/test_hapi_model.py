@@ -1,0 +1,95 @@
+"""hapi Model depth (VERDICT r3 weak #6): prepare() contracts, amp
+wiring, InputSpec-arity batch splitting, stacked predict outputs.
+Parity: python/paddle/hapi/model.py:1724 (prepare), :1034 (input
+splitting), predict stack_outputs.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.hapi.model import Model
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.static import InputSpec
+
+
+class TwoIn(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(8, 4)
+
+    def forward(self, a, b):
+        return self.fc(a + b)
+
+
+def _data(n=12, seed=0):
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal((n, 8)).astype("float32")
+    b = rng.standard_normal((n, 8)).astype("float32")
+    y = rng.integers(0, 4, size=(n, 1))
+    return a, b, y
+
+
+def test_prepare_rejects_non_metric():
+    m = Model(nn.Linear(4, 2))
+    with pytest.raises(TypeError, match="not a paddle.metric.Metric"):
+        m.prepare(metrics=["accuracy"])
+    with pytest.raises(TypeError, match="callable"):
+        m.prepare(loss="cross_entropy")
+    with pytest.raises(ValueError, match="amp level"):
+        m.prepare(amp_configs="O7")
+
+
+def test_input_spec_arity_splits_batches():
+    """Two inputs + one label: the declared InputSpec arity decides the
+    split (the default last-is-label rule would mis-feed b as the label)."""
+    net = TwoIn()
+    m = Model(net,
+              inputs=[InputSpec([None, 8], "float32", "a"),
+                      InputSpec([None, 8], "float32", "b")],
+              labels=[InputSpec([None, 1], "int64", "y")])
+    m.prepare(optimizer=paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(), metrics=Accuracy())
+    a, b, y = _data()
+    batches = [(paddle.to_tensor(a[i:i + 4]), paddle.to_tensor(b[i:i + 4]),
+                paddle.to_tensor(y[i:i + 4]))
+               for i in range(0, 12, 4)]
+    m.fit(batches, epochs=2, verbose=0)
+    logs = m.evaluate(batches, verbose=0)
+    assert set(logs) >= {"loss", "acc"}
+    assert np.isfinite(logs["loss"])
+
+
+def test_amp_prepare_trains():
+    """amp_configs='O1' routes train_batch through auto_cast + GradScaler
+    (scale → backward → minimize) and the loss still decreases."""
+    net = TwoIn()
+    m = Model(net)
+    m.prepare(optimizer=paddle.optimizer.SGD(
+        learning_rate=0.05, parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(), amp_configs={"level": "O1"})
+    a, b, y = _data()
+    batch = (paddle.to_tensor(a), paddle.to_tensor(b), paddle.to_tensor(y))
+    first = m.train_batch([batch[0], batch[1]], [batch[2]])[0]
+    for _ in range(12):
+        last = m.train_batch([batch[0], batch[1]], [batch[2]])[0]
+    assert np.isfinite(last)
+    assert last < first, (first, last)
+
+
+def test_predict_stack_outputs():
+    net = TwoIn()
+    m = Model(net, inputs=[InputSpec([None, 8], "float32"),
+                           InputSpec([None, 8], "float32")])
+    m.prepare()
+    a, b, _ = _data()
+    batches = [(paddle.to_tensor(a[i:i + 4]), paddle.to_tensor(b[i:i + 4]))
+               for i in range(0, 12, 4)]
+    out = m.predict(batches, stack_outputs=True)
+    assert isinstance(out, list) and len(out) == 1
+    assert out[0].shape == (12, 4)
+    per_batch = m.predict(batches, stack_outputs=False)
+    np.testing.assert_allclose(
+        out[0], np.concatenate([np.asarray(o._value) for o in per_batch]),
+        rtol=1e-6)
