@@ -30,13 +30,17 @@ class Model:
 
     def _split(self, data):
         """Split a loader batch into (inputs, labels) by the declared
-        InputSpec arity (reference hapi/model.py:1034 _update_inputs);
-        without specs, the last element is the label."""
+        InputSpec arities (reference hapi/model.py:1034 _update_inputs);
+        the label slice is bounded by the labels spec, so extra trailing
+        elements (sample weights etc.) are never force-fed to the loss.
+        Without specs, the last element is the label."""
         if self._inputs is not None and isinstance(data, (list, tuple)):
             n = len(self._inputs)
             ins = list(data[:n])
-            labs = list(data[n:]) or None
-            return ins, labs
+            labs = list(data[n:])
+            if self._labels is not None:
+                labs = labs[:len(self._labels)]
+            return ins, (labs or None)
         return _split_data(data)
 
     def prepare(self, optimizer=None, loss=None, metrics=None,
@@ -74,9 +78,14 @@ class Model:
                 # a typo can't be silently dropped
                 for k in ("init_loss_scaling", "incr_ratio", "decr_ratio",
                           "incr_every_n_steps",
-                          "decr_every_n_nan_or_inf"):
+                          "decr_every_n_nan_or_inf", "enable",
+                          "use_dynamic_loss_scaling"):
                     if k in cfg:
                         scaler_kw[k] = cfg.pop(k)
+                self._amp_lists = {
+                    k: cfg.pop(k) for k in ("custom_white_list",
+                                            "custom_black_list")
+                    if k in cfg}
                 cfg.pop("use_fp16_guard", None)   # accepted, no-op on TPU
                 cfg.pop("dtype", None)            # bf16 is the TPU dtype
                 if cfg:
@@ -108,7 +117,8 @@ class Model:
         if self._amp_level:
             from ..amp import auto_cast
 
-            with auto_cast(level=self._amp_level):
+            with auto_cast(level=self._amp_level,
+                           **getattr(self, "_amp_lists", {})):
                 outputs = self.network(*inputs)
                 losses = self._compute_loss(outputs, labels)
                 total = losses if isinstance(losses, Tensor) else sum(losses)

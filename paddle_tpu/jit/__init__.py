@@ -171,6 +171,16 @@ class StaticFunction:
     def concrete_program(self):
         return [e["jitted"] for e in self._cache.values()]
 
+    def program(self, *example_args, **example_kwargs):
+        """Op-graph view of this function traced at the example signature
+        (reference ConcreteProgram.main_program): a static.Program whose
+        Operators are the jaxpr equations — layer parameters appear as
+        persistable consts. Inspection-only (passes belong to XLA)."""
+        from ..static.program import Program
+
+        return Program.from_callable(self._fn, *example_args,
+                                     **example_kwargs)
+
     def _build(self, skel_args, skel_kwargs, n_args, out_box):
         from ..framework.capture import capture_buffer_updates
         from .branch_capture import capture_branches, combine_tensor_leaves

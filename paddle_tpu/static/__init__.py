@@ -30,17 +30,7 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
     return _jit_load(path_prefix)
 
 
-class Program:
-    """Vestigial Program object for API compatibility; capture replaces it."""
-
-    def __init__(self):
-        self._ops = []
-
-    def global_block(self):
-        return self
-
-    def clone(self, for_test=False):
-        return self
+from .program import Block, Operator, Program  # noqa: E402,F401
 
 
 _main = Program()

@@ -1,0 +1,226 @@
+"""Program-graph introspection: an OpDesc/Block/Program view over jaxpr.
+
+Parity: the reference's ProgramDesc object model
+(paddle/fluid/framework/program_desc.h; python surface
+python/paddle/base/framework.py Program/Block/Operator) — programs are
+inspectable op graphs: enumerate ops, read their inputs/outputs/attrs,
+list block variables, print the IR, clone for inference.
+
+TPU-native design: the single IR is the jaxpr. ``Program.from_callable``
+traces a python function (or a ``to_static`` StaticFunction) once with
+abstract values and exposes the closed jaxpr through the reference's
+object model — each jaxpr equation is an ``Operator``, each intermediate
+an entry in the block's var table. The view is read-only by design:
+transformation passes belong to XLA (SURVEY §7's absorption rule), but
+inspection, counting, and serialization-for-debugging are first-class.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+__all__ = ["Operator", "Block", "Program"]
+
+
+class Operator:
+    """One jaxpr equation viewed as the reference's Operator/OpDesc."""
+
+    def __init__(self, eqn, namer):
+        self._eqn = eqn
+        self.type = eqn.primitive.name
+        self.input_names = [namer(v) for v in eqn.invars]
+        self.output_names = [namer(v) for v in eqn.outvars]
+        # static params = the reference's op attributes
+        self._attrs = dict(eqn.params)
+
+    def input_arg_names(self) -> List[str]:
+        return list(self.input_names)
+
+    def output_arg_names(self) -> List[str]:
+        return list(self.output_names)
+
+    def attr_names(self) -> List[str]:
+        return sorted(self._attrs)
+
+    def attr(self, name: str):
+        return self._attrs[name]
+
+    @property
+    def attrs(self) -> Dict[str, Any]:
+        return dict(self._attrs)
+
+    def __repr__(self):
+        return (f"{{{', '.join(self.output_names)}}} = {self.type}"
+                f"({', '.join(self.input_names)})")
+
+
+class _VarView:
+    __slots__ = ("name", "shape", "dtype", "persistable")
+
+    def __init__(self, name, aval, persistable=False):
+        self.name = name
+        self.shape = list(getattr(aval, "shape", ()))
+        self.dtype = getattr(aval, "dtype", None)
+        self.persistable = persistable
+
+    def __repr__(self):
+        return f"var {self.name} : {self.dtype}{self.shape}"
+
+
+class Block:
+    """The reference's Block: an op list plus a var table."""
+
+    def __init__(self, idx: int = 0):
+        self.idx = idx
+        self.ops: List[Operator] = []
+        self._vars: Dict[str, _VarView] = {}
+
+    @property
+    def vars(self) -> Dict[str, _VarView]:
+        return dict(self._vars)
+
+    def var(self, name: str) -> _VarView:
+        if name not in self._vars:
+            raise ValueError(f"var {name!r} not in block {self.idx}")
+        return self._vars[name]
+
+    def all_parameters(self) -> List[_VarView]:
+        return [v for v in self._vars.values() if v.persistable]
+
+    def __repr__(self):
+        return f"<Block {self.idx}: {len(self.ops)} ops>"
+
+
+class Program:
+    """Inspectable program over a traced jaxpr (see module docstring).
+
+    >>> prog = Program.from_callable(fn, example_x)
+    >>> [op.type for op in prog.global_block().ops]
+    >>> print(prog)          # reference-style IR listing
+    """
+
+    def __init__(self):
+        self.blocks: List[Block] = [Block(0)]
+        self._jaxpr = None
+        self._param_names: List[str] = []
+        self._for_test = False
+
+    # -- construction -----------------------------------------------------
+    @classmethod
+    def from_callable(cls, fn, *example_args,
+                      param_names: Optional[Sequence[str]] = None,
+                      **example_kwargs) -> "Program":
+        """Trace ``fn`` abstractly and build the op-graph view. Example
+        args may be arrays, Tensors, or ShapeDtypeStructs."""
+        from ..core.tensor import Tensor
+
+        def unwrap(a):
+            if isinstance(a, Tensor):
+                return a._value
+            return a
+
+        args = jax.tree_util.tree_map(
+            unwrap, example_args, is_leaf=lambda v: isinstance(v, Tensor))
+        kwargs = jax.tree_util.tree_map(
+            unwrap, example_kwargs, is_leaf=lambda v: isinstance(v, Tensor))
+
+        def pure(*a, **k):
+            wrapped_a = jax.tree_util.tree_map(Tensor, a)
+            wrapped_k = jax.tree_util.tree_map(Tensor, k)
+            from ..autograd import no_grad
+
+            with no_grad():
+                out = fn(*wrapped_a, **wrapped_k)
+            return jax.tree_util.tree_map(
+                lambda t: t._value if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda v: isinstance(v, Tensor))
+
+        closed = jax.make_jaxpr(pure)(*args, **kwargs)
+        return cls.from_jaxpr(closed, param_names=param_names)
+
+    @classmethod
+    def from_jaxpr(cls, closed_jaxpr,
+                   param_names: Optional[Sequence[str]] = None) -> "Program":
+        prog = cls()
+        prog._jaxpr = closed_jaxpr
+        prog._param_names = list(param_names or [])
+        jaxpr = closed_jaxpr.jaxpr
+        blk = prog.blocks[0]
+        names: Dict[int, str] = {}
+        counter = [0]
+        lit_counter = [0]
+
+        def namer(v):
+            if type(v).__name__ == "Literal":
+                # every literal gets a var-table entry with a unique name
+                # (the reference invariant: every op input resolves to a
+                # block var); scalars show their value for readability
+                if id(v) in names:
+                    return names[id(v)]
+                if np.ndim(v.val) == 0:
+                    n = f"lit_{lit_counter[0]}({v.val!r})"
+                else:
+                    n = f"lit_{lit_counter[0]}(<array>)"
+                lit_counter[0] += 1
+                names[id(v)] = n
+                aval = getattr(v, "aval", None)
+                blk._vars[n] = _VarView(n, aval)   # const, NOT a parameter
+                return n
+            if id(v) not in names:
+                names[id(v)] = f"_t{counter[0]}"
+                counter[0] += 1
+            return names[id(v)]
+
+        pn = list(param_names or [])
+        for i, v in enumerate(jaxpr.invars):
+            name = pn[i] if i < len(pn) else f"x{i}"
+            names[id(v)] = name
+            blk._vars[name] = _VarView(name, v.aval,
+                                       persistable=i < len(pn))
+        for v in jaxpr.constvars:
+            n = namer(v)
+            blk._vars[n] = _VarView(n, v.aval, persistable=True)
+        for eqn in jaxpr.eqns:
+            op = Operator(eqn, namer)
+            blk.ops.append(op)
+            for v, n in zip(eqn.outvars, op.output_names):
+                blk._vars[n] = _VarView(n, v.aval)
+        prog._out_names = [namer(v) for v in jaxpr.outvars]
+        return prog
+
+    # -- reference API surface --------------------------------------------
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self.blocks)
+
+    def all_parameters(self) -> List[_VarView]:
+        return self.global_block().all_parameters()
+
+    def clone(self, for_test: bool = False) -> "Program":
+        p = (Program.from_jaxpr(self._jaxpr,
+                                param_names=self._param_names)
+             if self._jaxpr is not None else Program())
+        p._for_test = for_test
+        return p
+
+    def op_types(self) -> List[str]:
+        return [op.type for op in self.global_block().ops]
+
+    def __str__(self):
+        blk = self.global_block()
+        lines = [f"{{ // block {blk.idx}"]
+        for v in blk._vars.values():
+            lines.append(f"    {v!r}")
+        for op in blk.ops:
+            lines.append(f"    {op!r}")
+        lines.append(f"    return ({', '.join(self._out_names)})"
+                     if getattr(self, '_out_names', None) else "    return ()")
+        lines.append("}")
+        return "\n".join(lines)
+
+    __repr__ = __str__

@@ -1,0 +1,61 @@
+"""Program-graph introspection (static/program.py): the ProgramDesc
+object model — Operator/Block/Program — over the traced jaxpr.
+Parity: python/paddle/base/framework.py Program/Block/Operator surface
+(op enumeration, input/output/attr access, var tables, IR printing,
+clone); transformation passes are absorbed by XLA by design.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.jit import to_static
+from paddle_tpu.static import Program
+
+
+def test_from_callable_op_enumeration():
+    prog = Program.from_callable(
+        lambda x: paddle.tanh(x) * 2.0 + 1.0,
+        paddle.to_tensor(np.ones((2, 3), np.float32)))
+    blk = prog.global_block()
+    types = prog.op_types()
+    assert "tanh" in types and "mul" in types and "add" in types
+    op = blk.ops[0]
+    assert op.type == "tanh"
+    assert op.input_arg_names() == ["x0"]
+    assert len(op.output_arg_names()) == 1
+    # var table carries shapes/dtypes
+    v = blk.var("x0")
+    assert v.shape == [2, 3] and str(v.dtype) == "float32"
+    assert "tanh" in str(prog)
+
+
+def test_op_attrs_exposed():
+    prog = Program.from_callable(
+        lambda x: paddle.sum(x, axis=1),
+        paddle.to_tensor(np.ones((2, 3), np.float32)))
+    red = next(op for op in prog.global_block().ops
+               if op.type == "reduce_sum")
+    assert "axes" in red.attr_names()
+    assert red.attr("axes") == (1,)
+
+
+def test_layer_params_are_persistable_consts():
+    net = nn.Linear(4, 2)
+    st = to_static(net)
+    prog = st._static_function.program(
+        paddle.to_tensor(np.ones((3, 4), np.float32)))
+    params = prog.all_parameters()
+    shapes = sorted(tuple(p.shape) for p in params)
+    assert ((2,) in shapes or [2] in [list(s) for s in shapes])
+    assert any(list(p.shape) == [4, 2] for p in params)
+    assert any(op.type in ("dot_general", "matmul") for op in
+               prog.global_block().ops)
+
+
+def test_clone_for_test_preserves_graph():
+    prog = Program.from_callable(
+        lambda x: paddle.nn.functional.relu(x),
+        paddle.to_tensor(np.ones((2, 2), np.float32)))
+    c = prog.clone(for_test=True)
+    assert c.op_types() == prog.op_types()
+    assert c.num_blocks == 1
