@@ -1,0 +1,77 @@
+"""Elastic training: np-range launch, watchdog teardown, checkpoint resume.
+
+The full fault-tolerance loop (SURVEY §5.3 / reference
+fleet/elastic/manager.py + comm_task_manager.h):
+
+1. the launcher runs N workers within an elastic range (``--np M:N``);
+2. each worker installs a CommWatchdog — a worker hung on a dead-peer
+   rendezvous tears itself down (exit 77) instead of wedging the job;
+3. the launcher detects the dead pod and restarts the job — same world
+   size while the fault budget lasts, then scaled down within the range;
+4. workers reload their checkpoint (PADDLE_ELASTIC_RESTART counts the
+   generation) and training resumes at the new world size.
+
+Launcher:  python -m paddle_tpu.distributed.launch --np 2:4 \
+               examples/elastic_train.py
+Worker (this file) trains a tiny model and checkpoints every few steps.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+# each worker is a small CPU process in this demo (the one local chip
+# cannot host N coordination peers); a real pod runs one worker per host
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("PADDLE_TPU_VIRTUAL_DEVICES", "1")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _common  # noqa: E402
+
+_common.setup()
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import nn
+from paddle_tpu.distributed.watchdog import CommWatchdog, install
+
+CKPT = "/tmp/elastic_train_ckpt"
+
+
+def main():
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    restart = int(os.environ.get("PADDLE_ELASTIC_RESTART", 0))
+    print(f"[rank {rank}/{world}] generation {restart}")
+
+    # 2: the watchdog — any guarded blocking region that stalls > 60 s
+    # kills this worker so the launcher can restart the job
+    install(CommWatchdog(timeout=60.0, mode="tear_down"))
+
+    net = nn.Sequential(nn.Linear(64, 64), nn.ReLU(), nn.Linear(64, 8))
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=net.parameters())
+    start_step = 0
+    if restart and os.path.exists(CKPT + ".pdparams"):
+        net.set_state_dict(paddle.load(CKPT + ".pdparams"))
+        start_step = int(np.load(CKPT + ".step.npy"))
+        print(f"[rank {rank}] resumed from step {start_step}")
+
+    rng = np.random.default_rng(rank)
+    for step in range(start_step, start_step + 50):
+        x = paddle.to_tensor(rng.standard_normal((32, 64)).astype("f4"))
+        y = paddle.to_tensor(rng.integers(0, 8, (32, 1)))
+        loss = nn.CrossEntropyLoss()(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if rank == 0 and step % 10 == 0:
+            paddle.save(net.state_dict(), CKPT + ".pdparams")
+            np.save(CKPT + ".step.npy", np.asarray(step + 1))
+            print(f"[rank 0] step {step} loss={float(loss):.4f} "
+                  "(checkpointed)")
+        time.sleep(0.02)
+    print(f"[rank {rank}] done")
+
+
+if __name__ == "__main__":
+    main()

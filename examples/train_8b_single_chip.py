@@ -1,0 +1,68 @@
+"""Train Llama-3-8B on ONE 16 GB chip (the BASELINE north-star scale).
+
+The model's bf16 parameters alone (16 GB) exceed HBM, so the fused — and
+even the scanned layerwise — step cannot hold them. The host-streamed
+layerwise step (`optimizer/offload.make_streaming_train_step`) keeps the
+parameters per-layer in pinned host memory: the forward prefetches layer
+l+1 over PCIe while layer l computes; the backward re-runs each layer,
+takes its vjp, applies the adafactor update to donated buffers, and
+streams the updated weights back — at most two layers of weights occupy
+HBM at any moment. Measured on a v5e: ~2,000-2,200 tok/s ≈ 0.5-0.58
+MFU-6ND (the 40% bar is ~1,530 tok/s).
+
+Run:  python examples/train_8b_single_chip.py [--batch 8] [--steps 5]
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _common  # noqa: E402
+
+_common.setup()
+
+import jax  # noqa: E402
+
+from paddle_tpu.models import llama  # noqa: E402
+from paddle_tpu.optimizer.offload import (
+    init_streaming_train_state, make_streaming_train_step,
+    supports_compiled_host_memory)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--steps", type=int, default=5)
+    args = ap.parse_args()
+
+    if not supports_compiled_host_memory():
+        raise SystemExit("this example needs a device with a pinned_host "
+                         "memory space (TPU)")
+
+    cfg = llama.LlamaConfig(max_seq_len=args.seq, remat=True,
+                            loss_chunks=16)   # Llama-3-8B defaults
+    print("initializing 8B (per-layer on device → pinned host)...")
+    state = init_streaming_train_state(cfg, jax.random.PRNGKey(0))
+    step = make_streaming_train_step(cfg, lr=3e-4)
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1),
+                                (args.batch, args.seq + 1), 0,
+                                cfg.vocab_size)
+    state, loss = step(state, tokens)          # compile + first step
+    print(f"compiled; loss={float(np.asarray(loss)):.3f}")
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        state, loss = step(state, tokens)
+        l = float(np.asarray(loss))            # d2h sync
+        dt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        tps = args.batch * args.seq / dt
+        print(f"step {i}: {tps:,.0f} tok/s  loss={l:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -92,8 +92,10 @@ def auto_cast(enable: bool = True, custom_white_list=None, custom_black_list=Non
     """paddle.amp.auto_cast parity (bfloat16 default: TPU-native choice)."""
     global WHITE_LIST, BLACK_LIST
     prev = _amp_state()
-    added_w = set(custom_white_list or ())
-    added_b = set(custom_black_list or ())
+    # only ops NOT already in the defaults are added (and later removed):
+    # exiting must never delete default-list members like 'matmul'
+    added_w = set(custom_white_list or ()) - WHITE_LIST
+    added_b = set(custom_black_list or ()) - BLACK_LIST
     WHITE_LIST |= added_w
     BLACK_LIST |= added_b
     _tls.amp = _AmpState(enable, dtype, level)

@@ -67,6 +67,7 @@ class Model:
         self._metrics = list(metrics)
         level = None
         scaler_kw = {}
+        self._amp_lists = {}   # reset: lists never leak across prepares
         if amp_configs is not None:
             if isinstance(amp_configs, str):
                 level = amp_configs

@@ -24,6 +24,13 @@ import numpy as np
 __all__ = ["Operator", "Block", "Program"]
 
 
+class _Slot:
+    __slots__ = ("i",)
+
+    def __init__(self, i):
+        self.i = i
+
+
 class Operator:
     """One jaxpr equation viewed as the reference's Operator/OpDesc."""
 
@@ -116,28 +123,50 @@ class Program:
         args may be arrays, Tensors, or ShapeDtypeStructs."""
         from ..core.tensor import Tensor
 
-        def unwrap(a):
-            if isinstance(a, Tensor):
-                return a._value
-            return a
+        # only tensor-like leaves trace; python scalars/bools/strings stay
+        # STATIC in the skeleton, exactly like StaticFunction's guard-key
+        # args — `if flag:` signatures must build, not TracerBoolConvert
+        def is_traced(v):
+            return isinstance(v, (Tensor, jax.Array, np.ndarray)) or \
+                type(v).__name__ == "ShapeDtypeStruct"
 
-        args = jax.tree_util.tree_map(
-            unwrap, example_args, is_leaf=lambda v: isinstance(v, Tensor))
-        kwargs = jax.tree_util.tree_map(
-            unwrap, example_kwargs, is_leaf=lambda v: isinstance(v, Tensor))
+        leaves: List[Any] = []
 
-        def pure(*a, **k):
-            wrapped_a = jax.tree_util.tree_map(Tensor, a)
-            wrapped_k = jax.tree_util.tree_map(Tensor, k)
+        def split(obj):
+            if is_traced(obj):
+                leaves.append(obj._value if isinstance(obj, Tensor)
+                              else obj)
+                return _Slot(len(leaves) - 1)
+            if isinstance(obj, (list, tuple)):
+                return type(obj)(split(o) for o in obj)
+            if isinstance(obj, dict):
+                return {k: split(v) for k, v in obj.items()}
+            return obj
+
+        skel_args = split(list(example_args))
+        skel_kwargs = split(example_kwargs)
+
+        def rebuild(obj, vals):
+            if isinstance(obj, _Slot):
+                return Tensor(vals[obj.i])
+            if isinstance(obj, (list, tuple)):
+                return type(obj)(rebuild(o, vals) for o in obj)
+            if isinstance(obj, dict):
+                return {k: rebuild(v, vals) for k, v in obj.items()}
+            return obj
+
+        def pure(*vals):
             from ..autograd import no_grad
 
+            a = rebuild(skel_args, vals)
+            k = rebuild(skel_kwargs, vals)
             with no_grad():
-                out = fn(*wrapped_a, **wrapped_k)
+                out = fn(*a, **k)
             return jax.tree_util.tree_map(
                 lambda t: t._value if isinstance(t, Tensor) else t, out,
                 is_leaf=lambda v: isinstance(v, Tensor))
 
-        closed = jax.make_jaxpr(pure)(*args, **kwargs)
+        closed = jax.make_jaxpr(pure)(*leaves)
         return cls.from_jaxpr(closed, param_names=param_names)
 
     @classmethod
