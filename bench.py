@@ -218,6 +218,7 @@ def bench_8b(dev, results):
                 "value": round(tps, 1),
                 "unit": "tokens/s",
                 "vs_baseline": round(mfu / 0.40, 4),
+                "batch": batch,
             })
             return
         except Exception as e:

@@ -51,7 +51,8 @@ def main():
     opt = paddle.optimizer.Adam(learning_rate=1e-3,
                                 parameters=net.parameters())
     start_step = 0
-    if restart and os.path.exists(CKPT + ".pdparams"):
+    if restart and os.path.exists(CKPT + ".pdparams") \
+            and os.path.exists(CKPT + ".step.npy"):
         net.set_state_dict(paddle.load(CKPT + ".pdparams"))
         start_step = int(np.load(CKPT + ".step.npy"))
         print(f"[rank {rank}] resumed from step {start_step}")
@@ -65,8 +66,13 @@ def main():
         opt.step()
         opt.clear_grad()
         if rank == 0 and step % 10 == 0:
-            paddle.save(net.state_dict(), CKPT + ".pdparams")
-            np.save(CKPT + ".step.npy", np.asarray(step + 1))
+            # atomic: write aside + rename, step file last — a worker
+            # killed mid-save (the very fault this demo injects) must
+            # never leave a truncated checkpoint for the next generation
+            paddle.save(net.state_dict(), CKPT + ".pdparams.tmp")
+            os.replace(CKPT + ".pdparams.tmp", CKPT + ".pdparams")
+            np.save(CKPT + ".step.npy.tmp.npy", np.asarray(step + 1))
+            os.replace(CKPT + ".step.npy.tmp.npy", CKPT + ".step.npy")
             print(f"[rank 0] step {step} loss={float(loss):.4f} "
                   "(checkpointed)")
         time.sleep(0.02)

@@ -36,7 +36,12 @@ from .branch_capture import GraphBreak as _BranchGraphBreak
 
 __all__ = ["to_static", "InputSpec", "save", "load", "not_to_static",
            "ignore_module", "enable_to_static", "TranslatedLayer",
-           "BuildStrategy"]
+           "BuildStrategy", "segment_scope"]
+
+from .segments import segment_scope  # noqa: E402  (public: eager code can
+# opt into lazy-segment batching directly — ops defer into cached compiled
+# segments, any .item()/numpy() materializes; ~18x over per-op eager
+# through a remote-attached chip)
 
 _to_static_enabled = True
 
@@ -47,12 +52,12 @@ class BuildStrategy:
 
     ``allow_graph_break`` (default True): when tracing fails on
     data-dependent Python control flow (``if tensor.item() > 0:`` — a jax
-    ConcretizationTypeError), fall back to EAGER for that input signature
-    and cache the decision, the semantics of the reference's SOT
-    opcode-translator fallback (jit/sot/.../eval_frame_callback.py:54 —
-    mechanism differs: SOT breaks the frame mid-function; here the whole
-    call runs eager, which is always correct, just uncompiled). False =
-    re-raise (the reference's full_graph=True strictness).
+    ConcretizationTypeError), run that input signature SEGMENT-COMPILED
+    (jit/segments.py: ops defer into cached jitted segments, the break
+    itself runs eagerly, autograd composes across segments) and cache
+    the decision — the reference SOT's compile-prefix/resume-after-break
+    fallback (jit/sot/.../eval_frame_callback.py:54). False = re-raise
+    (the reference's full_graph=True strictness).
     """
 
     def __init__(self, allow_graph_break: bool = True):
@@ -110,6 +115,8 @@ def _split_tensors(obj, acc):
     if isinstance(obj, Tensor):
         acc.append(obj)
         return ("__tensor__", len(acc) - 1)
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):  # namedtuple
+        return type(obj)(*(_split_tensors(e, acc) for e in obj))
     if isinstance(obj, (list, tuple)):
         return type(obj)(_split_tensors(e, acc) for e in obj)
     if isinstance(obj, dict):
@@ -123,6 +130,8 @@ def _split_tensors(obj, acc):
 def _rebuild(skel, vals, wrap):
     if isinstance(skel, tuple) and len(skel) == 2 and skel[0] == "__tensor__":
         return wrap(vals[skel[1]])
+    if isinstance(skel, tuple) and hasattr(skel, "_fields"):  # namedtuple
+        return type(skel)(*(_rebuild(e, vals, wrap) for e in skel))
     if isinstance(skel, (list, tuple)) and not (
         isinstance(skel, tuple) and len(skel) == 2 and skel[0] == "__tensor__"
     ):

@@ -24,13 +24,6 @@ import numpy as np
 __all__ = ["Operator", "Block", "Program"]
 
 
-class _Slot:
-    __slots__ = ("i",)
-
-    def __init__(self, i):
-        self.i = i
-
-
 class Operator:
     """One jaxpr equation viewed as the reference's Operator/OpDesc."""
 
@@ -124,49 +117,35 @@ class Program:
         from ..core.tensor import Tensor
 
         # only tensor-like leaves trace; python scalars/bools/strings stay
-        # STATIC in the skeleton, exactly like StaticFunction's guard-key
-        # args — `if flag:` signatures must build, not TracerBoolConvert
+        # STATIC, exactly like StaticFunction's guard-key args — an
+        # `if flag:` signature must build, not TracerBoolConvert. The
+        # pytree flatten covers every registered container (namedtuples,
+        # custom nodes), not just list/tuple/dict.
         def is_traced(v):
             return isinstance(v, (Tensor, jax.Array, np.ndarray)) or \
                 type(v).__name__ == "ShapeDtypeStruct"
 
-        leaves: List[Any] = []
+        leaves, treedef = jax.tree_util.tree_flatten(
+            (list(example_args), dict(example_kwargs)),
+            is_leaf=lambda v: isinstance(v, Tensor))
+        traced_idx = [i for i, l in enumerate(leaves) if is_traced(l)]
+        vals = [leaves[i]._value if isinstance(leaves[i], Tensor)
+                else leaves[i] for i in traced_idx]
 
-        def split(obj):
-            if is_traced(obj):
-                leaves.append(obj._value if isinstance(obj, Tensor)
-                              else obj)
-                return _Slot(len(leaves) - 1)
-            if isinstance(obj, (list, tuple)):
-                return type(obj)(split(o) for o in obj)
-            if isinstance(obj, dict):
-                return {k: split(v) for k, v in obj.items()}
-            return obj
-
-        skel_args = split(list(example_args))
-        skel_kwargs = split(example_kwargs)
-
-        def rebuild(obj, vals):
-            if isinstance(obj, _Slot):
-                return Tensor(vals[obj.i])
-            if isinstance(obj, (list, tuple)):
-                return type(obj)(rebuild(o, vals) for o in obj)
-            if isinstance(obj, dict):
-                return {k: rebuild(v, vals) for k, v in obj.items()}
-            return obj
-
-        def pure(*vals):
+        def pure(*tvals):
             from ..autograd import no_grad
 
-            a = rebuild(skel_args, vals)
-            k = rebuild(skel_kwargs, vals)
+            new_leaves = list(leaves)
+            for i, v in zip(traced_idx, tvals):
+                new_leaves[i] = Tensor(v)
+            a, k = jax.tree_util.tree_unflatten(treedef, new_leaves)
             with no_grad():
                 out = fn(*a, **k)
             return jax.tree_util.tree_map(
                 lambda t: t._value if isinstance(t, Tensor) else t, out,
                 is_leaf=lambda v: isinstance(v, Tensor))
 
-        closed = jax.make_jaxpr(pure)(*leaves)
+        closed = jax.make_jaxpr(pure)(*vals)
         return cls.from_jaxpr(closed, param_names=param_names)
 
     @classmethod
