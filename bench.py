@@ -211,7 +211,7 @@ def bench_8b(dev, results):
         if need > 0.95 * _hbm_bytes(dev):
             continue
         try:
-            tps = _time_train(llama, cfg, batch, seq, opt, n_steps=3)
+            tps = _time_train(llama, cfg, batch, seq, opt, n_steps=5)
             mfu = llama.flops_per_token(cfg, seq) * tps / _peak_flops(dev)
             results.append({
                 "metric": "llama-8b_pretrain_tokens_per_sec_per_chip",

@@ -93,7 +93,10 @@ def _guard_key(args, kwargs):
         if isinstance(o, Tensor):
             parts.append(("T", tuple(o._value.shape), str(o._value.dtype)))
         elif isinstance(o, (list, tuple)):
-            parts.append(("L", len(o)))
+            # the container TYPE is part of the guard: two namedtuple
+            # classes (different field orders) with identical tensor
+            # layouts must not share a compiled program
+            parts.append(("L", type(o), len(o)))
             for e in o:
                 walk(e)
         elif isinstance(o, dict):
