@@ -80,7 +80,7 @@ class MoEConfig:
     # "full" recomputes the whole layer in backward; "outs" saves the
     # attention + routed-expert outputs (skips flash and grouped-GEMM
     # recompute for [B,S,h]×2 per layer of residency)
-    remat_policy: str = "full"
+    remat_policy: str = "full"   # "full" | "attn" (save flash outputs only) | "outs" (save attn + routed outputs)
     use_flash: bool = True
     context_parallel: bool = False
     # >1: scan the cross-entropy over sequence chunks so [B,S,vocab] f32
@@ -363,12 +363,20 @@ def hidden_states_with_aux(params, tokens, config: MoEConfig):
                 inner = jax.checkpoint(
                     fn, policy=jax.checkpoint_policies.
                     save_only_these_names("attn_out", "routed_out"))
+            elif c.remat_policy == "attn":
+                # save ONLY the attention outputs: backward skips the
+                # flash-kernel recompute but still recomputes the cheap
+                # norm/elementwise chain and the grouped GEMMs — the
+                # middle point between 'full' and 'outs'
+                inner = jax.checkpoint(
+                    fn, policy=jax.checkpoint_policies.
+                    save_only_these_names("attn_out"))
             elif c.remat_policy == "full":
                 inner = jax.checkpoint(fn)
             else:
                 raise ValueError(
                     f"MoEConfig.remat_policy={c.remat_policy!r}: expected "
-                    "'full' or 'outs'")
+                    "'full', 'attn', or 'outs'")
             return lambda carry, lp: (inner(carry, lp), None)
         return body
 

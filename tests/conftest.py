@@ -54,6 +54,7 @@ _SLOW = {
     "test_moe.py::test_dropless_ep_shard_map_matches_replicated",
     "test_moe.py::test_expert_parallel_matches_replicated",
     "test_moe.py::test_forward_and_train_step",
+    "test_moe.py::test_remat_policy_attn_matches_full",
     "test_offload.py::test_grads_stream_through_host",
     "test_offload.py::test_layerwise_step_matches_fused",
     "test_offload.py::test_offload_step_matches_fused",

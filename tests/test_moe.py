@@ -207,3 +207,28 @@ def test_dropless_a2a_lowering_has_ragged_all_to_all(cfg):
 
     assert "ragged_all_to_all" in text_for("a2a")
     assert "ragged_all_to_all" not in text_for("psum")
+
+
+def test_remat_policy_attn_matches_full():
+    """remat_policy='attn' (save only flash outputs) must be numerically
+    identical to 'full' — it changes what backward recomputes, not what
+    it computes (llama has the same policy set)."""
+    import dataclasses
+
+    cfg = moe.MoEConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        moe_intermediate_size=16, num_layers=2, num_heads=4, num_kv_heads=2,
+        head_dim=8, num_experts=4, top_k=2, n_shared_experts=1,
+        first_dense_layers=1, max_seq_len=32, remat=True)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0, 64)
+    losses = {}
+    for pol in ("full", "attn"):
+        c = dataclasses.replace(cfg, remat_policy=pol)
+        state = moe.init_train_state(c, jax.random.PRNGKey(0))
+        step = jax.jit(lambda s, t, c=c: moe.train_step(s, t, c))
+        state, _ = step(state, toks)
+        # SECOND step's loss depends on the first step's GRADIENTS — a
+        # policy that corrupted backward would diverge here
+        state, loss2 = step(state, toks)
+        losses[pol] = float(loss2)
+    assert abs(losses["full"] - losses["attn"]) < 1e-5, losses
