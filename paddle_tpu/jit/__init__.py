@@ -40,8 +40,8 @@ __all__ = ["to_static", "InputSpec", "save", "load", "not_to_static",
 
 from .segments import segment_scope  # noqa: E402  (public: eager code can
 # opt into lazy-segment batching directly — ops defer into cached compiled
-# segments, any .item()/numpy() materializes; ~18x over per-op eager
-# through a remote-attached chip)
+# segments, any .item()/numpy() materializes; avoids per-op dispatch and
+# compile storms through a remote-attached chip)
 
 _to_static_enabled = True
 

@@ -77,10 +77,12 @@ class MoEConfig:
     rms_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
-    # "full" recomputes the whole layer in backward; "outs" saves the
-    # attention + routed-expert outputs (skips flash and grouped-GEMM
-    # recompute for [B,S,h]×2 per layer of residency)
-    remat_policy: str = "full"   # "full" | "attn" (save flash outputs only) | "outs" (save attn + routed outputs)
+    # "full" recomputes the whole layer in backward; "attn" saves only
+    # the flash-attention outputs (skips the flash recompute, still
+    # recomputes the grouped GEMMs); "outs" saves attention + routed
+    # outputs (skips flash AND grouped-GEMM recompute for [B,S,h]×2 per
+    # layer of residency)
+    remat_policy: str = "full"
     use_flash: bool = True
     context_parallel: bool = False
     # >1: scan the cross-entropy over sequence chunks so [B,S,vocab] f32
