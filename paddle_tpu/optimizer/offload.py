@@ -48,6 +48,7 @@ __all__ = ["host_put", "device_put_leaf", "make_offload_train_step",
            "StreamTrainState", "init_streaming_train_state",
            "make_streaming_train_step", "streaming_state_from_layerwise",
            "layerwise_state_from_streaming",
+           "init_streaming_moe_train_state", "make_streaming_moe_train_step",
            "supports_host_memory", "supports_compiled_host_memory"]
 
 _f32 = jnp.float32
@@ -451,6 +452,23 @@ class StreamTrainState:
         self.step = int(step)
 
 
+def _make_fetch_park(dev, to_host):
+    """The streaming steps' h2d/d2h movers (shared by the llama and MoE
+    variants — one place for transfer-path fixes)."""
+    dev_sh = _kind_sharding(dev, "device")
+
+    def fetch(tree):
+        if not to_host:
+            return tree
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, dev_sh), tree)
+
+    def park(tree):
+        return host_put(tree, dev) if to_host else tree
+
+    return fetch, park
+
+
 def _nu_like_perlayer(p):
     """Per-layer adafactor second-moment slot (factored for matrices)."""
     if p.ndim >= 2:
@@ -601,7 +619,6 @@ def make_streaming_train_step(config, optimizer: str = "adafactor",
     dt = c.dtype
     dev = jax.devices()[0]
     to_host = supports_compiled_host_memory()
-    dev_sh = _kind_sharding(dev, "device")
 
     def _fac(p, g, nu, beta2t):
         return adafactor_update(p, g, nu, lr=lr, beta2t=beta2t, eps1=1e-30,
@@ -609,15 +626,7 @@ def make_streaming_train_step(config, optimizer: str = "adafactor",
                                 scale=1.0)
 
     head_grads, tail_update = _build_head_tail(c, _fac)
-
-    def _fetch(tree):
-        if not to_host:
-            return tree
-        return jax.tree_util.tree_map(
-            lambda x: jax.device_put(x, dev_sh), tree)
-
-    def _park(tree):
-        return host_put(tree, dev) if to_host else tree
+    _fetch, _park = _make_fetch_park(dev, to_host)
 
     @jax.jit
     def embed_fwd(embed, tokens):
@@ -679,6 +688,194 @@ def make_streaming_train_step(config, optimizer: str = "adafactor",
             state.embed, state.final_norm, state.lm_head,
             state.nu_embed, state.nu_fn, state.nu_head, inp, dx, dfn,
             dhead, beta2t)
+        return StreamTrainState(
+            new_layers, new_nu_layers, new_e, new_f, new_h,
+            nnu_e, nnu_f, nnu_h, state.step + 1), loss
+
+    return step
+
+
+# ---------------------------------------------------------------------------
+# host-streamed MoE step (DeepSeekMoE-16B — BASELINE config 5 — on one chip)
+# ---------------------------------------------------------------------------
+def init_streaming_moe_train_state(config, key, param_dtype=jnp.bfloat16):
+    """Streaming state for MoE configs: each layer (attention + router +
+    stacked experts + shared experts, ~1.2 GB at DeepSeekMoE-16B) is
+    initialised on device by one reused compiled program and parked in
+    pinned host memory — the full 33 GB parameter set never exists in
+    HBM."""
+    import math
+
+    c = config
+    h, L, E = c.hidden_size, c.num_layers, c.num_experts
+    nq, nkv, d = c.num_heads, c.num_kv_heads, c.head_dim
+    fm = c.moe_intermediate_size
+    fs = c.n_shared_experts * fm
+    s = 1.0 / math.sqrt(h)
+    o = s / math.sqrt(2 * L)
+    dev = jax.devices()[0]
+    to_host = supports_compiled_host_memory()
+
+    @functools.partial(jax.jit, static_argnames=("dense",))
+    def init_layer(k, *, dense):
+        ks = jax.random.split(k, 12)
+
+        def g(kk, shape, scale):
+            return (jax.random.normal(kk, shape, jnp.float32)
+                    * scale).astype(param_dtype)
+
+        lp = {
+            "attn_norm": jnp.ones((h,), param_dtype),
+            "wq": g(ks[0], (h, nq * d), s),
+            "wk": g(ks[1], (h, nkv * d), s),
+            "wv": g(ks[2], (h, nkv * d), s),
+            "wo": g(ks[3], (nq * d, h), o),
+            "mlp_norm": jnp.ones((h,), param_dtype),
+            "s_gate": g(ks[8], (h, fs), s),
+            "s_up": g(ks[9], (h, fs), s),
+            "s_down": g(ks[10], (fs, h), o),
+        }
+        if not dense:
+            # dense (first_dense_layers) layers never touch the router or
+            # experts — per-layer trees may simply omit them, saving their
+            # init, pinned-host residency, and per-step PCIe round trip
+            # (~2.2 GB/step at DeepSeekMoE-16B)
+            lp.update({
+                "router": g(ks[4], (h, E), s),
+                "e_gate": g(ks[5], (E, h, fm), s),
+                "e_up": g(ks[6], (E, h, fm), s),
+                "e_down": g(ks[7], (E, fm, h), o / math.sqrt(fm / h)),
+            })
+        return lp
+
+    keys = jax.random.split(key, L + 2)
+    layers, nu_layers = [], []
+    for l in range(L):
+        lp = init_layer(keys[l], dense=l < c.first_dense_layers)
+        nu_layers.append(jax.tree_util.tree_map(_nu_like_perlayer, lp))
+        layers.append(host_put(lp, dev) if to_host else lp)
+
+    @jax.jit
+    def init_tail(ke, kh):
+        embed = (jax.random.normal(ke, (c.vocab_size, h), jnp.float32)
+                 * s).astype(param_dtype)
+        head = (jax.random.normal(kh, (h, c.vocab_size), jnp.float32)
+                * s).astype(param_dtype)
+        return embed, jnp.ones((h,), param_dtype), head
+
+    embed, fn_w, head = init_tail(keys[L], keys[L + 1])
+    return StreamTrainState(
+        layers, nu_layers, embed, fn_w, head,
+        _nu_like_perlayer(embed), _nu_like_perlayer(fn_w),
+        _nu_like_perlayer(head), 0)
+
+
+def make_streaming_moe_train_step(config, optimizer: str = "adafactor",
+                                  lr=3e-4, wd=0.1, adafactor_clip=1.0):
+    """Host-streamed layerwise train step for MoE configs — trains
+    DeepSeekMoE-16B (33 GB of bf16 params, BASELINE config 5) on one
+    16 GB chip, the MoE twin of :func:`make_streaming_train_step`.
+
+    Same mechanism (pinned_host residency, prefetch-next-layer, per-layer
+    vjp + donated adafactor update, stream-back), plus the MoE-specific
+    piece: the router aux loss. ``loss = CE + coef · Σ_l aux_l`` and each
+    layer's aux contribution is LOCAL to that layer, so its gradient
+    enters the per-layer vjp as a constant cotangent ``coef`` on the
+    layer's aux output — no cross-layer aux state is ever needed.
+
+    Parity: incubate/distributed/models/moe (the reference's MoE stack)
+    has no single-device answer at this scale; the capability here is the
+    scheduling trade (PCIe streaming) the reference buys with multi-GPU
+    sharding. Returns ``step(state, tokens) -> (state, loss)``.
+    """
+    from ..models import moe as _moe
+
+    c = config
+    if optimizer != "adafactor":
+        raise NotImplementedError("streaming step supports adafactor")
+    if getattr(c, "context_parallel", False):
+        raise NotImplementedError("streaming step is single-chip")
+    dt = c.dtype
+    dev = jax.devices()[0]
+    to_host = supports_compiled_host_memory()
+    coef = float(c.router_aux_coef)
+    n_dense = c.first_dense_layers
+
+    def _fac(p, g, nu, beta2t):
+        return adafactor_update(p, g, nu, lr=lr, beta2t=beta2t, eps1=1e-30,
+                                eps2=1e-3, clip=adafactor_clip, wd=wd,
+                                scale=1.0)
+
+    head_grads, tail_update = _build_head_tail(c, _fac)
+    _fetch, _park = _make_fetch_park(dev, to_host)
+
+    @jax.jit
+    def embed_fwd(embed, tokens):
+        return embed.astype(dt)[tokens]
+
+    @functools.partial(jax.jit, static_argnames=("dense",))
+    def layer_fwd(x, aux_sum, lp, *, dense):
+        cos, sin = _moe._rope_tables(x.shape[1], c.head_dim, c.rope_theta)
+        (xo, aux) = _moe._layer_body((x, jnp.zeros((), jnp.float32)), lp,
+                                     cos, sin, c, 0, dense)
+        return xo, aux_sum + aux
+
+    @functools.partial(jax.jit, static_argnames=("dense",),
+                       donate_argnums=(0, 1))
+    def layer_bwd_update(lp, nu_l, x_in, dx, beta2t, *, dense):
+        cos, sin = _moe._rope_tables(x_in.shape[1], c.head_dim,
+                                     c.rope_theta)
+
+        def run(lp_, xi):
+            xo, aux = _moe._layer_body((xi, jnp.zeros((), jnp.float32)),
+                                       lp_, cos, sin, c, 0, dense)
+            return xo, aux
+
+        _, vjp = jax.vjp(run, lp, x_in)
+        # aux cotangent = coef: d(loss)/d(aux_l) for loss = ce + coef·Σaux
+        dlp, dx_prev = vjp((dx, jnp.asarray(coef, jnp.float32)))
+        new_lp, new_nu = {}, {}
+        for k in lp:
+            new_lp[k], new_nu[k] = _fac(lp[k], dlp[k], nu_l[k], beta2t)
+        return new_lp, new_nu, dx_prev
+
+    def step(state: StreamTrainState, tokens):
+        L = c.num_layers
+        inp = tokens[:, :-1]
+        tgt = tokens[:, 1:]
+        beta2t = 1.0 - float(state.step + 1) ** -0.8
+
+        xs = [None] * L
+        x = embed_fwd(state.embed, inp)
+        aux_sum = jnp.zeros((), jnp.float32)
+        nxt = _fetch(state.layers[0])
+        for l in range(L):
+            cur, nxt = nxt, (_fetch(state.layers[l + 1])
+                             if l + 1 < L else None)
+            xs[l] = x
+            x, aux_sum = layer_fwd(x, aux_sum, cur, dense=l < n_dense)
+            cur = None
+
+        ce, (dx, dfn, dhead) = head_grads(
+            x, state.final_norm, state.lm_head, tgt)
+
+        new_layers = list(state.layers)
+        new_nu_layers = list(state.nu_layers)
+        nxt = _fetch(state.layers[L - 1])
+        for l in range(L - 1, -1, -1):
+            cur, nxt = nxt, (_fetch(state.layers[l - 1]) if l > 0 else None)
+            new_lp, new_nu, dx = layer_bwd_update(
+                cur, state.nu_layers[l], xs[l], dx, beta2t,
+                dense=l < n_dense)
+            new_layers[l] = _park(new_lp)
+            new_nu_layers[l] = new_nu
+            xs[l] = None
+
+        new_e, new_f, new_h, nnu_e, nnu_f, nnu_h = tail_update(
+            state.embed, state.final_norm, state.lm_head,
+            state.nu_embed, state.nu_fn, state.nu_head, inp, dx, dfn,
+            dhead, beta2t)
+        loss = ce + coef * aux_sum
         return StreamTrainState(
             new_layers, new_nu_layers, new_e, new_f, new_h,
             nnu_e, nnu_f, nnu_h, state.step + 1), loss
