@@ -42,8 +42,8 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 __all__ = [
-    "dropless_moe_ffn", "dropless_moe_ffn_ep", "dropless_moe_ffn_a2a",
-    "sort_by_expert",
+    "dropless_moe_ffn", "dropless_moe_ffn_dense", "dropless_moe_ffn_ep",
+    "dropless_moe_ffn_a2a", "sort_by_expert",
 ]
 
 
@@ -185,6 +185,166 @@ def _expert_ffn(xs, gs, e_gate, e_up, e_down, dt, full_rows=False):
     return grouped_matmul(
         jax.nn.silu(gu[..., :f]) * gu[..., f:], e_down.astype(dt), gs,
         full_rows=full_rows)
+
+
+def _round_up(v: int, m: int) -> int:
+    return -(-v // m) * m
+
+
+def _dense_meta(idx, E: int, Q: int):
+    """Branch-free routing metadata for the dense-base dispatch.
+
+    Returns (r [A] slot id per flat assignment, src_tok [E*Q] source token
+    per slot (0 for empty), w_sel [E*Q] assignment id per slot (A for
+    empty), ok scalar bool: every expert's load fits Q).
+
+    No sort: each assignment's rank within its expert is the exclusive
+    prefix count of its expert's one-hot column — dense vector math the
+    VPU chews through, vs. the bitonic argsort of the gmm path."""
+    T, k = idx.shape
+    A = T * k
+    flat_e = idx.reshape(A)
+    onehot = (flat_e[:, None] == jnp.arange(E, dtype=flat_e.dtype)[None, :]
+              ).astype(jnp.int32)
+    pos = jnp.take_along_axis(
+        jnp.cumsum(onehot, axis=0) - onehot, flat_e[:, None], axis=1)[:, 0]
+    gs = onehot.sum(axis=0)
+    r = flat_e * Q + pos                       # slot per assignment
+    ok = jnp.max(gs) <= Q
+    # slot -> flat assignment id (A = empty). Out-of-range r (pos >= Q,
+    # only when !ok) drop out of the scatter; the cond takes the gmm
+    # branch in that case so the partial metadata is never consumed.
+    w_sel = jnp.full((E * Q,), A, jnp.int32).at[r].set(
+        jnp.arange(A, dtype=jnp.int32), mode="drop")
+    src_tok = jnp.where(w_sel < A, w_sel // k, 0)
+    return r, src_tok, w_sel, ok
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7,))
+def _dense_base_ffn(x, weights, e_gate, e_up, e_down, r, src_tok, k):
+    y, _ = _dense_base_fwd_impl(x, weights, e_gate, e_up, e_down, r,
+                                src_tok, k)
+    return y
+
+
+def _dense_base_fwd_impl(x, weights, e_gate, e_up, e_down, r, src_tok, k):
+    """Routed SwiGLU over a dense [E*Q, h] base buffer; gathers only.
+
+    Every data-movement op here — and in the hand-written vjp below — is a
+    gather: the combine uses the fact that slots r[t*k:(t+1)*k] enumerate
+    exactly token t's assignments, so both y (fwd) and dx (bwd) are k-way
+    gathered sums instead of the scatter-add the autodiff of jnp.take
+    would emit (measured 3 ms/layer on v5e — the single hottest op of the
+    r3 MoE step)."""
+    T, h = x.shape
+    E, _, f = e_gate.shape
+    dt = x.dtype
+    xb = jnp.take(x, src_tok, axis=0)                    # [E*Q, h]
+    gu = jnp.einsum("eqh,ehf->eqf", xb.reshape(E, -1, h),
+                    jnp.concatenate([e_gate, e_up], axis=-1).astype(dt),
+                    preferred_element_type=dt)
+    z = jax.nn.silu(gu[..., :f]) * gu[..., f:]
+    yb = jnp.einsum("eqf,efh->eqh", z, e_down.astype(dt),
+                    preferred_element_type=dt)
+    ycat = yb.reshape(-1, h)
+    yg = jnp.take(ycat, r, axis=0).reshape(T, k, h).astype(jnp.float32)
+    w = weights.reshape(T, k).astype(jnp.float32)
+    y = jnp.sum(yg * w[..., None], axis=1).astype(dt)
+    return y, (x, weights, e_gate, e_up, e_down, r, src_tok, xb, gu, z,
+               ycat)
+
+
+def _dense_base_fwd(x, weights, e_gate, e_up, e_down, r, src_tok, k):
+    return _dense_base_fwd_impl(x, weights, e_gate, e_up, e_down, r,
+                                src_tok, k)
+
+
+def _dense_base_bwd(k, res, dy):
+    x, weights, e_gate, e_up, e_down, r, src_tok, xb, gu, z, ycat = res
+    T, h = x.shape
+    E, _, f = e_gate.shape
+    dt = x.dtype
+    A = T * k
+    w = weights.reshape(A).astype(jnp.float32)
+
+    # router-weight grad: d_w[a] = <dy[tok(a)], ycat[r[a]]>
+    yg = jnp.take(ycat, r, axis=0).reshape(T, k, h).astype(jnp.float32)
+    d_w = jnp.einsum("th,tkh->tk", dy.astype(jnp.float32), yg)
+
+    # d_ycat: per-slot weight via the slot->assignment map (0 for empty
+    # slots), dy row via src_tok — a gather, not the take-vjp scatter.
+    w_sel = jnp.full((ycat.shape[0],), A, jnp.int32).at[r].set(
+        jnp.arange(A, dtype=jnp.int32), mode="drop")
+    w_slot = jnp.where(w_sel < A, jnp.take(w, jnp.minimum(w_sel, A - 1)),
+                       0.0)
+    d_yb = (jnp.take(dy, src_tok, axis=0).astype(jnp.float32)
+            * w_slot[:, None]).astype(dt).reshape(E, -1, h)
+
+    dz = jnp.einsum("eqh,efh->eqf", d_yb, e_down.astype(dt),
+                    preferred_element_type=dt)
+    d_down = jnp.einsum("eqf,eqh->efh", z, d_yb,
+                        preferred_element_type=jnp.float32)
+    g, u = gu[..., :f], gu[..., f:]
+    sg = jax.nn.sigmoid(g.astype(jnp.float32)).astype(dt)
+    silu_g = g * sg
+    d_u = dz * silu_g
+    d_g = dz * u * (sg + silu_g * (1 - sg)).astype(dt)
+    dgu = jnp.concatenate([d_g, d_u], axis=-1)
+    xbr = xb.reshape(E, -1, h)
+    d_w1 = jnp.einsum("eqh,eqf->ehf", xbr, dgu,
+                      preferred_element_type=jnp.float32)
+    d_gate, d_up = d_w1[..., :f], d_w1[..., f:]
+    d_xb = jnp.einsum("eqf,ehf->eqh",
+                      dgu, jnp.concatenate([e_gate, e_up],
+                                           axis=-1).astype(dt),
+                      preferred_element_type=dt).reshape(-1, h)
+    # dx[t] = sum_j d_xb[slot of assignment (t, j)] — gather by r again
+    dx = jnp.sum(jnp.take(d_xb, r, axis=0).reshape(T, k, h)
+                 .astype(jnp.float32), axis=1).astype(dt)
+    return (dx, d_w.reshape(weights.shape),
+            d_gate.astype(e_gate.dtype), d_up.astype(e_up.dtype),
+            d_down.astype(e_down.dtype), None, None)
+
+
+_dense_base_ffn.defvjp(_dense_base_fwd, _dense_base_bwd)
+
+
+def dropless_moe_ffn_dense(x, weights, idx, e_gate, e_up, e_down,
+                           slack: float = 0.125):
+    """Capacity-less routed FFN, dense-base form (single program).
+
+    The TPU-first reshape of the reference's unbounded global_scatter
+    (moe_layer.py:105-188): instead of ragged grouped GEMMs over
+    expert-sorted rows, scatter-free gathers stage each expert's tokens
+    into a static [E, Q, h] buffer (Q = A/E rounded up with ``slack``
+    headroom) and the expert FFN runs as *dense batched einsums* — 92% MXU
+    on v5e vs 63% for the best-tiled Mosaic grouped matmul at the bench
+    shapes, because XLA tiles a fixed-shape batched dot far better than
+    any ragged kernel. Nothing is dropped: a lax.cond falls back to the
+    sort+gmm path (`dropless_moe_ffn`) for the rare batch whose expert
+    load exceeds Q, so the fast path's capacity is a *performance* bound,
+    never a semantic one (vs. the reference's GShard capacity which
+    silently drops — see MoEConfig.routing="capacity").
+
+    Cost of the headroom: Q/(A/E)-1 wasted dense FLOPs (12.5% default) on
+    empty slots whose outputs are never gathered; with balanced routing
+    (what the aux loss maintains) the fallback fires with probability
+    ~Phi(-5 sigma) per step."""
+    T, h = x.shape
+    E = e_gate.shape[0]
+    k = idx.shape[1]
+    A = T * k
+    Q = min(_round_up(max(int(A / E * (1 + slack)), 1), 128), A)
+    if E * Q > 4 * A:
+        # tiny/test shapes: the base buffer would dwarf the real work
+        return dropless_moe_ffn(x, weights, idx, e_gate, e_up, e_down)
+    r, src_tok, w_sel, ok = _dense_meta(idx, E, Q)
+    return jax.lax.cond(
+        ok,
+        lambda x, w, i: _dense_base_ffn(x, w, e_gate, e_up, e_down, r,
+                                        src_tok, k),
+        lambda x, w, i: dropless_moe_ffn(x, w, i, e_gate, e_up, e_down),
+        x, weights, idx)
 
 
 def dropless_moe_ffn(x, weights, idx, e_gate, e_up, e_down):
