@@ -6,14 +6,26 @@ full list: dense 2k, long-context 8k, and MoE (dropless ragged_dot
 dispatch). Each entry: {"metric", "value", "unit", "vs_baseline"} with
 vs_baseline = achieved MFU / 0.40 (the BASELINE.json north-star: >=40% MFU
 — no reference-published numbers exist, see BASELINE.md).
+
+Process model (r4 post-mortem): each section runs in its OWN subprocess
+(``bench.py --section NAME``). r4 lost the entire round's metrics to one
+TPU RESOURCE_EXHAUSTED late in the run — HBM fragmentation accumulated
+across sections until an allocation failed outside a try block and killed
+the process before the JSON line printed. Per-section processes give every
+section a fresh TPU client and a fully empty HBM, bound each section with a
+wall-clock timeout, and guarantee the parent ALWAYS prints the JSON line no
+matter how a child dies. The parent never initializes a backend (the chip
+is single-tenant; only the one live child may hold it).
 """
 import gc
 import json
+import os
+import subprocess
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
+import jax            # import alone does not initialize a backend;
+import jax.numpy as jnp  # the parent never calls jax.devices()
 
 
 # per-device-kind spec sheet: bf16 peak FLOPs / HBM bytes / HBM bandwidth
@@ -160,9 +172,10 @@ def bench_dense(dev, results):
     from paddle_tpu.models import llama
     last_err = None
     for name, cfg, batch, seq, opt in _dense_configs():
+        if dev.platform == "cpu" and name != "llama-tiny":
+            continue  # CPU lane is a smoke test, not a measurement
         n_params = llama.num_params(llama._abstract_params(cfg))
-        if n_params * opt["bpp"] > 0.8 * _hbm_bytes(dev) \
-                and dev.platform != "cpu":
+        if n_params * opt["bpp"] > 0.8 * _hbm_bytes(dev):
             continue
         try:
             tps = _time_train(llama, cfg, batch, seq, opt)
@@ -447,22 +460,84 @@ def bench_serving(dev, results):
         _release()
 
 
-def main():
-    dev = jax.devices()[0]
-    results = []
-    bench_dense(dev, results)
-    bench_8b(dev, results)
-    bench_long_context(dev, results)
-    bench_moe(dev, results)
-    bench_decode(dev, results)
-    bench_serving(dev, results)
+# (section name, runner, wall-clock timeout seconds). Ordered: the first
+# section's first metric is the round headline.
+_SECTIONS = (
+    ("dense", bench_dense, 2400),
+    ("8b", bench_8b, 2400),
+    ("long_context", bench_long_context, 1500),
+    ("moe", bench_moe, 1500),
+    ("decode", bench_decode, 1500),
+    ("serving", bench_serving, 1800),
+)
 
+
+def _run_section(name: str) -> int:
+    """Child mode: run ONE section on the chip, print its results list."""
+    fn = dict((n, f) for n, f, _ in _SECTIONS)[name]
+    results = []
+    try:
+        dev = jax.devices()[0]
+        fn(dev, results)
+    except Exception as e:  # belt over each section's own suspenders
+        results.append({"metric": f"{name}_bench_failed", "value": 0.0,
+                        "unit": "tokens/s", "vs_baseline": 0.0,
+                        "error": str(e)[:200]})
+    print(json.dumps(results), flush=True)
+    return 0
+
+
+def _spawn_section(name: str, timeout: float):
+    """Run one section in a fresh process; return (results, error|None).
+    A dead/hung/garbled child yields an error string, never an exception."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--section", name],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            timeout=timeout, cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        # deterministic hang: do NOT retry (a second identical wait would
+        # burn 2x the budget for the same outcome)
+        return None, f"timeout after {timeout:.0f}s (not retried)"
+    except Exception as e:
+        return None, f"spawn failed: {e}"[:200]
+    # last stdout line that parses as JSON is the section's result list
+    for line in reversed(proc.stdout.decode(errors="replace").splitlines()):
+        line = line.strip()
+        if line.startswith("["):
+            try:
+                return json.loads(line), None
+            except ValueError:
+                continue
+    tail = proc.stderr.decode(errors="replace")[-400:]
+    return None, f"child died rc={proc.returncode}: {tail}"[:400]
+
+
+def main():
+    results = []
+    for name, _, timeout in _SECTIONS:
+        got, err = _spawn_section(name, timeout)
+        if got is None and "timeout" not in (err or ""):
+            # crashed child: one retry on a fresh client (timeouts are
+            # deterministic and excluded above)
+            got, err = _spawn_section(name, timeout)
+        if got is None:
+            results.append({"metric": f"{name}_bench_failed", "value": 0.0,
+                            "unit": "tokens/s", "vs_baseline": 0.0,
+                            "error": err})
+        else:
+            results.extend(got)
+    if not results:  # cannot happen, but the JSON line must exist
+        results = [{"metric": "bench_empty", "value": 0.0, "unit": "",
+                    "vs_baseline": 0.0}]
     headline = results[0]
     out = dict(headline)
     out["metrics"] = results
-    print(json.dumps(out))
+    print(json.dumps(out), flush=True)
     return 0 if headline.get("value", 0.0) > 0 else 1
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--section":
+        sys.exit(_run_section(sys.argv[2]))
     sys.exit(main())

@@ -211,23 +211,28 @@ def _dense_meta(idx, E: int, Q: int):
     gs = onehot.sum(axis=0)
     r = flat_e * Q + pos                       # slot per assignment
     ok = jnp.max(gs) <= Q
-    # slot -> flat assignment id (A = empty). Out-of-range r (pos >= Q,
-    # only when !ok) drop out of the scatter; the cond takes the gmm
-    # branch in that case so the partial metadata is never consumed.
+    # Overflow (pos >= Q, only when !ok) is clamped to E*Q so it truly
+    # drops out of the scatter below — without the clamp an overflowing
+    # assignment of expert e < E-1 would land inside expert e+1's slot
+    # range and overwrite a valid slot. The cond still takes the gmm
+    # branch when !ok; the clamp just keeps the metadata well-formed.
+    r = jnp.where(pos < Q, r, E * Q)
+    # slot -> flat assignment id (A = empty)
     w_sel = jnp.full((E * Q,), A, jnp.int32).at[r].set(
         jnp.arange(A, dtype=jnp.int32), mode="drop")
     src_tok = jnp.where(w_sel < A, w_sel // k, 0)
     return r, src_tok, w_sel, ok
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(7,))
-def _dense_base_ffn(x, weights, e_gate, e_up, e_down, r, src_tok, k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(8,))
+def _dense_base_ffn(x, weights, e_gate, e_up, e_down, r, src_tok, w_sel, k):
     y, _ = _dense_base_fwd_impl(x, weights, e_gate, e_up, e_down, r,
-                                src_tok, k)
+                                src_tok, w_sel, k)
     return y
 
 
-def _dense_base_fwd_impl(x, weights, e_gate, e_up, e_down, r, src_tok, k):
+def _dense_base_fwd_impl(x, weights, e_gate, e_up, e_down, r, src_tok,
+                         w_sel, k):
     """Routed SwiGLU over a dense [E*Q, h] base buffer; gathers only.
 
     Every data-movement op here — and in the hand-written vjp below — is a
@@ -250,17 +255,18 @@ def _dense_base_fwd_impl(x, weights, e_gate, e_up, e_down, r, src_tok, k):
     yg = jnp.take(ycat, r, axis=0).reshape(T, k, h).astype(jnp.float32)
     w = weights.reshape(T, k).astype(jnp.float32)
     y = jnp.sum(yg * w[..., None], axis=1).astype(dt)
-    return y, (x, weights, e_gate, e_up, e_down, r, src_tok, xb, gu, z,
-               ycat)
+    return y, (x, weights, e_gate, e_up, e_down, r, src_tok, w_sel, xb,
+               gu, z, ycat)
 
 
-def _dense_base_fwd(x, weights, e_gate, e_up, e_down, r, src_tok, k):
+def _dense_base_fwd(x, weights, e_gate, e_up, e_down, r, src_tok, w_sel, k):
     return _dense_base_fwd_impl(x, weights, e_gate, e_up, e_down, r,
-                                src_tok, k)
+                                src_tok, w_sel, k)
 
 
 def _dense_base_bwd(k, res, dy):
-    x, weights, e_gate, e_up, e_down, r, src_tok, xb, gu, z, ycat = res
+    (x, weights, e_gate, e_up, e_down, r, src_tok, w_sel, xb, gu, z,
+     ycat) = res
     T, h = x.shape
     E, _, f = e_gate.shape
     dt = x.dtype
@@ -271,10 +277,9 @@ def _dense_base_bwd(k, res, dy):
     yg = jnp.take(ycat, r, axis=0).reshape(T, k, h).astype(jnp.float32)
     d_w = jnp.einsum("th,tkh->tk", dy.astype(jnp.float32), yg)
 
-    # d_ycat: per-slot weight via the slot->assignment map (0 for empty
-    # slots), dy row via src_tok — a gather, not the take-vjp scatter.
-    w_sel = jnp.full((ycat.shape[0],), A, jnp.int32).at[r].set(
-        jnp.arange(A, dtype=jnp.int32), mode="drop")
+    # d_ycat: per-slot weight via the slot->assignment map from the
+    # residuals (0 for empty slots), dy row via src_tok — gathers, not
+    # the take-vjp scatter.
     w_slot = jnp.where(w_sel < A, jnp.take(w, jnp.minimum(w_sel, A - 1)),
                        0.0)
     d_yb = (jnp.take(dy, src_tok, axis=0).astype(jnp.float32)
@@ -303,7 +308,7 @@ def _dense_base_bwd(k, res, dy):
                  .astype(jnp.float32), axis=1).astype(dt)
     return (dx, d_w.reshape(weights.shape),
             d_gate.astype(e_gate.dtype), d_up.astype(e_up.dtype),
-            d_down.astype(e_down.dtype), None, None)
+            d_down.astype(e_down.dtype), None, None, None)
 
 
 _dense_base_ffn.defvjp(_dense_base_fwd, _dense_base_bwd)
@@ -342,7 +347,7 @@ def dropless_moe_ffn_dense(x, weights, idx, e_gate, e_up, e_down,
     return jax.lax.cond(
         ok,
         lambda x, w, i: _dense_base_ffn(x, w, e_gate, e_up, e_down, r,
-                                        src_tok, k),
+                                        src_tok, w_sel, k),
         lambda x, w, i: dropless_moe_ffn(x, w, i, e_gate, e_up, e_down),
         x, weights, idx)
 

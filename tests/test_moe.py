@@ -232,3 +232,89 @@ def test_remat_policy_attn_matches_full():
         state, loss2 = step(state, toks)
         losses[pol] = float(loss2)
     assert abs(losses["full"] - losses["attn"]) < 1e-5, losses
+
+
+# ---------------------------------------------------------------------------
+# dense-base dispatch (dropless_moe_ffn_dense) — the default production path
+# (MoEConfig.dense_base=True). Shapes below are chosen to actually TAKE the
+# dense path (E*Q <= 4*A), unlike the tiny shapes above which early-return
+# into the gmm path.
+# ---------------------------------------------------------------------------
+
+def _dense_path_operands(dtype, skew=False):
+    key = jax.random.PRNGKey(7)
+    T, k, E, h, f = 512, 2, 4, 64, 128  # A=1024, Q=384 -> dense path taken
+    ks = jax.random.split(key, 5)
+    x = jax.random.normal(ks[0], (T, h)).astype(dtype)
+    eg = (jax.random.normal(ks[1], (E, h, f)) * 0.1).astype(dtype)
+    eu = (jax.random.normal(ks[2], (E, h, f)) * 0.1).astype(dtype)
+    ed = (jax.random.normal(ks[3], (E, f, h)) * 0.1).astype(dtype)
+    logits = jax.random.normal(ks[4], (T, E))
+    if skew:
+        # every token's TOP-1 is expert 0 (top_k picks distinct experts,
+        # so its load is exactly T): 512 > Q=384 -> ok=False, the
+        # lax.cond must fall back to the gmm path
+        logits = logits.at[:, 0].add(100.0)
+    w, idx, _ = moe.top_k_gating(logits, k)
+    if skew:  # the fallback really is the branch under test
+        from paddle_tpu.kernels.moe_dispatch import _dense_meta
+        assert not bool(_dense_meta(idx, E, 384)[3])
+    return x, w.astype(dtype), idx, eg, eu, ed
+
+
+@pytest.mark.parametrize("skew", [False, True],
+                         ids=["balanced-dense", "skewed-fallback"])
+def test_dense_base_matches_gmm_fwd_and_grads(skew):
+    """dropless_moe_ffn_dense == dropless_moe_ffn: forward AND all grads
+    (x, weights, e_gate, e_up, e_down), at a shape that takes the dense
+    path; the skewed case trips the ok=False lax.cond fallback."""
+    from paddle_tpu.kernels import moe_dispatch as md
+    x, w, idx, eg, eu, ed = _dense_path_operands(jnp.float32, skew=skew)
+    ct = jax.random.normal(jax.random.PRNGKey(9), x.shape)
+
+    def loss(fn):
+        return lambda x, w, eg, eu, ed: jnp.sum(
+            fn(x, w, idx, eg, eu, ed).astype(jnp.float32) * ct)
+
+    y_d = md.dropless_moe_ffn_dense(x, w, idx, eg, eu, ed)
+    y_g = md.dropless_moe_ffn(x, w, idx, eg, eu, ed)
+    np.testing.assert_allclose(np.asarray(y_d), np.asarray(y_g),
+                               rtol=2e-4, atol=2e-5)
+    g_d = jax.grad(loss(md.dropless_moe_ffn_dense),
+                   argnums=(0, 1, 2, 3, 4))(x, w, eg, eu, ed)
+    g_g = jax.grad(loss(md.dropless_moe_ffn),
+                   argnums=(0, 1, 2, 3, 4))(x, w, eg, eu, ed)
+    for a, b, name in zip(g_d, g_g, ("x", "weights", "gate", "up", "down")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4, err_msg=name)
+
+
+def test_dense_base_bf16_fwd_matches_gmm():
+    """Production dtype: the dense path in bf16 agrees with the gmm path in
+    bf16 (both accumulate the combine in f32)."""
+    from paddle_tpu.kernels import moe_dispatch as md
+    x, w, idx, eg, eu, ed = _dense_path_operands(jnp.bfloat16)
+    y_d = md.dropless_moe_ffn_dense(x, w, idx, eg, eu, ed)
+    y_g = md.dropless_moe_ffn(x, w, idx, eg, eu, ed)
+    assert y_d.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(y_d, np.float32), np.asarray(y_g, np.float32),
+        rtol=5e-2, atol=5e-3)
+
+
+def test_dense_meta_overflow_slots_truly_drop():
+    """Overflowing assignments (pos >= Q) are clamped out of every expert's
+    slot range — they must NOT overwrite a later expert's valid slot
+    (ADVICE r4: non-last-expert overflow used to collide in-bounds)."""
+    from paddle_tpu.kernels.moe_dispatch import _dense_meta
+    E, Q = 4, 2
+    # expert 0 gets 4 assignments (overflow: pos 2,3 >= Q), expert 1 gets 2
+    idx = jnp.array([[0, 0], [0, 0], [1, 1]], jnp.int32)
+    r, src_tok, w_sel, ok = _dense_meta(idx, E, Q)
+    assert not bool(ok)
+    r = np.asarray(r)
+    # overflow slots r[2], r[3] (expert 0, pos 2/3) are clamped to E*Q
+    assert r[2] == E * Q and r[3] == E * Q
+    # expert 1's slots hold expert-1 assignments, not expert-0 overflow
+    w_sel = np.asarray(w_sel)
+    assert w_sel[Q] == 4 and w_sel[Q + 1] == 5

@@ -1,0 +1,62 @@
+"""Perf sweep on the local chip: MoE bench-config train-step variants.
+
+Locates the dense_base vs gmm dispatch gap at the bench shape (r5: the
+dense path measured 0.927x vs the gmm path's 0.997x) and sweeps the knobs
+around it: dispatch form, remat policy, batch. Prints tokens/s + MFU per
+variant. Not part of the test suite.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def bench_cfg(**kw):
+    from paddle_tpu.models import moe
+    return moe.MoEConfig(
+        vocab_size=32768, hidden_size=2048, intermediate_size=6144,
+        moe_intermediate_size=1408, num_layers=12, num_heads=16,
+        num_kv_heads=8, head_dim=128, num_experts=16, top_k=2,
+        n_shared_experts=2, first_dense_layers=1, max_seq_len=2048,
+        remat=True, **kw)
+
+
+def run(name, cfg, batch=8, seq=2048):
+    from bench import _peak_flops, _time_train, _release
+    from paddle_tpu.models import moe
+    opt = {"optimizer": "adafactor", "param_dtype": jnp.bfloat16}
+    try:
+        tps = _time_train(moe, cfg, batch, seq, opt, n_steps=10)
+        mfu = moe.flops_per_token(cfg, seq) * tps / _peak_flops(
+            jax.devices()[0])
+        print(f"{name}: {tps:,.0f} tok/s  MFU={mfu:.3f} "
+              f"vs_bar={mfu / 0.40:.4f}", flush=True)
+    except Exception as e:
+        print(f"{name}: FAILED {str(e)[:160]}", flush=True)
+        _release()
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which in ("all", "dispatch"):
+        run("gmm  b8 full", bench_cfg(dense_base=False))
+        run("dense b8 full", bench_cfg(dense_base=True))
+    if which in ("all", "remat"):
+        run("gmm  b8 attn", bench_cfg(dense_base=False,
+                                      remat_policy="attn"))
+        run("dense b8 attn", bench_cfg(dense_base=True,
+                                       remat_policy="attn"))
+        run("gmm  b8 outs", bench_cfg(dense_base=False,
+                                      remat_policy="outs"))
+        run("dense b8 outs", bench_cfg(dense_base=True,
+                                       remat_policy="outs"))
+    if which in ("all", "batch"):
+        run("gmm  b16 full", bench_cfg(dense_base=False), batch=16)
+        run("dense b16 full", bench_cfg(dense_base=True), batch=16)
+
+
+if __name__ == "__main__":
+    main()
