@@ -1,0 +1,102 @@
+"""Where does the MoE step's time go? Ablation timing on the local chip.
+
+Times, at the bench shape: forward-only, fwd+bwd (no optimizer), the full
+train step, and a routing-free control (routed FFN swapped for a dense FFN
+of identical active FLOPs). The deltas attribute the step's overhead to
+dispatch/routing vs backward/remat vs optimizer. Not part of the test
+suite.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def sync(x):
+    return float(np.asarray(x))
+
+
+def timeit(name, fn, *args, n=10, flops_per_step=None):
+    out = fn(*args)
+    out = fn(*args)  # compile + warm
+    sync(jax.tree_util.tree_leaves(out)[0].sum()
+         if not hasattr(out, "sum") else out.sum())
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    sync(jax.tree_util.tree_leaves(out)[0].sum()
+         if not hasattr(out, "sum") else out.sum())
+    dt = (time.perf_counter() - t0) / n
+    extra = ""
+    if flops_per_step:
+        from bench import _peak_flops
+        extra = (f"  MFU={flops_per_step / dt / _peak_flops(jax.devices()[0]):.3f}")
+    print(f"{name}: {dt * 1e3:,.1f} ms{extra}", flush=True)
+    return dt
+
+
+def main():
+    import dataclasses
+    from paddle_tpu.models import moe
+    from tools.moe_sweep import bench_cfg
+
+    B, S = 8, 2048
+    cfg = bench_cfg(dense_base=False)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (B, S + 1), 0,
+                              cfg.vocab_size)
+    fl = moe.flops_per_token(cfg, S) * B * S
+
+    state = moe.init_train_state(cfg, jax.random.PRNGKey(0),
+                                 optimizer="adafactor",
+                                 param_dtype=jnp.bfloat16)
+    params = state.params
+
+    fwd = jax.jit(lambda p, t: moe.loss_fn(p, t, cfg))
+    timeit("fwd only         ", fwd, params, toks, flops_per_step=fl / 3)
+
+    grad = jax.jit(lambda p, t: jax.grad(
+        lambda p: moe.loss_fn(p, t, cfg))(p))
+    timeit("fwd+bwd (no opt) ", grad, params, toks, flops_per_step=fl)
+
+    step = jax.jit(lambda s, t: moe.train_step(s, t, cfg,
+                                               optimizer="adafactor"),
+                   donate_argnums=0)
+    s2 = state
+    def run_step(t):
+        nonlocal s2
+        s2, loss = step(s2, t)
+        return loss
+    timeit("full train step  ", run_step, toks, flops_per_step=fl)
+    del s2, state
+    jax.clear_caches()
+
+    # routing-free control: top_k*f_moe-wide dense FFN in place of the
+    # routed experts — identical ACTIVE matmul FLOPs, zero dispatch.
+    # n_shared absorbs the routed width; num_experts=0-like via
+    # first_dense_layers=num_layers (every layer runs shared FFN only),
+    # shared width = (n_shared + top_k) * f_moe keeps FLOPs equal.
+    ctl = dataclasses.replace(
+        cfg, first_dense_layers=cfg.num_layers,
+        n_shared_experts=cfg.n_shared_experts + cfg.top_k)
+    # active params now differ only by the router matmul (negligible)
+    fl_ctl = moe.flops_per_token(ctl, S) * B * S
+    stc = moe.init_train_state(ctl, jax.random.PRNGKey(0),
+                               optimizer="adafactor",
+                               param_dtype=jnp.bfloat16)
+    stepc = jax.jit(lambda s, t: moe.train_step(s, t, ctl,
+                                                optimizer="adafactor"),
+                    donate_argnums=0)
+    def run_ctl(t):
+        nonlocal stc
+        stc, loss = stepc(stc, t)
+        return loss
+    timeit("no-routing control", run_ctl, toks, flops_per_step=fl_ctl)
+
+
+if __name__ == "__main__":
+    main()
