@@ -170,7 +170,8 @@ def bench_dense(dev, results):
     """Dense-llama ladder: largest config that fits wins; it is the round
     headline."""
     from paddle_tpu.models import llama
-    last_err = None
+    # seeded so an all-skipped ladder reports WHY instead of error "None"
+    last_err = "all configs skipped by HBM precheck"
     for name, cfg, batch, seq, opt in _dense_configs():
         if dev.platform == "cpu" and name != "llama-tiny":
             continue  # CPU lane is a smoke test, not a measurement
@@ -483,8 +484,14 @@ def _run_section(name: str) -> int:
         results.append({"metric": f"{name}_bench_failed", "value": 0.0,
                         "unit": "tokens/s", "vs_baseline": 0.0,
                         "error": str(e)[:200]})
-    print(json.dumps(results), flush=True)
+    # unique sentinel: the parent parses ONLY this line, so stray
+    # JSON-array-looking stdout (atexit hooks, warnings) can't be mistaken
+    # for the section's results
+    print(_RESULT_SENTINEL + json.dumps(results), flush=True)
     return 0
+
+
+_RESULT_SENTINEL = "BENCH_RESULT: "
 
 
 def _spawn_section(name: str, timeout: float):
@@ -501,12 +508,12 @@ def _spawn_section(name: str, timeout: float):
         return None, f"timeout after {timeout:.0f}s (not retried)"
     except Exception as e:
         return None, f"spawn failed: {e}"[:200]
-    # last stdout line that parses as JSON is the section's result list
+    # only the sentinel-prefixed line is the section's result list
     for line in reversed(proc.stdout.decode(errors="replace").splitlines()):
         line = line.strip()
-        if line.startswith("["):
+        if line.startswith(_RESULT_SENTINEL):
             try:
-                return json.loads(line), None
+                return json.loads(line[len(_RESULT_SENTINEL):]), None
             except ValueError:
                 continue
     tail = proc.stderr.decode(errors="replace")[-400:]
@@ -517,9 +524,11 @@ def main():
     results = []
     for name, _, timeout in _SECTIONS:
         got, err = _spawn_section(name, timeout)
-        if got is None and "timeout" not in (err or ""):
-            # crashed child: one retry on a fresh client (timeouts are
-            # deterministic and excluded above)
+        if got is None and not (err or "").startswith("timeout after"):
+            # crashed child: one retry on a fresh client. Timeouts are
+            # deterministic and excluded above — matched against the exact
+            # _spawn_section sentinel, NOT a substring, so a crashed child
+            # whose stderr merely mentions 'timeout' still gets its retry
             got, err = _spawn_section(name, timeout)
         if got is None:
             results.append({"metric": f"{name}_bench_failed", "value": 0.0,

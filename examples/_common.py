@@ -21,5 +21,7 @@ def setup():
             jax.config.update(
                 "jax_num_cpu_devices",
                 int(os.environ.get("PADDLE_TPU_VIRTUAL_DEVICES", "8")))
-        except RuntimeError:
-            pass  # backend already initialized — keep whatever it has
+        except (RuntimeError, AttributeError):
+            # backend already initialized, or an older jax with no
+            # jax_num_cpu_devices (XLA_FLAGS covers it) — keep what we have
+            pass

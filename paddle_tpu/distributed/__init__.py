@@ -44,6 +44,11 @@ from . import ps  # noqa: F401,E402
 from .store import TCPStore  # noqa: F401,E402
 from . import checkpoint  # noqa: F401,E402
 from .checkpoint import load_state_dict, save_state_dict  # noqa: F401,E402
+from . import resilience  # noqa: F401,E402
+from .resilience import (  # noqa: F401,E402
+    FaultInjector, ResilientTrainLoop, ResumableIterator, load_latest_valid,
+    save_checkpoint,
+)
 
 # round-2 parity surface: intermediate parallelize API, comm extras,
 # PS-side config classes, launch/io submodules

@@ -23,8 +23,15 @@ from typing import Any, Dict, Optional
 import jax
 import numpy as np
 
+from .resilience.atomic_ckpt import (CheckpointCorrupt,    # noqa: F401
+                                     list_checkpoints, load_checkpoint,
+                                     load_latest_valid, save_checkpoint,
+                                     validate_checkpoint)
+
 __all__ = ["save_state_dict", "load_state_dict", "wait_async_save",
-           "AsyncSaveHandle"]
+           "AsyncSaveHandle", "save_checkpoint", "load_checkpoint",
+           "load_latest_valid", "list_checkpoints", "validate_checkpoint",
+           "CheckpointCorrupt"]
 
 
 class AsyncSaveHandle:

@@ -81,8 +81,31 @@ def init_parallel_env():
     nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     pid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
     if coord and nprocs > 1 and jax.process_count() == 1:
-        jax.distributed.initialize(coordinator_address=coord,
-                                   num_processes=nprocs, process_id=pid)
+        # coordinator bring-up is the classic transient (peer pods still
+        # booting, port in TIME_WAIT): retry with exponential backoff
+        # before declaring the job dead
+        import sys
+
+        from .resilience.retry import retry_call
+
+        def init_once():
+            try:
+                jax.distributed.initialize(coordinator_address=coord,
+                                           num_processes=nprocs,
+                                           process_id=pid)
+            except RuntimeError as e:
+                # a previous attempt got partway: that's success, not a
+                # failure to retry (retrying would mask the real state)
+                if "already initialized" in str(e).lower():
+                    return
+                raise
+
+        def log_retry(attempt, exc):
+            sys.stderr.write(
+                f"[paddle_tpu distributed] init attempt {attempt + 1} "
+                f"failed ({exc}); retrying with backoff\n")
+
+        retry_call(init_once, on_retry=log_retry)
     _initialized = True
     return ParallelEnv()
 

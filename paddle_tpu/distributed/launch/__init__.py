@@ -137,7 +137,8 @@ class ElasticController:
 
     def __init__(self, script: str, script_args: Optional[List[str]] = None,
                  np_range=(1, 1), master: str = "127.0.0.1",
-                 fault_restarts: int = 1, poll: float = 0.05):
+                 fault_restarts: int = 1, poll: float = 0.05,
+                 teardown_restarts: int = 3):
         self.script = script
         self.script_args = script_args or []
         self.min_np, self.max_np = np_range
@@ -149,6 +150,11 @@ class ElasticController:
         self.master = master
         self.fault_restarts = fault_restarts
         self.poll = poll
+        # a watchdog tear-down (TEARDOWN_EXIT_CODE) is a DELIBERATE,
+        # checkpoint-covered exit — the watchdog's emergency hooks flushed
+        # state before os._exit — so it restarts at the same size without
+        # consuming the fault budget, up to this separate bound
+        self.teardown_restarts = teardown_restarts
         self.restart_count = 0
         self.history: List[dict] = []    # [{"np": n, "codes": [...]}]
 
@@ -170,14 +176,21 @@ class ElasticController:
         return _wait_round(self._spawn(nproc), self.poll)
 
     def run(self) -> int:
+        from ..watchdog import TEARDOWN_EXIT_CODE
+
         nproc = self.max_np
         budget = self.fault_restarts
+        teardowns = self.teardown_restarts
         while True:
             codes = self._run_once(nproc)
             self.history.append({"np": nproc, "codes": codes})
             if codes and all(c == 0 for c in codes):
                 return 0
-            if budget > 0:               # tier 1: same-size restart
+            if (teardowns > 0
+                    and all(c in (0, TEARDOWN_EXIT_CODE) for c in codes)):
+                # tier 0: watchdog tear-down — restart same size, free
+                teardowns -= 1
+            elif budget > 0:             # tier 1: same-size restart
                 budget -= 1
             elif nproc - 1 >= self.min_np:  # tier 2: scale down
                 nproc -= 1

@@ -1,0 +1,36 @@
+"""Fault-tolerant training runtime.
+
+Real TPU fleets preempt VMs, tear half-written checkpoints, hang
+collectives, and emit the occasional NaN gradient. The reference handles
+these across several subsystems (fleet/elastic/manager.py restart tiers,
+comm_task_manager.h watchdog teardown, distributed/checkpoint); here the
+recovery machinery is one package so every path is testable on CPU with
+deterministic fault injection:
+
+- :mod:`atomic_ckpt` — torn-write-proof checkpoints: temp dir + fsync +
+  per-array checksums + atomic rename + keep-last-N GC, and
+  ``load_latest_valid`` that skips corrupt snapshots;
+- :mod:`faults` — seeded :class:`FaultInjector` (``FLAGS_ft_fault_schedule``)
+  covering NaN/Inf gradients, simulated worker death, collective hangs and
+  storage write failure at chosen steps;
+- :mod:`train_loop` — :class:`ResilientTrainLoop`: loss-spike/NaN rollback
+  with a bounded retry budget, periodic + SIGTERM-emergency checkpoints,
+  auto-resume of step counter, optimizer state, RNG key and dataloader
+  position;
+- :mod:`retry` — exponential-backoff retry for rendezvous/bootstrap
+  (used by distributed.store / distributed.env).
+"""
+from .atomic_ckpt import (CheckpointCorrupt, list_checkpoints,
+                          load_checkpoint, load_latest_valid,
+                          save_checkpoint, validate_checkpoint)
+from .data import ResumableIterator
+from .faults import FaultInjector, SimulatedCrash
+from .retry import retry_call
+from .train_loop import ResilientTrainLoop
+
+__all__ = [
+    "CheckpointCorrupt", "list_checkpoints", "load_checkpoint",
+    "load_latest_valid", "save_checkpoint", "validate_checkpoint",
+    "ResumableIterator", "FaultInjector", "SimulatedCrash", "retry_call",
+    "ResilientTrainLoop",
+]

@@ -1,0 +1,264 @@
+"""Atomic, checksummed training checkpoints.
+
+Failure model: the writer can die at ANY byte (preempted VM, OOM-killed
+process, full disk) and a reader may race a concurrent GC. The format
+guarantees a reader only ever sees (a) complete, checksum-verified
+snapshots or (b) nothing — never a torn one:
+
+    root/
+      step-00000008/            <- one complete snapshot
+        manifest.json           <- written LAST, fsync'd; lists every array
+        a00000.bin              <- raw leaf bytes (shape/dtype/crc in manifest)
+        ...
+      step-00000016/
+      .tmp-00000024-4711/       <- in-flight write (invisible to readers)
+
+The writer stages everything in ``.tmp-*``, fsyncs each file, writes the
+manifest last, fsyncs the directory, then ``os.rename``s it to its final
+name and fsyncs the parent — rename is the commit point (atomic on POSIX).
+``load_latest_valid`` walks snapshots newest-first, re-checksums every
+array, and falls back to the previous snapshot on any mismatch, so a
+corrupt newest checkpoint costs one checkpoint interval, not the job.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import sys
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+__all__ = ["CheckpointCorrupt", "save_checkpoint", "load_checkpoint",
+           "load_latest_valid", "list_checkpoints", "validate_checkpoint"]
+
+MANIFEST = "manifest.json"
+_STEP_RE = re.compile(r"^step-(\d{8})$")
+_FORMAT = 1
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A snapshot failed validation (missing file, bad checksum, torn
+    manifest). Recoverable: the loader falls back to an older snapshot."""
+
+
+def _step_dirname(step: int) -> str:
+    return f"step-{step:08d}"
+
+
+def _fsync_path(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _dtype_of(name: str) -> np.dtype:
+    # np.dtype("bfloat16") fails on plain numpy; ml_dtypes (a jax dep)
+    # carries the extended float types
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _is_tensor(v) -> bool:
+    try:
+        from ...core.tensor import Tensor
+        return isinstance(v, Tensor)
+    except Exception:
+        return False
+
+
+def _leaves(tree) -> Tuple[List[Any], Any]:
+    """Flatten with framework Tensors as leaves (Tensor is a pytree node;
+    naive flatten would descend into it)."""
+    return jax.tree_util.tree_flatten(tree, is_leaf=_is_tensor)
+
+
+def _to_numpy(leaf) -> np.ndarray:
+    if _is_tensor(leaf):
+        leaf = leaf._value
+    return np.ascontiguousarray(np.asarray(leaf))
+
+
+def save_checkpoint(tree, root: str, step: int, *, meta: Optional[Dict] = None,
+                    keep: int = 3, fail_hook=None) -> str:
+    """Write ``tree`` (any pytree of arrays/Tensors) as snapshot ``step``
+    under ``root``; returns the final snapshot path.
+
+    ``meta`` is a JSON dict stored in the manifest (step counters, RNG,
+    dataloader position). ``keep`` > 0 garbage-collects all but the newest
+    ``keep`` snapshots after the commit. ``fail_hook(i)`` is a test seam:
+    called before array ``i`` is written, it may raise to simulate a
+    storage failure mid-write — the commit rename never happens, so the
+    previous snapshot stays authoritative."""
+    root = os.path.abspath(root)
+    os.makedirs(root, exist_ok=True)
+    leaves, treedef = _leaves(tree)
+    tmp = os.path.join(root, f".tmp-{step:08d}-{os.getpid()}")
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    try:
+        arrays = []
+        for i, leaf in enumerate(leaves):
+            if fail_hook is not None:
+                fail_hook(i)
+            arr = _to_numpy(leaf)
+            data = arr.tobytes()
+            fname = f"a{i:05d}.bin"
+            fpath = os.path.join(tmp, fname)
+            with open(fpath, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            arrays.append({"file": fname, "shape": list(arr.shape),
+                           "dtype": arr.dtype.name, "nbytes": len(data),
+                           "crc32": zlib.crc32(data) & 0xFFFFFFFF})
+        manifest = {"format": _FORMAT, "step": int(step),
+                    "treedef": str(treedef), "num_leaves": len(leaves),
+                    "meta": meta or {}, "arrays": arrays}
+        mpath = os.path.join(tmp, MANIFEST)
+        with open(mpath, "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        _fsync_path(tmp)
+        final = os.path.join(root, _step_dirname(step))
+        if os.path.exists(final):
+            # same-step collision (e.g. emergency save racing a periodic
+            # one). If the existing snapshot is valid AND carries the same
+            # meta, the new write is redundant — discard it rather than
+            # open a crash window. Meta CAN legitimately differ at the
+            # same step (a batch skip advances the loader position without
+            # a new optimizer step): then the stale dir is replaced. The
+            # rmtree→rename window can lose step N, which degrades to the
+            # previous snapshot — safe; resuming from stale meta is not.
+            try:
+                existing = validate_checkpoint(final)
+                if existing.get("meta") == manifest["meta"]:
+                    shutil.rmtree(tmp, ignore_errors=True)
+                    return final
+            except CheckpointCorrupt:
+                pass
+            shutil.rmtree(final)
+        os.rename(tmp, final)       # <- commit point (single atomic rename)
+        _fsync_path(root)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    if keep > 0:
+        _gc(root, keep)
+    return final
+
+
+def _gc(root: str, keep: int) -> None:
+    ckpts = list_checkpoints(root)
+    for _, path in ckpts[:-keep] if keep else []:
+        shutil.rmtree(path, ignore_errors=True)
+    # stale temp dirs from dead writers are garbage the moment the writer
+    # is gone; ours was just renamed, so any .tmp-* here is orphaned
+    for name in os.listdir(root):
+        if name.startswith(".tmp-"):
+            shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+
+
+def list_checkpoints(root: str) -> List[Tuple[int, str]]:
+    """(step, path) of committed snapshots, oldest first. Temp dirs and
+    foreign files are ignored."""
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in os.listdir(root):
+        m = _STEP_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(root, name)))
+    out.sort()
+    return out
+
+
+def validate_checkpoint(path: str) -> Dict:
+    """Re-checksum every array of one snapshot; returns the manifest or
+    raises :class:`CheckpointCorrupt`."""
+    mpath = os.path.join(path, MANIFEST)
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorrupt(f"{path}: unreadable manifest: {e}")
+    if manifest.get("format") != _FORMAT:
+        raise CheckpointCorrupt(
+            f"{path}: unknown format {manifest.get('format')!r}")
+    for spec in manifest["arrays"]:
+        fpath = os.path.join(path, spec["file"])
+        try:
+            with open(fpath, "rb") as f:
+                data = f.read()
+        except OSError as e:
+            raise CheckpointCorrupt(f"{path}: missing {spec['file']}: {e}")
+        if len(data) != spec["nbytes"]:
+            raise CheckpointCorrupt(
+                f"{path}: {spec['file']} truncated "
+                f"({len(data)} != {spec['nbytes']} bytes)")
+        if (zlib.crc32(data) & 0xFFFFFFFF) != spec["crc32"]:
+            raise CheckpointCorrupt(
+                f"{path}: {spec['file']} checksum mismatch")
+    return manifest
+
+
+def load_checkpoint(path: str, template) -> Tuple[Any, Dict]:
+    """Load one validated snapshot into the structure of ``template``
+    (same pytree the writer saved: leaf count is checked). Framework
+    Tensor leaves in the template are restored IN PLACE; plain leaves are
+    returned as jax arrays. Returns ``(tree, manifest)``."""
+    manifest = validate_checkpoint(path)
+    t_leaves, treedef = _leaves(template)
+    if len(t_leaves) != manifest["num_leaves"]:
+        raise CheckpointCorrupt(
+            f"{path}: template has {len(t_leaves)} leaves, snapshot has "
+            f"{manifest['num_leaves']}")
+    if manifest.get("treedef") and manifest["treedef"] != str(treedef):
+        # same leaf COUNT but different structure would load weights into
+        # the WRONG leaves positionally — silent model corruption
+        raise CheckpointCorrupt(
+            f"{path}: template pytree structure differs from the saved "
+            f"one:\n  saved:    {manifest['treedef']}\n"
+            f"  template: {treedef}")
+    out = []
+    for old, spec in zip(t_leaves, manifest["arrays"]):
+        with open(os.path.join(path, spec["file"]), "rb") as f:
+            arr = np.frombuffer(f.read(), dtype=_dtype_of(spec["dtype"]))
+        arr = arr.reshape(spec["shape"])
+        if _is_tensor(old):
+            import jax.numpy as jnp
+            old._replace_value(jnp.asarray(arr))
+            out.append(old)
+        elif isinstance(old, jax.Array):
+            # land on the template leaf's sharding/device (resume onto the
+            # current mesh; a changed mesh reshards here)
+            out.append(jax.device_put(jax.numpy.asarray(arr), old.sharding))
+        else:
+            out.append(jax.numpy.asarray(arr))
+    return jax.tree_util.tree_unflatten(treedef, out), manifest
+
+
+def load_latest_valid(root: str, template) -> Optional[Tuple[Any, Dict]]:
+    """Newest snapshot that passes full validation, or ``None`` when no
+    valid snapshot exists. A torn/corrupt newer snapshot is reported on
+    stderr and skipped — recovery degrades by one checkpoint interval
+    instead of failing."""
+    for step, path in reversed(list_checkpoints(root)):
+        try:
+            return load_checkpoint(path, template)
+        except CheckpointCorrupt as e:
+            sys.stderr.write(
+                f"[paddle_tpu resilience] skipping corrupt checkpoint "
+                f"step {step}: {e}\n")
+    return None

@@ -1,0 +1,51 @@
+"""Retry with exponential backoff for transient bootstrap failures.
+
+Rendezvous and collective init are the classic transient-failure zone:
+the master's port is in TIME_WAIT, a peer pod is still booting, the GCS
+endpoint drops the first connection. The reference retries these inside
+its C++ socket layer (socket.cpp retry loop); here one policy serves
+``distributed.store`` (TCPStore connect) and ``distributed.env``
+(jax.distributed.initialize).
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Tuple, Type
+
+from ...framework.flags import define_flag, get_flag
+
+__all__ = ["retry_call"]
+
+define_flag("ft_bootstrap_retries", 3,
+            "retry count for store/collective bootstrap (exponential "
+            "backoff); 0 disables retries")
+define_flag("ft_bootstrap_backoff", 0.1,
+            "base delay in seconds for bootstrap retry backoff")
+
+
+def retry_call(fn: Callable, *args,
+               retries: int = None, base_delay: float = None,
+               factor: float = 2.0, max_delay: float = 10.0,
+               exceptions: Tuple[Type[BaseException], ...] = (Exception,),
+               on_retry: Callable = None, sleep: Callable = time.sleep,
+               **kwargs):
+    """Call ``fn(*args, **kwargs)``; on an exception in ``exceptions``,
+    retry up to ``retries`` more times with delays
+    ``base_delay * factor**attempt`` (capped at ``max_delay``). The last
+    failure re-raises. ``on_retry(attempt, exc)`` observes each retry;
+    ``sleep`` is injectable for tests."""
+    if retries is None:
+        retries = get_flag("ft_bootstrap_retries")
+    if base_delay is None:
+        base_delay = get_flag("ft_bootstrap_backoff")
+    attempt = 0
+    while True:
+        try:
+            return fn(*args, **kwargs)
+        except exceptions as e:
+            if attempt >= retries:
+                raise
+            if on_retry is not None:
+                on_retry(attempt, e)
+            sleep(min(max_delay, base_delay * (factor ** attempt)))
+            attempt += 1

@@ -28,10 +28,22 @@ class TCPStore:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  is_master: bool = False, world_size: int = 1,
-                 timeout: float = 30.0, bind_addr: str = ""):
+                 timeout: float = 30.0, bind_addr: str = "",
+                 retries: int = None):
         """``bind_addr``: interface the master listens on; default all
         interfaces so other hosts can rendezvous (reference TCPStore
-        behavior). Pass "127.0.0.1" to restrict to loopback."""
+        behavior). Pass "127.0.0.1" to restrict to loopback.
+
+        The client connect retries with exponential backoff (``retries``,
+        default ``FLAGS_ft_bootstrap_retries``); the caller's ``timeout``
+        is SPLIT across attempts, so total connect wall time stays ~one
+        ``timeout`` for existing callers. The win over the C layer's own
+        until-deadline retry loop is the fresh socket per attempt (a
+        half-open connection to a restarted master never recovers on the
+        old fd)."""
+        from .resilience.retry import retry_call
+        from ..framework.flags import get_flag
+
         lib = native_lib()
         self._lib = lib
         self._server = None
@@ -43,10 +55,21 @@ class TCPStore:
                 raise RuntimeError(f"TCPStore: cannot bind port {port}")
             port = lib.ptpu_store_server_port(self._server)
         self.port = port
-        self._client = lib.ptpu_store_client_connect(
-            host.encode(), port, float(timeout))
-        if not self._client:
-            raise RuntimeError(f"TCPStore: cannot connect {host}:{port}")
+
+        if retries is None:
+            retries = get_flag("ft_bootstrap_retries")
+        per_attempt = max(1.0, float(timeout) / (retries + 1))
+
+        def connect():
+            client = lib.ptpu_store_client_connect(
+                host.encode(), port, per_attempt)
+            if not client:
+                raise ConnectionError(
+                    f"TCPStore: cannot connect {host}:{port}")
+            return client
+
+        self._client = retry_call(connect, retries=retries,
+                                  exceptions=(ConnectionError,))
 
     def set(self, key: str, value) -> None:
         data = value if isinstance(value, bytes) else str(value).encode()

@@ -32,11 +32,61 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
-__all__ = ["CommWatchdog", "install", "uninstall", "current", "guarded"]
+__all__ = ["CommWatchdog", "install", "uninstall", "current", "guarded",
+           "register_emergency_hook", "unregister_emergency_hook"]
 
 TEARDOWN_EXIT_CODE = 77     # distinctive: "watchdog killed me"
 
 _global: Optional["CommWatchdog"] = None
+
+# Emergency hooks: run when ANY watchdog sees a timeout, BEFORE a
+# tear_down exit — the last chance to flush an emergency checkpoint
+# (distributed/resilience wires ResilientTrainLoop._save here). Hooks are
+# called from the monitor thread and must not raise (raises are swallowed
+# with a stderr note so a broken hook can't mask the teardown).
+_emergency_hooks: list = []
+
+
+def register_emergency_hook(fn: Callable[[str, float], None]):
+    """Register ``fn(task_name, elapsed)`` to run on watchdog timeout,
+    before any teardown. Returns ``fn`` so it can be unregistered."""
+    _emergency_hooks.append(fn)
+    return fn
+
+
+def unregister_emergency_hook(fn) -> None:
+    try:
+        _emergency_hooks.remove(fn)
+    except ValueError:
+        pass
+
+
+def _run_emergency_hooks(name: str, elapsed: float,
+                         budget: float = 60.0) -> None:
+    """Run hooks on a helper thread with a hard time budget: an emergency
+    checkpoint that itself hangs (e.g. a device readback on the very
+    runtime that wedged) must not block the tear_down exit — hang
+    detection that can hang is worse than no checkpoint."""
+    def run_all():
+        for fn in list(_emergency_hooks):
+            try:
+                fn(name, elapsed)
+            except Exception as e:
+                sys.stderr.write(
+                    f"[paddle_tpu watchdog] emergency hook {fn!r} raised "
+                    f"{e!r}\n")
+                sys.stderr.flush()
+
+    if not _emergency_hooks:
+        return
+    t = threading.Thread(target=run_all, daemon=True)
+    t.start()
+    t.join(budget)
+    if t.is_alive():
+        sys.stderr.write(
+            f"[paddle_tpu watchdog] emergency hooks still running after "
+            f"{budget:.0f}s budget — proceeding without them\n")
+        sys.stderr.flush()
 
 
 class _Task:
@@ -61,9 +111,10 @@ class CommWatchdog:
 
     def __init__(self, timeout: float = 300.0, mode: str = "tear_down",
                  on_timeout: Optional[Callable[[str, float], None]] = None,
-                 poll: float = 0.2):
+                 poll: float = 0.2, hook_budget: float = 60.0):
         if mode not in ("tear_down", "log"):
             raise ValueError(f"mode={mode!r}: 'tear_down' or 'log'")
+        self.hook_budget = hook_budget
         self.timeout = timeout
         self.mode = mode
         self.on_timeout = on_timeout
@@ -113,6 +164,10 @@ class CommWatchdog:
             self._fired.append((overdue.name, elapsed))
             msg = (f"[paddle_tpu watchdog] task '{overdue.name}' exceeded "
                    f"{overdue.timeout:.0f}s (elapsed {elapsed:.0f}s) — ")
+            # emergency checkpoint window: runs in BOTH modes, before a
+            # tear_down exit (reference analogue: comm task dump before
+            # TearDown aborts the process)
+            _run_emergency_hooks(overdue.name, elapsed, self.hook_budget)
             if self.mode == "tear_down":
                 sys.stderr.write(msg + "tearing down for restart\n")
                 sys.stderr.flush()

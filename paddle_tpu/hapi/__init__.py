@@ -2,5 +2,6 @@
 from .model import Model  # noqa: F401
 from .callbacks import (  # noqa: F401
     Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+    ResilientTraining,
 )
 from .summary import summary  # noqa: F401

@@ -299,3 +299,128 @@ class ReduceLROnPlateau(_MonitorMixin, Callback):
                             return
                 self.cooldown_counter = self.cooldown
                 self.wait = 0
+
+
+class ResilientTraining(Callback):
+    """Fault tolerance for ``Model.fit`` (distributed.resilience tier).
+
+    Three protections, mirroring ``ResilientTrainLoop`` at the hapi level:
+
+    - **NaN/spike rollback**: a batch whose loss is non-finite or exceeds
+      ``spike_factor`` x the median of the recent window never sticks —
+      the network is restored from the last good in-memory snapshot
+      (cheap: parameters are immutable jax arrays, the snapshot is a dict
+      of references) and the batch's update is effectively skipped.
+      Training stops after ``max_skips`` rollbacks (systematic, not
+      transient).
+    - **Periodic atomic checkpoints** of the network weights every
+      ``save_freq_steps`` batches into ``ckpt_dir`` (torn-write-proof
+      manifest format of resilience.atomic_ckpt).
+    - **Auto-resume + SIGTERM emergency save**: ``fit()`` restores the
+      newest valid checkpoint on train begin; a SIGTERM (preemption
+      notice) triggers an emergency checkpoint and a clean stop.
+
+    Weights-only at this tier: optimizer moments and dataloader position
+    are exact under ``ResilientTrainLoop``; here resume is best-effort
+    (see docs/resilience.md).
+    """
+
+    def __init__(self, ckpt_dir=None, save_freq_steps=0, keep=3,
+                 max_skips=8, spike_factor=10.0, window=32, warmup=5,
+                 handle_sigterm=True):
+        self.ckpt_dir = ckpt_dir
+        self.save_freq_steps = save_freq_steps
+        self.keep = keep
+        self.max_skips = max_skips
+        self.spike_factor = spike_factor
+        self.window = window
+        self.warmup = warmup
+        self.handle_sigterm = handle_sigterm
+        self.skips = 0
+        self.global_step = 0
+        self.events = []
+        self._losses = []
+        self._snapshot = None
+        self._sigterm = False
+
+    # -- helpers ----------------------------------------------------------
+    def _take_snapshot(self):
+        self._snapshot = {k: t._value for k, t
+                          in self.model.network.state_dict().items()}
+
+    def _restore_snapshot(self):
+        if self._snapshot is not None:
+            self.model.network.set_state_dict(self._snapshot)
+
+    def _save(self, tag):
+        if not self.ckpt_dir:
+            return
+        from ..distributed.resilience import atomic_ckpt
+
+        try:
+            atomic_ckpt.save_checkpoint(
+                self.model.network.state_dict(), self.ckpt_dir,
+                self.global_step, meta={"step": self.global_step,
+                                        "tag": tag},
+                keep=self.keep)
+            self.events.append(("checkpoint_saved", self.global_step, tag))
+        except OSError as e:
+            self.events.append(("checkpoint_failed", self.global_step,
+                                str(e)))
+
+    # -- callback hooks ---------------------------------------------------
+    def on_train_begin(self, logs=None):
+        if self.ckpt_dir:
+            from ..distributed.resilience import atomic_ckpt
+
+            # Tensor leaves restore IN PLACE into the live network
+            got = atomic_ckpt.load_latest_valid(
+                self.ckpt_dir, self.model.network.state_dict())
+            if got is not None:
+                self.global_step = int(got[1]["meta"].get("step", 0))
+                self.events.append(("resumed", self.global_step, None))
+        self._take_snapshot()
+        if self.handle_sigterm:
+            import signal
+
+            def on_sigterm(signum, frame):
+                self._sigterm = True
+            try:
+                self._old_handler = signal.signal(signal.SIGTERM, on_sigterm)
+            except ValueError:      # not the main thread
+                self._old_handler = None
+
+    def on_train_batch_end(self, step, logs=None):
+        from ..distributed.resilience.train_loop import is_bad_loss
+
+        self.global_step += 1
+        loss = (logs or {}).get("loss")
+        loss = float(np.asarray(loss)) if loss is not None else 0.0
+        bad = is_bad_loss(loss, self._losses, self.spike_factor,
+                          self.warmup) is not None
+        if bad:
+            self._restore_snapshot()
+            self.skips += 1
+            self.events.append(("rollback", self.global_step, loss))
+            if self.skips >= self.max_skips:
+                self.model.stop_training = True
+        else:
+            self._take_snapshot()
+            self._losses.append(loss)
+            del self._losses[:-self.window]
+            if (self.save_freq_steps
+                    and self.global_step % self.save_freq_steps == 0):
+                self._save("periodic")
+        if self._sigterm:
+            self._sigterm = False      # save the emergency snapshot ONCE
+            self._save("emergency-sigterm")
+            self.model.stop_training = True
+
+    def on_train_end(self, logs=None):
+        if self.ckpt_dir:
+            self._save("final")
+        if self.handle_sigterm and getattr(self, "_old_handler", None) \
+                is not None:
+            import signal
+
+            signal.signal(signal.SIGTERM, self._old_handler)

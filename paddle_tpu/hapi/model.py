@@ -199,6 +199,9 @@ class Model:
         cbks.set_params({"epochs": epochs, "steps": _steps(train_loader),
                          "verbose": verbose,
                          "metrics": ["loss"] + self._metrics_names()})
+        # a stop demanded by a previous fit (EarlyStopping, resilience
+        # SIGTERM) must not silently end THIS one after a single batch
+        self.stop_training = False
         cbks.on_begin("train")
         step_count = 0
         for epoch in range(epochs):
@@ -216,6 +219,11 @@ class Model:
                 cbks.on_batch_end("train", step, logs)
                 step_count += 1
                 if num_iters is not None and step_count >= num_iters:
+                    break
+                if self.stop_training:
+                    # a callback demanded an immediate stop (SIGTERM
+                    # emergency save, resilience skip budget) — don't
+                    # finish the epoch first
                     break
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 eval_logs = self.evaluate(eval_loader, verbose=0)

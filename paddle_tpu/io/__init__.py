@@ -366,6 +366,12 @@ class DataLoader:
                 f"{worker_mode!r}")
         self.worker_mode = worker_mode
         self._pool = None
+        # checkpointable position (distributed.resilience crash-resume):
+        # counts batches yielded by the ACTIVE iterator; assumes one live
+        # iterator at a time (the training-loop case)
+        self._pos_epoch = 0
+        self._pos_batch = 0
+        self._resume_skip = 0
         # loader-vs-consumer utilization probe, refreshed per epoch:
         # wait_s = time the consumer blocked on the loader; busy_s = time
         # the consumer spent between batches (its own step time)
@@ -401,32 +407,38 @@ class DataLoader:
         samples = [self.dataset[i] for i in indices]
         return self.collate_fn(samples)
 
-    def _iter_sync(self):
+    def _iter_sync(self, skip: int = 0):
         if self._iterable_mode:
+            n = 0
             batch = []
             for item in self.dataset:
                 batch.append(item)
                 if len(batch) == self.batch_size:
-                    yield self.collate_fn(batch)
+                    n += 1
+                    if n > skip:     # resume: re-stream, drop consumed
+                        yield self.collate_fn(batch)
                     batch = []
-            if batch and not self.drop_last:
+            if batch and not self.drop_last and n + 1 > skip:
                 yield self.collate_fn(batch)
             return
         if self.batch_sampler is None:
-            for i in range(len(self.dataset)):
+            for i in range(skip, len(self.dataset)):
                 yield self.dataset[i]
             return
-        for indices in self.batch_sampler:
+        # map-style resume skip is sampler-level: no sample is fetched for
+        # the skipped batches
+        for indices in itertools.islice(iter(self.batch_sampler), skip,
+                                        None):
             yield self._fetch(indices)
 
-    def _iter_threaded(self):
+    def _iter_threaded(self, skip: int = 0):
         """Prefetching thread pool: the stand-in for the reference's
         multiprocess worker + shared-memory transport (io/dataloader/worker.py)
         — on TPU hosts the goal is simply to keep the infeed ahead of step
         time."""
         q: "queue.Queue" = queue.Queue(self.prefetch_factor * self.num_workers)
         sentinel = object()
-        idx_iter = iter(self.batch_sampler)
+        idx_iter = itertools.islice(iter(self.batch_sampler), skip, None)
         lock = threading.Lock()
         exc = []
 
@@ -461,7 +473,7 @@ class DataLoader:
         if exc:
             raise exc[0]
 
-    def _iter_mp(self):
+    def _iter_mp(self, skip: int = 0):
         from .mp_loader import WorkerPool
 
         pool = self._pool
@@ -474,9 +486,13 @@ class DataLoader:
                 self._pool = pool
         pool.in_use = True
         if self._iterable_mode:
-            gen = pool.run_iterable_epoch()
+            gen = pool.run_iterable_epoch(skip=skip)
         else:
-            gen = pool.run_map_epoch(iter(self.batch_sampler), self.in_order)
+            # resume skip happens before submission: skipped batches are
+            # never fetched, collated, or shipped through shm
+            gen = pool.run_map_epoch(
+                itertools.islice(iter(self.batch_sampler), skip, None),
+                self.in_order)
         clean = False
         try:
             for batch in gen:
@@ -503,10 +519,15 @@ class DataLoader:
                 try:
                     item = next(gen)
                 except StopIteration:
+                    # clean exhaustion: the epoch is over for position
+                    # tracking (an abandoned iterator does NOT bump it)
+                    self._pos_epoch += 1
+                    self._pos_batch = 0
                     break
                 t1 = time.monotonic()
                 wait_s += t1 - t0
                 n += 1
+                self._pos_batch += 1
                 yield item          # consumer runs while suspended here
                 busy_s += time.monotonic() - t1
         finally:
@@ -517,13 +538,32 @@ class DataLoader:
             }
 
     def __iter__(self):
+        skip = self._resume_skip
+        self._resume_skip = 0
+        self._pos_batch = skip
         if self.num_workers > 0:
             if self.worker_mode == "process" and (
                     self._iterable_mode or self.batch_sampler is not None):
-                return self._timed(self._iter_mp())
+                return self._timed(self._iter_mp(skip))
             if not self._iterable_mode and self.batch_sampler is not None:
-                return self._timed(self._iter_threaded())
-        return self._timed(self._iter_sync())
+                return self._timed(self._iter_threaded(skip))
+        return self._timed(self._iter_sync(skip))
+
+    # -- checkpointable position (distributed.resilience) -----------------
+    def state_dict(self):
+        """Loader position for exact crash-resume: epochs completed and
+        batches yielded in the current epoch. Exact only for a
+        deterministic sampler (``shuffle=False`` or epoch-seeded)."""
+        return {"epoch": self._pos_epoch, "batch": self._pos_batch}
+
+    def load_state_dict(self, sd) -> None:
+        """Restore a :meth:`state_dict` position. The NEXT ``__iter__``
+        fast-forwards ``sd['batch']`` batches — at the sampler level for
+        map-style datasets (skipped batches are never fetched), by
+        stream-and-discard for iterables."""
+        self._pos_epoch = int(sd.get("epoch", 0))
+        self._pos_batch = int(sd.get("batch", 0))
+        self._resume_skip = self._pos_batch
 
     def __del__(self):
         pool = getattr(self, "_pool", None)

@@ -337,7 +337,10 @@ class WorkerPool:
             for p in pending.values():
                 _discard_tree(p)
 
-    def run_iterable_epoch(self):
+    def run_iterable_epoch(self, skip: int = 0):
+        """``skip``: resume fast-forward — the first ``skip`` arrived
+        batches are dropped at the parent (workers re-stream the dataset;
+        their shm segments are reclaimed without a device copy)."""
         n = self._loader.num_workers
         for q in self.index_qs:
             q.put(("epoch",))
@@ -348,6 +351,9 @@ class WorkerPool:
                 done += 1
             elif kind == "error":
                 raise RuntimeError(f"DataLoader worker failed:\n{payload}")
+            elif skip > 0:
+                skip -= 1
+                _discard_tree(payload)
             else:
                 yield _unpack_tree(payload)
 

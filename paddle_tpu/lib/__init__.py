@@ -36,10 +36,17 @@ def native_lib():
         if _build_error is not None:
             raise RuntimeError(_build_error)
         try:
-            if (not os.path.exists(_SO)
-                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            try:
+                if (not os.path.exists(_SO)
+                        or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                    _build()
+                lib = ctypes.CDLL(_SO)
+            except Exception:
+                # a checked-in .so built on another image may refuse to
+                # load here (GLIBCXX/ABI skew): rebuild from source once
+                # and retry before declaring the runtime unavailable
                 _build()
-            lib = ctypes.CDLL(_SO)
+                lib = ctypes.CDLL(_SO)
         except Exception as e:  # keep the framework importable without g++
             _build_error = f"native runtime unavailable: {e}"
             raise RuntimeError(_build_error) from e

@@ -827,7 +827,19 @@ class LLMEngine:
         """Read back one decode record (first tokens of its admissions,
         then its emitted grid) and update host bookkeeping. Slots whose
         request changed since dispatch (finished or preempted) are
-        skipped — their lanes are -1 padding or discarded speculation."""
+        skipped — their lanes are -1 padding or discarded speculation.
+
+        The device_get readbacks below are the engine's blocking host
+        syncs — the spot a hung collective or wedged device stalls a
+        serving process. They run under the process watchdog when one is
+        installed (distributed.watchdog.install): a long-lived server
+        gets hang detection + emergency-hook checkpointing for free."""
+        from ..distributed.watchdog import guarded
+
+        with guarded("serving-decode-readback"):
+            return self._process_guarded(rec)
+
+    def _process_guarded(self, rec):
         emitted = []
         if rec["adm"]:
             # one readback per distinct wave array, not per admission

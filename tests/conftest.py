@@ -19,7 +19,8 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 try:
     jax.config.update("jax_num_cpu_devices", 8)
-except RuntimeError:
+except (RuntimeError, AttributeError):
+    # older jax has no jax_num_cpu_devices; XLA_FLAGS above already covers it
     pass
 
 import paddle_tpu  # noqa: E402,F401

@@ -1,0 +1,602 @@
+"""Fault-tolerant training runtime (distributed/resilience/): atomic
+checkpoints survive torn writes and corruption, the FaultInjector makes
+every recovery path deterministic on CPU, and ResilientTrainLoop resumes
+crash-for-crash bit-exact — the failure menu is injected, not awaited.
+"""
+import os
+import signal
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.distributed.resilience import (FaultInjector,
+                                               ResilientTrainLoop,
+                                               ResumableIterator,
+                                               SimulatedCrash, atomic_ckpt,
+                                               retry_call)
+
+
+# ---------------------------------------------------------------------------
+# shared tiny model: momentum-SGD on a least-squares problem — every step
+# is deterministic, so recovery claims can be checked bit-exactly
+# ---------------------------------------------------------------------------
+def _batches(n, bs=4, d=3, seed=0):
+    r = np.random.RandomState(seed)
+    return [(jnp.asarray(r.randn(bs, d).astype(np.float32)),
+             jnp.asarray(r.randn(bs).astype(np.float32)))
+            for _ in range(n)]
+
+
+def _step_fn(state, batch):
+    w, m = state
+    x, y = batch
+
+    def loss(w):
+        return jnp.mean((x @ w - y) ** 2)
+
+    l, g = jax.value_and_grad(loss)(w)
+    m = 0.9 * m + g
+    return (w - 0.05 * m, m), l
+
+
+def _init():
+    return (jnp.zeros((3,)), jnp.zeros((3,)))
+
+
+def _loop(data, **kw):
+    return ResilientTrainLoop(_step_fn, _init(),
+                              ResumableIterator(lambda e: iter(data)), **kw)
+
+
+def _assert_state_equal(a, b, exact=True):
+    cmp = np.array_equal if exact else np.allclose
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        assert cmp(np.asarray(x), np.asarray(y))
+
+
+# ---------------------------------------------------------------------------
+# atomic checkpoints
+# ---------------------------------------------------------------------------
+def test_atomic_roundtrip_with_meta(tmp_path):
+    tree = {"w": jnp.arange(12.0).reshape(3, 4),
+            "k": jax.random.PRNGKey(7),
+            "bf": jnp.full((5,), 2.5, jnp.bfloat16)}
+    atomic_ckpt.save_checkpoint(tree, str(tmp_path), 3,
+                                meta={"step": 3, "loader": {"epoch": 1}})
+    tpl = jax.tree_util.tree_map(jnp.zeros_like, tree)
+    out, manifest = atomic_ckpt.load_latest_valid(str(tmp_path), tpl)
+    _assert_state_equal(out, tree)
+    assert out["bf"].dtype == jnp.bfloat16
+    assert manifest["meta"] == {"step": 3, "loader": {"epoch": 1}}
+
+
+def test_crash_midway_leaves_previous_loadable(tmp_path):
+    tree = {"a": jnp.ones((4,)), "b": jnp.zeros((2, 2))}
+    atomic_ckpt.save_checkpoint(tree, str(tmp_path), 1)
+
+    def die(i):
+        if i >= 1:
+            raise OSError("disk on fire")
+
+    with pytest.raises(OSError):
+        atomic_ckpt.save_checkpoint(
+            jax.tree_util.tree_map(lambda x: x + 9, tree),
+            str(tmp_path), 2, fail_hook=die)
+    # the torn write never committed: no step-2 dir, step-1 still valid
+    assert [s for s, _ in atomic_ckpt.list_checkpoints(str(tmp_path))] == [1]
+    out, manifest = atomic_ckpt.load_latest_valid(str(tmp_path), tree)
+    assert manifest["step"] == 1
+    _assert_state_equal(out, tree)
+
+
+def test_checksum_mismatch_skipped(tmp_path):
+    tree = {"w": jnp.arange(8.0)}
+    atomic_ckpt.save_checkpoint(tree, str(tmp_path), 1)
+    atomic_ckpt.save_checkpoint({"w": jnp.arange(8.0) * 2}, str(tmp_path), 2)
+    newest = atomic_ckpt.list_checkpoints(str(tmp_path))[-1][1]
+    with open(os.path.join(newest, "a00000.bin"), "r+b") as f:
+        f.write(b"\xde\xad\xbe\xef")
+    with pytest.raises(atomic_ckpt.CheckpointCorrupt):
+        atomic_ckpt.validate_checkpoint(newest)
+    out, manifest = atomic_ckpt.load_latest_valid(str(tmp_path), tree)
+    assert manifest["step"] == 1          # fell back past the corrupt one
+    _assert_state_equal(out, tree)
+
+
+def test_truncated_and_missing_files_detected(tmp_path):
+    atomic_ckpt.save_checkpoint({"w": jnp.arange(16.0)}, str(tmp_path), 1)
+    path = atomic_ckpt.list_checkpoints(str(tmp_path))[0][1]
+    data = open(os.path.join(path, "a00000.bin"), "rb").read()
+    with open(os.path.join(path, "a00000.bin"), "wb") as f:
+        f.write(data[:-8])               # truncate
+    with pytest.raises(atomic_ckpt.CheckpointCorrupt, match="truncated"):
+        atomic_ckpt.validate_checkpoint(path)
+    os.remove(os.path.join(path, "a00000.bin"))
+    with pytest.raises(atomic_ckpt.CheckpointCorrupt, match="missing"):
+        atomic_ckpt.validate_checkpoint(path)
+    assert atomic_ckpt.load_latest_valid(str(tmp_path), {"w": jnp.zeros(16)}) \
+        is None
+
+
+def test_keep_last_n_gc(tmp_path):
+    for s in range(1, 7):
+        atomic_ckpt.save_checkpoint({"w": jnp.full((2,), float(s))},
+                                    str(tmp_path), s, keep=3)
+    assert [s for s, _ in atomic_ckpt.list_checkpoints(str(tmp_path))] \
+        == [4, 5, 6]
+    # stale temp dirs from dead writers are collected too
+    assert not [n for n in os.listdir(str(tmp_path))
+                if n.startswith(".tmp-")]
+
+
+def test_tensor_leaves_restore_in_place(tmp_path):
+    import paddle_tpu as paddle
+
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    atomic_ckpt.save_checkpoint({"t": t}, str(tmp_path), 1)
+    t2 = paddle.zeros([2, 2])
+    atomic_ckpt.load_latest_valid(str(tmp_path), {"t": t2})
+    np.testing.assert_array_equal(t2.numpy(), [[1.0, 2.0], [3.0, 4.0]])
+
+
+def test_checkpoint_api_reexported_from_distributed_checkpoint():
+    from paddle_tpu.distributed import checkpoint as dc
+
+    for name in ("save_checkpoint", "load_latest_valid", "list_checkpoints",
+                 "validate_checkpoint", "CheckpointCorrupt"):
+        assert hasattr(dc, name)
+
+
+# ---------------------------------------------------------------------------
+# fault injector
+# ---------------------------------------------------------------------------
+def test_injector_schedule_one_shot():
+    inj = FaultInjector("nan_grad@5, crash@9")
+    assert inj.pending == [("crash", 9), ("nan_grad", 5)]
+    assert inj.fires("nan_grad", 5)
+    assert not inj.fires("nan_grad", 5)     # one-shot: retries are clean
+    assert inj.take(9) == ["crash"]
+    assert inj.take(9) == []
+    assert inj.fired == [("nan_grad", 5), ("crash", 9)]
+
+
+def test_injector_rejects_bad_spec():
+    with pytest.raises(ValueError):
+        FaultInjector("meteor_strike@5")
+    with pytest.raises(ValueError):
+        FaultInjector("nan_grad@soon")
+
+
+def test_injector_from_flags():
+    import paddle_tpu
+
+    paddle_tpu.set_flags({"ft_fault_schedule": "inf_grad@2"})
+    try:
+        assert FaultInjector().pending == [("inf_grad", 2)]
+    finally:
+        paddle_tpu.set_flags({"ft_fault_schedule": ""})
+
+
+def test_random_schedule_deterministic():
+    a = FaultInjector.random_schedule(seed=42, n_steps=50)
+    b = FaultInjector.random_schedule(seed=42, n_steps=50)
+    c = FaultInjector.random_schedule(seed=43, n_steps=50)
+    assert a.pending == b.pending
+    assert a.pending != c.pending
+
+
+def test_poison_marks_float_leaves_only():
+    tree = {"w": jnp.ones((3,)), "i": jnp.arange(3)}
+    out = FaultInjector.poison(tree, "nan_grad")
+    assert np.isnan(np.asarray(out["w"])).all()
+    np.testing.assert_array_equal(np.asarray(out["i"]), np.arange(3))
+
+
+# ---------------------------------------------------------------------------
+# retry with backoff
+# ---------------------------------------------------------------------------
+def test_retry_backoff_exponential_then_success():
+    calls, delays = [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("not yet")
+        return "up"
+
+    assert retry_call(flaky, retries=5, base_delay=0.1,
+                      exceptions=(ConnectionError,),
+                      sleep=delays.append) == "up"
+    assert len(calls) == 3
+    assert delays == [pytest.approx(0.1), pytest.approx(0.2)]
+
+
+def test_retry_gives_up_and_reraises():
+    delays = []
+    with pytest.raises(ConnectionError):
+        retry_call(lambda: (_ for _ in ()).throw(ConnectionError("down")),
+                   retries=2, base_delay=0.01,
+                   exceptions=(ConnectionError,), sleep=delays.append)
+    assert len(delays) == 2
+
+
+# ---------------------------------------------------------------------------
+# resumable data position
+# ---------------------------------------------------------------------------
+def test_resumable_iterator_epoch_rollover_and_resume():
+    data = list(range(5))
+    it = ResumableIterator(lambda e: iter(data))
+    got = [next(it) for _ in range(7)]     # one full epoch + 2
+    assert got == [0, 1, 2, 3, 4, 0, 1]
+    assert it.state_dict() == {"epoch": 1, "index": 2}
+
+    it2 = ResumableIterator(lambda e: iter(data))
+    it2.load_state_dict({"epoch": 1, "index": 2})
+    assert [next(it2) for _ in range(4)] == [2, 3, 4, 0]
+
+
+def test_dataloader_position_state_dict_sync():
+    from paddle_tpu.io import DataLoader
+
+    ds = [np.full((2,), i, np.float32) for i in range(10)]
+    loader = DataLoader(ds, batch_size=2, shuffle=False)
+    it = iter(loader)
+    ref = [np.asarray(next(it)) for _ in range(5)]   # full epoch (5 batches)
+    assert loader.state_dict() == {"epoch": 0, "batch": 5}
+    with pytest.raises(StopIteration):
+        next(it)
+    assert loader.state_dict() == {"epoch": 1, "batch": 0}
+
+    fresh = DataLoader(ds, batch_size=2, shuffle=False)
+    fresh.load_state_dict({"epoch": 0, "batch": 3})
+    rest = [np.asarray(b) for b in fresh]
+    assert len(rest) == 2
+    np.testing.assert_array_equal(rest[0], ref[3])
+    np.testing.assert_array_equal(rest[1], ref[4])
+
+
+def test_mp_loader_position_restored():
+    from paddle_tpu.io import DataLoader
+
+    ds = [np.full((64, 64), i, np.float32) for i in range(12)]
+
+    def collect(loader):
+        return [np.asarray(b) for b in loader]
+
+    ref = collect(DataLoader(ds, batch_size=2, shuffle=False))
+    loader = DataLoader(ds, batch_size=2, shuffle=False, num_workers=2,
+                        worker_mode="process")
+    it = iter(loader)
+    for _ in range(4):
+        next(it)
+    state = loader.state_dict()
+    it.close()                      # crash analogue: iterator abandoned
+    assert state == {"epoch": 0, "batch": 4}
+
+    resumed = DataLoader(ds, batch_size=2, shuffle=False, num_workers=2,
+                         worker_mode="process")
+    resumed.load_state_dict(state)
+    rest = collect(resumed)
+    assert len(rest) == 2
+    np.testing.assert_array_equal(rest[0], ref[4])
+    np.testing.assert_array_equal(rest[1], ref[5])
+
+
+# ---------------------------------------------------------------------------
+# resilient train loop
+# ---------------------------------------------------------------------------
+def test_nan_injection_resumes_bit_exact():
+    data = _batches(30)
+    clean = _loop(data)
+    s_clean = clean.run(12)
+
+    faulted = _loop(data, injector=FaultInjector("nan_grad@5"))
+    s_faulted = faulted.run(12)
+    # the transient fault rolled back and the SAME batch retried cleanly
+    _assert_state_equal(s_clean, s_faulted, exact=True)
+    kinds = [e["kind"] for e in faulted.events]
+    assert "grad_fault_injected" in kinds and "rollback" in kinds
+    assert faulted.skipped_batches == 0
+    assert faulted.data.state_dict() == clean.data.state_dict()
+
+
+def test_persistent_bad_batch_skipped_without_update():
+    data = _batches(20)
+    bad_everytime = FaultInjector(
+        [("nan_grad", 3)] * 5)     # re-fires beyond the retry budget
+    # spike detection off: this test isolates the retry/skip budget
+    loop = _loop(data, injector=bad_everytime, max_retries_per_batch=2,
+                 spike_factor=1e9)
+    loop.run(6)
+    assert loop.skipped_batches == 1
+    assert any(e["kind"] == "batch_skipped" for e in loop.events)
+    assert loop.step == 6          # still reached the target step count
+
+
+def test_spike_detection_rolls_back():
+    data = _batches(20)
+    calls = {"n": 0}
+
+    def spiking_step(state, batch):
+        calls["n"] += 1
+        new_state, loss = _step_fn(state, batch)
+        if calls["n"] == 9:        # transient spike, one attempt only
+            return new_state, loss + 1e6
+        return new_state, loss
+
+    loop = ResilientTrainLoop(spiking_step, _init(),
+                              ResumableIterator(lambda e: iter(data)),
+                              warmup=3)
+    loop.run(10)
+    assert any(e["kind"] == "rollback" and e["reason"] == "loss_spike"
+               for e in loop.events)
+    assert loop.step == 10
+
+
+def test_crash_corrupt_newest_auto_resume_exact(tmp_path):
+    """The acceptance scenario: NaN grad at step 5, crash at step 9,
+    corrupt newest checkpoint — auto-resume matches an uninterrupted run
+    of equal total steps, including the dataloader position."""
+    data = _batches(40)
+    total = 14
+    s_clean = _loop(data).run(total)
+
+    d = str(tmp_path / "ckpt")
+    crashed = _loop(data, ckpt_dir=d, ckpt_every=2,
+                    injector=FaultInjector("nan_grad@5,crash@9"))
+    with pytest.raises(SimulatedCrash):
+        crashed.run(total)
+
+    newest = atomic_ckpt.list_checkpoints(d)[-1][1]
+    with open(os.path.join(newest, "a00000.bin"), "r+b") as f:
+        f.write(b"garbage!")
+
+    resumed = _loop(data, ckpt_dir=d, ckpt_every=2)   # fresh process analogue
+    s_resumed = resumed.run(total)
+    assert resumed.resumed_from == 6   # 8 was corrupt, fell back to 6
+    _assert_state_equal(s_clean, s_resumed, exact=True)
+    assert resumed.data.state_dict() == {"epoch": 0, "index": total}
+    assert resumed.step == total
+
+
+def test_storage_failure_keeps_previous_checkpoint(tmp_path):
+    d = str(tmp_path / "ckpt")
+    loop = _loop(_batches(20), ckpt_dir=d, ckpt_every=2,
+                 injector=FaultInjector("storage_fail@4"))
+    loop.run(6)
+    assert any(e["kind"] == "checkpoint_failed" for e in loop.events)
+    steps = [s for s, _ in atomic_ckpt.list_checkpoints(d)]
+    assert 4 not in steps and 2 in steps and 6 in steps
+
+
+def test_sigterm_triggers_emergency_save_and_resume(tmp_path):
+    d = str(tmp_path / "ckpt")
+    data = _batches(30)
+
+    def preempting(epoch):
+        for i, b in enumerate(iter(data)):
+            if epoch == 0 and i == 5:     # preemption notice mid-epoch
+                os.kill(os.getpid(), signal.SIGTERM)
+            yield b
+
+    loop = ResilientTrainLoop(_step_fn, _init(),
+                              ResumableIterator(preempting), ckpt_dir=d)
+    loop.run(20)
+    assert any(e["kind"] == "sigterm" for e in loop.events)
+    assert loop.step < 20
+    _, manifest = atomic_ckpt.load_latest_valid(d, {"state": _init()})
+    assert manifest["meta"]["tag"] == "emergency-sigterm"
+
+    # relaunch (the launcher's restart tier): finishes the remainder and
+    # matches the uninterrupted run
+    resumed = _loop(data, ckpt_dir=d)
+    s_resumed = resumed.run(20)
+    _assert_state_equal(_loop(data).run(20), s_resumed, exact=True)
+
+
+def test_watchdog_timeout_fires_emergency_checkpoint(tmp_path):
+    from paddle_tpu.distributed.watchdog import CommWatchdog
+
+    d = str(tmp_path / "ckpt")
+    wd = CommWatchdog(timeout=0.15, mode="log", poll=0.03)
+    try:
+        loop = _loop(_batches(20), ckpt_dir=d, watchdog=wd,
+                     hang_seconds=0.6,
+                     injector=FaultInjector("collective_timeout@2"))
+        loop.run(4)
+    finally:
+        wd.stop()
+    assert any(e["kind"] == "watchdog_emergency" for e in loop.events)
+    assert any(e["kind"] == "checkpoint_saved"
+               and e.get("tag") == "emergency-watchdog"
+               for e in loop.events)
+    assert wd._fired                       # the hang was actually detected
+
+
+def test_emergency_hook_registry():
+    from paddle_tpu.distributed import watchdog as wdm
+
+    hits = []
+    fn = wdm.register_emergency_hook(lambda n, e: hits.append(n))
+    bad = wdm.register_emergency_hook(
+        lambda n, e: (_ for _ in ()).throw(RuntimeError("hook bug")))
+    try:
+        wd = wdm.CommWatchdog(timeout=0.1, mode="log", poll=0.03)
+        try:
+            with wd.task("stuck"):
+                import time
+                deadline = time.time() + 5
+                while not hits and time.time() < deadline:
+                    time.sleep(0.03)
+        finally:
+            wd.stop()
+    finally:
+        wdm.unregister_emergency_hook(fn)
+        wdm.unregister_emergency_hook(bad)
+    assert hits == ["stuck"]               # raising hook didn't block it
+
+
+def test_elastic_controller_free_restart_on_teardown(tmp_path):
+    """A watchdog tear-down exit restarts at the same world size WITHOUT
+    consuming the fault budget (the exit is deliberate and checkpointed)."""
+    from paddle_tpu.distributed.launch import ElasticController
+
+    marker = tmp_path / "ran_once"
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, sys\n"
+        f"m = {str(marker)!r}\n"
+        "if not os.path.exists(m):\n"
+        "    open(m, 'w').close()\n"
+        "    sys.exit(77)\n"              # TEARDOWN_EXIT_CODE
+        "sys.exit(0)\n")
+    ctl = ElasticController(str(script), np_range=(1, 1), fault_restarts=0)
+    assert ctl.run() == 0
+    assert [h["codes"] for h in ctl.history] == [[77], [0]]
+
+
+# ---------------------------------------------------------------------------
+# hapi tier
+# ---------------------------------------------------------------------------
+def test_hapi_resilient_callback_rollback_and_resume(tmp_path):
+    import paddle_tpu.nn as nn
+    from paddle_tpu.hapi import ResilientTraining
+
+    class FakeModel:
+        pass
+
+    net = nn.Linear(4, 2)
+    m = FakeModel()
+    m.network = net
+    m.stop_training = False
+
+    d = str(tmp_path / "ckpt")
+    cb = ResilientTraining(ckpt_dir=d, save_freq_steps=2, warmup=2,
+                           handle_sigterm=False)
+    cb.set_model(m)
+    cb.on_begin("train")
+    w0 = np.asarray(net.state_dict()["weight"]._value).copy()
+
+    cb.on_batch_end("train", 0, {"loss": 1.0})
+    cb.on_batch_end("train", 1, {"loss": 0.9})       # periodic save here
+    # an update lands, then the loss goes NaN: roll back to last good
+    p = net.state_dict()["weight"]
+    good = np.asarray(p._value).copy()
+    p._replace_value(p._value + 100.0)
+    cb.on_batch_end("train", 2, {"loss": float("nan")})
+    np.testing.assert_array_equal(
+        np.asarray(net.state_dict()["weight"]._value), good)
+    assert cb.skips == 1 and not m.stop_training
+
+    # auto-resume restores saved weights into a fresh network
+    net2 = nn.Linear(4, 2)
+    m2 = FakeModel()
+    m2.network = net2
+    m2.stop_training = False
+    cb2 = ResilientTraining(ckpt_dir=d, handle_sigterm=False)
+    cb2.set_model(m2)
+    cb2.on_begin("train")
+    assert cb2.global_step == 2
+    np.testing.assert_array_equal(
+        np.asarray(net2.state_dict()["weight"]._value), w0)
+
+
+# ---------------------------------------------------------------------------
+# chaos run (tools/chaos_run.py) — the CI-grade end-to-end: tiny llama
+# under a seeded random fault schedule, final-loss parity with clean run
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_chaos_run_llama_parity(tmp_path):
+    import subprocess
+    import sys
+
+    tools = os.path.join(os.path.dirname(__file__), "..", "tools",
+                         "chaos_run.py")
+    proc = subprocess.run(
+        [sys.executable, tools, "--steps", "12", "--seed", "7",
+         "--workdir", str(tmp_path)],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "CHAOS_PARITY: OK" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# review-hardening regressions
+# ---------------------------------------------------------------------------
+def test_same_step_save_discards_redundant_replaces_differing(tmp_path):
+    tree = {"w": jnp.arange(4.0)}
+    atomic_ckpt.save_checkpoint(tree, str(tmp_path), 2, meta={"pos": 2})
+    # identical meta: redundant, the existing snapshot survives untouched
+    atomic_ckpt.save_checkpoint(tree, str(tmp_path), 2, meta={"pos": 2})
+    _, manifest = atomic_ckpt.load_latest_valid(str(tmp_path), tree)
+    assert manifest["meta"] == {"pos": 2}
+    # differing meta (a batch skip moved the loader without a new step):
+    # the stale snapshot is REPLACED, not silently kept
+    atomic_ckpt.save_checkpoint(tree, str(tmp_path), 2, meta={"pos": 3})
+    _, manifest = atomic_ckpt.load_latest_valid(str(tmp_path), tree)
+    assert manifest["meta"] == {"pos": 3}
+
+
+def test_structural_template_mismatch_is_corrupt(tmp_path):
+    atomic_ckpt.save_checkpoint({"a": jnp.zeros(2), "b": jnp.zeros(2)},
+                                str(tmp_path), 1)
+    path = atomic_ckpt.list_checkpoints(str(tmp_path))[0][1]
+    # same leaf count, different structure: positional load would swap
+    # weights silently — must be detected instead
+    with pytest.raises(atomic_ckpt.CheckpointCorrupt, match="structure"):
+        atomic_ckpt.load_checkpoint(path, {"a": jnp.zeros(2),
+                                           "c": jnp.zeros(2)})
+
+
+def test_resume_past_shrunk_source_raises():
+    it = ResumableIterator(lambda e: iter(range(3)))
+    it.load_state_dict({"epoch": 1, "index": 5})
+    with pytest.raises(RuntimeError, match="fast-forward"):
+        next(it)
+
+
+def test_resume_past_shrunk_dataloader_raises():
+    from paddle_tpu.io import DataLoader
+
+    ds = [np.zeros((2,), np.float32) for _ in range(6)]   # 3 batches
+    loader = DataLoader(ds, batch_size=2, shuffle=False)
+    it = ResumableIterator(loader)
+    it.load_state_dict({"epoch": 0, "index": 5})
+    with pytest.raises(RuntimeError, match="fast-forward"):
+        next(it)
+
+
+def test_resume_exactly_at_epoch_end_rolls_over():
+    from paddle_tpu.io import DataLoader
+
+    ds = [np.full((2,), i, np.float32) for i in range(6)]  # 3 batches
+    loader = DataLoader(ds, batch_size=2, shuffle=False)
+    it = ResumableIterator(loader)
+    it.load_state_dict({"epoch": 0, "index": 3})           # == epoch length
+    first = np.asarray(next(it))
+    np.testing.assert_array_equal(first, [[0, 0], [1, 1]])  # next epoch
+    assert it.state_dict() == {"epoch": 1, "index": 1}
+
+
+def test_fit_resets_stop_training():
+    from paddle_tpu.hapi.model import Model
+
+    class Net:
+        def state_dict(self):
+            return {}
+
+        def __call__(self, x):
+            return x
+
+    m = Model.__new__(Model)
+    m.stop_training = True
+    # fit() itself needs a full prepare(); assert the contract directly on
+    # the attribute reset path instead of driving a whole training run
+    assert hasattr(Model, "fit")
+    src = open(os.path.join(os.path.dirname(__file__), "..",
+                            "paddle_tpu", "hapi", "model.py")).read()
+    assert "self.stop_training = False" in src.split("def fit")[1]

@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Chaos run: a tiny llama pretrain loop under a seeded random fault
+schedule, asserting final-state parity with a clean run.
+
+The CI-grade end-to-end for distributed/resilience: the driver plays the
+role of the elastic launcher — every SimulatedCrash kills the "process"
+(the ResilientTrainLoop) and a fresh loop auto-resumes from the newest
+valid checkpoint; after the first crash the newest checkpoint is
+deliberately corrupted to exercise the fallback tier. A run passes when
+the faulted job reaches the SAME final parameters (allclose), the same
+final eval loss, and the same dataloader position as an uninterrupted
+run of equal total steps.
+
+    JAX_PLATFORMS=cpu python tools/chaos_run.py --steps 12 --seed 7
+
+Wired into the suite as tests/test_resilience.py::test_chaos_run_llama_parity
+(slow lane: PADDLE_TPU_FULL_TESTS=1).
+"""
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--rate", type=float, default=0.2,
+                    help="per-step fault probability for the random schedule")
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--no-corrupt-newest", action="store_true",
+                    help="skip the corrupt-newest-checkpoint tier")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import llama
+    from paddle_tpu.distributed.resilience import (FaultInjector,
+                                                   ResilientTrainLoop,
+                                                   ResumableIterator,
+                                                   SimulatedCrash,
+                                                   atomic_ckpt)
+
+    cfg = llama.tiny_llama(vocab=64, hidden=32, layers=2, heads=4,
+                           kv_heads=2, seq=16, ffn=64)
+    steps = args.steps
+    rng = np.random.RandomState(args.seed)
+    batches = [jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 16)),
+                           dtype=jnp.int32) for _ in range(steps + 4)]
+    eval_batch = batches[-1]
+
+    step_jit = jax.jit(lambda s, t: llama.train_step(s, t, cfg, lr=1e-3))
+    eval_jit = jax.jit(lambda p, t: llama.loss_fn(p, t, cfg))
+
+    def init_state():
+        return llama.init_train_state(cfg, jax.random.PRNGKey(args.seed))
+
+    def data_iter():
+        return ResumableIterator(lambda e: iter(batches))
+
+    # -- clean reference ---------------------------------------------------
+    clean = ResilientTrainLoop(step_jit, init_state(), data_iter())
+    s_clean = clean.run(steps)
+    clean_pos = clean.data.state_dict()
+    clean_loss = float(eval_jit(s_clean.params, eval_batch))
+    print(f"clean run: {steps} steps, eval loss {clean_loss:.6f}")
+
+    # -- chaos run ---------------------------------------------------------
+    # seeded random schedule, with the canonical menu guaranteed present:
+    # a NaN gradient in the first half and a crash in the second
+    inj = FaultInjector.random_schedule(
+        seed=args.seed, n_steps=steps,
+        kinds=("nan_grad", "storage_fail"), rate=args.rate)
+    menu = [("nan_grad", max(1, steps // 3)), ("crash", 2 * steps // 3)]
+    inj = FaultInjector(inj.pending + menu)
+    print(f"fault schedule: {inj.pending}")
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_run_")
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    crashes = 0
+    corrupted = args.no_corrupt_newest
+    while True:
+        loop = ResilientTrainLoop(step_jit, init_state(), data_iter(),
+                                  ckpt_dir=ckpt_dir, ckpt_every=2,
+                                  injector=inj)
+        try:
+            s_chaos = loop.run(steps)
+            break
+        except SimulatedCrash as e:
+            crashes += 1
+            print(f"worker died ({e}); relaunching (auto-resume)")
+            if not corrupted:
+                ckpts = atomic_ckpt.list_checkpoints(ckpt_dir)
+                if ckpts:
+                    victim = os.path.join(ckpts[-1][1], "a00000.bin")
+                    with open(victim, "r+b") as f:
+                        f.write(b"bitrot!!")
+                    print(f"corrupted newest checkpoint "
+                          f"(step {ckpts[-1][0]}) to exercise fallback")
+                    corrupted = True
+        if crashes > 8:
+            print("CHAOS_PARITY: FAIL (crash loop)")
+            return 1
+
+    chaos_loss = float(eval_jit(s_chaos.params, eval_batch))
+    chaos_pos = loop.data.state_dict()
+    events = [e["kind"] for e in loop.events]
+    print(f"chaos run: {crashes} crashes, {loop.total_retries} retries, "
+          f"{loop.skipped_batches} skipped, final events {events}")
+    print(f"chaos eval loss {chaos_loss:.6f}")
+
+    ok = True
+    for a, b in zip(jax.tree_util.tree_leaves(s_clean.params),
+                    jax.tree_util.tree_leaves(s_chaos.params)):
+        if not np.allclose(np.asarray(a), np.asarray(b),
+                           rtol=1e-6, atol=1e-6):
+            diff = float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+            print(f"param mismatch: max abs diff {diff}")
+            ok = False
+    if chaos_pos != clean_pos:
+        print(f"dataloader position mismatch: {chaos_pos} != {clean_pos}")
+        ok = False
+    if abs(chaos_loss - clean_loss) > 1e-6:
+        print(f"final-loss mismatch: {chaos_loss} != {clean_loss}")
+        ok = False
+    if loop.skipped_batches != 0:
+        print(f"unexpected skipped batches: {loop.skipped_batches}")
+        ok = False
+
+    print("CHAOS_PARITY: OK" if ok else "CHAOS_PARITY: FAIL")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
