@@ -71,6 +71,7 @@ from .framework.io import load, save  # noqa: F401
 from .jit import to_static  # noqa: F401
 from . import distributed  # noqa: F401
 from . import incubate  # noqa: F401
+from . import observability  # noqa: F401
 from . import profiler  # noqa: F401
 from . import distribution  # noqa: F401
 from . import sparse  # noqa: F401

@@ -100,7 +100,12 @@ def init_parallel_env():
                     return
                 raise
 
+        from ..observability.catalog import instrument
+
+        retry_counter = instrument("dist_init_retries_total")
+
         def log_retry(attempt, exc):
+            retry_counter.inc()
             sys.stderr.write(
                 f"[paddle_tpu distributed] init attempt {attempt + 1} "
                 f"failed ({exc}); retrying with backoff\n")

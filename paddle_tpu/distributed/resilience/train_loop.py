@@ -36,11 +36,25 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from ...observability import trace_span
+from ...observability.catalog import instrument as _instrument
 from . import atomic_ckpt
 from .data import ResumableIterator
 from .faults import FaultInjector, SimulatedCrash
 
 __all__ = ["ResilientTrainLoop", "is_bad_loss"]
+
+# always-on training telemetry (no-ops until FLAGS_obs_enabled; names
+# documented in observability.catalog)
+_M_STEPS = _instrument("train_steps_total")
+_M_STEP_SECONDS = _instrument("train_step_seconds")
+_M_ROLLBACKS = _instrument("train_rollbacks_total")
+_M_RETRIES = _instrument("train_retries_total")
+_M_SKIPPED = _instrument("train_batches_skipped_total")
+_M_CKPTS = _instrument("train_checkpoints_total")
+_M_EMERGENCY = _instrument("train_emergency_saves_total")
+_M_CKPT_SAVE = _instrument("train_checkpoint_save_seconds")
+_M_CKPT_LOAD = _instrument("train_checkpoint_load_seconds")
 
 
 def is_bad_loss(loss_val: float, window, spike_factor: float,
@@ -157,9 +171,16 @@ class ResilientTrainLoop:
                     "tag": tag, "skipped_batches": self.skipped_batches,
                     "loss_window": self._loss_window[-self.spike_window:]}
             try:
-                atomic_ckpt.save_checkpoint(
-                    self._ckpt_tree(), self.ckpt_dir, self.step,
-                    meta=meta, keep=self.keep, fail_hook=hook)
+                t0 = time.perf_counter()
+                with trace_span("train.checkpoint", tag=tag,
+                                step=self.step):
+                    atomic_ckpt.save_checkpoint(
+                        self._ckpt_tree(), self.ckpt_dir, self.step,
+                        meta=meta, keep=self.keep, fail_hook=hook)
+                _M_CKPT_SAVE.observe(time.perf_counter() - t0)
+                _M_CKPTS.inc(tag=tag)
+                if tag.startswith("emergency"):
+                    _M_EMERGENCY.inc()
                 self._event("checkpoint_saved", tag=tag)
                 return True
             except (OSError, IOError) as e:
@@ -176,9 +197,13 @@ class ResilientTrainLoop:
         Returns True when a checkpoint was restored."""
         if self.ckpt_dir is None:
             return False
-        got = atomic_ckpt.load_latest_valid(self.ckpt_dir, self._ckpt_tree())
+        t0 = time.perf_counter()
+        with trace_span("train.resume"):
+            got = atomic_ckpt.load_latest_valid(self.ckpt_dir,
+                                                self._ckpt_tree())
         if got is None:
             return False
+        _M_CKPT_LOAD.observe(time.perf_counter() - t0)
         tree, manifest = got
         self.state = tree["state"]
         if self.rng_key is not None:
@@ -261,19 +286,20 @@ class ResilientTrainLoop:
             except ValueError:       # not the main thread
                 old_handler = None
         try:
-            while self.step < num_steps:
-                if self._sigterm:
-                    self._event("sigterm")
-                    self._save(tag="emergency-sigterm")
-                    break
-                batch = next(self.data)
-                self._run_batch(batch)
-                if (self.ckpt_every and self.step > 0
-                        and self.step % self.ckpt_every == 0):
-                    self._save(tag="periodic")
-            else:
-                if self.ckpt_dir is not None:
-                    self._save(tag="final")
+            with trace_span("train.run", target_steps=num_steps):
+                while self.step < num_steps:
+                    if self._sigterm:
+                        self._event("sigterm")
+                        self._save(tag="emergency-sigterm")
+                        break
+                    batch = next(self.data)
+                    self._run_batch(batch)
+                    if (self.ckpt_every and self.step > 0
+                            and self.step % self.ckpt_every == 0):
+                        self._save(tag="periodic")
+                else:
+                    if self.ckpt_dir is not None:
+                        self._save(tag="final")
         finally:
             unregister_emergency_hook(on_wd_timeout)
             if old_handler is not None:
@@ -285,11 +311,15 @@ class ResilientTrainLoop:
         optimizer step."""
         retries = 0
         while True:
-            new_state, loss_val = self._attempt(batch)
+            t0 = time.perf_counter()
+            with trace_span("train.step", step=self.step, retry=retries):
+                new_state, loss_val = self._attempt(batch)
+            _M_STEP_SECONDS.observe(time.perf_counter() - t0)
             bad = self._is_bad(loss_val)
             if bad is None:
                 self.state = new_state        # commit
                 self.step += 1
+                _M_STEPS.inc()
                 self._loss_window.append(loss_val)
                 del self._loss_window[:-self.spike_window]
                 self._committed_pos = self.data.state_dict()
@@ -297,13 +327,16 @@ class ResilientTrainLoop:
             # roll back: new_state is dropped, self.state is the snapshot
             self._event("rollback", reason=bad, loss=loss_val,
                         retry=retries)
+            _M_ROLLBACKS.inc(reason=bad)
             retries += 1
             self.total_retries += 1
             if (retries <= self.max_retries_per_batch
                     and self.total_retries <= self.max_total_retries):
+                _M_RETRIES.inc()
                 continue                      # retry the SAME batch
             self.skipped_batches += 1
             self._event("batch_skipped", reason=bad)
+            _M_SKIPPED.inc()
             # the skip is a decision, not an accident: checkpoints made
             # from here on must not replay the dropped batch
             self._committed_pos = self.data.state_dict()

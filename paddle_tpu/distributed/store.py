@@ -68,8 +68,12 @@ class TCPStore:
                     f"TCPStore: cannot connect {host}:{port}")
             return client
 
-        self._client = retry_call(connect, retries=retries,
-                                  exceptions=(ConnectionError,))
+        from ..observability.catalog import instrument
+
+        retry_counter = instrument("dist_store_connect_retries_total")
+        self._client = retry_call(
+            connect, retries=retries, exceptions=(ConnectionError,),
+            on_retry=lambda attempt, exc: retry_counter.inc())
 
     def set(self, key: str, value) -> None:
         data = value if isinstance(value, bytes) else str(value).encode()

@@ -32,8 +32,14 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
+from ..observability import state as _obs_state
+from ..observability.catalog import instrument as _instrument
+
 __all__ = ["CommWatchdog", "install", "uninstall", "current", "guarded",
            "register_emergency_hook", "unregister_emergency_hook"]
+
+_M_HEARTBEAT = _instrument("watchdog_heartbeat_age_seconds")
+_M_TIMEOUTS = _instrument("watchdog_timeouts_total")
 
 TEARDOWN_EXIT_CODE = 77     # distinctive: "watchdog killed me"
 
@@ -151,17 +157,25 @@ class CommWatchdog:
         while not self._stop.wait(self.poll):
             now = time.monotonic()
             overdue = None
+            oldest = None
             with self._lock:
                 for t in self._tasks.values():
+                    if oldest is None or t.start < oldest:
+                        oldest = t.start
                     if now - t.start > t.timeout:
                         overdue = t
                         break
                 if overdue is not None:
                     self._tasks.pop(id(overdue), None)
+            if _obs_state.enabled():
+                # heartbeat age: how long the oldest guarded blocking
+                # region has been in flight (0 = nothing blocked)
+                _M_HEARTBEAT.set(0.0 if oldest is None else now - oldest)
             if overdue is None:
                 continue
             elapsed = now - overdue.start
             self._fired.append((overdue.name, elapsed))
+            _M_TIMEOUTS.inc()
             msg = (f"[paddle_tpu watchdog] task '{overdue.name}' exceeded "
                    f"{overdue.timeout:.0f}s (elapsed {elapsed:.0f}s) — ")
             # emergency checkpoint window: runs in BOTH modes, before a

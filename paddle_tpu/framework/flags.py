@@ -14,6 +14,10 @@ from typing import Any, Dict
 
 _lock = threading.Lock()
 _registry: Dict[str, "_Flag"] = {}
+# flag-change observers: fn(new_value) per watched name (observability's
+# enabled switch mirrors its flag through this, so paddle.set_flags is
+# never silently inert)
+_watchers: Dict[str, list] = {}
 
 
 class _Flag:
@@ -51,15 +55,36 @@ def define_flag(name: str, default: Any, help: str = "", type_=None):
 
 
 def set_flags(flags: Dict[str, Any]):
-    """paddle.set_flags parity."""
+    """paddle.set_flags parity. Validation is all-or-nothing: an unknown
+    name or uncoercible value raises BEFORE any flag is applied, so a
+    partial dict can never commit some values while skipping their
+    watcher notifications (which would desync e.g. FLAGS_obs_enabled
+    from the observability hot-path switch)."""
+    changed = []
     with _lock:
+        staged = []
         for k, v in flags.items():
             if k.startswith("FLAGS_"):
                 k = k[len("FLAGS_"):]
             if k not in _registry:
                 raise ValueError(f"unknown flag: {k}")
-            f = _registry[k]
-            f.value = _coerce(f.type, v)
+            staged.append((k, _coerce(_registry[k].type, v)))
+        for k, v in staged:
+            _registry[k].value = v
+            if k in _watchers:
+                changed.append((k, v))
+    # watchers run OUTSIDE the lock: one may call back into this module
+    for k, v in changed:
+        for fn in list(_watchers.get(k, ())):
+            fn(v)
+
+
+def watch_flag(name: str, fn):
+    """Register ``fn(new_value)`` to run whenever :func:`set_flags`
+    changes ``name``. Returns ``fn``."""
+    with _lock:
+        _watchers.setdefault(name, []).append(fn)
+    return fn
 
 
 def get_flags(flags=None) -> Dict[str, Any]:

@@ -1,7 +1,7 @@
 """paddle_tpu.hapi (parity: python/paddle/hapi/)."""
 from .model import Model  # noqa: F401
 from .callbacks import (  # noqa: F401
-    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
-    ResilientTraining,
+    Callback, EarlyStopping, LRScheduler, MetricsLogger, ModelCheckpoint,
+    ProgBarLogger, ResilientTraining,
 )
 from .summary import summary  # noqa: F401

@@ -1,9 +1,12 @@
 """hapi callbacks (parity: python/paddle/hapi/callbacks.py)."""
 from __future__ import annotations
 
+import os
 import time
 
 import numpy as np
+
+from .. import observability as _obs
 
 
 class Callback:
@@ -299,6 +302,64 @@ class ReduceLROnPlateau(_MonitorMixin, Callback):
                             return
                 self.cooldown_counter = self.cooldown
                 self.wait = 0
+
+
+class MetricsLogger(Callback):
+    """Periodic log/flush of the observability registry during
+    ``Model.fit`` (the :mod:`paddle_tpu.observability` tier's hapi hook,
+    mirroring how ``ResilientTraining`` surfaces distributed.resilience).
+
+    Every ``log_freq_steps`` train batches (and at train end) it prints a
+    compact one-line-per-metric view of the registry and, when
+    ``snapshot_dir`` is set, flushes ``metrics.json`` (one-shot JSON
+    snapshot) plus ``trace.json`` (Chrome-trace of the span ring) there —
+    the always-on counterpart of pointing a Prometheus scraper at
+    :func:`paddle_tpu.observability.start_http_server`.
+
+    ``enable=True`` (default) turns observability on at train begin so
+    the callback works out of the box; pass ``enable=None`` to leave the
+    ``FLAGS_obs_enabled`` state untouched.
+    """
+
+    def __init__(self, log_freq_steps=100, snapshot_dir=None, enable=True,
+                 printer=print):
+        self.log_freq_steps = log_freq_steps
+        self.snapshot_dir = snapshot_dir
+        self.enable = enable
+        self.printer = printer
+        self.global_step = 0
+
+    def on_train_begin(self, logs=None):
+        if self.enable:
+            _obs.enable()
+
+    def on_train_batch_end(self, step, logs=None):
+        self.global_step += 1
+        if (self.log_freq_steps
+                and self.global_step % self.log_freq_steps == 0):
+            self.flush()
+
+    def on_train_end(self, logs=None):
+        self.flush()
+
+    # -- flushing ---------------------------------------------------------
+    def _lines(self):
+        from ..observability.exposition import snapshot_rows
+
+        return [f"{name}{{{lbl}}} {val}" if lbl else f"{name} {val}"
+                for name, _kind, lbl, val in snapshot_rows(_obs.snapshot())]
+
+    def flush(self):
+        lines = self._lines()
+        if lines and self.printer is not None:
+            self.printer(f"[metrics] step {self.global_step}: "
+                         + " | ".join(lines))
+        if self.snapshot_dir:
+            os.makedirs(self.snapshot_dir, exist_ok=True)
+            _obs.dump_snapshot(os.path.join(self.snapshot_dir,
+                                            "metrics.json"))
+            _obs.export_chrome_trace(os.path.join(self.snapshot_dir,
+                                                  "trace.json"))
 
 
 class ResilientTraining(Callback):

@@ -17,6 +17,10 @@ from typing import Iterable, List, Optional
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..observability.catalog import instrument as _instrument
+
+_M_BATCHES = _instrument("dataloader_batches_total")
+_M_BATCH_WAIT = _instrument("dataloader_batch_wait_seconds")
 
 __all__ = [
     "Dataset", "IterableDataset", "TensorDataset", "ArrayDataset", "ComposeDataset",
@@ -528,6 +532,8 @@ class DataLoader:
                 wait_s += t1 - t0
                 n += 1
                 self._pos_batch += 1
+                _M_BATCH_WAIT.observe(t1 - t0)   # no-op unless obs enabled
+                _M_BATCHES.inc()
                 yield item          # consumer runs while suspended here
                 busy_s += time.monotonic() - t1
         finally:

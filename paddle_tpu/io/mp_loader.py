@@ -33,6 +33,10 @@ from typing import Any, Callable, List, Optional
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..observability import state as _obs_state
+from ..observability.catalog import instrument as _instrument
+
+_M_RQ_DEPTH = _instrument("dataloader_result_queue_depth")
 
 _SHM_MIN_BYTES = 1 << 14  # below 16 KiB the queue pickle is cheaper than shm
 
@@ -278,8 +282,14 @@ class WorkerPool:
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             try:
-                return self.result_q.get(timeout=1.0 if timeout is None
+                item = self.result_q.get(timeout=1.0 if timeout is None
                                          else max(0.01, deadline - time.monotonic()))
+                if _obs_state.enabled():
+                    try:       # qsize is advisory (unimplemented on macOS)
+                        _M_RQ_DEPTH.set(self.result_q.qsize())
+                    except (NotImplementedError, OSError):
+                        pass
+                return item
             except _queue.Empty:
                 if deadline is not None and time.monotonic() >= deadline:
                     raise RuntimeError(

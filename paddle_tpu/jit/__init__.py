@@ -20,13 +20,17 @@ buffers after each call — so ``to_static(model)`` training matches eager.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Callable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import observability as _obs
 from ..autograd import no_grad
+from ..observability import trace_span
+from ..observability.catalog import instrument as _instrument
 from ..core.tensor import Tensor
 from ..framework import dtype as dtypes
 from ..framework.random import next_key, rng_context
@@ -44,6 +48,12 @@ from .segments import segment_scope  # noqa: E402  (public: eager code can
 # compile storms through a remote-attached chip)
 
 _to_static_enabled = True
+
+# compile-path telemetry (no-ops until FLAGS_obs_enabled; names in
+# observability.catalog)
+_M_JIT_HITS = _instrument("jit_cache_hits_total")
+_M_JIT_MISSES = _instrument("jit_cache_misses_total")
+_M_JIT_COMPILE = _instrument("jit_compile_seconds")
 
 
 class BuildStrategy:
@@ -260,11 +270,15 @@ class StaticFunction:
         skel_args = _split_tensors(args, arg_tensors)
         skel_kwargs = _split_tensors(kwargs, arg_tensors)
         entry = self._cache.get(key)
-        if entry is None:
+        fresh = entry is None
+        if fresh:
+            _M_JIT_MISSES.inc()
             out_box = {}
             jitted = self._build(skel_args, skel_kwargs, len(arg_tensors), out_box)
             entry = {"jitted": jitted, "out_box": out_box}
             self._cache[key] = entry
+        else:
+            _M_JIT_HITS.inc()
         jitted = entry["jitted"]
         out_box = entry["out_box"]
 
@@ -283,9 +297,21 @@ class StaticFunction:
             return jitted(params, bufs, key_data, *avals)
 
         try:
-            outs = apply("jit::" + getattr(self._fn, "__name__", "fn"),
-                         lambda pvals, avals: runner(pvals, avals),
-                         list(ptensors), list(arg_tensors))
+            fn_name = getattr(self._fn, "__name__", "fn")
+            if fresh and _obs.enabled():
+                # a fresh cache entry's first run traces + compiles: the
+                # observed duration IS the compile cost (steady-state runs
+                # take the cached-program path below untimed)
+                t0 = time.perf_counter()
+                with trace_span("jit.compile", fn=fn_name):
+                    outs = apply("jit::" + fn_name,
+                                 lambda pvals, avals: runner(pvals, avals),
+                                 list(ptensors), list(arg_tensors))
+                _M_JIT_COMPILE.observe(time.perf_counter() - t0)
+            else:
+                outs = apply("jit::" + fn_name,
+                             lambda pvals, avals: runner(pvals, avals),
+                             list(ptensors), list(arg_tensors))
         except _GRAPH_BREAK_ERRORS + (_BranchGraphBreak,) as e:
             # data-dependent Python control flow the branch-capture oracle
             # could not convert to lax.cond (int/float/item concretization,

@@ -1,0 +1,55 @@
+"""paddle_tpu.observability — always-on metrics + span tracing.
+
+The reference answers "where did the time go / is the job healthy" with a
+host-tracer + CUPTI pipeline (paddle/fluid/platform/profiler/) plus a
+stats layer; the scheduled :mod:`paddle_tpu.profiler` covers the first
+question for offline captures. This package covers production: cheap
+always-on counters/gauges/histograms with Prometheus exposition, and a
+span tracer with Chrome-trace export, both near-zero cost until
+``FLAGS_obs_enabled`` (or :func:`enable`) turns them on.
+
+    import paddle_tpu.observability as obs
+
+    obs.enable()
+    reqs = obs.counter("myapp_requests_total", "requests served")
+    lat = obs.histogram("myapp_latency_seconds", "request latency")
+    with obs.trace_span("request", route="/gen"):
+        ...
+        reqs.inc(); lat.observe(dt)
+    obs.start_http_server()          # GET :9464/metrics, /snapshot.json
+    obs.export_chrome_trace("/tmp/trace.json")   # chrome://tracing
+
+Stdlib-only on purpose: importing it never pulls jax, so instrumented
+modules can depend on it unconditionally (guarded by the import-cost
+test). Metric names follow the catalogue in :mod:`.catalog`; see
+docs/observability.md.
+"""
+from __future__ import annotations
+
+from . import catalog  # noqa: F401
+from .state import disable, enable, enabled  # noqa: F401
+from .metrics import (  # noqa: F401
+    Counter, Gauge, Histogram, Registry, counter, gauge, get_registry,
+    histogram, log_buckets, time_buckets,
+)
+from .tracing import (  # noqa: F401
+    Span, SpanTracer, export_chrome_trace, get_tracer, trace_span,
+)
+from .exposition import (  # noqa: F401
+    dump_snapshot, load_snapshot, render_prometheus, snapshot,
+)
+from .http_server import (  # noqa: F401
+    MetricsServer, start_http_server, stop_http_server,
+)
+
+__all__ = [
+    "enabled", "enable", "disable",
+    "Counter", "Gauge", "Histogram", "Registry",
+    "counter", "gauge", "histogram", "get_registry",
+    "log_buckets", "time_buckets",
+    "Span", "SpanTracer", "trace_span", "get_tracer",
+    "export_chrome_trace",
+    "render_prometheus", "snapshot", "dump_snapshot", "load_snapshot",
+    "MetricsServer", "start_http_server", "stop_http_server",
+    "catalog",
+]

@@ -1,0 +1,123 @@
+"""Metric-name catalogue — the documented contract.
+
+One place naming every metric the framework emits, its kind, and its
+labels. docs/observability.md renders from the same entries and the
+integration tests assert the hot paths actually emit them — a renamed
+metric breaks here, not in someone's dashboard.
+
+Conventions:
+- snake_case, subsystem prefix first (``serving_``, ``train_``, ...);
+- counters end in ``_total``; durations are ``_seconds`` histograms;
+- labels are LOW-cardinality enums (reason, tag, phase) — never ids.
+"""
+from __future__ import annotations
+
+# name -> (kind, labels, help)
+CATALOG = {
+    # -- serving (LLMEngine) ----------------------------------------------
+    "serving_queue_depth": (
+        "gauge", (), "requests waiting for a slot"),
+    "serving_active_slots": (
+        "gauge", (), "slots currently decoding"),
+    "serving_kv_pool_used_blocks": (
+        "gauge", (), "KV-pool blocks allocated to live sequences"),
+    "serving_kv_pool_blocks": (
+        "gauge", (), "usable KV-pool block capacity (excludes trash block)"),
+    "serving_admissions_total": (
+        "counter", (), "requests admitted to a slot (incl. re-admissions)"),
+    "serving_preemptions_total": (
+        "counter", (), "recompute-preemptions under KV-pool pressure"),
+    "serving_requests_finished_total": (
+        "counter", (), "requests completed (eos or budget)"),
+    "serving_tokens_total": (
+        "counter", (), "generated tokens delivered to the host"),
+    "serving_ttft_seconds": (
+        "histogram", (), "time from add_request to first host-visible token"),
+    "serving_tokens_per_second": (
+        "histogram", (),
+        "host-visible generation throughput per engine step"),
+    # ^ throughput, not a duration: gets its own bucket range below
+    "serving_step_seconds": (
+        "histogram", (), "wall time of one LLMEngine.step call"),
+    # -- training (ResilientTrainLoop) ------------------------------------
+    "train_steps_total": (
+        "counter", (), "committed optimizer steps"),
+    "train_step_seconds": (
+        "histogram", (), "wall time of one train-step attempt "
+                         "(committed or rolled back)"),
+    "train_rollbacks_total": (
+        "counter", ("reason",),
+        "uncommitted steps (non_finite_loss / loss_spike)"),
+    "train_retries_total": (
+        "counter", (), "same-batch retries after a rollback"),
+    "train_batches_skipped_total": (
+        "counter", (), "batches dropped after exhausting the retry budget"),
+    "train_checkpoints_total": (
+        "counter", ("tag",),
+        "checkpoints written (periodic / final / emergency-*)"),
+    "train_emergency_saves_total": (
+        "counter", (), "emergency checkpoints (SIGTERM or watchdog)"),
+    "train_checkpoint_save_seconds": (
+        "histogram", (), "atomic checkpoint commit duration"),
+    "train_checkpoint_load_seconds": (
+        "histogram", (), "resume (load_latest_valid) duration"),
+    # -- data loading ------------------------------------------------------
+    "dataloader_batches_total": (
+        "counter", (), "batches yielded to the consumer"),
+    "dataloader_batch_wait_seconds": (
+        "histogram", (), "time the consumer blocked waiting on the loader"),
+    "dataloader_result_queue_depth": (
+        "gauge", (), "mp-loader result-queue occupancy at last get"),
+    # -- distributed runtime ----------------------------------------------
+    "dist_store_connect_retries_total": (
+        "counter", (), "TCPStore client connect retries"),
+    "dist_init_retries_total": (
+        "counter", (), "jax.distributed.initialize bootstrap retries"),
+    "watchdog_heartbeat_age_seconds": (
+        "gauge", (), "age of the oldest in-flight guarded region (0: idle)"),
+    "watchdog_timeouts_total": (
+        "counter", (), "guarded regions that exceeded their timeout"),
+    # -- jit / compile -----------------------------------------------------
+    "jit_cache_hits_total": (
+        "counter", (), "to_static calls served by a cached program"),
+    "jit_cache_misses_total": (
+        "counter", (), "to_static calls that traced a new program"),
+    "jit_compile_seconds": (
+        "histogram", (), "trace+compile+first-run time of a new program"),
+}
+
+# Histogram bucket overrides: (lo, hi, per_decade) for metrics whose
+# range is NOT the default duration window (100 us .. 100 s). A large
+# serving batch legitimately hits 10^3..10^4 tokens/s — on duration
+# buckets every such observation would collapse into +Inf.
+BUCKETS = {
+    "serving_tokens_per_second": (1.0, 1e5, 3),
+}
+
+# Span names the framework emits (chrome-trace `name` field).
+SPANS = (
+    "serving.step", "serving.prefill", "serving.decode", "serving.readback",
+    "train.run", "train.step", "train.checkpoint", "train.resume",
+    "jit.compile",
+)
+
+
+def describe(name: str):
+    return CATALOG[name]
+
+
+def instrument(name: str):
+    """Create (or fetch) the registered instrument for a catalogued name —
+    instrumented modules declare metrics through here, so an emitted name
+    can never drift from the documented contract."""
+    from . import metrics
+
+    kind, _labels, help_ = CATALOG[name]
+    if kind == "counter":
+        return metrics.counter(name, help_)
+    if kind == "gauge":
+        return metrics.gauge(name, help_)
+    rng = BUCKETS.get(name)
+    return metrics.histogram(
+        name, help_,
+        buckets=metrics.log_buckets(*rng) if rng else None)

@@ -1,0 +1,136 @@
+"""Exposition: Prometheus text format + one-shot JSON snapshot.
+
+Prometheus text exposition format 0.0.4 (the format every scraper
+understands): HELP/TYPE headers, escaped label values, histograms as
+cumulative ``_bucket{le=...}`` series plus ``_sum``/``_count``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Optional
+
+from .metrics import Registry, get_registry
+
+__all__ = ["render_prometheus", "snapshot", "dump_snapshot",
+           "load_snapshot", "snapshot_rows"]
+
+
+def _escape(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n") \
+        .replace('"', '\\"')
+
+
+def _label_str(labels: Dict[str, str], extra: str = "") -> str:
+    parts = [f'{k}="{_escape(v)}"' for k, v in sorted(labels.items())]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _hist_state(child):
+    """Consistent (counts, sum, count) triple: read under the child's
+    lock, or a scrape racing observe() could see a bucket incremented but
+    not yet the total — a non-monotone histogram that breaks
+    histogram_quantile/rate on the Prometheus side."""
+    with child._lock:
+        return list(child.counts), child.sum, child.count
+
+
+def render_prometheus(registry: Optional[Registry] = None) -> str:
+    reg = registry or get_registry()
+    out = []
+    for fam in reg.families():
+        series = fam.series()
+        out.append(f"# HELP {fam.name} {_escape(fam.help)}")
+        out.append(f"# TYPE {fam.name} {fam.kind}")
+        for child in series:
+            ls = child.labels
+            if fam.kind in ("counter", "gauge"):
+                out.append(f"{fam.name}{_label_str(ls)} {_fmt(child.value)}")
+            else:
+                counts, total_sum, total = _hist_state(child)
+                cum = 0
+                for bound, n in zip(child.bounds, counts):
+                    cum += n
+                    le = 'le="%s"' % _fmt(bound)
+                    out.append(
+                        f"{fam.name}_bucket{_label_str(ls, le)} {cum}")
+                inf = 'le="+Inf"'
+                out.append(
+                    f"{fam.name}_bucket{_label_str(ls, inf)} {total}")
+                out.append(f"{fam.name}_sum{_label_str(ls)} "
+                           f"{_fmt(total_sum)}")
+                out.append(f"{fam.name}_count{_label_str(ls)} {total}")
+    return "\n".join(out) + "\n"
+
+
+def snapshot(registry: Optional[Registry] = None) -> Dict:
+    """One-shot JSON-serializable view of every series."""
+    reg = registry or get_registry()
+    metrics = []
+    for fam in reg.families():
+        fam_out = {"name": fam.name, "kind": fam.kind, "help": fam.help,
+                   "series": []}
+        if fam._overflow_observations:
+            fam_out["overflow_observations"] = fam._overflow_observations
+        for child in fam.series():
+            s = {"labels": child.labels}
+            if fam.kind in ("counter", "gauge"):
+                s["value"] = child.value
+            else:
+                counts, total_sum, total = _hist_state(child)
+                s["bounds"] = list(child.bounds)
+                s["counts"] = counts
+                s["sum"] = total_sum
+                s["count"] = total
+            fam_out["series"].append(s)
+        metrics.append(fam_out)
+    return {"version": 1, "unix_time": time.time(), "pid": os.getpid(),
+            "metrics": metrics}
+
+
+def dump_snapshot(path: str, registry: Optional[Registry] = None) -> str:
+    """Write :func:`snapshot` as JSON; returns the path."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(snapshot(registry), f, indent=1)
+    return path
+
+
+def load_snapshot(path: str) -> Dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def snapshot_rows(snap: Dict):
+    """``(name, kind, labels_str, value_str)`` per NON-ZERO series of a
+    snapshot dict — the one renderer behind tools/obs_dump.py's table and
+    the hapi MetricsLogger log lines (histograms show count + mean)."""
+    rows = []
+    for fam in snap["metrics"]:
+        for s in fam["series"]:
+            lbl = ",".join(f"{k}={v}" for k, v
+                           in sorted(s.get("labels", {}).items()))
+            if fam["kind"] == "histogram":
+                cnt = s.get("count", 0)
+                if not cnt:
+                    continue
+                mean = s.get("sum", 0.0) / cnt
+                rows.append((fam["name"], fam["kind"], lbl,
+                             f"count={cnt} mean={mean:.6g}"))
+            else:
+                if not s.get("value"):
+                    continue
+                rows.append((fam["name"], fam["kind"], lbl,
+                             f"{s['value']:g}"))
+    return rows

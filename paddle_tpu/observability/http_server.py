@@ -1,0 +1,103 @@
+"""Tiny stdlib HTTP server for Prometheus scraping + JSON snapshots.
+
+GET /metrics        -> Prometheus text exposition (0.0.4)
+GET /snapshot.json  -> one-shot JSON snapshot of every series
+GET /trace.json     -> Chrome-trace JSON of the span ring
+GET /healthz        -> "ok" (liveness for load balancers)
+
+Serves from a daemon thread; ``port=0`` binds an OS-assigned ephemeral
+port (hermetic for tests — read it back from ``server.port``).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..framework.flags import get_flag
+from .exposition import render_prometheus, snapshot
+from .tracing import get_tracer
+
+__all__ = ["MetricsServer", "start_http_server", "stop_http_server"]
+
+_server: Optional["MetricsServer"] = None
+_lock = threading.Lock()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    registry = None     # set per-server via subclassing in MetricsServer
+
+    def _send(self, body: bytes, ctype: str, code: int = 200):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        path = self.path.split("?", 1)[0]
+        if path in ("/metrics", "/"):
+            body = render_prometheus(self.registry).encode()
+            self._send(body, "text/plain; version=0.0.4; charset=utf-8")
+        elif path in ("/snapshot.json", "/snapshot"):
+            body = json.dumps(snapshot(self.registry)).encode()
+            self._send(body, "application/json")
+        elif path in ("/trace.json", "/trace"):
+            body = json.dumps(get_tracer().chrome_trace()).encode()
+            self._send(body, "application/json")
+        elif path == "/healthz":
+            self._send(b"ok", "text/plain")
+        else:
+            self._send(b"not found", "text/plain", 404)
+
+    def log_message(self, *args):     # scrapes must not spam stderr
+        pass
+
+
+class MetricsServer:
+    def __init__(self, port: Optional[int] = None,
+                 host: Optional[str] = None, registry=None):
+        handler = type("_BoundHandler", (_Handler,),
+                       {"registry": registry})
+        self._httpd = ThreadingHTTPServer(
+            (host if host is not None else str(get_flag("obs_host")),
+             int(get_flag("obs_port")) if port is None else int(port)),
+            handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="paddle-tpu-obs-http",
+            daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_port
+
+    @property
+    def host(self) -> str:
+        return self._httpd.server_address[0]
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(2)
+
+
+def start_http_server(port: Optional[int] = None,
+                      host: Optional[str] = None,
+                      registry=None) -> MetricsServer:
+    """Start (or return the already-running) exposition server."""
+    global _server
+    with _lock:
+        if _server is None:
+            _server = MetricsServer(port=port, host=host, registry=registry)
+        return _server
+
+
+def stop_http_server() -> None:
+    global _server
+    with _lock:
+        if _server is not None:
+            _server.close()
+            _server = None

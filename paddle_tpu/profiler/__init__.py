@@ -15,6 +15,8 @@ from typing import Callable, Iterable, Optional
 
 import jax
 
+from ..observability import state as _obs_state
+from ..observability.tracing import get_tracer as _get_tracer
 from .statistics import Benchmark, EventLedger, SortedKeys, build_summary
 
 # stack of active profilers: RecordEvent feeds the innermost; a nested
@@ -156,9 +158,18 @@ class Profiler:
             self._end_trace()
 
     def stop(self):
-        if self in _ACTIVE:
-            _ACTIVE.remove(self)
-        self._end_trace()
+        try:
+            self._end_trace()
+        finally:
+            # Exception-safe stack restore: drop self AND any nested
+            # profiler that leaked above it (a body that raised between an
+            # inner start() and its stop() would otherwise leave the inner
+            # profiler as _ACTIVE[-1], silently stealing every subsequent
+            # RecordEvent from the outer one). _end_trace failures (a
+            # raising on_trace_ready hook) must not skip the restore.
+            if self in _ACTIVE:
+                idx = len(_ACTIVE) - 1 - _ACTIVE[::-1].index(self)
+                del _ACTIVE[idx:]
 
     def step_info(self, unit: str = "samples"):
         if not self._step_times:
@@ -187,12 +198,20 @@ class Profiler:
 @contextlib.contextmanager
 def RecordEvent(name: str, event_type=None):
     """parity: paddle.profiler.RecordEvent — annotates the device trace
-    (jax TraceAnnotation) AND feeds the host-side statistics ledger."""
+    (jax TraceAnnotation) AND feeds the host-side statistics ledger AND
+    the observability span ring (one annotation feeds all three). The
+    interval records even when the body raises — a failing region is
+    exactly the one the timeline needs to show."""
     t0 = time.perf_counter()
-    with jax.profiler.TraceAnnotation(name):
-        yield
-    if _ACTIVE:
-        _ACTIVE[-1]._ledger.add(name, t0, time.perf_counter())
+    try:
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    finally:
+        t1 = time.perf_counter()
+        if _ACTIVE:
+            _ACTIVE[-1]._ledger.add(name, t0, t1)
+        if _obs_state.enabled():
+            _get_tracer().record(name, t0, t1, {"src": "RecordEvent"})
 
 
 def load_profiler_result(path):

@@ -32,6 +32,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import math
+import time
 from collections import deque
 from typing import Dict, List, Optional
 
@@ -39,10 +40,27 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import observability as _obs
 from ..models.llama import (LlamaConfig, _apply_rope, _attention,
                             _rms_norm, _wmat)  # noqa: F401
+from ..observability import trace_span
+from ..observability.catalog import instrument as _instrument
 
 __all__ = ["LLMEngine", "Request"]
+
+# always-on serving telemetry (no-ops until FLAGS_obs_enabled /
+# observability.enable(); names documented in observability.catalog)
+_M_QUEUE_DEPTH = _instrument("serving_queue_depth")
+_M_ACTIVE_SLOTS = _instrument("serving_active_slots")
+_M_KV_USED = _instrument("serving_kv_pool_used_blocks")
+_M_KV_BLOCKS = _instrument("serving_kv_pool_blocks")
+_M_ADMISSIONS = _instrument("serving_admissions_total")
+_M_PREEMPTIONS = _instrument("serving_preemptions_total")
+_M_FINISHED = _instrument("serving_requests_finished_total")
+_M_TOKENS = _instrument("serving_tokens_total")
+_M_TTFT = _instrument("serving_ttft_seconds")
+_M_TPS = _instrument("serving_tokens_per_second")
+_M_STEP_SECONDS = _instrument("serving_step_seconds")
 
 
 @dataclasses.dataclass
@@ -458,6 +476,9 @@ class LLMEngine:
         # admissions whose in-program-sampled first token has not yet been
         # read back; attached to the next dispatch record
         self._pending_adm: List = []
+        # observability: add_request wall time per req awaiting its first
+        # host-visible token (TTFT); entries die with the request
+        self._obs_t_add: Dict[int, float] = {}
 
     # -- public api ---------------------------------------------------------
     def add_request(self, prompt: List[int], **kw) -> int:
@@ -474,6 +495,9 @@ class LLMEngine:
                 f"request {rid}: prompt length {len(req.prompt)} exceeds "
                 f"the largest prompt bucket {self.buckets[-1]}")
         self.queue.append(req)
+        if _obs.enabled():
+            self._obs_t_add[rid] = time.perf_counter()
+            _M_QUEUE_DEPTH.set(len(self.queue))
         return rid
 
     def has_work(self) -> bool:
@@ -528,8 +552,16 @@ class LLMEngine:
             # are never re-emitted
             req.generated.extend(out)
             self.queue.appendleft(req)
+            _M_PREEMPTIONS.inc()
         elif req is not None:
             self.results[req.req_id] = req.generated + out
+            _M_FINISHED.inc()
+            # a request that finishes in the SAME step its first token
+            # became host-visible retires before step()'s TTFT loop runs —
+            # its first token is host-visible right now, so observe here
+            t_add = self._obs_t_add.pop(req.req_id, None)
+            if t_add is not None and (req.generated or out):
+                _M_TTFT.observe(time.perf_counter() - t_add)
 
     def _admit(self):
         """Admit every queued request a free slot and free blocks can
@@ -597,12 +629,15 @@ class LLMEngine:
                  sampled and any(r.top_p < 1.0 for _, r, _, _, _ in wave
                                  if r.temperature > 0))
         self._key, sub = jax.random.split(self._key)
-        tok_dev, self.k_pool, self.v_pool = self._prefill_fn(
-            bucket, B, flags)(
-            self.params, jnp.asarray(toks), jnp.asarray(blk_ids),
-            jnp.asarray(true_lens), self.k_pool, self.v_pool,
-            jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
-            sub)
+        with trace_span("serving.prefill", bucket=bucket, batch=B,
+                        wave=len(wave)):
+            tok_dev, self.k_pool, self.v_pool = self._prefill_fn(
+                bucket, B, flags)(
+                self.params, jnp.asarray(toks), jnp.asarray(blk_ids),
+                jnp.asarray(true_lens), self.k_pool, self.v_pool,
+                jnp.asarray(temps), jnp.asarray(top_ks),
+                jnp.asarray(top_ps), sub)
+        _M_ADMISSIONS.inc(len(wave))
         for i, (slot, req, _, _, _) in enumerate(wave):
             # reference the WHOLE [B] first-token array + row index: the
             # readback then fetches one array per wave, not one tiny
@@ -807,11 +842,13 @@ class LLMEngine:
                                   n_steps=self.decode_steps,
                                   sample_flags=flags),
                 donate_argnums=(8, 9))
-        (toks, c_last, c_len, c_done, c_rem, c_key, self.k_pool,
-         self.v_pool) = decode(
-            self.params, c_last, c_len, c_done, c_rem, c_key, v_act,
-            self._table_dev, self.k_pool, self.v_pool, v_t, v_k, v_p,
-            v_eos)
+        with trace_span("serving.decode", slots=len(active_slots),
+                        steps=self.decode_steps):
+            (toks, c_last, c_len, c_done, c_rem, c_key, self.k_pool,
+             self.v_pool) = decode(
+                self.params, c_last, c_len, c_done, c_rem, c_key, v_act,
+                self._table_dev, self.k_pool, self.v_pool, v_t, v_k, v_p,
+                v_eos)
         self._carry = (c_last, c_len, c_done, c_rem, c_key)
         self._inflight = {
             "toks": toks,
@@ -836,7 +873,8 @@ class LLMEngine:
         gets hang detection + emergency-hook checkpointing for free."""
         from ..distributed.watchdog import guarded
 
-        with guarded("serving-decode-readback"):
+        with guarded("serving-decode-readback"), \
+                trace_span("serving.readback"):
             return self._process_guarded(rec)
 
     def _process_guarded(self, rec):
@@ -885,7 +923,36 @@ class LLMEngine:
         (``_spec_safe``), so the readback latency — the dominant cost on
         a remote-attached chip — overlaps the next call's compute. The
         token stream therefore lags the chip by up to one call
-        (decode_steps tokens per slot)."""
+        (decode_steps tokens per slot).
+
+        Observability (FLAGS_obs_enabled): each call lands a
+        ``serving.step`` span (prefill/decode/readback nested inside),
+        a step-duration + tokens/sec observation, TTFT for requests whose
+        first token became visible, and the queue/slot/KV-pool gauges.
+        Disabled, this wrapper costs one boolean check."""
+        if not _obs.enabled():
+            return self._step_inner()
+        t0 = time.perf_counter()
+        with trace_span("serving.step"):
+            emitted = self._step_inner()
+        now = time.perf_counter()
+        dt = now - t0
+        _M_STEP_SECONDS.observe(dt)
+        if emitted:
+            _M_TOKENS.inc(len(emitted))
+            if dt > 0:
+                _M_TPS.observe(len(emitted) / dt)
+            for rid, _tok in emitted:
+                t_add = self._obs_t_add.pop(rid, None)
+                if t_add is not None:
+                    _M_TTFT.observe(now - t_add)
+        _M_QUEUE_DEPTH.set(len(self.queue))
+        _M_ACTIVE_SLOTS.set(sum(r is not None for r in self.slot_req))
+        _M_KV_BLOCKS.set(self.nb - 1)
+        _M_KV_USED.set(self.nb - 1 - len(self.free_blocks))
+        return emitted
+
+    def _step_inner(self):
         emitted = []
         self._admit()
         if self._inflight is not None and not self._spec_safe():

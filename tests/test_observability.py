@@ -1,0 +1,460 @@
+"""Unit tests for paddle_tpu.observability: the lock-safe registry
+(concurrent increments, label cardinality cap, histogram bucket edges),
+span nesting + Chrome-trace round-trip, exposition (Prometheus text, JSON
+snapshot, HTTP server on a reserved port), the profiler interop /
+exception-safety fix, and the two cost guards (disabled-overhead < 5%,
+registry import < 50 ms)."""
+import importlib
+import io as _io
+import json
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import paddle_tpu  # noqa: F401  (forces the CPU/virtual-device conftest setup)
+import paddle_tpu.observability as obs
+from paddle_tpu.observability import metrics as obs_metrics
+
+
+@pytest.fixture
+def obs_on():
+    """Enabled observability over a zeroed registry + empty span ring;
+    always disabled again so other tests see the default-off state."""
+    obs.get_registry().reset()
+    obs.get_tracer().clear()
+    obs.enable()
+    try:
+        yield
+    finally:
+        obs.disable()
+        obs.get_registry().reset()
+        obs.get_tracer().clear()
+
+
+@pytest.fixture
+def obs_http_server(obs_on):
+    """Reserved-port exposition server: port 0 binds an OS-assigned
+    ephemeral port, so tier-1 can never collide with another process (or a
+    parallel test) on a fixed port."""
+    from paddle_tpu.observability.http_server import MetricsServer
+
+    srv = MetricsServer(port=0)
+    try:
+        yield srv
+    finally:
+        srv.close()
+
+
+# -- registry ---------------------------------------------------------------
+def test_counter_concurrent_increments_are_lossless(obs_on):
+    c = obs.counter("t_concurrent_total")
+    n_threads, per_thread = 8, 2000
+
+    def worker():
+        for _ in range(per_thread):
+            c.inc()
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # += on a float is not atomic; the per-series lock must make it so
+    assert c.labels().value == n_threads * per_thread
+
+
+def test_counter_labels_and_rules(obs_on):
+    c = obs.counter("t_labeled_total")
+    c.inc()
+    c.inc(2, reason="x")
+    c.inc(3, reason="y")
+    assert c.labels().value == 1
+    assert c.labels(reason="x").value == 2
+    assert c.labels(reason="y").value == 3
+    with pytest.raises(ValueError):
+        c.labels().inc(-1)
+    # same name re-registered with another kind is a bug, not a merge
+    with pytest.raises(ValueError):
+        obs.gauge("t_labeled_total")
+
+
+def test_gauge_set_inc_dec(obs_on):
+    g = obs.gauge("t_gauge")
+    g.set(5)
+    g.inc(2)
+    g.dec()
+    assert g.labels().value == 6
+
+
+def test_label_cardinality_cap_collapses_to_overflow(obs_on):
+    c = obs_metrics.Counter("t_capped_total", max_series=3)
+    for i in range(10):
+        c.inc(shard=str(i))
+    kinds = {tuple(ch.labels.items()) for ch in c.series()}
+    # default + 2 real label sets + the overflow series, never more
+    assert len(kinds) == 4
+    assert (("overflow", "true"),) in kinds
+    overflow = c.labels(shard="999")        # still routed to overflow
+    assert overflow.labels == {"overflow": "true"}
+    # once capped, the overflow child is cached for a lock-free fast path
+    assert c._overflow is overflow
+    assert sum(ch.value for ch in c.series()) == 10
+    assert c._overflow_observations >= 8
+
+
+def test_histogram_bucket_edges_inclusive_le(obs_on):
+    h = obs.histogram("t_edges_seconds", buckets=[1.0, 10.0, 100.0])
+    for v in (0.5, 1.0, 1.0000001, 10.0, 101.0):
+        h.observe(v)
+    child = h.labels()
+    # le is an INCLUSIVE upper bound: 1.0 lands in le=1, 10.0 in le=10
+    assert child.counts == [2, 2, 0, 1]
+    assert child.count == 5
+    assert child.sum == pytest.approx(113.5000001)
+
+
+def test_log_buckets_fixed_log_spacing():
+    b = obs.log_buckets(1e-3, 1.0, per_decade=2)
+    assert b[0] == pytest.approx(1e-3)
+    assert b[-1] >= 1.0 - 1e-9
+    ratios = [b[i + 1] / b[i] for i in range(len(b) - 1)]
+    for r in ratios:
+        assert r == pytest.approx(10 ** 0.5, rel=1e-3)
+    assert obs.time_buckets()[0] == pytest.approx(1e-4)
+
+
+def test_catalog_throughput_metric_has_throughput_buckets(obs_on):
+    """serving_tokens_per_second must not use duration buckets: a batch
+    legitimately emits thousands of tokens/s, which would all collapse
+    into +Inf on the 100us..100s window."""
+    from paddle_tpu.observability.catalog import instrument
+
+    h = instrument("serving_tokens_per_second")
+    assert h.bounds[-1] >= 1e5 - 1
+    h.observe(1280.0)
+    child = h.labels()
+    finite = sum(n for n in child.counts[:-1])
+    assert finite == 1 and child.counts[-1] == 0
+
+
+def test_set_flags_resizes_trace_ring(obs_on):
+    from paddle_tpu.framework.flags import get_flag, set_flags
+
+    old = get_flag("obs_trace_capacity")
+    try:
+        set_flags({"obs_trace_capacity": 2})
+        for i in range(5):
+            with obs.trace_span(f"cap{i}"):
+                pass
+        assert len(obs.get_tracer().spans()) == 2
+    finally:
+        set_flags({"obs_trace_capacity": old})
+
+
+def test_set_flags_is_all_or_nothing(obs_on):
+    from paddle_tpu.framework.flags import get_flags, set_flags
+
+    obs.disable()
+    with pytest.raises(ValueError):
+        set_flags({"obs_enabled": True, "no_such_flag_xyz": 1})
+    # nothing committed: registry value AND hot-path switch both stay off
+    assert get_flags("obs_enabled")["FLAGS_obs_enabled"] is False
+    assert not obs.enabled()
+    obs.enable()
+
+
+def test_set_flags_toggles_enabled(obs_on):
+    """paddle.set_flags is the documented flag surface — flipping
+    FLAGS_obs_enabled through it must actually gate instrumentation
+    (flag-watcher sync), not just change get_flags() output."""
+    from paddle_tpu.framework.flags import set_flags
+
+    c = obs.counter("t_flag_total")
+    set_flags({"FLAGS_obs_enabled": False})
+    assert not obs.enabled()
+    c.inc()
+    set_flags({"obs_enabled": True})
+    assert obs.enabled()
+    c.inc()
+    assert c.labels().value == 1
+
+
+def test_trace_span_instance_reuse_after_disable(obs_on):
+    """A kept trace_span instance must not record a bogus span (stale
+    start time / stale error attr) when re-entered while disabled."""
+    sp = obs.trace_span("reused")
+    with pytest.raises(ValueError):
+        with sp:
+            raise ValueError("x")
+    obs.disable()
+    with sp:
+        pass
+    obs.enable()
+    spans = [s for s in obs.get_tracer().spans() if s.name == "reused"]
+    assert len(spans) == 1              # only the enabled use recorded
+    with sp:                            # re-enabled reuse records cleanly
+        pass
+    spans = [s for s in obs.get_tracer().spans() if s.name == "reused"]
+    assert len(spans) == 2
+    assert "error" not in spans[1].attrs
+
+
+def test_disabled_everything_is_a_noop(obs_on):
+    c = obs.counter("t_off_total")
+    h = obs.histogram("t_off_seconds")
+    obs.disable()
+    c.inc(5)
+    h.observe(1.0)
+    with obs.trace_span("t_off_span"):
+        pass
+    obs.enable()
+    assert c.labels().value == 0
+    assert h.labels().count == 0
+    assert all(s.name != "t_off_span" for s in obs.get_tracer().spans())
+
+
+# -- exposition -------------------------------------------------------------
+def test_prometheus_rendering(obs_on):
+    obs.counter("t_prom_total", "help text").inc(3, mode='a"b\nc')
+    obs.gauge("t_prom_g").set(2.5)
+    h = obs.histogram("t_prom_seconds", buckets=[1.0, 10.0])
+    h.observe(0.5)
+    h.observe(5.0)
+    text = obs.render_prometheus()
+    assert "# HELP t_prom_total help text" in text
+    assert "# TYPE t_prom_total counter" in text
+    # escaped label value: quote and newline
+    assert 't_prom_total{mode="a\\"b\\nc"} 3' in text
+    assert "t_prom_g 2.5" in text
+    # histogram: CUMULATIVE buckets + +Inf + sum/count
+    assert 't_prom_seconds_bucket{le="1"} 1' in text
+    assert 't_prom_seconds_bucket{le="10"} 2' in text
+    assert 't_prom_seconds_bucket{le="+Inf"} 2' in text
+    assert "t_prom_seconds_sum 5.5" in text
+    assert "t_prom_seconds_count 2" in text
+
+
+def test_snapshot_roundtrip_and_cli_table(obs_on, tmp_path):
+    obs.counter("t_snap_total").inc(7)
+    obs.histogram("t_snap_seconds", buckets=[1.0]).observe(0.5)
+    path = obs.dump_snapshot(str(tmp_path / "snap.json"))
+    snap = obs.load_snapshot(path)
+    by_name = {m["name"]: m for m in snap["metrics"]}
+    assert by_name["t_snap_total"]["series"][0]["value"] == 7
+    hs = by_name["t_snap_seconds"]["series"][0]
+    assert hs["count"] == 1 and hs["bounds"] == [1.0]
+    # the obs_dump CLI renders the same snapshot (module loaded from path:
+    # tools/ is not a package)
+    spec = importlib.util.spec_from_file_location(
+        "obs_dump_for_test", "tools/obs_dump.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    buf = _io.StringIO()
+    rows = mod.print_table(snap, out=buf)
+    assert any(r[0] == "t_snap_total" for r in rows)
+    assert "t_snap_total" in buf.getvalue()
+
+
+def test_http_exposition_reserved_port(obs_http_server):
+    obs.counter("t_http_total").inc(4)
+    base = f"http://127.0.0.1:{obs_http_server.port}"
+    text = urllib.request.urlopen(base + "/metrics").read().decode()
+    assert "t_http_total 4" in text
+    snap = json.loads(
+        urllib.request.urlopen(base + "/snapshot.json").read())
+    assert any(m["name"] == "t_http_total" for m in snap["metrics"])
+    with obs.trace_span("t_http_span"):
+        pass
+    trace = json.loads(urllib.request.urlopen(base + "/trace.json").read())
+    assert any(e["name"] == "t_http_span" for e in trace["traceEvents"])
+    assert urllib.request.urlopen(base + "/healthz").read() == b"ok"
+    with pytest.raises(urllib.error.HTTPError):
+        urllib.request.urlopen(base + "/nope")
+
+
+# -- tracing ----------------------------------------------------------------
+def test_span_nesting_and_chrome_export(obs_on, tmp_path):
+    with obs.trace_span("outer", phase="x"):
+        time.sleep(0.002)
+        with obs.trace_span("inner"):
+            time.sleep(0.002)
+    path = obs.export_chrome_trace(str(tmp_path / "trace.json"))
+    with open(path) as f:
+        trace = json.load(f)
+    ev = {e["name"]: e for e in trace["traceEvents"] if e.get("ph") == "X"}
+    outer, inner = ev["outer"], ev["inner"]
+    assert outer["args"]["phase"] == "x"
+    assert outer["args"]["depth"] == 0 and inner["args"]["depth"] == 1
+    assert outer["tid"] == inner["tid"]
+    # nesting: outer's interval strictly encloses inner's
+    assert outer["ts"] <= inner["ts"]
+    assert outer["ts"] + outer["dur"] >= inner["ts"] + inner["dur"]
+
+
+def test_span_ring_retention(obs_on):
+    from paddle_tpu.observability.tracing import SpanTracer
+
+    tr = SpanTracer(capacity=4)
+    for i in range(10):
+        tr.record(f"s{i}", 0.0, 1.0)
+    names = [s.name for s in tr.spans()]
+    assert names == ["s6", "s7", "s8", "s9"]
+
+
+def test_span_records_on_exception_with_error_attr(obs_on):
+    with pytest.raises(RuntimeError):
+        with obs.trace_span("boom"):
+            raise RuntimeError("x")
+    spans = [s for s in obs.get_tracer().spans() if s.name == "boom"]
+    assert len(spans) == 1
+    assert spans[0].attrs["error"] == "RuntimeError"
+
+
+def test_per_thread_span_stacks(obs_on):
+    def worker():
+        with obs.trace_span("threaded"):
+            time.sleep(0.001)
+
+    with obs.trace_span("main_side"):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    spans = {s.name: s for s in obs.get_tracer().spans()}
+    # the worker's span must not inherit the main thread's open depth
+    assert spans["threaded"].depth == 0
+    assert spans["threaded"].tid != spans["main_side"].tid
+
+
+# -- profiler interop + _ACTIVE exception-safety ----------------------------
+def test_record_event_feeds_span_ring(obs_on):
+    from paddle_tpu import profiler
+
+    with profiler.RecordEvent("interop_evt"):
+        pass
+    spans = [s for s in obs.get_tracer().spans()
+             if s.name == "interop_evt"]
+    assert len(spans) == 1 and spans[0].attrs["src"] == "RecordEvent"
+
+
+def test_trace_span_feeds_profiler_ledger(obs_on):
+    from paddle_tpu import profiler
+
+    with profiler.Profiler(timer_only=True) as p:
+        with obs.trace_span("ledger_span"):
+            pass
+    assert any(n == "ledger_span" for n, _, _ in p._ledger.spans)
+
+
+def test_record_event_survives_raising_body(obs_on):
+    from paddle_tpu import profiler
+
+    with profiler.Profiler(timer_only=True) as p:
+        with pytest.raises(ValueError):
+            with profiler.RecordEvent("raising_evt"):
+                raise ValueError("x")
+    # the interval still reached both the ledger and the span ring
+    assert any(n == "raising_evt" for n, _, _ in p._ledger.spans)
+    assert any(s.name == "raising_evt" for s in obs.get_tracer().spans())
+
+
+def test_profiler_active_stack_exception_safe():
+    from paddle_tpu import profiler
+
+    assert profiler._ACTIVE == []
+    outer = profiler.Profiler(timer_only=True)
+    outer.start()
+    try:
+        # context-managed inner whose body raises: __exit__ must restore
+        # the OUTER profiler as innermost
+        with pytest.raises(RuntimeError):
+            with profiler.Profiler(timer_only=True):
+                raise RuntimeError("body failed")
+        assert profiler._ACTIVE == [outer]
+        # a LEAKED inner (started, body raised, stop never called):
+        # the outer's stop() purges it too instead of leaving it to
+        # swallow every later RecordEvent
+        leaked = profiler.Profiler(timer_only=True)
+        leaked.start()
+        assert profiler._ACTIVE == [outer, leaked]
+    finally:
+        outer.stop()
+    assert profiler._ACTIVE == []
+
+
+# -- cost guards ------------------------------------------------------------
+def test_registry_import_cost_under_50ms():
+    """The observability package must stay stdlib-cheap: re-importing it
+    fresh (parents already loaded) has to land well under 50 ms, so its
+    unconditional import from io/serving/jit/distributed modules never
+    shows up in `import paddle_tpu`."""
+    saved = {m: sys.modules.pop(m) for m in list(sys.modules)
+             if m.startswith("paddle_tpu.observability")}
+    try:
+        t0 = time.perf_counter()
+        importlib.import_module("paddle_tpu.observability")
+        dt = time.perf_counter() - t0
+    finally:
+        # restore the ORIGINAL modules: every instrumented call site holds
+        # references into them (shared registry, shared tracer) — including
+        # the parent package's attribute, which the fresh import rebound
+        for m in list(sys.modules):
+            if m.startswith("paddle_tpu.observability"):
+                del sys.modules[m]
+        sys.modules.update(saved)
+        paddle_tpu.observability = saved["paddle_tpu.observability"]
+    assert dt < 0.05, f"observability import took {dt * 1e3:.1f} ms"
+
+
+def test_disabled_overhead_under_5pct_on_decode_shaped_microbench():
+    """Acceptance guard: with observability DISABLED, the per-step cost of
+    the serving decode loop's instrumentation (1 enabled() check + a few
+    no-op spans/counters per step, exactly what LLMEngine.step adds) must
+    stay under 5% of a decode-step-shaped CPU workload."""
+    import numpy as np
+
+    obs.disable()
+    c = obs.counter("bench_total")
+    g = obs.gauge("bench_g")
+    h = obs.histogram("bench_seconds")
+    # ~3 ms of numpy per step (a realistic decode-step host cost): the
+    # disabled instrumentation measures ~6 us/step, so the 5% bound has
+    # >20x headroom and survives a loaded CI box
+    x = np.random.default_rng(0).standard_normal((128, 128))
+
+    def fake_decode_step(a):
+        for _ in range(3):
+            a = a @ a
+            a = a / np.abs(a).max()
+        return a
+
+    def run_base(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fake_decode_step(x)
+        return time.perf_counter() - t0
+
+    def run_instrumented(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            if obs.enabled():               # the step() gate
+                pass
+            with obs.trace_span("s1"):      # prefill/decode/readback spans
+                with obs.trace_span("s2"):
+                    fake_decode_step(x)
+            c.inc()
+            g.set(1.0)
+            h.observe(0.0)
+        return time.perf_counter() - t0
+
+    n = 40
+    run_base(2), run_instrumented(2)        # warm caches
+    for attempt in range(3):                # min-of-4, retry to deflake
+        base = min(run_base(n) for _ in range(4))
+        instr = min(run_instrumented(n) for _ in range(4))
+        if instr <= base * 1.05:
+            break
+    assert instr <= base * 1.05, \
+        f"disabled-instrumentation overhead {instr / base - 1:.1%} >= 5%"

@@ -1,0 +1,223 @@
+"""End-to-end observability: a short LLMEngine run and a short
+ResilientTrainLoop run must each expose the documented metric names
+(counters + histograms with non-zero counts) through BOTH the Prometheus
+endpoint and the JSON snapshot, and export a valid Chrome trace with
+nested prefill/decode (resp. run/step/checkpoint) spans."""
+import dataclasses
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401  (forces the CPU/virtual-device conftest setup)
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.observability as obs
+from paddle_tpu.observability.catalog import CATALOG
+
+
+@pytest.fixture
+def obs_on():
+    obs.get_registry().reset()
+    obs.get_tracer().clear()
+    obs.enable()
+    try:
+        yield
+    finally:
+        obs.disable()
+        obs.get_registry().reset()
+        obs.get_tracer().clear()
+
+
+def _nonzero_names(snap):
+    """Metric names with a non-zero series in a snapshot dict."""
+    out = set()
+    for fam in snap["metrics"]:
+        for s in fam["series"]:
+            if fam["kind"] == "histogram":
+                if s.get("count"):
+                    out.add(fam["name"])
+            elif s.get("value"):
+                out.add(fam["name"])
+    return out
+
+
+def _assert_exposed_everywhere(names):
+    """Each name is documented, in the snapshot, and on the endpoint."""
+    for n in names:
+        assert n in CATALOG, f"{n} missing from observability.catalog"
+    snap = obs.snapshot()
+    nonzero = _nonzero_names(snap)
+    missing = set(names) - nonzero
+    assert not missing, f"not emitted (or zero): {missing}"
+    text = obs.render_prometheus()
+    from paddle_tpu.observability.http_server import MetricsServer
+
+    srv = MetricsServer(port=0)      # reserved ephemeral port: hermetic
+    try:
+        scraped = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics").read().decode()
+    finally:
+        srv.close()
+    for n in names:
+        assert n in text
+        assert n in scraped
+
+
+def _span_index(trace):
+    evs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    by_name = {}
+    for e in evs:
+        by_name.setdefault(e["name"], []).append(e)
+    return by_name
+
+
+def _encloses(outer, inner):
+    return (outer["ts"] <= inner["ts"] + 1e-3
+            and outer["ts"] + outer["dur"] >= inner["ts"] + inner["dur"]
+            - 1e-3)
+
+
+@pytest.fixture(scope="module")
+def model():
+    from paddle_tpu.models import llama
+
+    cfg = dataclasses.replace(
+        llama.tiny_llama(vocab=64, hidden=32, layers=2, heads=4, kv_heads=2,
+                         seq=128, ffn=64),
+        dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def test_llm_engine_emits_documented_metrics(model, obs_on, tmp_path):
+    from paddle_tpu.serving import LLMEngine
+
+    cfg, params = model
+    rng = np.random.default_rng(0)
+    eng = LLMEngine(params, cfg, max_slots=2, block_size=8,
+                    max_model_len=64, prompt_buckets=[8, 32])
+    for n, k in ((3, 6), (7, 5), (12, 4)):
+        eng.add_request(rng.integers(1, 64, size=n).tolist(),
+                        max_new_tokens=k)
+    results = eng.run()
+    assert sum(len(v) for v in results.values()) == 15
+
+    # >= 6 documented names, counters AND histograms with non-zero counts,
+    # via prometheus text, the HTTP endpoint, and the JSON snapshot
+    _assert_exposed_everywhere([
+        "serving_admissions_total",            # counters
+        "serving_requests_finished_total",
+        "serving_tokens_total",
+        "serving_kv_pool_blocks",              # gauge
+        "serving_step_seconds",                # histograms
+        "serving_ttft_seconds",
+        "serving_tokens_per_second",
+    ])
+    reg = obs.get_registry()
+    assert reg.counter("serving_tokens_total").labels().value == 15
+    assert reg.counter("serving_admissions_total").labels().value == 3
+    assert reg.histogram("serving_ttft_seconds").labels().count == 3
+
+    # valid chrome trace with prefill/decode spans NESTED in their step
+    path = obs.export_chrome_trace(str(tmp_path / "serving_trace.json"))
+    with open(path) as f:
+        trace = json.load(f)
+    spans = _span_index(trace)
+    for name in ("serving.step", "serving.prefill", "serving.decode",
+                 "serving.readback"):
+        assert spans.get(name), f"no {name} spans in the chrome trace"
+    steps = spans["serving.step"]
+    for name in ("serving.prefill", "serving.decode"):
+        for inner in spans[name]:
+            assert any(_encloses(s, inner) for s in steps), \
+                f"{name} span not nested inside any serving.step span"
+    assert spans["serving.prefill"][0]["args"]["bucket"] in (8, 32)
+
+
+def test_resilient_train_loop_emits_documented_metrics(obs_on, tmp_path):
+    from paddle_tpu.distributed.resilience import ResilientTrainLoop
+
+    flaky = {"armed": True}
+
+    def step_fn(state, batch):
+        # one transient NaN: exercises rollback + same-batch retry
+        if flaky["armed"] and int(batch[0]) == 3:
+            flaky["armed"] = False
+            return state, jnp.float32(float("nan"))
+        w = state["w"] - 0.01 * batch.mean()
+        return {"w": w}, jnp.abs(w).sum()
+
+    batches = [jnp.full((2,), float(i), jnp.float32) for i in range(8)]
+    ckpt_dir = str(tmp_path / "ckpt")
+    loop = ResilientTrainLoop(step_fn, {"w": jnp.ones((2,), jnp.float32)},
+                              batches, ckpt_dir=ckpt_dir, ckpt_every=2)
+    loop.run(6)
+    assert loop.step == 6
+
+    _assert_exposed_everywhere([
+        "train_steps_total",                   # counters
+        "train_rollbacks_total",
+        "train_retries_total",
+        "train_checkpoints_total",
+        "train_step_seconds",                  # histograms
+        "train_checkpoint_save_seconds",
+    ])
+    reg = obs.get_registry()
+    assert reg.counter("train_steps_total").labels().value == 6
+    assert reg.counter("train_rollbacks_total").labels(
+        reason="non_finite_loss").value == 1
+    # 6 commits + 1 rolled-back attempt all observed
+    assert reg.histogram("train_step_seconds").labels().count == 7
+    tags = {ch.labels.get("tag")
+            for ch in reg.counter("train_checkpoints_total").series()
+            if ch.value}
+    assert "periodic" in tags and "final" in tags
+
+    # resume path: a second loop restores from the checkpoint and lands
+    # the load-duration histogram
+    loop2 = ResilientTrainLoop(step_fn, {"w": jnp.ones((2,), jnp.float32)},
+                               batches, ckpt_dir=ckpt_dir)
+    assert loop2.resume()
+    assert reg.histogram("train_checkpoint_load_seconds").labels().count \
+        >= 1
+
+    # chrome trace: step AND checkpoint spans nested inside train.run
+    path = obs.export_chrome_trace(str(tmp_path / "train_trace.json"))
+    with open(path) as f:
+        trace = json.load(f)
+    spans = _span_index(trace)
+    for name in ("train.run", "train.step", "train.checkpoint",
+                 "train.resume"):
+        assert spans.get(name), f"no {name} spans in the chrome trace"
+    run_span = spans["train.run"][0]
+    for name in ("train.step", "train.checkpoint"):
+        for inner in spans[name]:
+            assert _encloses(run_span, inner), \
+                f"{name} span not nested inside train.run"
+    assert len(spans["train.step"]) == 7
+    assert spans["train.step"][0]["args"]["depth"] == 1
+
+
+def test_metrics_logger_callback_flushes(obs_on, tmp_path):
+    """hapi MetricsLogger: periodic log lines + snapshot/trace flush
+    without needing a full Model.fit (callback protocol driven directly,
+    the way CallbackList does)."""
+    from paddle_tpu.hapi import MetricsLogger
+
+    lines = []
+    cb = MetricsLogger(log_freq_steps=2, snapshot_dir=str(tmp_path),
+                       printer=lines.append)
+    obs.counter("t_cb_total").inc(3)
+    cb.on_train_begin()
+    assert obs.enabled()
+    for step in range(4):
+        cb.on_train_batch_end(step, {"loss": 0.5})
+    cb.on_train_end()
+    assert any("t_cb_total" in ln for ln in lines)
+    snap = obs.load_snapshot(str(tmp_path / "metrics.json"))
+    assert any(m["name"] == "t_cb_total" for m in snap["metrics"])
+    with open(tmp_path / "trace.json") as f:
+        json.load(f)        # valid chrome-trace JSON

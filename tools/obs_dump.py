@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""obs dump: print a metrics table and write a Chrome trace.
+
+Two modes (slow-lane tooling, like tools/chaos_run.py):
+
+- attach to a snapshot file (written by ``observability.dump_snapshot``,
+  the ``MetricsLogger`` hapi callback, or scraped from the exposition
+  server's ``/snapshot.json``) and print the table::
+
+      python tools/obs_dump.py --snapshot /tmp/obs/metrics.json
+
+- run a tiny built-in workload with observability enabled, print the
+  resulting table, and write ``snapshot.json`` + ``trace.json`` (open
+  the latter in chrome://tracing or ui.perfetto.dev)::
+
+      JAX_PLATFORMS=cpu python tools/obs_dump.py --demo serving --out /tmp/obs
+      JAX_PLATFORMS=cpu python tools/obs_dump.py --demo train --out /tmp/obs
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def print_table(snap, out=sys.stdout):
+    """Render a snapshot dict (exposition.snapshot format) as a table."""
+    from paddle_tpu.observability.exposition import snapshot_rows
+
+    rows = snapshot_rows(snap)
+    if not rows:
+        out.write("(no non-zero series)\n")
+        return rows
+    w0 = max(len(r[0]) for r in rows)
+    w1 = max(len(r[1]) for r in rows)
+    w2 = max(len(r[2]) for r in rows)
+    out.write(f"{'metric':{w0}}  {'kind':{w1}}  {'labels':{w2}}  value\n")
+    out.write("-" * (w0 + w1 + w2 + 12) + "\n")
+    for name, kind, lbl, val in rows:
+        out.write(f"{name:{w0}}  {kind:{w1}}  {lbl:{w2}}  {val}\n")
+    return rows
+
+
+def demo_serving():
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.models import llama
+    from paddle_tpu.serving import LLMEngine
+
+    cfg = dataclasses.replace(
+        llama.tiny_llama(vocab=64, hidden=32, layers=2, heads=4, kv_heads=2,
+                         seq=128, ffn=64),
+        dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    eng = LLMEngine(params, cfg, max_slots=2, block_size=8,
+                    max_model_len=64, prompt_buckets=[8, 32])
+    for n, k in ((3, 6), (7, 5), (12, 4)):
+        eng.add_request(rng.integers(1, 64, size=n).tolist(),
+                        max_new_tokens=k)
+    results = eng.run()
+    print(f"demo serving: {len(results)} requests, "
+          f"{sum(len(v) for v in results.values())} tokens")
+
+
+def demo_train(workdir):
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed.resilience import ResilientTrainLoop
+
+    def step_fn(state, batch):
+        w = state["w"] - 0.1 * batch.mean()
+        return {"w": w}, jnp.abs(w).sum()
+
+    batches = [jnp.full((2,), 0.1 * (i + 1)) for i in range(8)]
+    loop = ResilientTrainLoop(
+        step_fn, {"w": jnp.ones((2,))}, batches,
+        ckpt_dir=os.path.join(workdir, "ckpt"), ckpt_every=2,
+        rng_key=None)
+    loop.run(len(batches))
+    print(f"demo train: {loop.step} steps, "
+          f"{len([e for e in loop.events if e['kind']=='checkpoint_saved'])}"
+          " checkpoints")
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--snapshot", default=None,
+                    help="print the table from an existing JSON snapshot")
+    ap.add_argument("--demo", choices=("serving", "train"), default=None,
+                    help="run a tiny built-in workload with obs enabled")
+    ap.add_argument("--out", default="./obs_dump",
+                    help="demo mode: directory for snapshot.json/trace.json")
+    args = ap.parse_args()
+
+    if args.snapshot:
+        from paddle_tpu.observability import load_snapshot
+
+        print_table(load_snapshot(args.snapshot))
+        return 0
+    if args.demo is None:
+        ap.error("pass --snapshot PATH or --demo {serving,train}")
+
+    import paddle_tpu.observability as obs
+
+    obs.enable()
+    os.makedirs(args.out, exist_ok=True)
+    if args.demo == "serving":
+        demo_serving()
+    else:
+        demo_train(args.out)
+    snap_path = obs.dump_snapshot(os.path.join(args.out, "snapshot.json"))
+    trace_path = obs.export_chrome_trace(os.path.join(args.out,
+                                                      "trace.json"))
+    print_table(obs.snapshot())
+    print(f"\nsnapshot: {snap_path}\nchrome trace: {trace_path} "
+          "(open in chrome://tracing or ui.perfetto.dev)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
