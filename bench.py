@@ -273,37 +273,49 @@ def bench_long_context(dev, results):
 
 
 def bench_moe(dev, results):
-    """Dropless MoE (sort + ragged_dot grouped-GEMM dispatch,
-    kernels/moe_dispatch.py) — BASELINE config 5's capability measured on
-    chip. MFU uses active params per token."""
+    """Dropless MoE (fused-routing dense-base dispatch with autotuned
+    grouped-GEMM fallback, kernels/moe_dispatch.py) — BASELINE config 5's
+    capability measured on chip. MFU uses active params per token.
+
+    Remat ladder (the llama-740m precedent): 'outs' saves attention +
+    routed outputs so backward skips the flash AND grouped-GEMM
+    recompute (measured +9% / +~0.6 GB residency at the bench config —
+    models/moe.py remat_policy notes); 'full' is the fallback if the
+    extra residency doesn't fit."""
     from paddle_tpu.models import moe
     if dev.platform == "cpu":
         return  # chip-only section
-    cfg = moe.MoEConfig(
-        vocab_size=32768, hidden_size=2048, intermediate_size=6144,
-        moe_intermediate_size=1408, num_layers=12, num_heads=16,
-        num_kv_heads=8, head_dim=128, num_experts=16, top_k=2,
-        n_shared_experts=2, first_dense_layers=1, max_seq_len=2048,
-        remat=True)
     opt = {"optimizer": "adafactor", "param_dtype": jnp.bfloat16}
-    try:
-        tps = _time_train(moe, cfg, 8, 2048, opt, n_steps=10)
-        mfu = moe.flops_per_token(cfg, 2048) * tps / _peak_flops(dev)
-        n_total = moe.num_params(jax.eval_shape(
-            lambda k: moe.init_params(cfg, k), jax.random.PRNGKey(0)))
-        results.append({
-            "metric": "moe-dropless_pretrain_tokens_per_sec_per_chip",
-            "value": round(tps, 1),
-            "unit": "tokens/s",
-            "vs_baseline": round(mfu / 0.40, 4),
-            "total_params": n_total,
-            "active_params_per_token": moe.active_params_per_token(cfg),
-        })
-    except Exception as e:
-        results.append({"metric": "moe_bench_failed", "value": 0.0,
-                        "unit": "tokens/s", "vs_baseline": 0.0,
-                        "error": str(e)[:200]})
-        _release()
+    last_err = "all remat policies failed"
+    for policy in ("outs", "full"):
+        cfg = moe.MoEConfig(
+            vocab_size=32768, hidden_size=2048, intermediate_size=6144,
+            moe_intermediate_size=1408, num_layers=12, num_heads=16,
+            num_kv_heads=8, head_dim=128, num_experts=16, top_k=2,
+            n_shared_experts=2, first_dense_layers=1, max_seq_len=2048,
+            remat=True, remat_policy=policy)
+        try:
+            tps = _time_train(moe, cfg, 8, 2048, opt, n_steps=10)
+            mfu = moe.flops_per_token(cfg, 2048) * tps / _peak_flops(dev)
+            n_total = moe.num_params(jax.eval_shape(
+                lambda k: moe.init_params(cfg, k), jax.random.PRNGKey(0)))
+            results.append({
+                "metric": "moe-dropless_pretrain_tokens_per_sec_per_chip",
+                "value": round(tps, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(mfu / 0.40, 4),
+                "total_params": n_total,
+                "active_params_per_token": moe.active_params_per_token(cfg),
+                "remat_policy": policy,
+            })
+            return
+        except Exception as e:
+            last_err = e
+            _release()
+    results.append({"metric": "moe_bench_failed", "value": 0.0,
+                    "unit": "tokens/s", "vs_baseline": 0.0,
+                    "error": str(last_err)[:200]})
+    _release()
 
 
 def _retry(fn, tries=3, base_delay=2.0):

@@ -40,7 +40,11 @@ from .branch_capture import GraphBreak as _BranchGraphBreak
 
 __all__ = ["to_static", "InputSpec", "save", "load", "not_to_static",
            "ignore_module", "enable_to_static", "TranslatedLayer",
-           "BuildStrategy", "segment_scope"]
+           "BuildStrategy", "segment_scope", "cache"]
+
+from . import cache  # noqa: E402  (persistent compile-artifact store —
+# measured-not-traced products like the MoE gmm tiling winners survive
+# the process; see jit/cache.py)
 
 from .segments import segment_scope  # noqa: E402  (public: eager code can
 # opt into lazy-segment batching directly — ops defer into cached compiled

@@ -1,0 +1,83 @@
+"""Persistent compile-artifact cache for the jit layer.
+
+The in-memory guard cache (``StaticFunction._cache``) dies with the
+process; artifacts whose recomputation is *measured* rather than traced
+— today the MoE grouped-matmul tiling winners
+(:mod:`paddle_tpu.kernels.gmm_autotune`) — are worth keeping across
+runs. This module is the one place that knows where such artifacts
+live and how to write them without torn files:
+
+* ``cache_dir()`` — ``FLAGS_jit_cache_dir`` > ``$PADDLE_TPU_CACHE_DIR``
+  > ``$XDG_CACHE_HOME/paddle_tpu`` > ``~/.cache/paddle_tpu``;
+* ``load_json(name)`` / ``store_json(name, obj)`` — JSON documents
+  committed with the resilience tier's temp+fsync+rename idiom
+  (atomic_ckpt.py), so a crash mid-write leaves the previous version,
+  never a truncated one. Corrupt/missing files read as ``{}``.
+
+Deliberately tiny and stdlib-only: callers treat persistence as
+best-effort (a read-only filesystem must never break compilation).
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any, Dict
+
+from ..framework.flags import define_flag, get_flag
+
+define_flag("jit_cache_dir", "",
+            "directory for persistent compile artifacts (tiling autotune "
+            "winners etc.); empty = $PADDLE_TPU_CACHE_DIR or "
+            "$XDG_CACHE_HOME/paddle_tpu or ~/.cache/paddle_tpu")
+
+__all__ = ["cache_dir", "cache_path", "load_json", "store_json"]
+
+
+def cache_dir() -> str:
+    d = get_flag("jit_cache_dir") or os.environ.get("PADDLE_TPU_CACHE_DIR")
+    if not d:
+        xdg = os.environ.get("XDG_CACHE_HOME")
+        base = xdg if xdg else os.path.join(os.path.expanduser("~"), ".cache")
+        d = os.path.join(base, "paddle_tpu")
+    return d
+
+
+def cache_path(name: str) -> str:
+    return os.path.join(cache_dir(), name + ".json")
+
+
+def load_json(name: str) -> Dict[str, Any]:
+    """Read a cached JSON document; missing or corrupt → ``{}``."""
+    try:
+        with open(cache_path(name), "r") as f:
+            obj = json.load(f)
+        return obj if isinstance(obj, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def store_json(name: str, obj: Dict[str, Any]) -> bool:
+    """Atomically commit ``obj`` (temp file + fsync + rename). Returns
+    False instead of raising on any I/O failure — persistence is an
+    optimization, never a requirement."""
+    path = cache_path(name)
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                                   prefix=".tmp-" + name)
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(obj, f, indent=1, sort_keys=True)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)      # the commit point (atomic on POSIX)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return True
+    except OSError:
+        return False

@@ -1,0 +1,340 @@
+"""Measured tiling autotuner for the Mosaic grouped matmul.
+
+The dropless-MoE grouped GEMMs (:func:`moe_dispatch.grouped_matmul`)
+used to pick their ``(tm, tk, tn)`` tilings from a static heuristic
+calibrated on v5e at the bench shapes. The optimum moves with device
+generation, expert count, and dtype — so this module *measures*: on the
+first encounter of each ``(m, k, n, E, dtype, full_rows)`` key on a TPU
+backend it times a small candidate grid for all three passes (forward
+gmm, dgrad gmm with ``transpose_rhs``, wgrad tgmm), keeps the winner
+in-process, and persists it through the jit compile-cache machinery
+(:mod:`paddle_tpu.jit.cache`, ``gmm_tilings.json``) so steady-state
+steps — and future processes on the same device kind — pay zero tuning
+cost.
+
+Where measurement is impossible (CPU lane, ``FLAGS_moe_gmm_autotune``
+off, or a candidate that fails to compile) the static heuristic answers
+instead; unmeasured answers are cached in-process only, never
+persisted, so the on-disk file holds nothing but measured winners.
+
+Tuning cost and cache traffic are visible in the observability catalog:
+``moe_tiling_cache_{hits,misses}_total``, ``moe_tiling_autotune_seconds``
+and the ``moe.autotune`` / ``moe.gmm`` spans (see docs/moe.md).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from ..framework.flags import define_flag, get_flag
+from ..observability import trace_span
+from ..observability.catalog import instrument as _instrument
+
+define_flag("moe_gmm_autotune", True,
+            "measure grouped-matmul tilings on first encounter of each "
+            "shape (TPU only); off = the static heuristic")
+
+__all__ = [
+    "heuristic_tilings", "get_tilings", "candidate_tilings", "clear",
+    "entries", "PERSIST_NAME",
+]
+
+Tiling = Tuple[int, int, int]
+TriTiling = Tuple[Tiling, Tiling, Tiling]          # (fwd, dgrad, wgrad)
+
+PERSIST_NAME = "gmm_tilings"
+_PASSES = ("fwd", "dgrad", "wgrad")
+
+_M_HITS = _instrument("moe_tiling_cache_hits_total")
+_M_MISSES = _instrument("moe_tiling_cache_misses_total")
+_M_TUNE = _instrument("moe_tiling_autotune_seconds")
+
+_LOCK = threading.Lock()
+_CACHE: Dict[str, dict] = {}
+_LOADED = False
+
+_TILES = (1408, 1024, 512, 256, 128)
+
+
+def _fits(tm: int, tk: int, tn: int) -> bool:
+    """Mosaic compile envelope, calibrated on v5e: double-buffered bf16
+    input tiles within scoped VMEM, and the f32 accumulator tile below the
+    observed crash line (tm*tn*4 of 4 MiB fails, 2.88 MiB compiles)."""
+    return (2 * 2 * (tm * tk + tk * tn) + 4 * tm * tn <= 15.5 * 2**20
+            and 4 * tm * tn <= 3 * 2**20)
+
+
+def heuristic_tilings(m: int, k: int, n: int) -> Optional[TriTiling]:
+    """Static per-pass tilings, measured on v5e at the bench shapes
+    (m=32768, E=16; % of bf16 peak):
+
+      fwd  [m,2048]@[E,2048,2816]  (512,512,1408)  33.7%  (512-cubed: 22%)
+      fwd  [m,1408]@[E,1408,2048]  (256,1408,2048) 20.7%
+      dgrad (transpose_rhs)        whole-K, tn=512 ~31%
+      wgrad (tgmm)                 (512,512,1408)  29.2%
+
+    The stock megablox ops.gmm shares ONE tiling between forward, dgrad,
+    and tgmm — the measured optimum differs per pass (the dgrad/wgrad
+    contraction is the forward's n/m), worth ~1.5x on the routed FFN.
+    Returns (fwd, dgrad, wgrad) or None for shapes the kernel doesn't
+    like (odd alignments → ragged_dot). tgmm's first tile divides the
+    contraction (m) — it must use the same m-aligned tm as the others.
+
+    This is the autotuner's seed ordering and its fallback whenever
+    measurement is unavailable."""
+    if m % 256 or k % 128 or n % 128:
+        return None
+    tm = 512 if m % 512 == 0 else 256
+    tn = next(t for t in _TILES if n % t == 0)
+    if k % 512 == 0:
+        fwd_cands = [(tm, 512, tn), (tm, 512, 512), (tm, 512, 128)]
+    else:
+        fwd_cands = [(256, k, n), (256, k, 1024), (256, k, 512)]
+    cands = {
+        "fwd": fwd_cands,
+        "dgrad": [(tm, n, 512), (tm, 512, 512), (tm, 128, 512)],
+        "wgrad": [(tm, 512, tn), (tm, 512, 512), (tm, 512, 128)],
+    }
+    picked = {}
+    for pass_, cs in cands.items():
+        picked[pass_] = next((c for c in cs if _fits(*c)), None)
+        if picked[pass_] is None:
+            return None
+    return picked["fwd"], picked["dgrad"], picked["wgrad"]
+
+
+def candidate_tilings(m: int, k: int, n: int,
+                      cap: int = 8) -> Optional[Dict[str, list]]:
+    """Per-pass candidate grid, heuristic winner first. Every candidate
+    satisfies the :func:`_fits` VMEM envelope; the heuristic's alignment
+    preconditions gate the whole shape. ``cap`` bounds measurement cost
+    (first-encounter only, but each candidate is a fresh Mosaic compile)."""
+    heur = heuristic_tilings(m, k, n)
+    if heur is None:
+        return None
+    tm_opts = [t for t in (512, 256) if m % t == 0]
+    k_tiles = [t for t in (1024, 512, 256) if k % t == 0] or [k]
+    n_tiles = [t for t in _TILES if n % t == 0]
+    grids = {
+        # fwd gmm: [m,k] @ [E,k,n] — (m tile, k contraction tile, n tile)
+        "fwd": [(tm, tk, tn)
+                for tm in tm_opts for tk in k_tiles for tn in n_tiles],
+        # dgrad gmm (transpose_rhs): [m,n] @ [E,n,k]^T — contraction is n
+        "dgrad": [(tm, t2, t3)
+                  for tm in tm_opts
+                  for t2 in dict.fromkeys((n, 512, 128))
+                  for t3 in (512, 256)],
+        # wgrad tgmm: [k,m] x [m,n] — first tile divides the contraction m
+        "wgrad": [(tm, t2, t3)
+                  for tm in tm_opts for t2 in (512, 256, 128)
+                  for t3 in dict.fromkeys((min(n_tiles[0], 1024), 512, 128))],
+    }
+    out = {}
+    for i, pass_ in enumerate(_PASSES):
+        seen = [heur[i]]
+        for c in grids[pass_]:
+            if c not in seen and _fits(*c):
+                seen.append(c)
+        out[pass_] = seen[:cap]
+    return out
+
+
+def _key(device: str, m: int, k: int, n: int, E: int, dtype: str,
+         full_rows: bool) -> str:
+    return f"{device}|m={m}|k={k}|n={n}|E={E}|{dtype}|full_rows={full_rows}"
+
+
+def _ensure_loaded() -> None:
+    """Merge the persisted winners into the in-process cache (once)."""
+    global _LOADED
+    if _LOADED:
+        return
+    from ..jit import cache as _jcache
+
+    disk = _jcache.load_json(PERSIST_NAME)
+    with _LOCK:
+        if _LOADED:
+            return
+        for key, ent in disk.items():
+            t = ent.get("tilings") if isinstance(ent, dict) else None
+            if (isinstance(t, dict) and all(p in t for p in _PASSES)
+                    and key not in _CACHE):
+                _CACHE[key] = {
+                    "tilings": {p: tuple(int(v) for v in t[p])
+                                for p in _PASSES},
+                    "source": ent.get("source", "measured"),
+                }
+        _LOADED = True
+
+
+def _persist() -> None:
+    from ..jit import cache as _jcache
+
+    with _LOCK:
+        doc = {key: {"tilings": {p: list(ent["tilings"][p])
+                                 for p in _PASSES},
+                     "source": ent["source"]}
+               for key, ent in _CACHE.items()
+               if ent["source"] == "measured"}
+    _jcache.store_json(PERSIST_NAME, doc)
+
+
+def _as_tri(ent: dict) -> TriTiling:
+    t = ent["tilings"]
+    return tuple(tuple(t[p]) for p in _PASSES)  # type: ignore[return-value]
+
+
+def _default_measure(m, k, n, E, dtype, full_rows):
+    """Build the on-device timing closure, or None when this backend
+    can't run the Mosaic kernel (the CPU lane)."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return None
+    import functools
+
+    import jax.numpy as jnp
+    from jax.experimental.pallas.ops.tpu.megablox.gmm import gmm, tgmm
+
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    lhs = jax.random.normal(ks[0], (m, k), jnp.float32).astype(dtype)
+    rhs = jax.random.normal(ks[1], (E, k, n), jnp.float32).astype(dtype)
+    grad = jax.random.normal(ks[2], (m, n), jnp.float32).astype(dtype)
+    # balanced groups summing to m — the load the aux loss maintains
+    gs = jnp.full((E,), m // E, jnp.int32).at[0].add(m - E * (m // E))
+    lhs_t = lhs.swapaxes(0, 1)
+
+    def run(pass_: str, tiling: Tiling) -> float:
+        if pass_ == "fwd":
+            f = jax.jit(functools.partial(
+                gmm, preferred_element_type=lhs.dtype, tiling=tiling))
+            args = (lhs, rhs, gs)
+        elif pass_ == "dgrad":
+            f = jax.jit(functools.partial(
+                gmm, preferred_element_type=lhs.dtype, tiling=tiling,
+                transpose_rhs=True))
+            args = (grad, rhs, gs)
+        else:
+            f = jax.jit(functools.partial(
+                tgmm, preferred_element_type=rhs.dtype, tiling=tiling,
+                num_actual_groups=E))
+            args = (lhs_t, grad, gs)
+        f(*args).block_until_ready()          # compile + warm
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            f(*args).block_until_ready()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    return run
+
+
+def get_tilings(m: int, k: int, n: int, E: int, dtype, full_rows: bool,
+                *, measure: Optional[Callable] = None
+                ) -> Optional[TriTiling]:
+    """(fwd, dgrad, wgrad) tilings for one ``grouped_matmul`` call site.
+
+    Cache hit → the remembered winner (persisted winners count as hits:
+    the whole point is that a warmed cache makes every step steady-state).
+    Miss → measure the candidate grid when possible, else the heuristic.
+    ``measure(pass_, tiling) -> seconds`` is injectable for tests and for
+    :mod:`tools.moe_tune`; pass a factory result, not a factory.
+    Returns None for shapes the Mosaic kernel doesn't like — the caller
+    falls back to ``ragged_dot``."""
+    import numpy as _np
+
+    heur = heuristic_tilings(m, k, n)
+    if heur is None:
+        return None
+    if not get_flag("moe_gmm_autotune"):
+        return heur
+    _ensure_loaded()
+    dtype_s = _np.dtype(dtype).name
+    key = _key(_device_tag(), m, k, n, E, dtype_s, bool(full_rows))
+    with _LOCK:
+        ent = _CACHE.get(key)
+    if ent is not None:
+        _M_HITS.inc()
+        return _as_tri(ent)
+    _M_MISSES.inc()
+
+    runner = measure if measure is not None else _default_measure(
+        m, k, n, E, dtype, full_rows)
+    if runner is None:
+        # nothing to time here: serve the heuristic, remember it
+        # in-process only (never persisted — the disk file is
+        # measured-winners-only)
+        with _LOCK:
+            _CACHE.setdefault(
+                key, {"tilings": dict(zip(_PASSES, heur)),
+                      "source": "heuristic"})
+        return heur
+
+    cands = candidate_tilings(m, k, n)
+    picked: Dict[str, Tiling] = {}
+    all_measured = True
+    t_start = time.perf_counter()
+    with trace_span("moe.autotune", m=m, k=k, n=n, E=E, dtype=dtype_s):
+        for i, pass_ in enumerate(_PASSES):
+            best, best_t = heur[i], float("inf")
+            for tiling in cands[pass_]:
+                try:
+                    with trace_span("moe.gmm", pass_=pass_,
+                                    tiling=str(tiling)):
+                        dt = runner(pass_, tiling)
+                except Exception:
+                    continue      # candidate fails to compile/run: skip
+                if dt < best_t:
+                    best, best_t = tiling, dt
+            if best_t == float("inf"):
+                # every candidate failed: the default-win heuristic was
+                # never validated — do NOT let it persist as "measured"
+                # (a toolchain fix should re-trigger measurement)
+                all_measured = False
+            picked[pass_] = tuple(best)
+    _M_TUNE.observe(time.perf_counter() - t_start)
+    source = "measured" if all_measured else "heuristic"
+    with _LOCK:
+        _CACHE.setdefault(key, {"tilings": picked, "source": source})
+        ent = _CACHE[key]
+    if all_measured:
+        _persist()
+    return _as_tri(ent)
+
+
+def _device_tag() -> str:
+    """Tilings are device-generation-specific: the cache key leads with
+    the accelerator kind so a v5e file never answers for a v6e."""
+    import jax
+
+    backend = jax.default_backend()
+    if backend != "tpu":
+        return backend
+    try:
+        return jax.devices()[0].device_kind.replace(" ", "_")
+    except Exception:
+        return "tpu"
+
+
+def clear(persisted: bool = False) -> None:
+    """Drop the in-process cache; ``persisted=True`` also truncates the
+    on-disk file (documented escape hatch after a toolchain upgrade)."""
+    global _LOADED
+    with _LOCK:
+        _CACHE.clear()
+        _LOADED = False     # next access re-reads the persisted winners
+    if persisted:
+        from ..jit import cache as _jcache
+
+        _jcache.store_json(PERSIST_NAME, {})
+
+
+def entries():
+    """Snapshot of (key, source, {pass: tiling}) — the tools/moe_tune.py
+    table."""
+    _ensure_loaded()
+    with _LOCK:
+        return [(key, ent["source"], dict(ent["tilings"]))
+                for key, ent in sorted(_CACHE.items())]

@@ -30,21 +30,65 @@ TPU-native re-design (three strategies, one semantic):
 All three differentiate: ``ragged_dot`` has jvp/transpose rules, the sorts
 and scatters transpose to gathers, and the collectives transpose to
 themselves (psum) or the reverse exchange.
+
+Hot-path structure (see docs/moe.md):
+
+* :func:`fused_routing` is the dispatch *prologue*: the fp32 router
+  matmul, top-k gating, aux loss, AND the expert-sort scatter metadata
+  come out of one shared one-hot/argsort — the router never round-trips
+  through separate computations, and every dispatch form below accepts
+  the precomputed ``routing=`` so nothing is derived twice.
+* :func:`plan_dispatch` memoizes the shape-derived plan (slot count Q,
+  dense-vs-gmm decision) per routing shape — every MoE layer of a model
+  shares one plan, visible in ``moe_plan_cache_{hits,misses}_total``.
+* ``grouped_matmul`` tilings come from the *measured* autotuner
+  (:mod:`.gmm_autotune`) with the v5e heuristic as seed and fallback.
+* The expert-parallel forms overlap their collectives with the shared-
+  expert FFN: pass ``shared=(s_gate, s_up, s_down)`` and the token batch
+  is processed as double-buffered halves, each half's collective hiding
+  behind the other half's grouped GEMM and the shared-expert compute.
 """
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+import threading
+from typing import Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..observability.catalog import instrument as _instrument
+from .gmm_autotune import (  # noqa: F401  (re-exported for back-compat)
+    _fits, get_tilings, heuristic_tilings, heuristic_tilings as
+    _pick_tilings,
+)
+
 __all__ = [
     "dropless_moe_ffn", "dropless_moe_ffn_dense", "dropless_moe_ffn_ep",
-    "dropless_moe_ffn_a2a", "sort_by_expert",
+    "dropless_moe_ffn_a2a", "sort_by_expert", "fused_routing", "Routing",
+    "plan_dispatch", "DispatchPlan", "clear_plan_cache",
 ]
+
+_M_PLAN_HITS = _instrument("moe_plan_cache_hits_total")
+_M_PLAN_MISSES = _instrument("moe_plan_cache_misses_total")
+_M_FALLBACKS = _instrument("moe_dispatch_fallbacks_total")
+
+
+def _shard_map(f, mesh, in_specs, out_specs, axis_names, check_vma=False):
+    """jax.shard_map across jax versions: the public API (axis_names/
+    check_vma) when present, else jax.experimental.shard_map (0.4.x —
+    partial-manual is spelled ``auto`` = the complement of axis_names,
+    replication checking is ``check_rep``)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, axis_names=axis_names,
+                             check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma,
+               auto=frozenset(mesh.axis_names) - set(axis_names))
 
 
 def sort_by_expert(idx: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
@@ -59,51 +103,120 @@ def sort_by_expert(idx: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
     return order, tok, flat_e
 
 
-_TILES = (1408, 1024, 512, 256, 128)
+# ---------------------------------------------------------------------------
+# fused routing prologue — router matmul + gating + aux loss + sort metadata
+# from ONE shared one-hot/argsort (the reference computes these as separate
+# gate / scatter-prep passes; here they are one XLA computation feeding
+# every dispatch strategy below)
+# ---------------------------------------------------------------------------
+
+class Routing(NamedTuple):
+    """Everything the router run produces, computed once per MoE layer.
+
+    ``weights``/``idx``/``aux`` match :func:`models.moe.top_k_gating`
+    bit-for-bit at fp32; ``order``/``tok``/``flat_e``/``gs`` are the
+    expert-sort scatter metadata the single-program dispatch forms would
+    otherwise re-derive."""
+
+    weights: jax.Array   # [T,k] f32, renormalized top-k gate weights
+    idx: jax.Array       # [T,k] int32 expert ids
+    aux: jax.Array       # scalar f32 load-balance aux loss (GShard eq. 4)
+    order: jax.Array     # [T*k] expert-sorted assignment permutation
+    tok: jax.Array       # [T*k] source token of each sorted assignment
+    flat_e: jax.Array    # [T*k] unsorted expert ids
+    gs: jax.Array        # [E] int32 per-expert assignment counts
 
 
-def _fits(tm: int, tk: int, tn: int) -> bool:
-    """Mosaic compile envelope, calibrated on v5e: double-buffered bf16
-    input tiles within scoped VMEM, and the f32 accumulator tile below the
-    observed crash line (tm*tn*4 of 4 MiB fails, 2.88 MiB compiles)."""
-    return (2 * 2 * (tm * tk + tk * tn) + 4 * tm * tn <= 15.5 * 2**20
-            and 4 * tm * tn <= 3 * 2**20)
+def routing_from_logits(logits: jax.Array, top_k: int) -> Routing:
+    """Gating + metadata from precomputed router logits (fp32)."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)   # [T,E]
+    weights, idx = jax.lax.top_k(probs, top_k)                    # [T,k]
+    weights = weights / jnp.sum(weights, -1, keepdims=True)
+    T, E = logits.shape
+    A = T * top_k
+    flat_e = idx.reshape(A)
+    # ONE one-hot feeds the group sizes AND the aux-loss expert fractions
+    onehot = (flat_e[:, None] == jnp.arange(E, dtype=flat_e.dtype)[None, :]
+              ).astype(jnp.int32)                                 # [A,E]
+    gs = onehot.sum(axis=0)
+    me = jnp.mean(probs, axis=0)                                  # [E]
+    # rows 0, k, 2k, ... of the flat one-hot are the top-1 assignments
+    ce = jnp.mean(
+        onehot.reshape(T, top_k, E)[:, 0].astype(jnp.float32), axis=0)
+    aux = E * jnp.sum(me * ce)
+    order = jnp.argsort(flat_e)           # stable → deterministic combine
+    tok = order // top_k
+    return Routing(weights, idx, aux, order, tok, flat_e, gs)
 
 
-def _pick_tilings(m: int, k: int, n: int):
-    """Per-pass tilings for the Mosaic grouped matmul, measured on v5e at
-    the bench shapes (m=32768, E=16; % of bf16 peak):
+def fused_routing(x: jax.Array, router_w: jax.Array,
+                  top_k: int) -> Routing:
+    """The dispatch prologue: fp32 router matmul → :class:`Routing`.
 
-      fwd  [m,2048]@[E,2048,2816]  (512,512,1408)  33.7%  (512-cubed: 22%)
-      fwd  [m,1408]@[E,1408,2048]  (256,1408,2048) 20.7%
-      dgrad (transpose_rhs)        whole-K, tn=512 ~31%
-      wgrad (tgmm)                 (512,512,1408)  29.2%
+    Numerically identical to ``top_k_gating(x.astype(f32) @
+    router_w.astype(f32), top_k)`` (same op sequence), plus the sort
+    metadata every single-program dispatch form consumes via
+    ``routing=`` — so the router, the aux loss, and the scatter prep
+    are one fused computation instead of three."""
+    logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
+    return routing_from_logits(logits, top_k)
 
-    The stock megablox ops.gmm shares ONE tiling between forward, dgrad,
-    and tgmm — the measured optimum differs per pass (the dgrad/wgrad
-    contraction is the forward's n/m), worth ~1.5x on the routed FFN.
-    Returns (fwd, dgrad, wgrad) tilings or None for shapes the kernel
-    doesn't like (odd alignments → ragged_dot). tgmm's first tile divides
-    the contraction (m) — it must use the same m-aligned tm as the others."""
-    if m % 256 or k % 128 or n % 128:
-        return None
-    tm = 512 if m % 512 == 0 else 256
-    tn = next(t for t in _TILES if n % t == 0)
-    if k % 512 == 0:
-        fwd_cands = [(tm, 512, tn), (tm, 512, 512), (tm, 512, 128)]
-    else:
-        fwd_cands = [(256, k, n), (256, k, 1024), (256, k, 512)]
-    cands = {
-        "fwd": fwd_cands,
-        "dgrad": [(tm, n, 512), (tm, 512, 512), (tm, 128, 512)],
-        "wgrad": [(tm, 512, tn), (tm, 512, 512), (tm, 512, 128)],
-    }
-    picked = {}
-    for pass_, cs in cands.items():
-        picked[pass_] = next((c for c in cs if _fits(*c)), None)
-        if picked[pass_] is None:
-            return None
-    return picked["fwd"], picked["dgrad"], picked["wgrad"]
+
+# ---------------------------------------------------------------------------
+# dispatch plan — shape-derived constants, one per routing shape
+# ---------------------------------------------------------------------------
+
+class DispatchPlan(NamedTuple):
+    """Static dispatch decisions for one routing shape (T, k, E, h).
+
+    Everything here is derivable from shapes alone — it is *host-side*
+    metadata (slot count Q, dense-base eligibility), computed once and
+    shared by every MoE layer and every step with the same shape instead
+    of being re-derived per layer."""
+
+    T: int
+    k: int
+    E: int
+    h: int
+    Q: int               # dense-base slots per expert (A/E + slack, /128)
+    use_dense: bool      # dense [E,Q,h] staging beats the gmm sort here
+
+
+_PLAN_CACHE: Dict[tuple, DispatchPlan] = {}
+_PLAN_LOCK = threading.Lock()
+
+
+def _round_up(v: int, m: int) -> int:
+    return -(-v // m) * m
+
+
+def plan_dispatch(T: int, k: int, E: int, h: int,
+                  slack: float = 0.125,
+                  dense_base: bool = True) -> DispatchPlan:
+    """The memoized plan for one routing shape (hit = every MoE layer
+    after the first, and every later step)."""
+    key = (T, k, E, h, float(slack), bool(dense_base))
+    with _PLAN_LOCK:
+        plan = _PLAN_CACHE.get(key)
+    if plan is not None:
+        _M_PLAN_HITS.inc()
+        return plan
+    _M_PLAN_MISSES.inc()
+    A = T * k
+    Q = min(_round_up(max(int(A / E * (1 + slack)), 1), 128), A)
+    use_dense = bool(dense_base) and E * Q <= 4 * A
+    if dense_base and not use_dense:
+        # tiny/test shapes: the base buffer would dwarf the real work
+        _M_FALLBACKS.labels(reason="dense_buffer_too_big").inc()
+    plan = DispatchPlan(T, k, E, h, Q, use_dense)
+    with _PLAN_LOCK:
+        _PLAN_CACHE.setdefault(key, plan)
+    return plan
+
+
+def clear_plan_cache() -> None:
+    with _PLAN_LOCK:
+        _PLAN_CACHE.clear()
 
 
 def _zero_tail(out, gs):
@@ -152,8 +265,11 @@ def grouped_matmul(xs, w, gs, full_rows: bool = False):
     """[m, k] @ per-group [E, k, n] over expert-sorted rows. On TPU this is
     the Mosaic block-sparse grouped matmul (MegaBlocks-style: only row
     blocks that exist are computed — the analogue of the reference's
-    cutlass moe_gemm), with per-pass measured tilings (``_pick_tilings``);
-    elsewhere jax.lax.ragged_dot.
+    cutlass moe_gemm), with per-pass tilings from the measured autotuner
+    (:func:`gmm_autotune.get_tilings`: first encounter of each
+    ``(m, k, n, E, dtype, full_rows)`` key times a candidate grid, the
+    winner is cached in-process and persisted); elsewhere
+    jax.lax.ragged_dot.
 
     ``full_rows=True`` asserts sum(gs) == m statically (every row belongs
     to a group), skipping the tail-zeroing pass (``_zero_tail``).
@@ -164,9 +280,10 @@ def grouped_matmul(xs, w, gs, full_rows: bool = False):
     m, k = xs.shape
     n = w.shape[-1]
     if jax.default_backend() == "tpu":
-        tilings = _pick_tilings(m, k, n)
+        tilings = get_tilings(m, k, n, w.shape[0], xs.dtype, full_rows)
         if tilings is not None:
             return _gmm_tuned(xs, w, gs, tilings, full_rows)
+        _M_FALLBACKS.labels(reason="shape_unaligned").inc()
     return jax.lax.ragged_dot(xs, w, gs)
 
 
@@ -187,8 +304,12 @@ def _expert_ffn(xs, gs, e_gate, e_up, e_down, dt, full_rows=False):
         full_rows=full_rows)
 
 
-def _round_up(v: int, m: int) -> int:
-    return -(-v // m) * m
+def _shared_swiglu(x, s_gate, s_up, s_down, dt):
+    """The always-on shared-expert FFN — computed inside the expert-
+    parallel dispatch bodies so its MXU work hides the collectives."""
+    xc = x.astype(dt)
+    g = jax.nn.silu(xc @ s_gate.astype(dt))
+    return (g * (xc @ s_up.astype(dt))) @ s_down.astype(dt)
 
 
 def _dense_meta(idx, E: int, Q: int):
@@ -315,7 +436,9 @@ _dense_base_ffn.defvjp(_dense_base_fwd, _dense_base_bwd)
 
 
 def dropless_moe_ffn_dense(x, weights, idx, e_gate, e_up, e_down,
-                           slack: float = 0.125):
+                           slack: float = 0.125,
+                           routing: Optional[Routing] = None,
+                           plan: Optional[DispatchPlan] = None):
     """Capacity-less routed FFN, dense-base form (single program).
 
     The TPU-first reshape of the reference's unbounded global_scatter
@@ -334,16 +457,26 @@ def dropless_moe_ffn_dense(x, weights, idx, e_gate, e_up, e_down,
     Cost of the headroom: Q/(A/E)-1 wasted dense FLOPs (12.5% default) on
     empty slots whose outputs are never gathered; with balanced routing
     (what the aux loss maintains) the fallback fires with probability
-    ~Phi(-5 sigma) per step."""
+    ~Phi(-5 sigma) per step.
+
+    ``routing`` (from :func:`fused_routing`) is reused when this shape
+    skips the dense base entirely; ``plan`` skips re-deriving Q when the
+    caller already holds the shared :class:`DispatchPlan`."""
     T, h = x.shape
     E = e_gate.shape[0]
     k = idx.shape[1]
-    A = T * k
-    Q = min(_round_up(max(int(A / E * (1 + slack)), 1), 128), A)
-    if E * Q > 4 * A:
-        # tiny/test shapes: the base buffer would dwarf the real work
-        return dropless_moe_ffn(x, weights, idx, e_gate, e_up, e_down)
+    if plan is None:
+        plan = plan_dispatch(T, k, E, h, slack=slack)
+    Q = plan.Q
+    if not plan.use_dense:
+        return dropless_moe_ffn(x, weights, idx, e_gate, e_up, e_down,
+                                routing=routing)
     r, src_tok, w_sel, ok = _dense_meta(idx, E, Q)
+    # the overflow fallback must NOT capture routing.order/tok: cond
+    # operands are computed unconditionally every step, while work inside
+    # the untaken branch is not — re-deriving the sort in the ~never-taken
+    # branch keeps the argsort off the steady-state dense path (the
+    # prologue's sort metadata is DCE'd when nothing else consumes it)
     return jax.lax.cond(
         ok,
         lambda x, w, i: _dense_base_ffn(x, w, e_gate, e_up, e_down, r,
@@ -352,19 +485,26 @@ def dropless_moe_ffn_dense(x, weights, idx, e_gate, e_up, e_down,
         x, weights, idx)
 
 
-def dropless_moe_ffn(x, weights, idx, e_gate, e_up, e_down):
+def dropless_moe_ffn(x, weights, idx, e_gate, e_up, e_down,
+                     routing: Optional[Routing] = None):
     """Capacity-less routed FFN, single-program (GSPMD) form.
 
     x: [T,h]; weights/idx: [T,k] from the router; experts [E,h,f]/[E,f,h].
     Every assignment is computed — there is no capacity C and nothing to
     drop (reference semantics: moe_layer.py global_scatter with unbounded
-    per-expert counts)."""
+    per-expert counts).
+
+    With ``routing`` (the :func:`fused_routing` prologue) the sort
+    permutation and group sizes are reused instead of re-derived."""
     T, h = x.shape
     E = e_gate.shape[0]
     dt = x.dtype
-    order, tok, flat_e = sort_by_expert(idx)
+    if routing is None:
+        order, tok, flat_e = sort_by_expert(idx)
+        gs = jnp.zeros((E,), jnp.int32).at[flat_e].add(1)
+    else:
+        order, tok, gs = routing.order, routing.tok, routing.gs
     xs = jnp.take(x, tok, axis=0)                         # [T*k, h]
-    gs = jnp.zeros((E,), jnp.int32).at[flat_e].add(1)
     # every assignment belongs to a real expert → sum(gs) == T*k
     ys = _expert_ffn(xs, gs, e_gate, e_up, e_down, dt, full_rows=True)
     ws = weights.reshape(T * idx.shape[1])[order].astype(jnp.float32)
@@ -373,16 +513,13 @@ def dropless_moe_ffn(x, weights, idx, e_gate, e_up, e_down):
     return y.astype(dt)
 
 
-def _ep_local(x_l, w_l, idx_l, eg_l, eu_l, ed_l, *, num_experts_local,
-              compute_dtype):
-    """Per-(data,ep)-rank body: local tokens × local expert shard, psum('ep').
+def _ep_partial(x_l, w_l, idx_l, eg_l, eu_l, ed_l, *, El, me, dt):
+    """Routed partial sums for one token slice: local tokens × local
+    expert shard, pre-psum [T_slice, h] f32.
 
     Assignments routed to foreign experts sort to the tail and get combine
-    weight 0; the psum sums each token's k partial expert outputs across the
-    ep ranks that own them. Boundary tensors are f32 (see the caller); the
-    grouped GEMMs run in ``compute_dtype`` (bf16 on TPU → MXU)."""
-    El = num_experts_local
-    me = jax.lax.axis_index("ep")
+    weight 0; the caller's psum sums each token's k partial expert outputs
+    across the ep ranks that own them."""
     Tl, k = idx_l.shape
     A = Tl * k
 
@@ -391,24 +528,59 @@ def _ep_local(x_l, w_l, idx_l, eg_l, eu_l, ed_l, *, num_experts_local,
     mine = (lid >= 0) & (lid < El)
     order = jnp.argsort(jnp.where(mine, lid, El))         # foreign → tail
     tok = order // k
-    xs = jnp.take(x_l.astype(compute_dtype), tok, axis=0)
+    xs = jnp.take(x_l.astype(dt), tok, axis=0)
     gs = jnp.zeros((El,), jnp.int32).at[jnp.where(mine, lid, 0)].add(
         mine.astype(jnp.int32))
-    ys = _expert_ffn(xs, gs, eg_l, eu_l, ed_l, compute_dtype)
+    ys = _expert_ffn(xs, gs, eg_l, eu_l, ed_l, dt)
     ws = jnp.where(mine, w_l.reshape(A), 0.0)[order].astype(jnp.float32)
-    y = jnp.zeros((Tl, x_l.shape[1]), jnp.float32).at[tok].add(
+    return jnp.zeros((Tl, x_l.shape[1]), jnp.float32).at[tok].add(
         ys.astype(jnp.float32) * ws[:, None])
-    return jax.lax.psum(y, "ep")
+
+
+def _ep_local(x_l, w_l, idx_l, eg_l, eu_l, ed_l, shared_w=None, *,
+              num_experts_local, compute_dtype):
+    """Per-(data,ep)-rank body of the psum strategy. Boundary tensors are
+    f32 (see the caller); the grouped GEMMs run in ``compute_dtype``
+    (bf16 on TPU → MXU).
+
+    With ``shared_w`` the token slice is processed as double-buffered
+    halves: half 0's combine psum is issued while half 1's grouped GEMMs
+    run, and the shared-expert FFN fills the remaining collective
+    shadow — the psum never sits on the critical path alone."""
+    El = num_experts_local
+    me = jax.lax.axis_index("ep")
+    dt = compute_dtype
+    Tl = x_l.shape[0]
+    part = functools.partial(_ep_partial, eg_l=eg_l, eu_l=eu_l, ed_l=ed_l,
+                             El=El, me=me, dt=dt)
+    if shared_w is None or Tl < 2 or Tl % 2:
+        y = jax.lax.psum(part(x_l, w_l, idx_l), "ep")
+        if shared_w is not None:
+            y = y + _shared_swiglu(x_l, *shared_w, dt).astype(jnp.float32)
+        return y
+    H = Tl // 2
+    y0 = part(x_l[:H], w_l[:H], idx_l[:H])
+    p0 = jax.lax.psum(y0, "ep")           # in flight while half 1 computes
+    y1 = part(x_l[H:], w_l[H:], idx_l[H:])
+    p1 = jax.lax.psum(y1, "ep")           # hidden by the shared FFN below
+    s = _shared_swiglu(x_l, *shared_w, dt).astype(jnp.float32)
+    return jnp.concatenate([p0, p1], axis=0) + s
 
 
 def dropless_moe_ffn_ep(x, weights, idx, e_gate, e_up, e_down, mesh: Mesh,
-                        token_axes: Tuple[str, ...] = ("dp",)):
+                        token_axes: Tuple[str, ...] = ("dp",),
+                        shared: Optional[Tuple] = None):
     """Explicit expert-parallel dropless FFN (partial-manual shard_map).
 
     Token tensors are sharded over ``token_axes`` and replicated over 'ep';
     experts are sharded over 'ep' on their leading axis. Axes not named
     ('tp' fsdp etc.) stay under GSPMD control, so this nests inside a fully
     sharded train step.
+
+    ``shared=(s_gate, s_up, s_down)`` moves the always-on shared-expert
+    FFN *inside* the shard_map body so its compute overlaps the combine
+    psum (double-buffered halves, see :func:`_ep_local`); the return
+    value is then routed + shared.
 
     The shard_map boundary is kept f32: differentiating a bf16-carrying
     partial-manual shard_map inside ``lax.scan`` hits an XLA:CPU compiler
@@ -418,39 +590,55 @@ def dropless_moe_ffn_ep(x, weights, idx, e_gate, e_up, e_down, mesh: Mesh,
     E = e_gate.shape[0]
     ep = dict(mesh.shape).get("ep", 1)
     if ep <= 1 or E % ep != 0:
-        return dropless_moe_ffn(x, weights, idx, e_gate, e_up, e_down)
+        _M_FALLBACKS.labels(reason="ep_shape_mismatch").inc()
+        y = dropless_moe_ffn(x, weights, idx, e_gate, e_up, e_down)
+        if shared is not None:
+            y = y + _shared_swiglu(x, *shared, x.dtype)
+        return y
     dt = x.dtype
     tok_axes = tuple(a for a in token_axes if dict(mesh.shape).get(a, 1) > 1)
     tok_spec = P(tok_axes if tok_axes else None)
-    fn = jax.shard_map(
-        lambda xl, wl, il, g, u, d: _ep_local(
-            xl, wl, il, g, u, d, num_experts_local=E // ep,
-            compute_dtype=dt),
+    body = functools.partial(_ep_local, num_experts_local=E // ep,
+                             compute_dtype=dt)
+    if shared is None:
+        fn = _shard_map(
+            lambda xl, wl, il, g, u, d: body(xl, wl, il, g, u, d),
+            mesh=mesh,
+            in_specs=(tok_spec, tok_spec, tok_spec, P("ep"), P("ep"),
+                      P("ep")),
+            out_specs=tok_spec,
+            axis_names=set(tok_axes) | {"ep"},
+            check_vma=False)
+        return fn(x.astype(jnp.float32), weights, idx,
+                  e_gate, e_up, e_down).astype(dt)
+    fn = _shard_map(
+        lambda xl, wl, il, g, u, d, sg, su, sd: body(
+            xl, wl, il, g, u, d, (sg, su, sd)),
         mesh=mesh,
-        in_specs=(tok_spec, tok_spec, tok_spec, P("ep"), P("ep"), P("ep")),
+        in_specs=(tok_spec, tok_spec, tok_spec, P("ep"), P("ep"), P("ep"),
+                  P(None), P(None), P(None)),
         out_specs=tok_spec,
         axis_names=set(tok_axes) | {"ep"},
         check_vma=False)
-    return fn(x.astype(jnp.float32), weights, idx,
-              e_gate, e_up, e_down).astype(dt)
+    return fn(x.astype(jnp.float32), weights, idx, e_gate, e_up, e_down,
+              *shared).astype(dt)
 
 
-def _a2a_local(x_l, w_l, idx_l, eg_l, eu_l, ed_l, *, num_experts,
-               num_experts_local, ep_size):
-    """Per-ep-rank body of the ragged-all-to-all exchange (reference's
-    global_scatter → grouped GEMM → global_gather, TPU collectives)."""
-    E, El, R = num_experts, num_experts_local, ep_size
+def _a2a_exchange(x_h, w_h, idx_h, *, E, El, R):
+    """Stage 1 of the ragged exchange for one token slice: expert-sort,
+    size all_gather, and the payload + expert-id ragged all-to-alls
+    (both in flight when this returns — consume late)."""
     me = jax.lax.axis_index("ep")
-    Tl, k = idx_l.shape
+    Tl, k = idx_h.shape
     A = Tl * k
     Amax = A * R
-    h = x_l.shape[1]
-    dt = x_l.dtype
+    h = x_h.shape[1]
+    dt = x_h.dtype
 
-    flat_e = idx_l.reshape(A)
+    flat_e = idx_h.reshape(A)
     order = jnp.argsort(flat_e)                    # expert order == rank order
     tok = order // k
-    xs = jnp.take(x_l, tok, axis=0)                # [A,h] send buffer
+    xs = jnp.take(x_h, tok, axis=0)                # [A,h] send buffer
     eid_send = flat_e[order]
 
     dest = flat_e // El
@@ -466,7 +654,15 @@ def _a2a_local(x_l, w_l, idx_l, eg_l, eu_l, ed_l, *, num_experts,
     er = jax.lax.ragged_all_to_all(
         eid_send, jnp.full((Amax,), E, jnp.int32),
         in_off, send_sizes, out_off, recv_sizes, axis_name="ep")
+    state = (order, tok, w_h, sizes, send_sizes, recv_sizes)
+    return xr, er, state
 
+
+def _a2a_ffn(xr, er, eg_l, eu_l, ed_l, *, E, El):
+    """Stage 2: group the received rows by local expert and run the
+    grouped-GEMM SwiGLU (padding rows sort to a zero-weight tail)."""
+    dt = xr.dtype
+    me = jax.lax.axis_index("ep")
     lid = jnp.where(er < E, er - me * El, El)      # padding → tail group
     order2 = jnp.argsort(lid)
     xg = jnp.take(xr, order2, axis=0)
@@ -474,29 +670,78 @@ def _a2a_local(x_l, w_l, idx_l, eg_l, eu_l, ed_l, *, num_experts,
     gs = jnp.zeros((El,), jnp.int32).at[jnp.where(valid, lid, 0)].add(
         valid.astype(jnp.int32))
     yg = _expert_ffn(xg, gs, eg_l, eu_l, ed_l, dt)
-    yr = jnp.zeros_like(yg).at[order2].set(yg)     # back to receive order
+    return jnp.zeros_like(yg).at[order2].set(yg)   # back to receive order
 
+
+def _a2a_combine(yr, state, *, h):
+    """Stage 3: reverse ragged all-to-all + gate-weighted combine for one
+    token slice. Returns [T_slice, h] f32."""
+    me = jax.lax.axis_index("ep")
+    order, tok, w_h, sizes, send_sizes, recv_sizes = state
+    Tl, k = w_h.shape
+    A = Tl * k
+    dt = yr.dtype
     rev_in_off = jnp.cumsum(recv_sizes) - recv_sizes
     rev_out_off = (jnp.cumsum(sizes, axis=1) - sizes)[:, me]
     ys = jax.lax.ragged_all_to_all(
         yr, jnp.zeros((A, h), dt),
         rev_in_off, recv_sizes, rev_out_off, send_sizes, axis_name="ep")
-
-    ws = w_l.reshape(A)[order].astype(jnp.float32)
-    y = jnp.zeros((Tl, h), jnp.float32).at[tok].add(
+    ws = w_h.reshape(A)[order].astype(jnp.float32)
+    return jnp.zeros((Tl, h), jnp.float32).at[tok].add(
         ys.astype(jnp.float32) * ws[:, None])
+
+
+def _a2a_local(x_l, w_l, idx_l, eg_l, eu_l, ed_l, shared_w=None, *,
+               num_experts, num_experts_local, ep_size):
+    """Per-ep-rank body of the ragged-all-to-all exchange (reference's
+    global_scatter → grouped GEMM → global_gather, TPU collectives).
+
+    With ``shared_w`` the slice is processed as double-buffered halves:
+    both halves' forward exchanges are issued back to back, the shared-
+    expert FFN computes in their shadow, and half 0's reverse exchange
+    hides behind half 1's grouped GEMMs."""
+    E, El, R = num_experts, num_experts_local, ep_size
+    Tl = x_l.shape[0]
+    h = x_l.shape[1]
+    dt = x_l.dtype
+
+    def one(x_h, w_h, idx_h):
+        xr, er, st = _a2a_exchange(x_h, w_h, idx_h, E=E, El=El, R=R)
+        yr = _a2a_ffn(xr, er, eg_l, eu_l, ed_l, E=E, El=El)
+        return _a2a_combine(yr, st, h=h)
+
+    if shared_w is None or Tl < 2 or Tl % 2:
+        y = one(x_l, w_l, idx_l)
+        if shared_w is not None:
+            y = y + _shared_swiglu(x_l, *shared_w, dt).astype(jnp.float32)
+        return y.astype(dt)
+    H = Tl // 2
+    xr0, er0, st0 = _a2a_exchange(x_l[:H], w_l[:H], idx_l[:H],
+                                  E=E, El=El, R=R)
+    xr1, er1, st1 = _a2a_exchange(x_l[H:], w_l[H:], idx_l[H:],
+                                  E=E, El=El, R=R)
+    s = _shared_swiglu(x_l, *shared_w, dt)         # hides both exchanges
+    yr0 = _a2a_ffn(xr0, er0, eg_l, eu_l, ed_l, E=E, El=El)
+    y0 = _a2a_combine(yr0, st0, h=h)               # reverse a2a of half 0…
+    yr1 = _a2a_ffn(xr1, er1, eg_l, eu_l, ed_l, E=E, El=El)  # …hides here
+    y1 = _a2a_combine(yr1, st1, h=h)
+    y = jnp.concatenate([y0, y1], axis=0) + s.astype(jnp.float32)
     return y.astype(dt)
 
 
 def dropless_moe_ffn_a2a(x, weights, idx, e_gate, e_up, e_down, mesh: Mesh,
-                         token_axes: Tuple[str, ...] = ("dp", "ep")):
+                         token_axes: Tuple[str, ...] = ("dp", "ep"),
+                         shared: Optional[Tuple] = None):
     """Ragged-all-to-all dropless FFN: tokens sharded over ``token_axes``
     (which always includes 'ep'), exchanged to expert owners within each ep
     group and back (the literal global_scatter/global_gather shape — only
     ~T*k/ep assignments are GEMM'd per rank, vs the psum strategy's T*k).
     Requires a backend with a ragged-all-to-all lowering — real TPU;
     XLA:CPU raises UNIMPLEMENTED, so CPU tests use the _ep/psum strategy
-    (a lowering-only test pins the wiring)."""
+    (a lowering-only test pins the wiring).
+
+    ``shared=(s_gate, s_up, s_down)`` fuses the shared-expert FFN into the
+    body so the exchanges hide behind it (see :func:`_a2a_local`)."""
     E = e_gate.shape[0]
     ep = dict(mesh.shape).get("ep", 1)
     T = x.shape[0]
@@ -505,15 +750,31 @@ def dropless_moe_ffn_a2a(x, weights, idx, e_gate, e_up, e_down, mesh: Mesh,
     n_tok_shards = int(np.prod([dict(mesh.shape)[a] for a in tok_axes])) \
         if tok_axes else 1
     if ep <= 1 or E % ep != 0 or T % max(n_tok_shards, 1) != 0:
-        return dropless_moe_ffn(x, weights, idx, e_gate, e_up, e_down)
+        _M_FALLBACKS.labels(reason="ep_shape_mismatch").inc()
+        y = dropless_moe_ffn(x, weights, idx, e_gate, e_up, e_down)
+        if shared is not None:
+            y = y + _shared_swiglu(x, *shared, x.dtype)
+        return y
     tok_spec = P(tok_axes)
-    fn = jax.shard_map(
-        lambda xl, wl, il, g, u, d: _a2a_local(
-            xl, wl, il, g, u, d, num_experts=E,
-            num_experts_local=E // ep, ep_size=ep),
+    body = functools.partial(_a2a_local, num_experts=E,
+                             num_experts_local=E // ep, ep_size=ep)
+    if shared is None:
+        fn = _shard_map(
+            lambda xl, wl, il, g, u, d: body(xl, wl, il, g, u, d),
+            mesh=mesh,
+            in_specs=(tok_spec, tok_spec, tok_spec, P("ep"), P("ep"),
+                      P("ep")),
+            out_specs=tok_spec,
+            axis_names=set(tok_axes) | {"ep"},
+            check_vma=False)
+        return fn(x, weights, idx, e_gate, e_up, e_down)
+    fn = _shard_map(
+        lambda xl, wl, il, g, u, d, sg, su, sd: body(
+            xl, wl, il, g, u, d, (sg, su, sd)),
         mesh=mesh,
-        in_specs=(tok_spec, tok_spec, tok_spec, P("ep"), P("ep"), P("ep")),
+        in_specs=(tok_spec, tok_spec, tok_spec, P("ep"), P("ep"), P("ep"),
+                  P(None), P(None), P(None)),
         out_specs=tok_spec,
         axis_names=set(tok_axes) | {"ep"},
         check_vma=False)
-    return fn(x, weights, idx, e_gate, e_up, e_down)
+    return fn(x, weights, idx, e_gate, e_up, e_down, *shared)

@@ -37,6 +37,7 @@ __all__ = [
     "TrainState", "init_train_state", "train_step", "num_params",
 ]
 
+from ..observability import trace_span
 from .llama import (  # reuse the dense-transformer scaffolding
     TrainState, _apply_rope, _attention, _constrain, _rms_norm, _rope_tables,
     activation_mesh,
@@ -237,49 +238,69 @@ def top_k_gating(logits, top_k: int):
     return weights, idx, aux
 
 
-def moe_ffn(x, router_w, e_gate, e_up, e_down, config: MoEConfig):
+def moe_ffn(x, router_w, e_gate, e_up, e_down, config: MoEConfig,
+            shared_weights=None):
     """Routed-expert FFN over flattened tokens — dispatch by config.routing.
 
-    "dropless" (default): capacity-less sort-based dispatch + ragged_dot
-    grouped GEMMs (kernels/moe_dispatch.py) — the MXU analogue of the
-    reference's global_scatter/gather + cutlass grouped GEMM
-    (moe_layer.py:105-188, fusion/cutlass_kernels/moe_gemm/). Under a mesh
-    with ep>1 it runs the explicit shard_map expert-parallel form.
+    "dropless" (default): the fused hot path — one
+    :func:`kernels.moe_dispatch.fused_routing` prologue (fp32 router
+    matmul + top-k gating + aux loss + expert-sort metadata in one
+    computation) feeding the capacity-less grouped-GEMM dispatch — the
+    MXU analogue of the reference's global_scatter/gather + cutlass
+    grouped GEMM (moe_layer.py:105-188, fusion/cutlass_kernels/moe_gemm/).
+    Under a mesh with ep>1 it runs the explicit shard_map expert-parallel
+    form, and ``shared_weights=(s_gate, s_up, s_down)`` moves the
+    shared-expert FFN inside the dispatch so its compute overlaps the
+    collectives (double-buffered halves — see docs/moe.md). With
+    ``shared_weights`` the returned ``y`` is routed + shared.
 
     "capacity": GShard fixed-capacity one-hot einsum dispatch [T,E,C];
     tokens past capacity are dropped. 'ep' sharding of the E axis makes
     GSPMD emit the all-to-alls."""
     c = config
-    weights, idx, aux = top_k_gating(
-        x.astype(jnp.float32) @ router_w.astype(jnp.float32), c.top_k)
     if c.routing == "dropless":
         from ..kernels import moe_dispatch as _md
         mesh = _llama._ACT_MESH
+        strategy = "single"
         if mesh is not None and dict(mesh.shape).get("ep", 1) > 1:
             strategy = c.ep_strategy
             if strategy == "auto":
                 strategy = ("a2a" if jax.default_backend() == "tpu"
                             else "psum")
+        # span = host-side build cost of this layer's routing+dispatch;
+        # the device time lives inside the compiled step program
+        with trace_span("moe.dispatch", strategy=strategy):
+            routing = _md.fused_routing(x, router_w, c.top_k)
+            weights, idx, aux = routing.weights, routing.idx, routing.aux
             if strategy == "a2a":
                 y = _md.dropless_moe_ffn_a2a(
                     x, weights, idx, e_gate, e_up, e_down, mesh,
-                    token_axes=("dp", "sp", "ep"))
+                    token_axes=("dp", "sp", "ep"), shared=shared_weights)
             elif strategy == "psum":
                 y = _md.dropless_moe_ffn_ep(
                     x, weights, idx, e_gate, e_up, e_down, mesh,
-                    token_axes=("dp", "sp"))
+                    token_axes=("dp", "sp"), shared=shared_weights)
+            elif strategy == "single":
+                if c.dense_base:
+                    y = _md.dropless_moe_ffn_dense(
+                        x, weights, idx, e_gate, e_up, e_down,
+                        routing=routing)
+                else:
+                    y = _md.dropless_moe_ffn(x, weights, idx, e_gate,
+                                             e_up, e_down, routing=routing)
+                if shared_weights is not None:
+                    # no collective to hide on a single program — XLA
+                    # schedules the shared FFN alongside the routed GEMMs
+                    y = y + _md._shared_swiglu(x, *shared_weights, x.dtype)
             else:
                 raise ValueError(f"ep_strategy={strategy!r}: expected "
                                  "'auto', 'a2a', or 'psum'")
-        elif c.dense_base:
-            y = _md.dropless_moe_ffn_dense(x, weights, idx, e_gate, e_up,
-                                           e_down)
-        else:
-            y = _md.dropless_moe_ffn(x, weights, idx, e_gate, e_up, e_down)
         return y, aux
     if c.routing != "capacity":
         raise ValueError(f"routing={c.routing!r}: expected 'dropless' or "
                          "'capacity'")
+    weights, idx, aux = top_k_gating(
+        x.astype(jnp.float32) @ router_w.astype(jnp.float32), c.top_k)
     T, h = x.shape
     E, k = c.num_experts, c.top_k
     C = max(1, int(c.capacity_factor * T * k / E))
@@ -304,6 +325,9 @@ def moe_ffn(x, router_w, e_gate, e_up, e_down, config: MoEConfig):
     up = jnp.einsum("ech,ehf->ecf", xe, e_up.astype(x.dtype))
     ye = jnp.einsum("ecf,efh->ech", gate * up, e_down.astype(x.dtype))
     y = jnp.einsum("tec,ech->th", comb, ye)                       # [T,h]
+    if shared_weights is not None:
+        from ..kernels.moe_dispatch import _shared_swiglu
+        y = y + _shared_swiglu(x, *shared_weights, x.dtype)
     return y, aux
 
 
@@ -328,17 +352,22 @@ def _layer_body(carry, layer_params, cos, sin, config: MoEConfig,
     x = _constrain(x)
 
     hn = _rms_norm(x, p["mlp_norm"], c.rms_eps)
-    # shared experts (always-on FFN)
-    sg = jax.nn.silu(hn @ p["s_gate"].astype(dt))
-    y = (sg * (hn @ p["s_up"].astype(dt))) @ p["s_down"].astype(dt)
     if not dense:
-        routed, aux = moe_ffn(hn.reshape(B * S, h), p["router"],
-                              p["e_gate"], p["e_up"], p["e_down"], c)
+        # the shared-expert FFN rides INSIDE moe_ffn so the expert-
+        # parallel dispatch overlaps it with the collectives; y is
+        # routed + shared
+        y, aux = moe_ffn(hn.reshape(B * S, h), p["router"],
+                         p["e_gate"], p["e_up"], p["e_down"], c,
+                         shared_weights=(p["s_gate"], p["s_up"],
+                                         p["s_down"]))
         # named so remat_policy="outs" keeps it: the grouped GEMMs are the
         # expensive recompute, [B,S,h] per layer the cheap residency
-        routed = checkpoint_name(routed, "routed_out")
-        y = y + routed.reshape(B, S, h)
+        y = checkpoint_name(y, "routed_out").reshape(B, S, h)
         aux_sum = aux_sum + aux
+    else:
+        # dense (non-MoE) layers: the shared FFN is the whole MLP
+        sg = jax.nn.silu(hn @ p["s_gate"].astype(dt))
+        y = (sg * (hn @ p["s_up"].astype(dt))) @ p["s_down"].astype(dt)
     x = x + y
     return (_constrain(x), aux_sum)
 

@@ -84,6 +84,25 @@ CATALOG = {
         "counter", (), "to_static calls that traced a new program"),
     "jit_compile_seconds": (
         "histogram", (), "trace+compile+first-run time of a new program"),
+    # -- MoE dispatch hot path (kernels/moe_dispatch, gmm_autotune) --------
+    "moe_tiling_cache_hits_total": (
+        "counter", (), "grouped-matmul tiling lookups served by a "
+                       "remembered winner (in-process or persisted)"),
+    "moe_tiling_cache_misses_total": (
+        "counter", (), "first-encounter tiling keys (each triggers one "
+                       "autotune or a heuristic fallback)"),
+    "moe_tiling_autotune_seconds": (
+        "histogram", (), "wall time of one candidate-grid measurement "
+                         "(fwd+dgrad+wgrad) for a new tiling key"),
+    "moe_plan_cache_hits_total": (
+        "counter", (), "MoE dispatch plans reused across layers/steps "
+                       "that share a routing shape"),
+    "moe_plan_cache_misses_total": (
+        "counter", (), "routing shapes that derived a fresh dispatch plan"),
+    "moe_dispatch_fallbacks_total": (
+        "counter", ("reason",),
+        "dispatch decisions off the fast path (shape_unaligned / "
+        "dense_buffer_too_big / ep_shape_mismatch)"),
 }
 
 # Histogram bucket overrides: (lo, hi, per_decade) for metrics whose
@@ -99,6 +118,11 @@ SPANS = (
     "serving.step", "serving.prefill", "serving.decode", "serving.readback",
     "train.run", "train.step", "train.checkpoint", "train.resume",
     "jit.compile",
+    # MoE hot path: moe.dispatch wraps one layer's routing+dispatch BUILD
+    # (host-side trace cost; the device time lives inside the compiled
+    # step), moe.autotune wraps a first-encounter tiling measurement,
+    # moe.gmm one candidate's timed run (real device time).
+    "moe.dispatch", "moe.autotune", "moe.gmm",
 )
 
 

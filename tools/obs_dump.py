@@ -15,6 +15,7 @@ Two modes (slow-lane tooling, like tools/chaos_run.py):
 
       JAX_PLATFORMS=cpu python tools/obs_dump.py --demo serving --out /tmp/obs
       JAX_PLATFORMS=cpu python tools/obs_dump.py --demo train --out /tmp/obs
+      JAX_PLATFORMS=cpu python tools/obs_dump.py --demo moe --out /tmp/obs
 """
 import argparse
 import os
@@ -67,6 +68,33 @@ def demo_serving():
           f"{sum(len(v) for v in results.values())} tokens")
 
 
+def demo_moe():
+    """Two dropless-MoE programs over one routing shape: the second is a
+    plan-cache hit — the table shows moe_plan_cache_{hits,misses}_total
+    and moe_dispatch_fallbacks_total, the trace the per-layer
+    moe.dispatch spans. (The moe_tiling_* counters need a TPU backend:
+    grouped_matmul only consults the autotuner there.)"""
+    import jax
+
+    from paddle_tpu.kernels import moe_dispatch
+    from paddle_tpu.models import moe
+
+    moe_dispatch.clear_plan_cache()
+    cfg = moe.tiny_moe()
+    state = moe.init_train_state(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0,
+                                cfg.vocab_size)
+    # two programs over the same routing shape: the eval trace derives
+    # the dispatch plan (miss), the grad trace reuses it (hit)
+    jax.jit(lambda p: moe.loss_fn(p, tokens, cfg))(state.params)
+    step = jax.jit(lambda p, t: jax.value_and_grad(
+        lambda p: moe.loss_fn(p, t, cfg))(p))
+    for _ in range(2):
+        loss, _grads = step(state.params, tokens)
+    print(f"demo moe: {cfg.num_layers} layers x {cfg.num_experts} experts, "
+          f"loss {float(loss):.3f}")
+
+
 def demo_train(workdir):
     import jax
     import jax.numpy as jnp
@@ -92,7 +120,8 @@ def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--snapshot", default=None,
                     help="print the table from an existing JSON snapshot")
-    ap.add_argument("--demo", choices=("serving", "train"), default=None,
+    ap.add_argument("--demo", choices=("serving", "train", "moe"),
+                    default=None,
                     help="run a tiny built-in workload with obs enabled")
     ap.add_argument("--out", default="./obs_dump",
                     help="demo mode: directory for snapshot.json/trace.json")
@@ -112,6 +141,8 @@ def main():
     os.makedirs(args.out, exist_ok=True)
     if args.demo == "serving":
         demo_serving()
+    elif args.demo == "moe":
+        demo_moe()
     else:
         demo_train(args.out)
     snap_path = obs.dump_snapshot(os.path.join(args.out, "snapshot.json"))
