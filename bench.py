@@ -420,14 +420,15 @@ def bench_serving(dev, results):
     cfg = _decode_cfg_2p6b()
     SLOTS, NEW = 8, 128
 
-    def attempt(tag, make_params):
+    def attempt(tag, make_params, kv_dtype=None):
         params = make_params()
         # decode_steps=64: one compiled call per 64 tokens/slot — measured
         # +30% engine throughput over 16 on the tunnel-attached chip
         # (admission granularity coarsens to 64, fine for throughput)
         eng = LLMEngine(params, cfg, max_slots=SLOTS, block_size=64,
                         max_model_len=1024,
-                        prompt_buckets=[128, 512, 1024], decode_steps=64)
+                        prompt_buckets=[128, 512, 1024], decode_steps=64,
+                        kv_dtype=kv_dtype)
         rng = np.random.default_rng(0)
         # warm: compile the touched prompt buckets + the decode program
         for ln in (100, 400):
@@ -465,6 +466,14 @@ def bench_serving(dev, results):
         _retry(lambda: attempt(
             "int8",
             lambda: jax.jit(llama.quantize_params)(_init_bf16_params(cfg))))
+        _release()
+        # int8 everywhere: int8 weights + int8 KV pools (per-entry-scaled,
+        # dequant fused into the bucketed decode attention) — halves the
+        # decode KV traffic on top of the halved weight bytes
+        _retry(lambda: attempt(
+            "int8_kv8",
+            lambda: jax.jit(llama.quantize_params)(_init_bf16_params(cfg)),
+            kv_dtype="int8"))
     except Exception as e:
         results.append({"metric": "serving_bench_failed", "value": 0.0,
                         "unit": "tokens/s", "vs_baseline": 0.0,

@@ -1,0 +1,10 @@
+"""TPU kernel library: attention (flash/ring/ulysses/paged), MoE dispatch,
+grouped-matmul autotuning, and int8 weight-only / KV quantized matmuls."""
+from .quant_matmul import (attn_pv, attn_qk, dequantize_kv,  # noqa: F401
+                           mixed_dot_supported, quantize_kv,
+                           weight_only_matmul)
+
+__all__ = [
+    "weight_only_matmul", "quantize_kv", "dequantize_kv",
+    "attn_qk", "attn_pv", "mixed_dot_supported",
+]

@@ -41,7 +41,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = [
     "LlamaConfig", "llama3_8b", "tiny_llama", "init_params", "forward",
-    "loss_fn", "param_specs", "make_shardings", "num_params",
+    "loss_fn", "param_specs", "make_shardings", "make_serving_shardings",
+    "num_params",
     "TrainState", "init_train_state", "train_step", "make_mesh",
 ]
 
@@ -178,7 +179,15 @@ def quantize_params(params, include_lm_head: bool = True):
 
 def _wmat(p, name, dt):
     """Weight leaf → dense matmul operand in ``dt``; dequantizes int8
-    weight-only leaves inline (XLA fuses it into the matmul)."""
+    weight-only leaves inline (XLA fuses it into the matmul).
+
+    NOTE: hot decode paths should prefer
+    ``kernels.quant_matmul.weight_only_matmul`` (used below by
+    ``forward_with_cache`` and by serving/engine.py), which feeds the
+    int8 matrix to the dot UNCONVERTED and applies the per-channel scale
+    to the output — this helper's explicit ``q * s`` epilogue can
+    materialize a full-width dequantized copy when XLA declines to fuse
+    it. Kept for cold paths (export tracing, debugging)."""
     w = p[name] if isinstance(name, str) else name
     if isinstance(w, dict) and "q" in w:
         return (w["q"].astype(jnp.float32)
@@ -289,6 +298,37 @@ def make_shardings(config: LlamaConfig, mesh: Mesh, fsdp: bool = True):
         lambda spec, arr: NamedSharding(mesh, _fit_spec(spec, arr.shape, mesh)),
         param_specs(config, fsdp), shapes,
         is_leaf=lambda x: isinstance(x, P))
+
+
+def make_serving_shardings(params, config: LlamaConfig, mesh: Mesh,
+                           fsdp: bool = False):
+    """``make_shardings`` generalized over the ACTUAL param tree, so int8
+    weight-only params (quantize_params) shard for tp serving: each
+    quantized leaf's ``q`` matrix takes the dense weight's Megatron spec
+    and its per-output-channel ``s`` vector keeps the spec of the OUTPUT
+    axis (sharded over 'tp' for column-parallel qkv/gate/up and lm_head,
+    replicated for row-parallel wo/down whose outputs are not
+    tp-sharded) — the scale always lives with the channels it scales, so
+    the weight-only dot needs no extra collectives."""
+    dense = param_specs(config, fsdp)
+
+    def one(spec, leaf):
+        if isinstance(leaf, dict) and "q" in leaf:
+            s_spec = (P(spec[0], spec[-1]) if leaf["q"].ndim == 3
+                      else P(spec[-1]))
+            return {"q": NamedSharding(
+                        mesh, _fit_spec(spec, leaf["q"].shape, mesh)),
+                    "s": NamedSharding(
+                        mesh, _fit_spec(s_spec, leaf["s"].shape, mesh))}
+        return NamedSharding(mesh, _fit_spec(spec, leaf.shape, mesh))
+
+    out = {"embed": one(dense["embed"], params["embed"]),
+           "layers": {k: one(dense["layers"][k], params["layers"][k])
+                      for k in params["layers"]},
+           "final_norm": one(dense["final_norm"], params["final_norm"])}
+    if "lm_head" in params:
+        out["lm_head"] = one(dense["lm_head"], params["lm_head"])
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -781,27 +821,35 @@ def forward_with_cache(params, tokens, cache, config: LlamaConfig):
     # updates on the STACKED arrays — XLA aliases them in place inside the
     # fused decode while_loop; a rebuild (stack of per-layer copies) would
     # move the whole multi-GB cache through HBM every step.
+    # Weight matmuls go through weight_only_matmul: int8 weight-only
+    # leaves contract unconverted with the scale applied to the output —
+    # the weight-bandwidth-bound decode step reads half the bytes and
+    # never materializes a dequantized weight copy.
+    from ..kernels.quant_matmul import weight_only_matmul as _wo_mm
+
     ck, cv = cache["k"], cache["v"]
     for l in range(c.num_layers):
         p = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
         hn = _rms_norm(x, p["attn_norm"], c.rms_eps)
-        q = (hn @ _wmat(p, "wq", dt)).reshape(B, S, c.num_heads, c.head_dim)
-        k = (hn @ _wmat(p, "wk", dt)).reshape(B, S, c.num_kv_heads, c.head_dim)
-        v = (hn @ _wmat(p, "wv", dt)).reshape(B, S, c.num_kv_heads, c.head_dim)
+        q = _wo_mm(hn, p["wq"], dt).reshape(B, S, c.num_heads, c.head_dim)
+        k = _wo_mm(hn, p["wk"], dt).reshape(B, S, c.num_kv_heads, c.head_dim)
+        v = _wo_mm(hn, p["wv"], dt).reshape(B, S, c.num_kv_heads, c.head_dim)
         q = _apply_rope(q, cos, sin)
         k = _apply_rope(k, cos, sin)
         ck = jax.lax.dynamic_update_slice(ck, k[None], (l, 0, pos, 0, 0))
         cv = jax.lax.dynamic_update_slice(cv, v[None], (l, 0, pos, 0, 0))
         att = _cached_attention(q, ck[l], cv[l], pos, c)
-        x = x + att.reshape(B, S, c.num_heads * c.head_dim) @ _wmat(p, "wo", dt)
+        x = x + _wo_mm(att.reshape(B, S, c.num_heads * c.head_dim),
+                       p["wo"], dt)
         hn = _rms_norm(x, p["mlp_norm"], c.rms_eps)
-        gate = jax.nn.silu(hn @ _wmat(p, "w_gate", dt))
-        x = x + (gate * (hn @ _wmat(p, "w_up", dt))) @ _wmat(p, "w_down", dt)
+        gate = jax.nn.silu(_wo_mm(hn, p["w_gate"], dt))
+        x = x + _wo_mm(gate * _wo_mm(hn, p["w_up"], dt), p["w_down"], dt)
 
     x = _rms_norm(x, params["final_norm"], c.rms_eps)
-    head = (params["embed"].astype(dt).T if c.tie_embeddings
-            else _wmat(params, "lm_head", dt))
-    logits = (x[:, -1] @ head).astype(jnp.float32)
+    if c.tie_embeddings:
+        logits = (x[:, -1] @ params["embed"].astype(dt).T).astype(jnp.float32)
+    else:
+        logits = _wo_mm(x[:, -1], params["lm_head"], dt).astype(jnp.float32)
     cache = {"k": ck, "v": cv, "pos": pos + S}
     return logits, cache
 

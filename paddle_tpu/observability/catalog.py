@@ -39,6 +39,17 @@ CATALOG = {
     # ^ throughput, not a duration: gets its own bucket range below
     "serving_step_seconds": (
         "histogram", (), "wall time of one LLMEngine.step call"),
+    "serving_decode_prefix_bucket": (
+        "gauge", (), "ragged prefix horizon (tokens) of the decode "
+                     "variant dispatched last — power-of-two block "
+                     "buckets over max(lengths)+decode_steps"),
+    "serving_decode_recompiles_total": (
+        "counter", (), "decode program variants compiled "
+                       "((prefix bucket, sampling flags) tuples; bounded "
+                       "at log2(blocks/slot) x 8)"),
+    "serving_decode_kv_read_bytes": (
+        "gauge", (), "K/V pool bytes one decode call gathers at the "
+                     "current prefix bucket (int8 pools halve this)"),
     # -- training (ResilientTrainLoop) ------------------------------------
     "train_steps_total": (
         "counter", (), "committed optimizer steps"),

@@ -7,10 +7,20 @@ behavioral model its serving stacks build on top.
 
 TPU-native design — everything the chip executes has STATIC shapes:
 
-- ONE compiled decode step over ``max_slots`` sequence slots. A slot is a
-  row of the batch; requests come and go, the program never retraces. Idle
-  slots write their K/V to a reserved trash block and are masked out of
-  sampling — XLA sees the same program every step.
+- ONE compiled decode step PER PREFIX BUCKET over ``max_slots`` sequence
+  slots. A slot is a row of the batch; requests come and go, the program
+  never retraces on slot churn. Idle slots write their K/V to a reserved
+  trash block and are masked out of sampling.
+- Ragged/length-bucketed prefix attention: the decode call's dense prefix
+  gather spans only the smallest power-of-two BLOCK COUNT covering
+  ``max(lengths) + decode_steps`` across the active slots (plus the
+  in-flight pipeline lag), not the ``max_model_len`` allocation maximum —
+  short-context steady state stops paying full-model-len gather bandwidth
+  and attention FLOPs. The bucket is picked host-side from the engine's
+  exact ``self.lengths``; the compiled-variant set stays bounded at
+  (log2 buckets) x (<= 8 sampling-flag tuples), mirrored by the
+  ``serving_decode_prefix_bucket`` gauge and the
+  ``serving_decode_recompiles_total`` counter.
 - Bucketed prefill: prompts pad to the smallest configured bucket, one
   compiled program per bucket (the guard-cache analogue of the reference's
   shape-bucketed serving graphs). Prefill K/V is scattered straight into
@@ -22,6 +32,15 @@ TPU-native design — everything the chip executes has STATIC shapes:
   pool runs dry mid-decode the newest-admitted request is preempted (blocks
   freed, request re-queued for a fresh prefill) — forward progress for the
   rest, vLLM's recompute-preemption policy.
+- int8 everywhere (optional, decode is weight/KV-bandwidth-bound):
+  int8 weight-only params (models/llama.quantize_params) feed the matmuls
+  UNCONVERTED via kernels/quant_matmul.weight_only_matmul — scales apply
+  to the output, no dequantized weight copy per step — including under a
+  'tp' mesh (the int8 qweights + scales shard with the same Megatron
+  specs as their dense counterparts). ``kv_dtype="int8"`` additionally
+  quantizes the K/V pools with per-entry scales dequantized inside the
+  bucketed attention contractions: half the decode KV traffic, double the
+  effective block-pool capacity at the same HBM (fewer preemptions).
 - Per-request sampling knobs (temperature/top-k/top-p) ride as traced
   vectors through the compiled step: varying them never recompiles.
 - Pools are donated through both prefill and decode (jax donate_argnums),
@@ -41,6 +60,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import observability as _obs
+from ..kernels.quant_matmul import (attn_pv, attn_qk, quantize_kv,
+                                    weight_only_matmul as _wo_mm)
 from ..models.llama import (LlamaConfig, _apply_rope, _attention,
                             _rms_norm, _wmat)  # noqa: F401
 from ..observability import trace_span
@@ -61,6 +82,9 @@ _M_TOKENS = _instrument("serving_tokens_total")
 _M_TTFT = _instrument("serving_ttft_seconds")
 _M_TPS = _instrument("serving_tokens_per_second")
 _M_STEP_SECONDS = _instrument("serving_step_seconds")
+_M_PREFIX_BUCKET = _instrument("serving_decode_prefix_bucket")
+_M_DECODE_RECOMPILES = _instrument("serving_decode_recompiles_total")
+_M_KV_READ_BYTES = _instrument("serving_decode_kv_read_bytes")
 
 
 @dataclasses.dataclass
@@ -141,9 +165,9 @@ def _apply_admissions(c_last, c_len, c_done, c_rem, wave_toks, slot_of_row,
     return c_last, c_len, c_done, c_rem
 
 
-def _paged_prefill(params, tokens, blk_ids, true_len, k_pool, v_pool,
+def _paged_prefill(params, tokens, blk_ids, true_len, pools,
                    temps, top_ks, top_ps, key, *, config: LlamaConfig,
-                   sample_flags=(True, True, True)):
+                   sample_flags=(True, True, True), kv_int8: bool = False):
     """Prefill a WAVE of admissions in one compiled program: causal
     forward over the padded prompt batch, every layer's K/V written into
     the slots' pool blocks by ONE batched scatter, and each request's
@@ -151,8 +175,10 @@ def _paged_prefill(params, tokens, blk_ids, true_len, k_pool, v_pool,
 
     tokens: [B, S_bucket]; blk_ids: [B, S_bucket // bs] physical block
     ids (0 = trash block for pad rows / the pad tail); true_len: [B];
-    temps/top_ks/top_ps: [B] sampling knobs. Returns
-    (first_tokens [B] int32, k_pool, v_pool).
+    temps/top_ks/top_ps: [B] sampling knobs; pools: the donated pool dict
+    ({"k", "v"} [L, NB, bs, Hkv, D] — plus per-entry f32 scale pools
+    {"ks", "vs"} [L, NB, bs, Hkv] when ``kv_int8``). Returns
+    (first_tokens [B] int32, pools).
 
     The engine pads every multi-admission wave to ``max_slots`` rows
     (single admissions use a dedicated B=1 variant — steady-state churn
@@ -173,7 +199,7 @@ def _paged_prefill(params, tokens, blk_ids, true_len, k_pool, v_pool,
     c = config
     dt = c.dtype
     B, S = tokens.shape
-    bs = k_pool.shape[2]
+    bs = pools["k"].shape[2]
     nb = S // bs
     x = params["embed"].astype(dt)[tokens]
     pos = jnp.arange(S, dtype=jnp.float32)
@@ -186,11 +212,11 @@ def _paged_prefill(params, tokens, blk_ids, true_len, k_pool, v_pool,
     for l in range(c.num_layers):
         p = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
         hn = _rms_norm(x, p["attn_norm"], c.rms_eps)
-        q = (hn @ _wmat(p, "wq", dt)).reshape(B, S, c.num_heads, c.head_dim)
-        k = (hn @ _wmat(p, "wk", dt)).reshape(B, S, c.num_kv_heads,
-                                              c.head_dim)
-        v = (hn @ _wmat(p, "wv", dt)).reshape(B, S, c.num_kv_heads,
-                                              c.head_dim)
+        q = _wo_mm(hn, p["wq"], dt).reshape(B, S, c.num_heads, c.head_dim)
+        k = _wo_mm(hn, p["wk"], dt).reshape(B, S, c.num_kv_heads,
+                                            c.head_dim)
+        v = _wo_mm(hn, p["wv"], dt).reshape(B, S, c.num_kv_heads,
+                                            c.head_dim)
         q = _apply_rope(q, cos, sin)
         k = _apply_rope(k, cos, sin)
         k_all.append(k)
@@ -198,10 +224,10 @@ def _paged_prefill(params, tokens, blk_ids, true_len, k_pool, v_pool,
         # plain causal GQA attention — the model's own core (llama._attention)
         att = _attention(q, k, v, c).reshape(B, S,
                                              c.num_heads * c.head_dim)
-        x = x + att @ _wmat(p, "wo", dt)
+        x = x + _wo_mm(att, p["wo"], dt)
         hn = _rms_norm(x, p["mlp_norm"], c.rms_eps)
-        gate = jax.nn.silu(hn @ _wmat(p, "w_gate", dt))
-        x = x + (gate * (hn @ _wmat(p, "w_up", dt))) @ _wmat(p, "w_down", dt)
+        gate = jax.nn.silu(_wo_mm(hn, p["w_gate"], dt))
+        x = x + _wo_mm(gate * _wo_mm(hn, p["w_up"], dt), p["w_down"], dt)
 
     # hoisted writeback: all layers' K/V in ONE scatter per pool (the
     # per-layer Pallas/XLA block appends cost ~0.6 ms of launch overhead
@@ -212,22 +238,32 @@ def _paged_prefill(params, tokens, blk_ids, true_len, k_pool, v_pool,
                                        c.head_dim)
     v_stack = jnp.stack(v_all).reshape(L, B * nb, bs, c.num_kv_heads,
                                        c.head_dim)
-    k_pool = k_pool.at[:, flat].set(k_stack)
-    v_pool = v_pool.at[:, flat].set(v_stack)
+    pools = dict(pools)
+    if kv_int8:
+        qk, sk = quantize_kv(k_stack)
+        qv, sv = quantize_kv(v_stack)
+        pools["k"] = pools["k"].at[:, flat].set(qk)
+        pools["v"] = pools["v"].at[:, flat].set(qv)
+        pools["ks"] = pools["ks"].at[:, flat].set(sk)
+        pools["vs"] = pools["vs"].at[:, flat].set(sv)
+    else:
+        pools["k"] = pools["k"].at[:, flat].set(k_stack)
+        pools["v"] = pools["v"].at[:, flat].set(v_stack)
 
     x = _rms_norm(x, params["final_norm"], c.rms_eps)
-    head = (params["embed"].astype(dt).T if c.tie_embeddings
-            else _wmat(params, "lm_head", dt))
     last_h = x[jnp.arange(B), jnp.maximum(true_len - 1, 0)]
-    logits = (last_h @ head).astype(jnp.float32)
+    if c.tie_embeddings:
+        logits = (last_h @ params["embed"].astype(dt).T).astype(jnp.float32)
+    else:
+        logits = _wo_mm(last_h, params["lm_head"], dt).astype(jnp.float32)
     toks = _sample_rows(logits, key, temps, top_ks, top_ps, *sample_flags)
-    return toks, k_pool, v_pool
+    return toks, pools
 
 
 def _paged_decode(params, last_tokens, lengths, done0, budgets, key, active,
-                  block_table, k_pool, v_pool, temps, top_ks, top_ps,
+                  block_table, pools, temps, top_ks, top_ps,
                   eos_ids, *, config: LlamaConfig, n_steps: int,
-                  sample_flags=(True, True, True)):
+                  sample_flags=(True, True, True), kv_int8: bool = False):
     """``n_steps`` decode iterations in ONE compiled program (multi-step
     scheduling): the host loop syncs once per call instead of once per
     token — through a remote-attached chip the per-step d2h round-trip
@@ -245,6 +281,20 @@ def _paged_decode(params, last_tokens, lengths, done0, budgets, key, active,
     the pools in ONE batched scatter at call end. Zero kernel launches
     inside the scan; per-step cost matches the fixed-batch fused loop.
 
+    Ragged prefix bucketing (r6): ``block_table`` arrives SLICED to the
+    engine-chosen bucket [N, MB_bucket], so P = MB_bucket * bs covers only
+    ``max(lengths) + n_steps`` (rounded to a power-of-two block count) —
+    the gather, the scores, and the PV contraction all scale with the
+    ACTUAL ragged horizon instead of max_model_len. Exactness: every
+    position >= a slot's length was masked to -1e30 before the softmax,
+    so dropping it changes nothing (exp underflows to exactly 0.0).
+
+    int8 KV pools (``kv_int8``): the gathered prefix stays int8 through
+    the QK/PV contractions with per-entry scales applied to the f32
+    scores resp. folded into the probabilities (kernels/quant_matmul) —
+    half the gather/attention KV bytes. The in-call ring stays model
+    dtype and is quantized once at writeback.
+
     The (last, lengths, done, budgets, key) quintet is a device-resident
     carry: the engine feeds each call the previous call's outputs
     untouched while the slot composition is unchanged, so steady-state
@@ -257,12 +307,13 @@ def _paged_decode(params, last_tokens, lengths, done0, budgets, key, active,
 
     eos_ids: [N] (-1 = no eos); budgets: [N] tokens each slot may still
     emit. Returns (emitted [n_steps, N] int32 with -1 padding, last,
-    lengths, done, budgets, key, k_pool, v_pool).
+    lengths, done, budgets, key, pools).
     """
     c = config
     dt = c.dtype
     Lc = c.num_layers
     N, MB = block_table.shape
+    k_pool, v_pool = pools["k"], pools["v"]
     bs = k_pool.shape[2]
     Hkv, D = k_pool.shape[3], k_pool.shape[4]
     G = c.num_heads // c.num_kv_heads
@@ -272,8 +323,12 @@ def _paged_decode(params, last_tokens, lengths, done0, budgets, key, active,
     scale = 1.0 / math.sqrt(D)
 
     # ---- hoist: one dense gather of every slot's (frozen) prefix --------
+    # (int8 pools: the dense arrays stay int8 — half the bytes moved)
     kd = k_pool[:, block_table].reshape(Lc, N, P, Hkv, D)
     vd = v_pool[:, block_table].reshape(Lc, N, P, Hkv, D)
+    if kv_int8:
+        ksc = pools["ks"][:, block_table].reshape(Lc, N, P, Hkv)
+        vsc = pools["vs"][:, block_table].reshape(Lc, N, P, Hkv)
     pre_mask = (jnp.arange(P)[None, :]
                 < lens0[:, None])[:, None, None, :]       # [N,1,1,P]
 
@@ -287,8 +342,16 @@ def _paged_decode(params, last_tokens, lengths, done0, budgets, key, active,
         ss = jnp.sin(ang)[:, None, :].astype(t.dtype)
         return jnp.concatenate([t1 * cc - t2 * ss, t2 * cc + t1 * ss], -1)
 
-    head_w = (params["embed"].astype(dt).T if c.tie_embeddings
-              else _wmat(params, "lm_head", dt))
+    # hoist the dense head operand (incl. its dtype convert) out of the
+    # scan — XLA does not lift the loop-invariant [hidden, vocab] astype
+    # out of the body on its own. An int8 weight-only lm_head has nothing
+    # to hoist: it contracts unconverted in-body (weight_only_matmul).
+    if c.tie_embeddings:
+        head_w = params["embed"].astype(dt).T
+    elif not isinstance(params["lm_head"], dict):
+        head_w = params["lm_head"].astype(dt)
+    else:
+        head_w = None
 
     def body(carry, t):
         last, lens, done, rem, rk, rv, k = carry
@@ -300,9 +363,9 @@ def _paged_decode(params, last_tokens, lengths, done0, budgets, key, active,
         for l in range(Lc):
             p = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
             hn = _rms_norm(x, p["attn_norm"], c.rms_eps)
-            q = (hn[:, 0] @ _wmat(p, "wq", dt)).reshape(N, Hkv * G, D)
-            kk = (hn[:, 0] @ _wmat(p, "wk", dt)).reshape(N, Hkv, D)
-            vv = (hn[:, 0] @ _wmat(p, "wv", dt)).reshape(N, Hkv, D)
+            q = _wo_mm(hn[:, 0], p["wq"], dt).reshape(N, Hkv * G, D)
+            kk = _wo_mm(hn[:, 0], p["wk"], dt).reshape(N, Hkv, D)
+            vv = _wo_mm(hn[:, 0], p["wv"], dt).reshape(N, Hkv, D)
             q, kk = rope1(q, ang), rope1(kk, ang)
             # uniform step index: dynamic_update_slice, never a scatter
             rk = jax.lax.dynamic_update_slice(
@@ -310,27 +373,30 @@ def _paged_decode(params, last_tokens, lengths, done0, budgets, key, active,
             rv = jax.lax.dynamic_update_slice(
                 rv, vv[None, :, None], (l, 0, t, 0, 0))
             qg = q.reshape(N, Hkv, G, D)
-            s_pre = jnp.einsum("nhgd,nphd->nhgp", qg, kd[l],
-                               preferred_element_type=jnp.float32) * scale
+            s_pre = attn_qk(qg, kd[l], ksc[l] if kv_int8 else None) * scale
             s_rng = jnp.einsum("nhgd,nshd->nhgs", qg, rk[l],
                                preferred_element_type=jnp.float32) * scale
             s_pre = jnp.where(pre_mask, s_pre, -1e30)
             s_rng = jnp.where(ring_mask, s_rng, -1e30)
             probs = jax.nn.softmax(
                 jnp.concatenate([s_pre, s_rng], axis=-1), axis=-1)
-            p_pre = probs[..., :P].astype(dt)
             p_rng = probs[..., P:].astype(dt)
-            att = (jnp.einsum("nhgp,nphd->nhgd", p_pre, vd[l])
+            att = (attn_pv(probs[..., :P], vd[l],
+                           vsc[l] if kv_int8 else None, out_dtype=dt)
                    + jnp.einsum("nhgs,nshd->nhgd", p_rng, rv[l]))
             att = att.reshape(N, 1, Hkv * G * D).astype(dt)
-            x = x + att @ _wmat(p, "wo", dt)
+            x = x + _wo_mm(att, p["wo"], dt)
             hn = _rms_norm(x, p["mlp_norm"], c.rms_eps)
-            gate = jax.nn.silu(hn @ _wmat(p, "w_gate", dt))
-            x = x + (gate * (hn @ _wmat(p, "w_up", dt))) @ _wmat(
-                p, "w_down", dt)
+            gate = jax.nn.silu(_wo_mm(hn, p["w_gate"], dt))
+            x = x + _wo_mm(gate * _wo_mm(hn, p["w_up"], dt),
+                           p["w_down"], dt)
 
         xf = _rms_norm(x, params["final_norm"], c.rms_eps)
-        logits = (xf[:, 0] @ head_w).astype(jnp.float32)
+        if head_w is not None:
+            logits = (xf[:, 0] @ head_w).astype(jnp.float32)
+        else:
+            logits = _wo_mm(xf[:, 0], params["lm_head"],
+                            dt).astype(jnp.float32)
         nxt = _sample_rows(logits, sub, temps, top_ks, top_ps,
                            *sample_flags)
         emitted = jnp.where(act, nxt, -1)
@@ -356,10 +422,18 @@ def _paged_decode(params, last_tokens, lengths, done0, budgets, key, active,
     phys = jnp.take_along_axis(block_table, log_blk, axis=1)
     phys = jnp.where(valid, phys, 0)                      # trash block 0
     off = pos % bs
-    k_pool = k_pool.at[:, phys, off].set(ring_k)
-    v_pool = v_pool.at[:, phys, off].set(ring_v)
-    return (emitted, last_tokens, lens_end, done0, budgets, key,
-            k_pool, v_pool)
+    pools = dict(pools)
+    if kv_int8:
+        rq_k, rs_k = quantize_kv(ring_k)
+        rq_v, rs_v = quantize_kv(ring_v)
+        pools["k"] = pools["k"].at[:, phys, off].set(rq_k)
+        pools["v"] = pools["v"].at[:, phys, off].set(rq_v)
+        pools["ks"] = pools["ks"].at[:, phys, off].set(rs_k)
+        pools["vs"] = pools["vs"].at[:, phys, off].set(rs_v)
+    else:
+        pools["k"] = pools["k"].at[:, phys, off].set(ring_k)
+        pools["v"] = pools["v"].at[:, phys, off].set(ring_v)
+    return (emitted, last_tokens, lens_end, done0, budgets, key, pools)
 
 
 # ---------------------------------------------------------------------------
@@ -380,11 +454,17 @@ class LLMEngine:
                  block_size: int = 16, max_model_len: int = 512,
                  num_blocks: Optional[int] = None,
                  prompt_buckets: Optional[List[int]] = None, seed: int = 0,
-                 mesh=None, decode_steps: int = 1):
-        """``mesh``: an optional jax Mesh with a 'tp' axis — weights take
-        the model's Megatron shardings (llama.param_specs), the KV pools
-        shard their kv-head dim over 'tp', and GSPMD inserts the serving
-        collectives (the reference's multi-GPU serving via mp_degree).
+                 mesh=None, decode_steps: int = 1, kv_dtype=None):
+        """``params`` may be dense (bf16/f32) or int8 weight-only
+        (llama.quantize_params) — quantized leaves feed the decode/prefill
+        matmuls unconverted (kernels/quant_matmul.weight_only_matmul).
+
+        ``mesh``: an optional jax Mesh with a 'tp' axis — weights take
+        the model's Megatron shardings (llama.make_serving_shardings;
+        int8 qweights + scales shard with the same specs as their dense
+        counterparts), the KV pools shard their kv-head dim over 'tp',
+        and GSPMD inserts the serving collectives (the reference's
+        multi-GPU serving via mp_degree).
 
         ``decode_steps``: decode iterations fused into one compiled call
         (multi-step scheduling). 1 = a host sync per token (exact
@@ -392,12 +472,17 @@ class LLMEngine:
         ~an order of magnitude on remote-attached chips — admission and
         slot reclamation then happen every K tokens.
 
+        ``kv_dtype``: ``None`` keeps the pools in the model dtype;
+        ``"int8"`` quantizes them with per-entry scales (dequant fused
+        into the bucketed attention contractions) — half the decode KV
+        traffic and double the effective block capacity at the same HBM.
+
         Pipelining caveat: the engine dispatches call k+1 before reading
         call k's tokens only when every in-flight slot is GUARANTEED
         alive through call k (``_spec_safe``) — which requires
         ``eos_token_id`` unset, since an eos can finish a slot at any
-        step. Workloads where every request carries an eos therefore run
-        with a synchronous readback between calls (today's r3 behavior);
+        step. Workloads where every request carries an eos run with a
+        synchronous readback between decode calls instead;
         ``decode_steps`` remains the amortization lever there."""
         c = config
         assert max_model_len % block_size == 0
@@ -422,10 +507,23 @@ class LLMEngine:
                 raise ValueError(
                     f"prompt bucket {b} is not a multiple of "
                     f"block_size {block_size}")
+        if kv_dtype not in (None, "int8", jnp.int8):
+            raise ValueError(
+                f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
+        self.kv_int8 = kv_dtype is not None
         pool_shape = (c.num_layers, self.nb, block_size, c.num_kv_heads,
                       c.head_dim)
-        self.k_pool = jnp.zeros(pool_shape, c.dtype)
-        self.v_pool = jnp.zeros(pool_shape, c.dtype)
+        if self.kv_int8:
+            # int8 payload + f32 per-entry scales (~3% overhead at D=128)
+            self.pools = {
+                "k": jnp.zeros(pool_shape, jnp.int8),
+                "v": jnp.zeros(pool_shape, jnp.int8),
+                "ks": jnp.zeros(pool_shape[:-1], jnp.float32),
+                "vs": jnp.zeros(pool_shape[:-1], jnp.float32),
+            }
+        else:
+            self.pools = {"k": jnp.zeros(pool_shape, c.dtype),
+                          "v": jnp.zeros(pool_shape, c.dtype)}
         self.mesh = mesh
         if mesh is not None:
             from jax.sharding import NamedSharding
@@ -437,15 +535,14 @@ class LLMEngine:
             if c.num_kv_heads % max(tp, 1):
                 raise ValueError(
                     f"tp={tp} must divide num_kv_heads={c.num_kv_heads}")
-            if isinstance(params["layers"].get("wq"), dict):
-                raise NotImplementedError(
-                    "tp-sharded serving of int8 weight-only params is not "
-                    "wired yet — pass dense (bf16) params with a mesh")
             self.params = params = jax.device_put(
-                params, _llama.make_shardings(c, mesh, fsdp=False))
+                params, _llama.make_serving_shardings(params, c, mesh,
+                                                      fsdp=False))
             pool_sh = NamedSharding(mesh, P(None, None, None, "tp", None))
-            self.k_pool = jax.device_put(self.k_pool, pool_sh)
-            self.v_pool = jax.device_put(self.v_pool, pool_sh)
+            scale_sh = NamedSharding(mesh, P(None, None, None, "tp"))
+            self.pools = {
+                k: jax.device_put(v, pool_sh if v.ndim == 5 else scale_sh)
+                for k, v in self.pools.items()}
         self.free_blocks = deque(range(1, self.nb))
         self.table = np.zeros((self.N, self.mb), np.int32)
         self.n_alloc = np.zeros(self.N, np.int64)  # backed logical blocks
@@ -459,8 +556,11 @@ class LLMEngine:
         self._key = jax.random.PRNGKey(seed)
         self._prefill = {}
         self.decode_steps = max(1, int(decode_steps))
-        # one compiled decode variant per sampling-feature tuple (≤8): an
-        # all-greedy slot mix must not pay top-k/top-p's full-vocab sorts
+        # one compiled decode variant per (prefix-bucket, sampling-flag)
+        # tuple — flags stay ≤8 (an all-greedy slot mix must not pay
+        # top-k/top-p's full-vocab sorts) and prefix buckets are
+        # power-of-two block counts (≤ log2(mb)+2 values), so the variant
+        # set is bounded however the workload mixes lengths
         self._decode_cache: Dict = {}
         # device-resident decode carry (last/lengths/done/budgets/key) +
         # static per-slot vectors; the carry chains from call to call and
@@ -469,7 +569,7 @@ class LLMEngine:
         self._slot_vecs = None
         self._slots_dirty = True
         self._table_dirty = True
-        self._table_dev = None
+        self._table_dev = {}         # prefix-bucket (blocks) → device table
         # the dispatched-but-unread decode call (pipeline depth 1): its
         # tokens are fetched while the NEXT call occupies the chip
         self._inflight = None
@@ -481,6 +581,14 @@ class LLMEngine:
         self._obs_t_add: Dict[int, float] = {}
 
     # -- public api ---------------------------------------------------------
+    @property
+    def k_pool(self):
+        return self.pools["k"]
+
+    @property
+    def v_pool(self):
+        return self.pools["v"]
+
     def add_request(self, prompt: List[int], **kw) -> int:
         rid = self._next_id
         self._next_id += 1
@@ -524,8 +632,9 @@ class LLMEngine:
         if fn is None:
             fn = jax.jit(functools.partial(_paged_prefill,
                                            config=self.config,
-                                           sample_flags=flags),
-                         donate_argnums=(4, 5))
+                                           sample_flags=flags,
+                                           kv_int8=self.kv_int8),
+                         donate_argnums=(4,))
             self._prefill[key] = fn
         return fn
 
@@ -631,10 +740,9 @@ class LLMEngine:
         self._key, sub = jax.random.split(self._key)
         with trace_span("serving.prefill", bucket=bucket, batch=B,
                         wave=len(wave)):
-            tok_dev, self.k_pool, self.v_pool = self._prefill_fn(
-                bucket, B, flags)(
+            tok_dev, self.pools = self._prefill_fn(bucket, B, flags)(
                 self.params, jnp.asarray(toks), jnp.asarray(blk_ids),
-                jnp.asarray(true_lens), self.k_pool, self.v_pool,
+                jnp.asarray(true_lens), self.pools,
                 jnp.asarray(temps), jnp.asarray(top_ks),
                 jnp.asarray(top_ps), sub)
         _M_ADMISSIONS.inc(len(wave))
@@ -806,6 +914,29 @@ class LLMEngine:
                                jnp.asarray(eos_ids))
             self._slots_dirty = False
 
+    def _prefix_blocks(self, active_slots) -> int:
+        """Pick the decode call's prefix horizon: the smallest
+        power-of-two BLOCK COUNT covering ``max(lengths) + decode_steps``
+        over the active slots — from the engine's exact host lengths,
+        plus the pipeline lag (an unread in-flight call may already have
+        appended up to ``decode_steps`` tokens beyond the host's view for
+        the slots in its snapshot). Power-of-two rounding keeps the
+        compiled-variant set logarithmic in ``mb`` while amortizing
+        growth recompiles."""
+        prev = self._inflight
+        snap = ({s for s, _ in prev["snapshot"]} if prev is not None
+                else ())
+        hmax = need = 0
+        for i in active_slots:
+            h = int(self.lengths[i]) + (self.decode_steps if i in snap
+                                        else 0)
+            hmax = max(hmax, h)
+            need = max(need, int(self.n_alloc[i]))
+        horizon = min(hmax + self.decode_steps, self.max_model_len)
+        need = max(1, need, -(-horizon // self.bs))
+        nbk = 1 << (need - 1).bit_length()
+        return min(nbk, self.mb)        # mb >= need, so the clamp is safe
+
     def _dispatch_decode(self, active_slots):
         """Enqueue one multi-step decode call and record it as in-flight.
         rem_start tracks each slot's EXACT remaining budget at the start
@@ -823,9 +954,14 @@ class LLMEngine:
             else:
                 rem_start[i] = req.max_new_tokens - len(req.generated) \
                     - len(self.slot_out[i])
-        if self._table_dirty or self._table_dev is None:
-            self._table_dev = jnp.asarray(self.table)
+        nbk = self._prefix_blocks(active_slots)
+        if self._table_dirty:
+            self._table_dev = {}
             self._table_dirty = False
+        tbl = self._table_dev.get(nbk)
+        if tbl is None:
+            # host-side slice: one tiny h2d per (table change, bucket)
+            tbl = self._table_dev[nbk] = jnp.asarray(self.table[:, :nbk])
         c_last, c_len, c_done, c_rem, c_key = self._carry
         v_act, v_t, v_k, v_p, v_eos = self._slot_vecs
         reqs = [self.slot_req[i] for i in active_slots]
@@ -835,20 +971,27 @@ class LLMEngine:
                                  if r.temperature > 0),
                  sampled and any(r.top_p < 1.0 for r in reqs
                                  if r.temperature > 0))
-        decode = self._decode_cache.get(flags)
+        decode = self._decode_cache.get((nbk, flags))
         if decode is None:
-            decode = self._decode_cache[flags] = jax.jit(
+            decode = self._decode_cache[(nbk, flags)] = jax.jit(
                 functools.partial(_paged_decode, config=self.config,
                                   n_steps=self.decode_steps,
-                                  sample_flags=flags),
-                donate_argnums=(8, 9))
+                                  sample_flags=flags,
+                                  kv_int8=self.kv_int8),
+                donate_argnums=(8,))
+            _M_DECODE_RECOMPILES.inc()
+        if _obs.enabled():
+            _M_PREFIX_BUCKET.set(nbk * self.bs)
+            _M_KV_READ_BYTES.set(sum(
+                a.shape[0] * self.N * nbk
+                * int(np.prod(a.shape[2:])) * a.dtype.itemsize
+                for a in self.pools.values()))
         with trace_span("serving.decode", slots=len(active_slots),
-                        steps=self.decode_steps):
-            (toks, c_last, c_len, c_done, c_rem, c_key, self.k_pool,
-             self.v_pool) = decode(
+                        steps=self.decode_steps, prefix_bucket=nbk * self.bs):
+            (toks, c_last, c_len, c_done, c_rem, c_key,
+             self.pools) = decode(
                 self.params, c_last, c_len, c_done, c_rem, c_key, v_act,
-                self._table_dev, self.k_pool, self.v_pool, v_t, v_k, v_p,
-                v_eos)
+                tbl, self.pools, v_t, v_k, v_p, v_eos)
         self._carry = (c_last, c_len, c_done, c_rem, c_key)
         self._inflight = {
             "toks": toks,
