@@ -28,38 +28,36 @@ import jax            # import alone does not initialize a backend;
 import jax.numpy as jnp  # the parent never calls jax.devices()
 
 
-# per-device-kind spec sheet: bf16 peak FLOPs / HBM bytes / HBM bandwidth
-_SPECS = {
-    #             flops    hbm    hbm B/s
-    "v4":        (275e12, 32e9, 1.20e12),
-    "v5p":       (459e12, 95e9, 2.77e12),
-    "v5e":       (197e12, 16e9, 8.19e11),
-    "v5 lite":   (197e12, 16e9, 8.19e11),
-    "v6e":       (918e12, 32e9, 1.64e12),
-    "trillium":  (918e12, 32e9, 1.64e12),
-}
-
-
-def _spec(dev, idx: int, default: float) -> float:
-    kind = getattr(dev, "device_kind", "").lower()
-    for key, vals in _SPECS.items():
-        if key in kind:
-            return vals[idx]
-    return default
-
-
+# The per-device-kind spec sheet lives in observability.perf.DEVICE_SPECS
+# (one table for the always-on MFU gauges AND the benchmark); imports stay
+# lazy so loading bench.py in the parent touches no paddle_tpu package.
 def _peak_flops(dev) -> float:
-    if dev.platform == "cpu":
-        return 1e12  # nominal, so MFU is defined everywhere
-    return _spec(dev, 0, 459e12)  # assume v5p-class
+    from paddle_tpu.observability.perf import peak_flops
+    return peak_flops(dev)
 
 
 def _hbm_bytes(dev) -> float:
-    return _spec(dev, 1, 95e9)
+    from paddle_tpu.observability.perf import hbm_bytes
+    return hbm_bytes(dev)
 
 
 def _hbm_bw(dev) -> float:
-    return _spec(dev, 2, 8.19e11)
+    from paddle_tpu.observability.perf import hbm_bandwidth
+    return hbm_bandwidth(dev)
+
+
+def _efficiency(row, mfu=None):
+    """Attach the shared efficiency columns to one result row: explicit
+    ``mfu`` (vs_baseline already encodes mfu/0.40 for train rows, but the
+    raw number should not need arithmetic to read) and the measured
+    ``peak_hbm_gb`` watermark from PJRT memory_stats (absent on CPU)."""
+    from paddle_tpu.observability import perf
+    if mfu is not None:
+        row["mfu"] = round(mfu, 4)
+    s = perf.hbm_stats()
+    if s.get("peak_bytes_in_use"):
+        row["peak_hbm_gb"] = round(s["peak_bytes_in_use"] / 1e9, 2)
+    return row
 
 
 def _dense_configs():
@@ -181,12 +179,12 @@ def bench_dense(dev, results):
         try:
             tps = _time_train(llama, cfg, batch, seq, opt)
             mfu = llama.flops_per_token(cfg, seq) * tps / _peak_flops(dev)
-            results.append({
+            results.append(_efficiency({
                 "metric": f"{name}_pretrain_tokens_per_sec_per_chip",
                 "value": round(tps, 1),
                 "unit": "tokens/s",
                 "vs_baseline": round(mfu / 0.40, 4),
-            })
+            }, mfu=mfu))
             return
         except Exception as e:
             last_err = e
@@ -227,13 +225,13 @@ def bench_8b(dev, results):
         try:
             tps = _time_train(llama, cfg, batch, seq, opt, n_steps=5)
             mfu = llama.flops_per_token(cfg, seq) * tps / _peak_flops(dev)
-            results.append({
+            results.append(_efficiency({
                 "metric": "llama-8b_pretrain_tokens_per_sec_per_chip",
                 "value": round(tps, 1),
                 "unit": "tokens/s",
                 "vs_baseline": round(mfu / 0.40, 4),
                 "batch": batch,
-            })
+            }, mfu=mfu))
             return
         except Exception as e:
             last_err = e
@@ -259,12 +257,12 @@ def bench_long_context(dev, results):
     try:
         tps = _time_train(llama, cfg, 2, 8192, opt)
         mfu = llama.flops_per_token(cfg, 8192) * tps / _peak_flops(dev)
-        results.append({
+        results.append(_efficiency({
             "metric": "llama-2.6b@8k_pretrain_tokens_per_sec_per_chip",
             "value": round(tps, 1),
             "unit": "tokens/s",
             "vs_baseline": round(mfu / 0.40, 4),
-        })
+        }, mfu=mfu))
     except Exception as e:
         results.append({"metric": "long_context_bench_failed", "value": 0.0,
                         "unit": "tokens/s", "vs_baseline": 0.0,
@@ -299,7 +297,7 @@ def bench_moe(dev, results):
             mfu = moe.flops_per_token(cfg, 2048) * tps / _peak_flops(dev)
             n_total = moe.num_params(jax.eval_shape(
                 lambda k: moe.init_params(cfg, k), jax.random.PRNGKey(0)))
-            results.append({
+            results.append(_efficiency({
                 "metric": "moe-dropless_pretrain_tokens_per_sec_per_chip",
                 "value": round(tps, 1),
                 "unit": "tokens/s",
@@ -307,7 +305,7 @@ def bench_moe(dev, results):
                 "total_params": n_total,
                 "active_params_per_token": moe.active_params_per_token(cfg),
                 "remat_policy": policy,
-            })
+            }, mfu=mfu))
             return
         except Exception as e:
             last_err = e
@@ -450,13 +448,18 @@ def bench_serving(dev, results):
         wbytes = sum(x.nbytes
                      for x in jax.tree_util.tree_leaves(params))
         roofline = SLOTS * _hbm_bw(dev) / wbytes
-        results.append({
+        # decode MFU from the standard 2 x params FLOPs/token estimate
+        # (attention-light at these contexts); tiny next to the bandwidth
+        # roofline by construction — that IS the decode story
+        n_params = llama.num_params(llama._abstract_params(cfg))
+        mfu = 2.0 * n_params * tps / _peak_flops(dev)
+        results.append(_efficiency({
             "metric": f"llama-2.6b_serving_engine_{tag}_tokens_per_sec",
             "value": round(tps, 1),
             "unit": "tokens/s",
             "vs_baseline": round(tps / (0.40 * roofline), 4),
             "requests": len(reqs),
-        })
+        }, mfu=mfu))
 
     try:
         _retry(lambda: attempt("bf16", lambda: _init_bf16_params(cfg)))

@@ -36,6 +36,10 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from ...observability import flight_recorder as _flight
+from ...observability import goodput as _goodput
+from ...observability import perf as _perf
+from ...observability import state as _obs_state
 from ...observability import trace_span
 from ...observability.catalog import instrument as _instrument
 from . import atomic_ckpt
@@ -55,6 +59,8 @@ _M_CKPTS = _instrument("train_checkpoints_total")
 _M_EMERGENCY = _instrument("train_emergency_saves_total")
 _M_CKPT_SAVE = _instrument("train_checkpoint_save_seconds")
 _M_CKPT_LOAD = _instrument("train_checkpoint_load_seconds")
+_M_MFU = _instrument("train_mfu")
+_M_TPS = _instrument("train_tokens_per_second")
 
 
 def is_bad_loss(loss_val: float, window, spike_factor: float,
@@ -98,6 +104,14 @@ class ResilientTrainLoop:
             exceeds ``spike_factor *`` the median of the last
             ``spike_window`` accepted losses (after ``warmup`` steps).
         on_event: ``fn(event_dict)`` observer for every recovery action.
+        flops_per_step: FLOPs one step executes, for the ``train_mfu``
+            gauge. ``None`` (default) derives it once from XLA cost
+            analysis of ``step_fn`` when observability is enabled
+            (skipped silently if ``step_fn`` doesn't trace); pass ``0``
+            to disable the derivation.
+        tokens_per_batch: token count per batch for the
+            ``train_tokens_per_second`` gauge. ``None`` infers it from
+            the integer-dtype leaves of the batch.
     """
 
     def __init__(self, step_fn: Callable, state, data, *,
@@ -110,7 +124,9 @@ class ResilientTrainLoop:
                  max_skips: int = 32, spike_factor: float = 10.0,
                  spike_window: int = 32, warmup: int = 5,
                  handle_sigterm: bool = True,
-                 on_event: Optional[Callable[[Dict], None]] = None):
+                 on_event: Optional[Callable[[Dict], None]] = None,
+                 flops_per_step: Optional[float] = None,
+                 tokens_per_batch: Optional[int] = None):
         self.step_fn = step_fn
         self.state = state
         self.data = data if isinstance(data, ResumableIterator) \
@@ -131,6 +147,9 @@ class ResilientTrainLoop:
         self.warmup = warmup
         self.handle_sigterm = handle_sigterm
         self.on_event = on_event
+        self.tokens_per_batch = tokens_per_batch
+        self._flops = flops_per_step          # None: derive lazily
+        self._flops_derivable = flops_per_step is None
 
         self.step = 0                    # completed optimizer steps
         self.total_retries = 0
@@ -177,10 +196,14 @@ class ResilientTrainLoop:
                     atomic_ckpt.save_checkpoint(
                         self._ckpt_tree(), self.ckpt_dir, self.step,
                         meta=meta, keep=self.keep, fail_hook=hook)
-                _M_CKPT_SAVE.observe(time.perf_counter() - t0)
+                dt = time.perf_counter() - t0
+                _M_CKPT_SAVE.observe(dt)
+                _goodput.account("checkpoint_save", dt)
                 _M_CKPTS.inc(tag=tag)
                 if tag.startswith("emergency"):
                     _M_EMERGENCY.inc()
+                _flight.record("checkpoint", step=self.step, tag=tag,
+                               seconds=round(dt, 6))
                 self._event("checkpoint_saved", tag=tag)
                 return True
             except (OSError, IOError) as e:
@@ -201,9 +224,12 @@ class ResilientTrainLoop:
         with trace_span("train.resume"):
             got = atomic_ckpt.load_latest_valid(self.ckpt_dir,
                                                 self._ckpt_tree())
+        t_load = time.perf_counter() - t0
         if got is None:
             return False
-        _M_CKPT_LOAD.observe(time.perf_counter() - t0)
+        _M_CKPT_LOAD.observe(t_load)
+        _goodput.account("checkpoint_load", t_load)
+        t1 = time.perf_counter()
         tree, manifest = got
         self.state = tree["state"]
         if self.rng_key is not None:
@@ -216,6 +242,10 @@ class ResilientTrainLoop:
             self.data.load_state_dict(meta["loader"])
         self._committed_pos = self.data.state_dict()
         self.resumed_from = self.step
+        # restore + loader replay are resume badput distinct from the
+        # checkpoint read itself
+        _goodput.account("resume", time.perf_counter() - t1)
+        _flight.record("resumed", step=self.step, tag=meta.get("tag"))
         self._event("resumed", tag=meta.get("tag"))
         return True
 
@@ -270,6 +300,9 @@ class ResilientTrainLoop:
         from ..watchdog import register_emergency_hook, \
             unregister_emergency_hook
 
+        # goodput wall-clock starts here: anything before the first
+        # accounted interval (resume included) is visible, not lost
+        _goodput.get_tracker().ensure_started()
         self.resume()
 
         def on_wd_timeout(name, elapsed):
@@ -290,7 +323,9 @@ class ResilientTrainLoop:
                 while self.step < num_steps:
                     if self._sigterm:
                         self._event("sigterm")
+                        _flight.record("sigterm", step=self.step)
                         self._save(tag="emergency-sigterm")
+                        _flight.maybe_dump("sigterm")
                         break
                     batch = next(self.data)
                     self._run_batch(batch)
@@ -300,10 +335,21 @@ class ResilientTrainLoop:
                 else:
                     if self.ckpt_dir is not None:
                         self._save(tag="final")
+        except BaseException as e:
+            # the crash post-mortem: ring events + metrics snapshot +
+            # open spans, written BEFORE the exception propagates (the
+            # relaunched process starts from a clean registry)
+            _flight.record("exception", step=self.step,
+                           error=type(e).__name__,
+                           message=str(e)[:500])
+            _flight.maybe_dump("exception", error=e)
+            raise
         finally:
             unregister_emergency_hook(on_wd_timeout)
             if old_handler is not None:
                 signal.signal(signal.SIGTERM, old_handler)
+            if _obs_state.enabled():
+                _goodput.get_tracker().report()   # refresh goodput_ratio
         return self.state
 
     def _run_batch(self, batch) -> None:
@@ -314,17 +360,29 @@ class ResilientTrainLoop:
             t0 = time.perf_counter()
             with trace_span("train.step", step=self.step, retry=retries):
                 new_state, loss_val = self._attempt(batch)
-            _M_STEP_SECONDS.observe(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            _M_STEP_SECONDS.observe(dt)
             bad = self._is_bad(loss_val)
             if bad is None:
                 self.state = new_state        # commit
                 self.step += 1
                 _M_STEPS.inc()
+                # a committed attempt is goodput; its wall-clock already
+                # includes any nested compile (report() normalizes the
+                # overlap away)
+                _goodput.account("productive_step", dt)
+                _flight.record("step", step=self.step,
+                               seconds=round(dt, 6))
+                self._update_efficiency(batch, dt)
                 self._loss_window.append(loss_val)
                 del self._loss_window[:-self.spike_window]
                 self._committed_pos = self.data.state_dict()
                 return
             # roll back: new_state is dropped, self.state is the snapshot
+            _goodput.account("rollback_retry", dt)
+            _flight.record("rollback", step=self.step, reason=bad,
+                           retry=retries,
+                           loss=repr(loss_val))
             self._event("rollback", reason=bad, loss=loss_val,
                         retry=retries)
             _M_ROLLBACKS.inc(reason=bad)
@@ -336,6 +394,7 @@ class ResilientTrainLoop:
                 continue                      # retry the SAME batch
             self.skipped_batches += 1
             self._event("batch_skipped", reason=bad)
+            _flight.record("batch_skipped", step=self.step, reason=bad)
             _M_SKIPPED.inc()
             # the skip is a decision, not an accident: checkpoints made
             # from here on must not replay the dropped batch
@@ -346,3 +405,33 @@ class ResilientTrainLoop:
                     f"(> max_skips={self.max_skips}); data or numerics "
                     "are systematically bad, refusing to spin")
             return                            # drop batch, no commit
+
+    def _update_efficiency(self, batch, dt: float) -> None:
+        """Refresh train_mfu / train_tokens_per_second / HBM gauges after
+        a committed step. One boolean check while disabled."""
+        if not _obs_state.enabled() or dt <= 0:
+            return
+        if self._flops is None and self._flops_derivable:
+            # one lowering of step_fn (a trace, not a compile) buys MFU
+            # for the whole run; fns that don't trace opt out silently
+            self._flops_derivable = False
+            # allow_compile=False: on jax versions with no pre-compile
+            # analysis, skip MFU rather than compile step_fn twice
+            if self.rng_key is not None:
+                import jax
+                key = jax.random.fold_in(self.rng_key, self.step)
+                self._flops = _perf.flops_of(self.step_fn, self.state,
+                                             batch, key,
+                                             allow_compile=False)
+            else:
+                self._flops = _perf.flops_of(self.step_fn, self.state,
+                                             batch, allow_compile=False)
+        m = _perf.mfu(self._flops, dt)
+        if m is not None:
+            _M_MFU.set(m)
+        tokens = self.tokens_per_batch
+        if tokens is None:
+            tokens = self.tokens_per_batch = _perf.token_count(batch)
+        if tokens:
+            _M_TPS.set(tokens / dt)
+        _perf.update_hbm_gauges()

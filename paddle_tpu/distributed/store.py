@@ -104,6 +104,16 @@ class TCPStore:
             raise RuntimeError("TCPStore.add failed")
         return int(out)
 
+    def gather(self, prefix: str, rank: int, world_size: int,
+               value) -> list:
+        """All-gather through the store: publish ``value`` under
+        ``prefix/<rank>`` and return every rank's value (list of bytes,
+        rank order), blocking until all ``world_size`` are set. The
+        rendezvous primitive behind e.g. the goodput step-time exchange
+        (observability.goodput.exchange_step_times)."""
+        self.set(f"{prefix}/{rank}", value)
+        return [self.wait(f"{prefix}/{r}") for r in range(world_size)]
+
     def barrier(self, key: str, world_size: int) -> None:
         """All participants call with the same key; returns when world_size
         have arrived."""

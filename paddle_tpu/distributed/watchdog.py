@@ -32,6 +32,7 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
+from ..observability import flight_recorder as _flight
 from ..observability import state as _obs_state
 from ..observability.catalog import instrument as _instrument
 
@@ -176,12 +177,18 @@ class CommWatchdog:
             elapsed = now - overdue.start
             self._fired.append((overdue.name, elapsed))
             _M_TIMEOUTS.inc()
+            _flight.record("watchdog_timeout", task=overdue.name,
+                           elapsed=round(elapsed, 3),
+                           timeout=overdue.timeout, mode=self.mode)
             msg = (f"[paddle_tpu watchdog] task '{overdue.name}' exceeded "
                    f"{overdue.timeout:.0f}s (elapsed {elapsed:.0f}s) — ")
             # emergency checkpoint window: runs in BOTH modes, before a
             # tear_down exit (reference analogue: comm task dump before
             # TearDown aborts the process)
             _run_emergency_hooks(overdue.name, elapsed, self.hook_budget)
+            # post-mortem AFTER the hooks: the dump then records the
+            # emergency checkpoint the hooks just flushed
+            _flight.maybe_dump("watchdog")
             if self.mode == "tear_down":
                 sys.stderr.write(msg + "tearing down for restart\n")
                 sys.stderr.flush()

@@ -18,6 +18,9 @@ _registry: Dict[str, "_Flag"] = {}
 # enabled switch mirrors its flag through this, so paddle.set_flags is
 # never silently inert)
 _watchers: Dict[str, list] = {}
+# global observers: fn(name, new_value) for EVERY set_flags change — the
+# crash flight recorder logs flag flips as incident evidence through this
+_global_watchers: list = []
 
 
 class _Flag:
@@ -61,6 +64,7 @@ def set_flags(flags: Dict[str, Any]):
     watcher notifications (which would desync e.g. FLAGS_obs_enabled
     from the observability hot-path switch)."""
     changed = []
+    really_changed = []
     with _lock:
         staged = []
         for k, v in flags.items():
@@ -70,6 +74,8 @@ def set_flags(flags: Dict[str, Any]):
                 raise ValueError(f"unknown flag: {k}")
             staged.append((k, _coerce(_registry[k].type, v)))
         for k, v in staged:
+            if _registry[k].value != v:
+                really_changed.append((k, v))
             _registry[k].value = v
             if k in _watchers:
                 changed.append((k, v))
@@ -77,6 +83,11 @@ def set_flags(flags: Dict[str, Any]):
     for k, v in changed:
         for fn in list(_watchers.get(k, ())):
             fn(v)
+    # global watchers see only ACTUAL value changes (the flight recorder
+    # logs these as incident evidence; an idempotent re-set is not one)
+    for k, v in really_changed:
+        for fn in list(_global_watchers):
+            fn(k, v)
 
 
 def watch_flag(name: str, fn):
@@ -84,6 +95,14 @@ def watch_flag(name: str, fn):
     changes ``name``. Returns ``fn``."""
     with _lock:
         _watchers.setdefault(name, []).append(fn)
+    return fn
+
+
+def watch_all_flags(fn):
+    """Register ``fn(name, new_value)`` to run on every :func:`set_flags`
+    change (any flag). Returns ``fn``."""
+    with _lock:
+        _global_watchers.append(fn)
     return fn
 
 

@@ -17,6 +17,7 @@ from typing import Iterable, List, Optional
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..observability import goodput as _goodput
 from ..observability.catalog import instrument as _instrument
 
 _M_BATCHES = _instrument("dataloader_batches_total")
@@ -534,6 +535,8 @@ class DataLoader:
                 self._pos_batch += 1
                 _M_BATCH_WAIT.observe(t1 - t0)   # no-op unless obs enabled
                 _M_BATCHES.inc()
+                # consumer-blocked time is data_wait badput
+                _goodput.account("data_wait", t1 - t0)
                 yield item          # consumer runs while suspended here
                 busy_s += time.monotonic() - t1
         finally:

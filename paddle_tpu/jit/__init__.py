@@ -29,6 +29,8 @@ import numpy as np
 
 from .. import observability as _obs
 from ..autograd import no_grad
+from ..observability import flight_recorder as _flight
+from ..observability import goodput as _goodput
 from ..observability import trace_span
 from ..observability.catalog import instrument as _instrument
 from ..core.tensor import Tensor
@@ -311,7 +313,11 @@ class StaticFunction:
                     outs = apply("jit::" + fn_name,
                                  lambda pvals, avals: runner(pvals, avals),
                                  list(ptensors), list(arg_tensors))
-                _M_JIT_COMPILE.observe(time.perf_counter() - t0)
+                dt = time.perf_counter() - t0
+                _M_JIT_COMPILE.observe(dt)
+                _goodput.account("compile", dt)
+                _flight.record("compile", fn=fn_name,
+                               seconds=round(dt, 6))
             else:
                 outs = apply("jit::" + fn_name,
                              lambda pvals, avals: runner(pvals, avals),

@@ -41,6 +41,11 @@ from .exposition import (  # noqa: F401
 from .http_server import (  # noqa: F401
     MetricsServer, start_http_server, stop_http_server,
 )
+from . import flight_recorder, goodput, perf  # noqa: F401
+from .goodput import (  # noqa: F401
+    GoodputTracker, goodput_section,
+)
+from .flight_recorder import FlightRecorder  # noqa: F401
 
 __all__ = [
     "enabled", "enable", "disable",
@@ -51,5 +56,6 @@ __all__ = [
     "export_chrome_trace",
     "render_prometheus", "snapshot", "dump_snapshot", "load_snapshot",
     "MetricsServer", "start_http_server", "stop_http_server",
-    "catalog",
+    "catalog", "goodput", "perf", "flight_recorder",
+    "GoodputTracker", "goodput_section", "FlightRecorder",
 ]

@@ -114,6 +114,48 @@ CATALOG = {
         "counter", ("reason",),
         "dispatch decisions off the fast path (shape_unaligned / "
         "dense_buffer_too_big / ep_shape_mismatch)"),
+    # -- goodput / efficiency (observability.goodput, .perf) --------------
+    "goodput_ratio": (
+        "gauge", (), "fraction of wall-clock spent in productive train "
+                     "steps (GoodputTracker.report)"),
+    "goodput_time_seconds_total": (
+        "counter", ("bucket",),
+        "wall-clock accounted per goodput bucket (productive_step / "
+        "compile / checkpoint_save / checkpoint_load / data_wait / "
+        "rollback_retry / resume)"),
+    "goodput_stragglers_total": (
+        "counter", (), "straggler flags raised by the per-host step-time "
+                       "exchange (step time > k x cross-host median)"),
+    "train_mfu": (
+        "gauge", (), "model FLOP utilization of the last committed step "
+                     "(cost-model FLOPs / step time / device peak)"),
+    "train_tokens_per_second": (
+        "gauge", (), "training tokens/s of the last committed step "
+                     "(integer-dtype batch elements / step time)"),
+    "hbm_used_bytes": (
+        "gauge", (), "device-0 HBM bytes in use at last update "
+                     "(PJRT memory_stats; 0 where unavailable)"),
+    "hbm_peak_bytes": (
+        "gauge", (), "device-0 HBM allocator high-water mark"),
+    "serving_mfu": (
+        "gauge", (), "decode-program FLOP utilization over the last "
+                     "engine step (cost-model FLOPs of the dispatched "
+                     "decode variant / step wall time / device peak)"),
+    "serving_tpot_seconds": (
+        "histogram", (), "per-request decode seconds per output token "
+                         "(time-per-output-token, observed at finish; "
+                         "pipelined readback batches flatten it)"),
+    "serving_slo_ttft_attainment": (
+        "gauge", (), "fraction of requests with TTFT <= "
+                     "FLAGS_obs_slo_ttft_ms (from the TTFT histogram)"),
+    "serving_slo_tpot_attainment": (
+        "gauge", (), "fraction of requests with TPOT <= "
+                     "FLAGS_obs_slo_tpot_ms (from the TPOT histogram)"),
+    # -- crash flight recorder --------------------------------------------
+    "flight_recorder_dumps_total": (
+        "counter", ("trigger",),
+        "post-mortem JSON dumps written (exception / watchdog / sigterm "
+        "/ manual)"),
 }
 
 # Histogram bucket overrides: (lo, hi, per_decade) for metrics whose

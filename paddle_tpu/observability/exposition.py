@@ -7,14 +7,16 @@ cumulative ``_bucket{le=...}`` series plus ``_sum``/``_count``.
 from __future__ import annotations
 
 import json
+import math
 import os
 import time
-from typing import Dict, Optional
+from typing import Dict, Optional, Sequence
 
 from .metrics import Registry, get_registry
 
 __all__ = ["render_prometheus", "snapshot", "dump_snapshot",
-           "load_snapshot", "snapshot_rows"]
+           "load_snapshot", "snapshot_rows", "quantile",
+           "fraction_at_or_below"]
 
 
 def _escape(v: str) -> str:
@@ -43,6 +45,64 @@ def _hist_state(child):
     histogram_quantile/rate on the Prometheus side."""
     with child._lock:
         return list(child.counts), child.sum, child.count
+
+
+def quantile(bounds: Sequence[float], counts: Sequence[int],
+             q: float) -> Optional[float]:
+    """Estimate the q-quantile of a histogram from its buckets.
+
+    ``counts`` has one entry per bucket (``len(bounds) + 1``, the last
+    being +Inf). Within a bucket the position is interpolated on a LOG
+    scale — the registry's buckets are log-spaced, so log interpolation
+    is exact for log-uniform mass where linear interpolation (the
+    Prometheus ``histogram_quantile`` default) skews high. The first
+    bucket interpolates linearly from 0; a quantile landing in +Inf
+    returns the largest finite bound. ``None`` on an empty histogram.
+    """
+    total = sum(counts)
+    if total <= 0 or not bounds:
+        return None
+    target = min(1.0, max(0.0, q)) * total
+    cum = 0
+    for i, n in enumerate(counts):
+        cum += n
+        if n > 0 and cum >= target:
+            if i >= len(bounds):          # +Inf bucket: no upper edge
+                return float(bounds[-1])
+            frac = 1.0 - (cum - target) / n
+            hi = float(bounds[i])
+            lo = float(bounds[i - 1]) if i > 0 else 0.0
+            if lo > 0 and hi > lo:
+                return lo * (hi / lo) ** frac
+            return lo + (hi - lo) * frac
+    return float(bounds[-1])
+
+
+def fraction_at_or_below(bounds: Sequence[float], counts: Sequence[int],
+                         threshold: float) -> Optional[float]:
+    """Estimated fraction of observations <= ``threshold`` (the SLO
+    attainment readout), log-interpolated inside the bucket the
+    threshold falls in. ``None`` on an empty histogram."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    cum = 0.0
+    for i, n in enumerate(counts):
+        lo = float(bounds[i - 1]) if i > 0 else 0.0
+        hi = float(bounds[i]) if i < len(bounds) else math.inf
+        if threshold >= hi:
+            cum += n
+            continue
+        if threshold > lo and n:
+            if lo > 0 and math.isfinite(hi):
+                frac = math.log(threshold / lo) / math.log(hi / lo)
+            elif math.isfinite(hi):
+                frac = (threshold - lo) / (hi - lo)
+            else:
+                frac = 0.0
+            cum += n * frac
+        break
+    return min(1.0, cum / total)
 
 
 def render_prometheus(registry: Optional[Registry] = None) -> str:
@@ -86,6 +146,8 @@ def snapshot(registry: Optional[Registry] = None) -> Dict:
             s = {"labels": child.labels}
             if fam.kind in ("counter", "gauge"):
                 s["value"] = child.value
+                if fam.kind == "gauge" and getattr(child, "updated", False):
+                    s["updated"] = True
             else:
                 counts, total_sum, total = _hist_state(child)
                 s["bounds"] = list(child.bounds)
@@ -113,9 +175,11 @@ def load_snapshot(path: str) -> Dict:
 
 
 def snapshot_rows(snap: Dict):
-    """``(name, kind, labels_str, value_str)`` per NON-ZERO series of a
-    snapshot dict — the one renderer behind tools/obs_dump.py's table and
-    the hapi MetricsLogger log lines (histograms show count + mean)."""
+    """``(name, kind, labels_str, value_str)`` per TOUCHED series of a
+    snapshot dict (zero counters/empty histograms/never-set gauges are
+    hidden; a gauge explicitly set to 0 is shown) — the one renderer
+    behind tools/obs_dump.py's table and the hapi MetricsLogger log
+    lines (histograms show count + mean)."""
     rows = []
     for fam in snap["metrics"]:
         for s in fam["series"]:
@@ -126,10 +190,20 @@ def snapshot_rows(snap: Dict):
                 if not cnt:
                     continue
                 mean = s.get("sum", 0.0) / cnt
-                rows.append((fam["name"], fam["kind"], lbl,
-                             f"count={cnt} mean={mean:.6g}"))
+                val = f"count={cnt} mean={mean:.6g}"
+                bounds, counts = s.get("bounds"), s.get("counts")
+                if bounds and counts:
+                    qs = (quantile(bounds, counts, q)
+                          for q in (0.5, 0.95, 0.99))
+                    val += "".join(
+                        f" p{p}={v:.6g}" for p, v in
+                        zip((50, 95, 99), qs) if v is not None)
+                rows.append((fam["name"], fam["kind"], lbl, val))
             else:
-                if not s.get("value"):
+                # Zero counters were never incremented; zero gauges are
+                # shown when they were explicitly set (0% attainment is
+                # the reading an operator most needs to see).
+                if not s.get("value") and not s.get("updated"):
                     continue
                 rows.append((fam["name"], fam["kind"], lbl,
                              f"{s['value']:g}"))

@@ -77,22 +77,28 @@ class _CounterChild(_Child):
 
 
 class _GaugeChild(_Child):
-    __slots__ = ("value",)
+    # `updated` distinguishes an explicit set(0) (e.g. 0% SLO attainment,
+    # which MUST surface) from a never-touched instrument created at import
+    # (which renderers may hide).
+    __slots__ = ("value", "updated")
 
     def __init__(self, labels):
         super().__init__(labels)
         self.value = 0.0
+        self.updated = False
 
     def set(self, value: float) -> None:
         if not state.enabled():
             return
         self.value = float(value)    # single store: atomic under the GIL
+        self.updated = True
 
     def inc(self, amount: float = 1.0) -> None:
         if not state.enabled():
             return
         with self._lock:
             self.value += amount
+            self.updated = True
 
     def dec(self, amount: float = 1.0) -> None:
         self.inc(-amount)

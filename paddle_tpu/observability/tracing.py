@@ -61,13 +61,24 @@ class SpanTracer:
             else int(get_flag("obs_trace_capacity"))
         self._ring: collections.deque = collections.deque(maxlen=cap)
         self._tls = threading.local()
+        # tid -> that thread's live open-span stack (the same list object
+        # the thread mutates): lets the flight recorder answer "what was
+        # in flight" at crash time without touching other threads
+        self._stacks: Dict[int, List[str]] = {}
 
     # -- recording --------------------------------------------------------
     def _stack(self) -> List[str]:
         st = getattr(self._tls, "stack", None)
         if st is None:
             st = self._tls.stack = []
+            self._stacks[threading.get_ident()] = st
         return st
+
+    def open_spans(self) -> Dict[int, List[str]]:
+        """tid -> names of spans currently OPEN on that thread (outermost
+        first). Finished threads drop out once their stack empties."""
+        return {tid: list(st) for tid, st in list(self._stacks.items())
+                if st}
 
     def record(self, name: str, t0: float, t1: float,
                attrs: Optional[Dict] = None, depth: Optional[int] = None):

@@ -64,6 +64,8 @@ from ..kernels.quant_matmul import (attn_pv, attn_qk, quantize_kv,
                                     weight_only_matmul as _wo_mm)
 from ..models.llama import (LlamaConfig, _apply_rope, _attention,
                             _rms_norm, _wmat)  # noqa: F401
+from ..observability import flight_recorder as _flight
+from ..observability import perf as _perf
 from ..observability import trace_span
 from ..observability.catalog import instrument as _instrument
 
@@ -85,6 +87,8 @@ _M_STEP_SECONDS = _instrument("serving_step_seconds")
 _M_PREFIX_BUCKET = _instrument("serving_decode_prefix_bucket")
 _M_DECODE_RECOMPILES = _instrument("serving_decode_recompiles_total")
 _M_KV_READ_BYTES = _instrument("serving_decode_kv_read_bytes")
+_M_TPOT = _instrument("serving_tpot_seconds")
+_M_SERVING_MFU = _instrument("serving_mfu")
 
 
 @dataclasses.dataclass
@@ -579,6 +583,13 @@ class LLMEngine:
         # observability: add_request wall time per req awaiting its first
         # host-visible token (TTFT); entries die with the request
         self._obs_t_add: Dict[int, float] = {}
+        # first-token wall time per req still decoding, for TPOT at
+        # finish; survives preemption (the decode clock keeps running)
+        self._obs_t_first: Dict[int, float] = {}
+        # cost-model FLOPs per compiled decode variant (serving_mfu);
+        # None = analysis unavailable on this jax/backend
+        self._decode_flops: Dict = {}
+        self._last_decode_flops = None
 
     # -- public api ---------------------------------------------------------
     @property
@@ -662,15 +673,29 @@ class LLMEngine:
             req.generated.extend(out)
             self.queue.appendleft(req)
             _M_PREEMPTIONS.inc()
+            _flight.record("preemption", req_id=req.req_id,
+                           generated=len(req.generated))
         elif req is not None:
             self.results[req.req_id] = req.generated + out
             _M_FINISHED.inc()
+            now = time.perf_counter()
+            t_first = self._obs_t_first.pop(req.req_id, None)
             # a request that finishes in the SAME step its first token
             # became host-visible retires before step()'s TTFT loop runs —
-            # its first token is host-visible right now, so observe here
+            # its first token is host-visible right now, so observe here.
+            # No TPOT for it: first-visibility and finish coincide, so
+            # there is no decode cadence to measure (an exact-0
+            # observation would drag the SLO gauge optimistically)
             t_add = self._obs_t_add.pop(req.req_id, None)
             if t_add is not None and (req.generated or out):
-                _M_TTFT.observe(time.perf_counter() - t_add)
+                _M_TTFT.observe(now - t_add)
+            elif t_first is not None:
+                # TPOT = decode latency after first-token visibility, per
+                # subsequent token (the depth-1 pipeline batches
+                # readbacks; the histogram tracks steady-state cadence)
+                n_out = len(req.generated) + len(out)
+                if n_out > 1:
+                    _M_TPOT.observe((now - t_first) / (n_out - 1))
 
     def _admit(self):
         """Admit every queued request a free slot and free blocks can
@@ -986,6 +1011,15 @@ class LLMEngine:
                 a.shape[0] * self.N * nbk
                 * int(np.prod(a.shape[2:])) * a.dtype.itemsize
                 for a in self.pools.values()))
+            # cost-model FLOPs once per compiled variant (lower() is a
+            # trace; allow_compile=False so MFU never compiles twice)
+            vk = (nbk, flags)
+            if vk not in self._decode_flops:
+                self._decode_flops[vk] = _perf.flops_of(
+                    decode, self.params, c_last, c_len, c_done, c_rem,
+                    c_key, v_act, tbl, self.pools, v_t, v_k, v_p, v_eos,
+                    allow_compile=False)
+            self._last_decode_flops = self._decode_flops[vk]
         with trace_span("serving.decode", slots=len(active_slots),
                         steps=self.decode_steps, prefix_bucket=nbk * self.bs):
             (toks, c_last, c_len, c_done, c_rem, c_key,
@@ -1089,6 +1123,13 @@ class LLMEngine:
                 t_add = self._obs_t_add.pop(rid, None)
                 if t_add is not None:
                     _M_TTFT.observe(now - t_add)
+                    self._obs_t_first[rid] = now
+        if self._last_decode_flops:
+            m = _perf.mfu(self._last_decode_flops, dt)
+            if m is not None:
+                _M_SERVING_MFU.set(m)
+        _perf.update_serving_slo_gauges(_M_TTFT, _M_TPOT)
+        _perf.update_hbm_gauges()
         _M_QUEUE_DEPTH.set(len(self.queue))
         _M_ACTIVE_SLOTS.set(sum(r is not None for r in self.slot_req))
         _M_KV_BLOCKS.set(self.nb - 1)
@@ -1097,6 +1138,9 @@ class LLMEngine:
 
     def _step_inner(self):
         emitted = []
+        # stale FLOPs from an earlier dispatch must not divide a
+        # no-decode step's wall time (a bogus MFU spike on idle steps)
+        self._last_decode_flops = None
         self._admit()
         if self._inflight is not None and not self._spec_safe():
             emitted += self._process_inflight()

@@ -16,12 +16,30 @@ Two modes (slow-lane tooling, like tools/chaos_run.py):
       JAX_PLATFORMS=cpu python tools/obs_dump.py --demo serving --out /tmp/obs
       JAX_PLATFORMS=cpu python tools/obs_dump.py --demo train --out /tmp/obs
       JAX_PLATFORMS=cpu python tools/obs_dump.py --demo moe --out /tmp/obs
+      JAX_PLATFORMS=cpu python tools/obs_dump.py --demo goodput --out /tmp/obs
+
+- pretty-print a crash flight-recorder dump (written on unhandled
+  exception / watchdog timeout / SIGTERM when FLAGS_obs_postmortem_dir
+  is set, or by ``observability.flight_recorder.dump``)::
+
+      python tools/obs_dump.py --postmortem /tmp/obs/postmortem-1234-1.json
 """
 import argparse
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _fresh_ckpt_dir(workdir):
+    """Checkpoint dir for a demo run, cleared first — a leftover
+    checkpoint from a prior run with the same --out would auto-resume
+    past the whole demo workload."""
+    import shutil
+
+    path = os.path.join(workdir, "ckpt")
+    shutil.rmtree(path, ignore_errors=True)
+    return path
 
 
 def print_table(snap, out=sys.stdout):
@@ -126,7 +144,7 @@ def demo_train(workdir):
     batches = [jnp.full((2,), 0.1 * (i + 1)) for i in range(8)]
     loop = ResilientTrainLoop(
         step_fn, {"w": jnp.ones((2,))}, batches,
-        ckpt_dir=os.path.join(workdir, "ckpt"), ckpt_every=2,
+        ckpt_dir=_fresh_ckpt_dir(workdir), ckpt_every=2,
         rng_key=None)
     loop.run(len(batches))
     print(f"demo train: {loop.step} steps, "
@@ -134,11 +152,90 @@ def demo_train(workdir):
           " checkpoints")
 
 
+def demo_goodput(workdir):
+    """Chaos-injected goodput demo: a resilient train run with an
+    injected NaN (one rollback-retry) and periodic checkpoints, then the
+    goodput report — bucket fractions summing to 1.0 — and a manual
+    flight-recorder post-mortem dump."""
+    import jax.numpy as jnp
+
+    import paddle_tpu.observability as obs
+    from paddle_tpu.distributed.resilience import (FaultInjector,
+                                                   ResilientTrainLoop)
+
+    def step_fn(state, batch):
+        w = state["w"] - 0.1 * batch.mean()
+        return {"w": w}, jnp.abs(w).sum()
+
+    batches = [jnp.full((2,), 0.1 * (i + 1)) for i in range(8)]
+    loop = ResilientTrainLoop(
+        step_fn, {"w": jnp.ones((2,))}, batches,
+        ckpt_dir=_fresh_ckpt_dir(workdir), ckpt_every=3,
+        rng_key=None, injector=FaultInjector("nan_grad@4"))
+    loop.run(len(batches))
+    rep = obs.goodput.get_tracker().report()
+    print(f"demo goodput: {loop.step} steps, "
+          f"{loop.total_retries} rollback(s)")
+    print(f"goodput ratio {rep['goodput_ratio']:.3f} over "
+          f"{rep['total_seconds']:.3f}s:")
+    for b, frac in rep["fractions"].items():
+        if frac > 0:
+            print(f"  {b:16s} {frac:7.2%}  "
+                  f"({rep['seconds'][b]:.3f}s)")
+    pm = obs.flight_recorder.dump(
+        os.path.join(workdir, "postmortem.json"))
+    print(f"post-mortem: {pm} "
+          "(pretty-print with tools/obs_dump.py --postmortem)")
+
+
+def print_postmortem(path, out=sys.stdout):
+    """Pretty-print one flight-recorder post-mortem JSON."""
+    import json
+    import time as _time
+
+    with open(path) as f:
+        doc = json.load(f)
+    when = _time.strftime("%Y-%m-%d %H:%M:%S",
+                          _time.localtime(doc.get("unix_time", 0)))
+    out.write(f"post-mortem  trigger={doc.get('trigger')}  "
+              f"pid={doc.get('pid')}  {when}\n")
+    err = doc.get("error")
+    if err:
+        out.write(f"error: {err.get('type')}: {err.get('message')}\n")
+    gp = doc.get("goodput")
+    if gp:
+        out.write(f"goodput ratio {gp.get('goodput_ratio', 0):.3f} "
+                  f"over {gp.get('total_seconds', 0):.3f}s (")
+        out.write(", ".join(
+            f"{b} {f:.1%}" for b, f in gp.get("fractions", {}).items()
+            if f > 0.0005) + ")\n")
+    spans = doc.get("open_spans") or {}
+    if any(spans.values()):
+        out.write("open spans at dump:\n")
+        for tid, names in spans.items():
+            out.write(f"  thread {tid}: {' > '.join(names)}\n")
+    events = doc.get("events") or []
+    out.write(f"\nlast {len(events)} events:\n")
+    t_end = events[-1]["t"] if events else 0.0
+    for ev in events:
+        rest = {k: v for k, v in ev.items() if k not in ("t", "kind")}
+        detail = "  ".join(f"{k}={v}" for k, v in rest.items())
+        out.write(f"  {ev['t'] - t_end:+9.3f}s  {ev['kind']:20s} "
+                  f"{detail}\n")
+    metrics = doc.get("metrics")
+    if metrics:
+        out.write("\nmetrics at dump:\n")
+        print_table(metrics, out=out)
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--snapshot", default=None,
                     help="print the table from an existing JSON snapshot")
-    ap.add_argument("--demo", choices=("serving", "train", "moe"),
+    ap.add_argument("--postmortem", default=None,
+                    help="pretty-print a flight-recorder post-mortem dump")
+    ap.add_argument("--demo", choices=("serving", "train", "moe",
+                                       "goodput"),
                     default=None,
                     help="run a tiny built-in workload with obs enabled")
     ap.add_argument("--out", default="./obs_dump",
@@ -150,8 +247,12 @@ def main():
 
         print_table(load_snapshot(args.snapshot))
         return 0
+    if args.postmortem:
+        print_postmortem(args.postmortem)
+        return 0
     if args.demo is None:
-        ap.error("pass --snapshot PATH or --demo {serving,train}")
+        ap.error("pass --snapshot PATH, --postmortem PATH or "
+                 "--demo {serving,train,moe,goodput}")
 
     import paddle_tpu.observability as obs
 
@@ -161,6 +262,8 @@ def main():
         demo_serving()
     elif args.demo == "moe":
         demo_moe()
+    elif args.demo == "goodput":
+        demo_goodput(args.out)
     else:
         demo_train(args.out)
     snap_path = obs.dump_snapshot(os.path.join(args.out, "snapshot.json"))
@@ -173,4 +276,7 @@ def main():
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except BrokenPipeError:       # `obs_dump ... | head` is fine
+        os._exit(0)
