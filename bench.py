@@ -270,9 +270,94 @@ def bench_long_context(dev, results):
         _release()
 
 
+def moe_phase_breakdown(cfg, batch, seq, n_steps=3):
+    """Per-phase wall-clock of ONE MoE layer's routed FFN (fwd+bwd) at
+    the bench shape — the bisect harness behind the MoE row's
+    ``phase_ms`` field (and ``tools/moe_tune.py --bisect``). Backend
+    agnostic: the CPU mini-config smoke test pins the decomposition.
+
+    Phases (JSON keys, milliseconds):
+      routing   — fused router prologue (fp32 matmul + top-k + aux +
+                  sort metadata);
+      combine   — dispatch data movement: the expert-sort gather of the
+                  token rows plus the gate-weighted combine;
+      gmm_fwd   — forward grouped GEMMs (total fwd minus the above);
+      gmm_bwd   — dgrad+wgrad (total fwd+bwd minus fwd);
+      collective — 0.0 on a single program (the EP forms' psum/a2a time
+                  lands here when a mesh is active — not yet measured).
+
+    By construction the phases sum to the measured fwd+bwd layer time
+    (``layer_ms``) up to clamping of negative subtractions, so a future
+    BENCH_r*.json localizes a regression without a bisect session."""
+    from paddle_tpu.kernels import moe_dispatch as md
+    from paddle_tpu.kernels import moe_fused as mf
+    from paddle_tpu.models import moe as moe_mod
+
+    T = batch * seq
+    h, f = cfg.hidden_size, cfg.moe_intermediate_size
+    E, k = cfg.num_experts, cfg.top_k
+    dt = cfg.dtype
+    x, rw, eg, eu, ed = md.make_moe_operands(T, h, E, f, dt)
+
+    def timed(fn, *args):
+        return md.time_best(fn, *args, n=n_steps)
+
+    t_rout = timed(lambda x: md.fused_routing(x, rw, k), x)
+
+    def fwd(x, eg, eu, ed):
+        return moe_mod.moe_ffn(x, rw, eg, eu, ed, cfg)[0]
+
+    t_fwd = timed(fwd, x, eg, eu, ed)
+
+    def total(x, eg, eu, ed):
+        def loss(*a):
+            return jnp.sum(jnp.square(fwd(*a).astype(jnp.float32)))
+        return jax.grad(loss, argnums=(0, 1, 2, 3))(x, eg, eu, ed)
+
+    t_tot = timed(total, x, eg, eu, ed)
+
+    # dispatch data movement, measured on the fused form's ops
+    r = jax.jit(lambda x: md.fused_routing(x, rw, k))(x)
+    inv2d = mf._inverse_permutation(r.order).reshape(T, k)
+    t_gather = timed(lambda x: jnp.take(x, r.tok, axis=0), x)
+    ys = jnp.zeros((T * k, h), dt)
+    t_combine = timed(
+        lambda ys: mf._combine_rows(ys, inv2d, r.tok), ys)
+
+    phases = {
+        "routing": t_rout,
+        "gmm_fwd": max(t_fwd - t_rout - t_gather - t_combine, 0.0),
+        "gmm_bwd": max(t_tot - t_fwd, 0.0),
+        "combine": t_gather + t_combine,
+        "collective": 0.0,
+    }
+    return {"phase_ms": {p: round(v * 1e3, 3) for p, v in phases.items()},
+            "layer_ms": round(t_tot * 1e3, 3)}
+
+
+def _moe_dispatch_evidence(row, cfg, batch, seq):
+    """Attach the measured dispatch-form pick (the r05 bisect lever) to
+    the bench row so every future BENCH_r*.json records which form won
+    and by how much. Matched to THIS bench's routing-shape key — a
+    shared cache dir may hold entries for other shapes (serving runs,
+    moe_tune warm-ups) and their winners are not this row's evidence."""
+    from paddle_tpu.kernels import moe_dispatch as md
+    shape_sig = (f"|T={batch * seq}|k={cfg.top_k}|E={cfg.num_experts}"
+                 f"|h={cfg.hidden_size}|f={cfg.moe_intermediate_size}|")
+    with md._PLAN_LOCK:
+        forms = {k: dict(e) for k, e in md._FORM_CACHE.items()}
+    for key, ent in sorted(forms.items()):
+        if shape_sig in key:
+            row["dispatch_form"] = ent.get("winner")
+            row["dispatch_form_ms"] = ent.get("ms")
+            break
+    return row
+
+
 def bench_moe(dev, results):
-    """Dropless MoE (fused-routing dense-base dispatch with autotuned
-    grouped-GEMM fallback, kernels/moe_dispatch.py) — BASELINE config 5's
+    """Dropless MoE (fused routing → measured dispatch form: the fused
+    scatter-free grouped-GEMM path, the gmm path, or the dense base —
+    kernels/moe_dispatch.pick_dispatch_form) — BASELINE config 5's
     capability measured on chip. MFU uses active params per token.
 
     Remat ladder (the llama-740m precedent): 'outs' saves attention +
@@ -297,7 +382,7 @@ def bench_moe(dev, results):
             mfu = moe.flops_per_token(cfg, 2048) * tps / _peak_flops(dev)
             n_total = moe.num_params(jax.eval_shape(
                 lambda k: moe.init_params(cfg, k), jax.random.PRNGKey(0)))
-            results.append(_efficiency({
+            row = _efficiency({
                 "metric": "moe-dropless_pretrain_tokens_per_sec_per_chip",
                 "value": round(tps, 1),
                 "unit": "tokens/s",
@@ -305,7 +390,13 @@ def bench_moe(dev, results):
                 "total_params": n_total,
                 "active_params_per_token": moe.active_params_per_token(cfg),
                 "remat_policy": policy,
-            }, mfu=mfu))
+            }, mfu=mfu)
+            _moe_dispatch_evidence(row, cfg, 8, 2048)
+            try:
+                row.update(moe_phase_breakdown(cfg, 8, 2048))
+            except Exception as e:   # the headline survives a harness bug
+                row["phase_ms_error"] = str(e)[:120]
+            results.append(row)
             return
         except Exception as e:
             last_err = e

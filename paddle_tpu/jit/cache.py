@@ -14,6 +14,15 @@ live and how to write them without torn files:
   (atomic_ckpt.py), so a crash mid-write leaves the previous version,
   never a truncated one. Corrupt/missing files read as ``{}``.
 
+Documents may carry a **schema version**: ``store_json(name, obj,
+schema=N)`` stamps the document with ``{"__schema__": N}`` and
+``load_json(name, schema=N)`` returns ``{}`` for any document whose
+stamp does not match — a process running older code silently starts
+from an empty cache instead of misreading entries whose key format
+changed (the gmm tiling keys gained dtype/kernel-variant fields this
+way). ``schema=None`` (the default) keeps the historical unversioned
+behaviour.
+
 Deliberately tiny and stdlib-only: callers treat persistence as
 best-effort (a read-only filesystem must never break compilation).
 """
@@ -31,7 +40,10 @@ define_flag("jit_cache_dir", "",
             "winners etc.); empty = $PADDLE_TPU_CACHE_DIR or "
             "$XDG_CACHE_HOME/paddle_tpu or ~/.cache/paddle_tpu")
 
-__all__ = ["cache_dir", "cache_path", "load_json", "store_json"]
+__all__ = ["cache_dir", "cache_path", "load_json", "store_json",
+           "SCHEMA_KEY"]
+
+SCHEMA_KEY = "__schema__"
 
 
 def cache_dir() -> str:
@@ -47,20 +59,35 @@ def cache_path(name: str) -> str:
     return os.path.join(cache_dir(), name + ".json")
 
 
-def load_json(name: str) -> Dict[str, Any]:
-    """Read a cached JSON document; missing or corrupt → ``{}``."""
+def load_json(name: str, schema: int = None) -> Dict[str, Any]:
+    """Read a cached JSON document; missing or corrupt → ``{}``.
+
+    With ``schema=N`` the document must carry ``{"__schema__": N}``
+    (written by ``store_json(..., schema=N)``) — any other stamp, or a
+    pre-versioning file, reads as ``{}`` so callers re-derive rather
+    than misinterpret entries under an old key format. The stamp itself
+    is stripped from the returned mapping."""
     try:
         with open(cache_path(name), "r") as f:
             obj = json.load(f)
-        return obj if isinstance(obj, dict) else {}
+        if not isinstance(obj, dict):
+            return {}
     except (OSError, ValueError):
         return {}
+    if schema is not None:
+        if obj.get(SCHEMA_KEY) != schema:
+            return {}
+    obj.pop(SCHEMA_KEY, None)
+    return obj
 
 
-def store_json(name: str, obj: Dict[str, Any]) -> bool:
+def store_json(name: str, obj: Dict[str, Any], schema: int = None) -> bool:
     """Atomically commit ``obj`` (temp file + fsync + rename). Returns
     False instead of raising on any I/O failure — persistence is an
-    optimization, never a requirement."""
+    optimization, never a requirement. ``schema=N`` stamps the document
+    for :func:`load_json` version checking."""
+    if schema is not None:
+        obj = dict(obj, **{SCHEMA_KEY: schema})
     path = cache_path(name)
     try:
         os.makedirs(os.path.dirname(path), exist_ok=True)

@@ -17,6 +17,21 @@ off, or a candidate that fails to compile) the static heuristic answers
 instead; unmeasured answers are cached in-process only, never
 persisted, so the on-disk file holds nothing but measured winners.
 
+Two trust guards (r05 postmortem, docs/moe.md):
+
+* **Never-worse-than-heuristic**: the heuristic seed is always timed as
+  candidate 0, and a measured winner is kept only when it beats the
+  heuristic by more than the noise margin — otherwise the heuristic is
+  served and ``moe_tiling_autotune_rejected_total`` counts the
+  rejection. A noisy grid can therefore never regress below the static
+  baseline it replaced.
+* **Persisted entries are validated, not trusted**: entries whose
+  tilings fall outside the Mosaic envelope (``_fits``) or alignment
+  rules are dropped at load (counted as rejected) and re-measured on
+  next encounter; the file carries a schema version
+  (:data:`SCHEMA`) so a key-format change invalidates old documents
+  wholesale instead of misreading them.
+
 Tuning cost and cache traffic are visible in the observability catalog:
 ``moe_tiling_cache_{hits,misses}_total``, ``moe_tiling_autotune_seconds``
 and the ``moe.autotune`` / ``moe.gmm`` spans (see docs/moe.md).
@@ -26,6 +41,8 @@ from __future__ import annotations
 import threading
 import time
 from typing import Callable, Dict, Optional, Tuple
+
+import numpy as _np
 
 from ..framework.flags import define_flag, get_flag
 from ..observability import trace_span
@@ -37,18 +54,26 @@ define_flag("moe_gmm_autotune", True,
 
 __all__ = [
     "heuristic_tilings", "get_tilings", "candidate_tilings", "clear",
-    "entries", "PERSIST_NAME",
+    "entries", "PERSIST_NAME", "SCHEMA",
 ]
 
 Tiling = Tuple[int, int, int]
 TriTiling = Tuple[Tiling, Tiling, Tiling]          # (fwd, dgrad, wgrad)
 
 PERSIST_NAME = "gmm_tilings"
+# v2: keys gained the dtype itemsize envelope (int8 expert weights) and
+# the kernel-variant field (plain gmm vs the fused gather-GMM kernel).
+SCHEMA = 2
 _PASSES = ("fwd", "dgrad", "wgrad")
+
+# a non-heuristic winner must beat the heuristic by more than this
+# fraction, else measurement noise could swap in a worse tiling
+_NOISE_MARGIN = 0.03
 
 _M_HITS = _instrument("moe_tiling_cache_hits_total")
 _M_MISSES = _instrument("moe_tiling_cache_misses_total")
 _M_TUNE = _instrument("moe_tiling_autotune_seconds")
+_M_REJECTED = _instrument("moe_tiling_autotune_rejected_total")
 
 _LOCK = threading.Lock()
 _CACHE: Dict[str, dict] = {}
@@ -57,12 +82,28 @@ _LOADED = False
 _TILES = (1408, 1024, 512, 256, 128)
 
 
-def _fits(tm: int, tk: int, tn: int) -> bool:
-    """Mosaic compile envelope, calibrated on v5e: double-buffered bf16
-    input tiles within scoped VMEM, and the f32 accumulator tile below the
-    observed crash line (tm*tn*4 of 4 MiB fails, 2.88 MiB compiles)."""
-    return (2 * 2 * (tm * tk + tk * tn) + 4 * tm * tn <= 15.5 * 2**20
+def _fits(tm: int, tk: int, tn: int, itemsize: int = 2) -> bool:
+    """Mosaic compile envelope, calibrated on v5e: double-buffered input
+    tiles within scoped VMEM, and the f32 accumulator tile below the
+    observed crash line (tm*tn*4 of 4 MiB fails, 2.88 MiB compiles).
+    ``itemsize`` is the operand byte width (2 = bf16, 1 = int8 expert
+    weights — int8 halves the input-tile footprint, so bigger tiles fit)."""
+    return (2 * itemsize * (tm * tk + tk * tn) + 4 * tm * tn
+            <= 15.5 * 2**20
             and 4 * tm * tn <= 3 * 2**20)
+
+
+def _aligned(t) -> bool:
+    """Sanity envelope for a (t1, t2, t3) tiling from an untrusted
+    source (the persisted file): positive ints, sublane/lane aligned.
+    Everything the candidate generator emits passes; hand-poisoned or
+    bit-rotted entries do not."""
+    try:
+        t1, t2, t3 = (int(v) for v in t)
+    except (TypeError, ValueError):
+        return False
+    return (t1 > 0 and t2 > 0 and t3 > 0
+            and t1 % 8 == 0 and t2 % 128 == 0 and t3 % 128 == 0)
 
 
 def heuristic_tilings(m: int, k: int, n: int) -> Optional[TriTiling]:
@@ -105,9 +146,11 @@ def heuristic_tilings(m: int, k: int, n: int) -> Optional[TriTiling]:
 
 
 def candidate_tilings(m: int, k: int, n: int,
-                      cap: int = 8) -> Optional[Dict[str, list]]:
+                      cap: int = 8,
+                      itemsize: int = 2) -> Optional[Dict[str, list]]:
     """Per-pass candidate grid, heuristic winner first. Every candidate
-    satisfies the :func:`_fits` VMEM envelope; the heuristic's alignment
+    satisfies the :func:`_fits` VMEM envelope at the operand ``itemsize``
+    (int8 weights admit bigger tiles); the heuristic's alignment
     preconditions gate the whole shape. ``cap`` bounds measurement cost
     (first-encounter only, but each candidate is a fresh Mosaic compile)."""
     heur = heuristic_tilings(m, k, n)
@@ -134,38 +177,60 @@ def candidate_tilings(m: int, k: int, n: int,
     for i, pass_ in enumerate(_PASSES):
         seen = [heur[i]]
         for c in grids[pass_]:
-            if c not in seen and _fits(*c):
+            if c not in seen and _fits(*c, itemsize=itemsize):
                 seen.append(c)
         out[pass_] = seen[:cap]
     return out
 
 
 def _key(device: str, m: int, k: int, n: int, E: int, dtype: str,
-         full_rows: bool) -> str:
-    return f"{device}|m={m}|k={k}|n={n}|E={E}|{dtype}|full_rows={full_rows}"
+         full_rows: bool, variant: str = "gmm") -> str:
+    return (f"{device}|m={m}|k={k}|n={n}|E={E}|{dtype}"
+            f"|full_rows={full_rows}|v={variant}")
 
 
 def _ensure_loaded() -> None:
-    """Merge the persisted winners into the in-process cache (once)."""
+    """Merge the persisted winners into the in-process cache (once).
+
+    Entries are *validated*, never trusted: a tiling outside the Mosaic
+    alignment/VMEM envelope (hand-edited file, bit rot, or a winner
+    measured under a different envelope calibration) is dropped — the
+    next encounter of its key is a cache miss that re-measures — and
+    counted in ``moe_tiling_autotune_rejected_total``."""
     global _LOADED
     if _LOADED:
         return
     from ..jit import cache as _jcache
 
-    disk = _jcache.load_json(PERSIST_NAME)
+    disk = _jcache.load_json(PERSIST_NAME, schema=SCHEMA)
+    rejected = 0
     with _LOCK:
         if _LOADED:
             return
         for key, ent in disk.items():
             t = ent.get("tilings") if isinstance(ent, dict) else None
-            if (isinstance(t, dict) and all(p in t for p in _PASSES)
-                    and key not in _CACHE):
+            if not (isinstance(t, dict) and all(p in t for p in _PASSES)):
+                rejected += 1
+                continue
+            # key layout: device|m=..|k=..|n=..|E=..|<dtype>|full_rows=..|v=..
+            try:
+                itemsize = _np.dtype(key.split("|")[5]).itemsize
+            except (IndexError, TypeError):
+                itemsize = 2          # unparsable dtype: bf16 envelope
+            if not all(_aligned(t[p]) and _fits(*(int(v) for v in t[p]),
+                                                itemsize=itemsize)
+                       for p in _PASSES):
+                rejected += 1          # poisoned/stale: re-measure later
+                continue
+            if key not in _CACHE:
                 _CACHE[key] = {
                     "tilings": {p: tuple(int(v) for v in t[p])
                                 for p in _PASSES},
                     "source": ent.get("source", "measured"),
                 }
         _LOADED = True
+    for _ in range(rejected):
+        _M_REJECTED.inc()
 
 
 def _persist() -> None:
@@ -177,7 +242,7 @@ def _persist() -> None:
                      "source": ent["source"]}
                for key, ent in _CACHE.items()
                if ent["source"] == "measured"}
-    _jcache.store_json(PERSIST_NAME, doc)
+    _jcache.store_json(PERSIST_NAME, doc, schema=SCHEMA)
 
 
 def _as_tri(ent: dict) -> TriTiling:
@@ -232,8 +297,8 @@ def _default_measure(m, k, n, E, dtype, full_rows):
 
 
 def get_tilings(m: int, k: int, n: int, E: int, dtype, full_rows: bool,
-                *, measure: Optional[Callable] = None
-                ) -> Optional[TriTiling]:
+                *, measure: Optional[Callable] = None,
+                variant: str = "gmm") -> Optional[TriTiling]:
     """(fwd, dgrad, wgrad) tilings for one ``grouped_matmul`` call site.
 
     Cache hit → the remembered winner (persisted winners count as hits:
@@ -241,18 +306,27 @@ def get_tilings(m: int, k: int, n: int, E: int, dtype, full_rows: bool,
     Miss → measure the candidate grid when possible, else the heuristic.
     ``measure(pass_, tiling) -> seconds`` is injectable for tests and for
     :mod:`tools.moe_tune`; pass a factory result, not a factory.
+    ``variant`` keys the kernel family ("gmm" = stock megablox,
+    "fused" = the gather-fused kernel in :mod:`.moe_fused`) — their
+    optima differ, so they never share cache entries.
+
+    Never-worse guard: the heuristic is always timed (candidate 0), and
+    a different winner is kept only when it beats the heuristic by more
+    than the noise margin; rejected winners increment
+    ``moe_tiling_autotune_rejected_total``.
+
     Returns None for shapes the Mosaic kernel doesn't like — the caller
     falls back to ``ragged_dot``."""
-    import numpy as _np
-
     heur = heuristic_tilings(m, k, n)
     if heur is None:
         return None
     if not get_flag("moe_gmm_autotune"):
         return heur
     _ensure_loaded()
-    dtype_s = _np.dtype(dtype).name
-    key = _key(_device_tag(), m, k, n, E, dtype_s, bool(full_rows))
+    np_dtype = _np.dtype(dtype)
+    dtype_s = np_dtype.name
+    key = _key(_device_tag(), m, k, n, E, dtype_s, bool(full_rows),
+               variant)
     with _LOCK:
         ent = _CACHE.get(key)
     if ent is not None:
@@ -272,13 +346,14 @@ def get_tilings(m: int, k: int, n: int, E: int, dtype, full_rows: bool,
                       "source": "heuristic"})
         return heur
 
-    cands = candidate_tilings(m, k, n)
+    cands = candidate_tilings(m, k, n, itemsize=np_dtype.itemsize)
     picked: Dict[str, Tiling] = {}
     all_measured = True
     t_start = time.perf_counter()
     with trace_span("moe.autotune", m=m, k=k, n=n, E=E, dtype=dtype_s):
         for i, pass_ in enumerate(_PASSES):
             best, best_t = heur[i], float("inf")
+            heur_t = float("inf")
             for tiling in cands[pass_]:
                 try:
                     with trace_span("moe.gmm", pass_=pass_,
@@ -286,6 +361,8 @@ def get_tilings(m: int, k: int, n: int, E: int, dtype, full_rows: bool,
                         dt = runner(pass_, tiling)
                 except Exception:
                     continue      # candidate fails to compile/run: skip
+                if tiling == heur[i]:
+                    heur_t = dt
                 if dt < best_t:
                     best, best_t = tiling, dt
             if best_t == float("inf"):
@@ -293,6 +370,12 @@ def get_tilings(m: int, k: int, n: int, E: int, dtype, full_rows: bool,
                 # never validated — do NOT let it persist as "measured"
                 # (a toolchain fix should re-trigger measurement)
                 all_measured = False
+            elif (tuple(best) != tuple(heur[i])
+                    and best_t > heur_t * (1.0 - _NOISE_MARGIN)):
+                # winner inside the noise band of the heuristic: the
+                # measurement proved nothing — keep the static pick
+                best = heur[i]
+                _M_REJECTED.inc()
             picked[pass_] = tuple(best)
     _M_TUNE.observe(time.perf_counter() - t_start)
     source = "measured" if all_measured else "heuristic"
@@ -328,7 +411,7 @@ def clear(persisted: bool = False) -> None:
     if persisted:
         from ..jit import cache as _jcache
 
-        _jcache.store_json(PERSIST_NAME, {})
+        _jcache.store_json(PERSIST_NAME, {}, schema=SCHEMA)
 
 
 def entries():

@@ -52,28 +52,43 @@ from __future__ import annotations
 
 import functools
 import threading
-from typing import Dict, NamedTuple, Optional, Tuple
+import time
+from typing import Callable, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..framework.flags import define_flag, get_flag
+from ..observability import trace_span
 from ..observability.catalog import instrument as _instrument
 from .gmm_autotune import (  # noqa: F401  (re-exported for back-compat)
     _fits, get_tilings, heuristic_tilings, heuristic_tilings as
     _pick_tilings,
 )
 
+define_flag("moe_dispatch_autotune", True,
+            "measure dense vs gmm vs fused dispatch once per routing "
+            "shape on TPU and use the winner (never worse than the "
+            "static default); off = the static choice")
+define_flag("moe_overlap_min_tokens", 1024,
+            "expert-parallel double-buffered overlap is bypassed below "
+            "this per-rank token count (halving overhead beats the "
+            "collective hiding on small slices; see docs/moe.md)")
+
 __all__ = [
     "dropless_moe_ffn", "dropless_moe_ffn_dense", "dropless_moe_ffn_ep",
-    "dropless_moe_ffn_a2a", "sort_by_expert", "fused_routing", "Routing",
-    "plan_dispatch", "DispatchPlan", "clear_plan_cache",
+    "dropless_moe_ffn_a2a", "dropless_moe_ffn_fused", "sort_by_expert",
+    "fused_routing", "Routing", "plan_dispatch", "DispatchPlan",
+    "clear_plan_cache", "pick_dispatch_form", "clear_form_cache",
+    "make_moe_operands", "time_best",
 ]
 
 _M_PLAN_HITS = _instrument("moe_plan_cache_hits_total")
 _M_PLAN_MISSES = _instrument("moe_plan_cache_misses_total")
 _M_FALLBACKS = _instrument("moe_dispatch_fallbacks_total")
+_M_OVERLAP_BYPASS = _instrument("moe_overlap_bypass_total")
 
 
 def _shard_map(f, mesh, in_specs, out_specs, axis_names, check_vma=False):
@@ -217,6 +232,179 @@ def plan_dispatch(T: int, k: int, E: int, h: int,
 def clear_plan_cache() -> None:
     with _PLAN_LOCK:
         _PLAN_CACHE.clear()
+
+
+# ---------------------------------------------------------------------------
+# measured dispatch-form selection — the r05 regression fix
+#
+# r04 made the dense-base staging form the static default on the strength
+# of a forward-only MXU measurement; under the full train step it lost
+# ~7% to the grouped-GEMM form at the bench shape (BENCH_r05 0.925x,
+# docs/moe.md postmortem). Shape heuristics keep getting this wrong, so
+# the form is now MEASURED once per routing shape on TPU — fwd+bwd, the
+# quantity the bench actually pays — and the winner is persisted through
+# the jit artifact cache. The static default ("fused") is always among
+# the candidates, so the pick is never worse than the fallback.
+# ---------------------------------------------------------------------------
+
+_FORM_PERSIST = "moe_dispatch_forms"
+# v2: keys gained the dense_ok candidate-set field — an entry measured
+# with the dense form admitted must never answer for a caller that
+# excluded it (dense staging can OOM where fused/gmm cannot)
+_FORM_SCHEMA = 2
+_FORM_STATIC = "fused"
+_FORM_CACHE: Dict[str, dict] = {}
+_FORM_LOADED = False
+
+
+def _forms_ensure_loaded() -> None:
+    global _FORM_LOADED
+    if _FORM_LOADED:
+        return
+    from ..jit import cache as _jcache
+
+    disk = _jcache.load_json(_FORM_PERSIST, schema=_FORM_SCHEMA)
+    with _PLAN_LOCK:
+        if _FORM_LOADED:
+            return
+        for key, ent in disk.items():
+            if (isinstance(ent, dict)
+                    and ent.get("winner") in ("fused", "gmm", "dense")
+                    and key not in _FORM_CACHE):
+                _FORM_CACHE[key] = ent
+        _FORM_LOADED = True
+
+
+def _forms_persist() -> None:
+    from ..jit import cache as _jcache
+
+    with _PLAN_LOCK:
+        doc = {k: dict(e) for k, e in _FORM_CACHE.items()
+               if e.get("source") == "measured"}
+    _jcache.store_json(_FORM_PERSIST, doc, schema=_FORM_SCHEMA)
+
+
+def clear_form_cache() -> None:
+    global _FORM_LOADED
+    with _PLAN_LOCK:
+        _FORM_CACHE.clear()
+        _FORM_LOADED = False
+
+
+def make_moe_operands(T: int, h: int, E: int, f: int, dtype, seed: int = 0):
+    """The shared synthetic routed-FFN operand recipe: ``(x [T,h],
+    router_w [h,E] f32, e_gate [E,h,f], e_up [E,h,f], e_down [E,f,h])``
+    with weights scaled 0.1. Every measurement/parity surface (the
+    dispatch-form autotuner here, ``bench.moe_phase_breakdown``, the
+    ``tests_tpu/`` lane) builds operands through THIS function so they
+    time and compare the same problem."""
+    ks = jax.random.split(jax.random.PRNGKey(seed), 5)
+    x = jax.random.normal(ks[0], (T, h), jnp.float32).astype(dtype)
+    rw = jax.random.normal(ks[1], (h, E), jnp.float32) * 0.1
+    eg = (jax.random.normal(ks[2], (E, h, f), jnp.float32) * 0.1
+          ).astype(dtype)
+    eu = (jax.random.normal(ks[3], (E, h, f), jnp.float32) * 0.1
+          ).astype(dtype)
+    ed = (jax.random.normal(ks[4], (E, f, h), jnp.float32) * 0.1
+          ).astype(dtype)
+    return x, rw, eg, eu, ed
+
+
+def time_best(fn, *args, n: int = 3) -> float:
+    """Best-of-``n`` wall-clock seconds of ``jax.jit(fn)(*args)`` after a
+    compile+warm call — the shared timing discipline of the dispatch-form
+    and phase-breakdown measurements."""
+    f_jit = jax.jit(fn)
+    jax.block_until_ready(f_jit(*args))             # compile + warm
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f_jit(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _default_form_measure(T: int, k: int, E: int, h: int, f: int, dtype
+                          ) -> Optional[Callable]:
+    """fwd+bwd timing closure for one dispatch form at the real routing
+    shape, or None off-TPU (the static default answers there)."""
+    if jax.default_backend() != "tpu":
+        return None
+
+    def run(form: str) -> float:
+        from . import moe_fused as _mf
+
+        fns = {"fused": _mf.fused_moe_ffn,
+               "gmm": dropless_moe_ffn,
+               "dense": dropless_moe_ffn_dense}
+        fn = fns[form]
+        x, rw, eg, eu, ed = make_moe_operands(T, h, E, f, dtype)
+
+        def loss(x, eg, eu, ed):
+            r = fused_routing(x, rw, k)
+            y = fn(x, r.weights, r.idx, eg, eu, ed, routing=r)
+            return jnp.sum(jnp.square(y.astype(jnp.float32)))
+
+        return time_best(jax.grad(loss, argnums=(0, 1, 2, 3)),
+                         x, eg, eu, ed)
+
+    return run
+
+
+def pick_dispatch_form(T: int, k: int, E: int, h: int, f: int, dtype,
+                       *, dense_ok: bool = False,
+                       measure: Optional[Callable] = None) -> str:
+    """'fused' | 'gmm' | 'dense' for one single-program routing shape.
+
+    TPU: first encounter measures fwd+bwd of each candidate form at the
+    real shape, keeps the winner (never worse than the static default —
+    the default is always a candidate, and a winner inside the noise
+    band of the default is rejected in its favor), and persists it.
+    Elsewhere, or with ``FLAGS_moe_dispatch_autotune`` off: the static
+    default. ``measure(form) -> seconds`` is injectable for tests."""
+    static = _FORM_STATIC
+    if not get_flag("moe_dispatch_autotune"):
+        return static
+    runner = measure if measure is not None else _default_form_measure(
+        T, k, E, h, f, dtype)
+    if runner is None:
+        return static
+    from .gmm_autotune import _device_tag
+
+    cands = ["fused", "gmm"] + (["dense"] if dense_ok else [])
+    _forms_ensure_loaded()
+    key = (f"{_device_tag()}|T={T}|k={k}|E={E}|h={h}|f={f}|"
+           f"{np.dtype(dtype).name}|dense_ok={bool(dense_ok)}")
+    with _PLAN_LOCK:
+        ent = _FORM_CACHE.get(key)
+    if ent is not None and ent["winner"] in cands:
+        return ent["winner"]
+    times: Dict[str, float] = {}
+    with trace_span("moe.autotune", kind="dispatch_form", T=T, E=E):
+        for form in cands:
+            try:
+                times[form] = runner(form)
+            except Exception:
+                continue              # a form that fails to build loses
+    if static not in times:
+        return static
+    winner = min(times, key=times.get)
+    if winner != static and times[winner] > times[static] * 0.98:
+        winner = static               # within noise: keep the default
+    ent = {"winner": winner,
+           "ms": {fm: round(v * 1e3, 3) for fm, v in times.items()},
+           "source": "measured"}
+    with _PLAN_LOCK:
+        # a concurrent measurement may have raced us — keep the existing
+        # entry only if its winner is admissible HERE, else overwrite (a
+        # stale record must never answer with an excluded form)
+        existing = _FORM_CACHE.get(key)
+        if existing is not None and existing.get("winner") in cands:
+            ent = existing
+        else:
+            _FORM_CACHE[key] = ent
+    _forms_persist()
+    return ent["winner"]
 
 
 def _zero_tail(out, gs):
@@ -513,6 +701,18 @@ def dropless_moe_ffn(x, weights, idx, e_gate, e_up, e_down,
     return y.astype(dt)
 
 
+def dropless_moe_ffn_fused(x, weights, idx, e_gate, e_up, e_down,
+                           routing: Optional[Routing] = None):
+    """Capacity-less routed FFN, fused scatter-free form — see
+    :func:`paddle_tpu.kernels.moe_fused.fused_moe_ffn` (same grouped
+    GEMMs as :func:`dropless_moe_ffn`, gather-only data movement in both
+    directions, Pallas gather-GMM kernel on TPU, int8 expert dicts)."""
+    from .moe_fused import fused_moe_ffn
+
+    return fused_moe_ffn(x, weights, idx, e_gate, e_up, e_down,
+                         routing=routing)
+
+
 def _ep_partial(x_l, w_l, idx_l, eg_l, eu_l, ed_l, *, El, me, dt):
     """Routed partial sums for one token slice: local tokens × local
     expert shard, pre-psum [T_slice, h] f32.
@@ -537,6 +737,22 @@ def _ep_partial(x_l, w_l, idx_l, eg_l, eu_l, ed_l, *, El, me, dt):
         ys.astype(jnp.float32) * ws[:, None])
 
 
+def _overlap_bypassed(shared_w, Tl: int) -> bool:
+    """True when the double-buffered-halves overlap should not run for a
+    per-rank token slice of ``Tl``: no shared-expert FFN to hide behind,
+    an un-halvable slice, or a slice below ``FLAGS_moe_overlap_min_tokens``
+    — on small slices the halved grouped GEMMs lose more MXU efficiency
+    than the collective hiding buys (the r05 bisect lever), so single
+    buffering wins. Threshold bypasses are counted per traced call site
+    in ``moe_overlap_bypass_total``."""
+    if shared_w is None or Tl < 2 or Tl % 2:
+        return True
+    if Tl < int(get_flag("moe_overlap_min_tokens")):
+        _M_OVERLAP_BYPASS.inc()
+        return True
+    return False
+
+
 def _ep_local(x_l, w_l, idx_l, eg_l, eu_l, ed_l, shared_w=None, *,
               num_experts_local, compute_dtype):
     """Per-(data,ep)-rank body of the psum strategy. Boundary tensors are
@@ -553,7 +769,7 @@ def _ep_local(x_l, w_l, idx_l, eg_l, eu_l, ed_l, shared_w=None, *,
     Tl = x_l.shape[0]
     part = functools.partial(_ep_partial, eg_l=eg_l, eu_l=eu_l, ed_l=ed_l,
                              El=El, me=me, dt=dt)
-    if shared_w is None or Tl < 2 or Tl % 2:
+    if _overlap_bypassed(shared_w, Tl):
         y = jax.lax.psum(part(x_l, w_l, idx_l), "ep")
         if shared_w is not None:
             y = y + _shared_swiglu(x_l, *shared_w, dt).astype(jnp.float32)
@@ -710,7 +926,7 @@ def _a2a_local(x_l, w_l, idx_l, eg_l, eu_l, ed_l, shared_w=None, *,
         yr = _a2a_ffn(xr, er, eg_l, eu_l, ed_l, E=E, El=El)
         return _a2a_combine(yr, st, h=h)
 
-    if shared_w is None or Tl < 2 or Tl % 2:
+    if _overlap_bypassed(shared_w, Tl):
         y = one(x_l, w_l, idx_l)
         if shared_w is not None:
             y = y + _shared_swiglu(x_l, *shared_w, dt).astype(jnp.float32)

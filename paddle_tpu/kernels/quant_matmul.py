@@ -32,6 +32,7 @@ import jax.numpy as jnp
 __all__ = [
     "weight_only_matmul", "quantize_kv", "dequantize_kv",
     "attn_qk", "attn_pv", "mixed_dot_supported",
+    "quantize_grouped", "is_quantized_weight",
 ]
 
 
@@ -53,6 +54,44 @@ def mixed_dot_supported() -> bool:
 
 def _is_quantized(w) -> bool:
     return isinstance(w, dict) and "q" in w
+
+
+def is_quantized_weight(w) -> bool:
+    """True for an int8 weight-only leaf ``{"q": int8, "s": f32}`` (the
+    quantize_params / quantize_grouped layout)."""
+    return _is_quantized(w)
+
+
+def quantize_grouped(w, axis: int):
+    """Symmetric per-channel int8 for stacked per-expert weights.
+
+    ``w``: [E, ...] grouped weights; ``axis`` is the axis the scale is
+    *shared over* (reduced by absmax), e.g.:
+
+    - gate/up ``[E, h, f]`` with ``axis=1`` → ``s`` [E, f]: one scale per
+      (expert, output channel), applied to the GEMM *output* — the
+      weight_only_matmul idiom, grouped;
+    - down ``[E, f, h]`` with ``axis=2`` → ``s`` [E, f]: one scale per
+      (expert, *input* channel), folded into the GEMM *input* — it rides
+      the same elementwise chain as the MoE combine weights
+      (``z * w * s``), so the dequantization costs nothing extra.
+
+    Returns ``{"q": int8 (w.shape), "s": f32 (w.shape minus axis)}``.
+    Scales are constants at use sites (stop_gradient): quantization never
+    leaks into any gradient."""
+    wf = w.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(wf), axis=axis) / 127.0
+    scale = jnp.maximum(scale, 1e-12)
+    q = jnp.round(wf / jnp.expand_dims(scale, axis))
+    return {"q": jnp.clip(q, -127, 127).astype(jnp.int8), "s": scale}
+
+
+def dequantize_grouped(w, axis: int, dtype):
+    """Materialize the dense weights of a :func:`quantize_grouped` leaf
+    (the slow exact fallback — paths that can't keep the int8 operand
+    resident, e.g. the shard_map expert-parallel forms)."""
+    return (w["q"].astype(jnp.float32)
+            * jnp.expand_dims(w["s"], axis)).astype(dtype)
 
 
 def weight_only_matmul(x, w, out_dtype):

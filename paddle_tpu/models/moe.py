@@ -35,6 +35,7 @@ __all__ = [
     "MoEConfig", "deepseek_moe_16b", "tiny_moe", "init_params", "forward",
     "loss_fn", "param_specs", "make_shardings", "moe_ffn", "top_k_gating",
     "TrainState", "init_train_state", "train_step", "num_params",
+    "quantize_expert_params",
 ]
 
 from ..observability import trace_span
@@ -71,12 +72,32 @@ class MoEConfig:
     #          (runs everywhere incl. XLA:CPU, T*k GEMM rows per rank),
     # "auto" — a2a on TPU, psum elsewhere.
     ep_strategy: str = "auto"
-    # single-program dropless only: stage the balanced bulk in a static
-    # [E, Q, h] buffer and run the expert FFN as dense batched einsums
-    # (92% MXU on v5e vs 63% for the grouped-GEMM kernel), falling back to
-    # the sort+gmm path via lax.cond when a batch overflows Q — see
-    # kernels/moe_dispatch.dropless_moe_ffn_dense. Nothing is dropped.
+    # single-program dropless dispatch form:
+    # "auto"  — MEASURED once per routing shape on TPU (fwd+bwd, never
+    #           worse than the static default; persisted via jit/cache —
+    #           the r05 postmortem fix, see docs/moe.md), the fused form
+    #           elsewhere;
+    # "fused" — scatter-free grouped-GEMM rewrite + Pallas gather-GMM
+    #           kernel on TPU (kernels/moe_fused.py);
+    # "gmm"   — expert-sorted Mosaic grouped matmul with scatter-add
+    #           combine (the pre-r04 default);
+    # "dense" — [E, Q, h] dense-base staging einsums (the r04/r05
+    #           default; loses ~7% fwd+bwd at the bench shape — kept as
+    #           an explicit choice and an "auto" candidate).
+    dispatch: str = "auto"
+    # allow "auto"/"dense" to stage the balanced bulk in a static
+    # [E, Q, h] buffer (dense batched einsums with a lax.cond overflow
+    # fallback — kernels/moe_dispatch.dropless_moe_ffn_dense). Nothing
+    # is dropped either way.
     dense_base: bool = True
+    # False = the unfused router (separate top_k_gating + re-derived
+    # sort metadata) — a bisect lever for tools/moe_tune.py --bisect,
+    # numerically identical to the fused prologue
+    fused_router: bool = True
+    # "int8": routed-expert weights quantized per-channel to int8 dicts
+    # by quantize_expert_params (scales fold into the fused dispatch's
+    # elementwise chains; frozen — forward/serving paths, not training)
+    expert_dtype: Optional[str] = None
     capacity_factor: float = 1.25
     router_aux_coef: float = 0.01
     max_seq_len: int = 4096
@@ -155,6 +176,42 @@ def init_params(config: MoEConfig, key: jax.Array) -> Dict[str, Any]:
 
 def num_params(params) -> int:
     return int(sum(np.prod(p.shape) for p in jax.tree_util.tree_leaves(params)))
+
+
+def quantize_expert_params(params, config: MoEConfig = None):
+    """int8-quantize the routed-expert weights (``layers.e_gate/e_up/
+    e_down`` become ``{"q": int8, "s": f32}`` dicts — the
+    :func:`kernels.quant_matmul.quantize_grouped` layout, stacked over
+    layers). The fused dispatch keeps the int8 operand resident and
+    folds the per-channel scales into its elementwise chains; gate/up
+    scale over the h contraction, down over the f contraction (applied
+    to the GEMM input, riding the combine-weight chain).
+
+    Forward/serving-path weights: the quantized leaves are frozen
+    (scales are stop_gradient'd at use sites — gradients flow to the
+    activations and every *other* parameter, never into q or s).
+    Everything else (router, shared experts, attention, embeddings)
+    stays in its original dtype."""
+    from ..kernels.quant_matmul import quantize_grouped
+
+    if config is not None and config.expert_dtype != "int8":
+        if config.expert_dtype is None:
+            return params
+        raise ValueError(f"expert_dtype={config.expert_dtype!r}: "
+                         "expected None or 'int8'")
+    if config is not None and config.routing != "dropless":
+        raise ValueError(
+            f"routing={config.routing!r}: int8 expert weights require "
+            "routing='dropless' (the capacity einsum path has no "
+            "quantized form)")
+    out = {k: (dict(v) if isinstance(v, dict) else v)
+           for k, v in params.items()}
+    layers = dict(params["layers"])
+    layers["e_gate"] = quantize_grouped(params["layers"]["e_gate"], 2)
+    layers["e_up"] = quantize_grouped(params["layers"]["e_up"], 2)
+    layers["e_down"] = quantize_grouped(params["layers"]["e_down"], 3)
+    out["layers"] = layers
+    return out
 
 
 def active_params_per_token(config: MoEConfig) -> int:
@@ -260,6 +317,7 @@ def moe_ffn(x, router_w, e_gate, e_up, e_down, config: MoEConfig,
     c = config
     if c.routing == "dropless":
         from ..kernels import moe_dispatch as _md
+        from ..kernels import quant_matmul as _qm
         mesh = _llama._ACT_MESH
         strategy = "single"
         if mesh is not None and dict(mesh.shape).get("ep", 1) > 1:
@@ -267,11 +325,28 @@ def moe_ffn(x, router_w, e_gate, e_up, e_down, config: MoEConfig,
             if strategy == "auto":
                 strategy = ("a2a" if jax.default_backend() == "tpu"
                             else "psum")
+        quantized = _qm.is_quantized_weight(e_gate)
+        if quantized and strategy != "single":
+            # the shard_map forms keep dense operands; int8 stays exact
+            # through the documented dequantize (fused path only keeps
+            # the int8 operand resident)
+            e_gate = _qm.dequantize_grouped(e_gate, 1, x.dtype)
+            e_up = _qm.dequantize_grouped(e_up, 1, x.dtype)
+            e_down = _qm.dequantize_grouped(e_down, 2, x.dtype)
         # span = host-side build cost of this layer's routing+dispatch;
         # the device time lives inside the compiled step program
         with trace_span("moe.dispatch", strategy=strategy):
-            routing = _md.fused_routing(x, router_w, c.top_k)
-            weights, idx, aux = routing.weights, routing.idx, routing.aux
+            if c.fused_router:
+                routing = _md.fused_routing(x, router_w, c.top_k)
+                weights, idx, aux = (routing.weights, routing.idx,
+                                     routing.aux)
+            else:
+                # bisect lever: the unfused reference router — the
+                # dispatch re-derives the sort metadata
+                routing = None
+                weights, idx, aux = top_k_gating(
+                    x.astype(jnp.float32)
+                    @ router_w.astype(jnp.float32), c.top_k)
             if strategy == "a2a":
                 y = _md.dropless_moe_ffn_a2a(
                     x, weights, idx, e_gate, e_up, e_down, mesh,
@@ -281,13 +356,31 @@ def moe_ffn(x, router_w, e_gate, e_up, e_down, config: MoEConfig,
                     x, weights, idx, e_gate, e_up, e_down, mesh,
                     token_axes=("dp", "sp"), shared=shared_weights)
             elif strategy == "single":
-                if c.dense_base:
+                T, h = x.shape
+                qg = e_gate["q"] if quantized else e_gate
+                E, f = qg.shape[0], qg.shape[-1]
+                plan = _md.plan_dispatch(T, c.top_k, E, h)
+                form = c.dispatch
+                if quantized:
+                    form = "fused"     # int8 dicts live on the fused path
+                elif form == "auto":
+                    form = _md.pick_dispatch_form(
+                        T, c.top_k, E, h, f, x.dtype,
+                        dense_ok=c.dense_base and plan.use_dense)
+                if form == "dense":
                     y = _md.dropless_moe_ffn_dense(
+                        x, weights, idx, e_gate, e_up, e_down,
+                        routing=routing, plan=plan)
+                elif form == "gmm":
+                    y = _md.dropless_moe_ffn(x, weights, idx, e_gate,
+                                             e_up, e_down, routing=routing)
+                elif form == "fused":
+                    y = _md.dropless_moe_ffn_fused(
                         x, weights, idx, e_gate, e_up, e_down,
                         routing=routing)
                 else:
-                    y = _md.dropless_moe_ffn(x, weights, idx, e_gate,
-                                             e_up, e_down, routing=routing)
+                    raise ValueError(f"dispatch={form!r}: expected "
+                                     "'auto', 'fused', 'gmm', or 'dense'")
                 if shared_weights is not None:
                     # no collective to hide on a single program — XLA
                     # schedules the shared FFN alongside the routed GEMMs
@@ -299,6 +392,12 @@ def moe_ffn(x, router_w, e_gate, e_up, e_down, config: MoEConfig,
     if c.routing != "capacity":
         raise ValueError(f"routing={c.routing!r}: expected 'dropless' or "
                          "'capacity'")
+    from ..kernels.quant_matmul import is_quantized_weight as _is_q
+    if _is_q(e_gate):
+        raise ValueError(
+            "int8 expert weights (quantize_expert_params) require "
+            "routing='dropless' — the capacity einsum path has no "
+            "quantized form")
     weights, idx, aux = top_k_gating(
         x.astype(jnp.float32) @ router_w.astype(jnp.float32), c.top_k)
     T, h = x.shape

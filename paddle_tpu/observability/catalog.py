@@ -114,6 +114,23 @@ CATALOG = {
         "counter", ("reason",),
         "dispatch decisions off the fast path (shape_unaligned / "
         "dense_buffer_too_big / ep_shape_mismatch)"),
+    "moe_tiling_autotune_rejected_total": (
+        "counter", (),
+        "autotune results rejected by the never-worse guard: measured "
+        "winners inside the heuristic's noise band, and persisted "
+        "entries that failed validation at load (re-measured on next "
+        "encounter)"),
+    "moe_gmm_fused_dispatch_total": (
+        "counter", ("path",),
+        "fused-dispatch entries by implementation path (pallas = "
+        "gather-fused TPU kernel, xla = portable scatter-free rewrite, "
+        "xla_fallback = kernel failed to build and the rewrite "
+        "answered)"),
+    "moe_overlap_bypass_total": (
+        "counter", (),
+        "expert-parallel overlap bypasses: per-rank token slices below "
+        "FLAGS_moe_overlap_min_tokens ran single-buffered (halving "
+        "overhead would beat the collective hiding)"),
     # -- goodput / efficiency (observability.goodput, .perf) --------------
     "goodput_ratio": (
         "gauge", (), "fraction of wall-clock spent in productive train "
