@@ -39,6 +39,7 @@ import numpy as np
 from ...observability import flight_recorder as _flight
 from ...observability import goodput as _goodput
 from ...observability import perf as _perf
+from ...observability import profiling as _profiling
 from ...observability import state as _obs_state
 from ...observability import trace_span
 from ...observability.catalog import instrument as _instrument
@@ -357,6 +358,9 @@ class ResilientTrainLoop:
         optimizer step."""
         retries = 0
         while True:
+            # on-demand device-capture window boundary (profiling
+            # control plane; one module-global read when nothing armed)
+            _profiling.step_tick()
             t0 = time.perf_counter()
             with trace_span("train.step", step=self.step, retry=retries):
                 new_state, loss_val = self._attempt(batch)

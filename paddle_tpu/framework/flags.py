@@ -127,6 +127,17 @@ def get_flag(name: str):
         return _registry[name].value
 
 
+def flag_entries(prefix: str = ""):
+    """``{name: (value, default, help)}`` for every registered flag
+    whose name starts with ``prefix`` — the introspection behind
+    ``tools/obs_dump.py --flags`` (operators discovering the obs knobs
+    without reading source)."""
+    with _lock:
+        return {k: (f.value, f.default, f.help)
+                for k, f in sorted(_registry.items())
+                if k.startswith(prefix)}
+
+
 # Core flags (counterparts of the reference's most-used runtime flags).
 define_flag("check_nan_inf", False, "scan op outputs for nan/inf like the reference's FLAGS_check_nan_inf")
 define_flag("paddle_tpu_log_level", 0, "verbosity for framework logging")

@@ -47,6 +47,57 @@ from .goodput import (  # noqa: F401
 )
 from .flight_recorder import FlightRecorder  # noqa: F401
 
+# request_trace / profiling are PEP 562 lazy: they are only needed by the
+# serving engine and the HTTP control plane (which import the submodules
+# directly), and loading them here would eat the package's import-cost
+# budget for every instrumented module that wants plain counters. Their
+# flags live HERE so set_flags / obs_dump --flags see them before either
+# module loads.
+from ..framework.flags import define_flag as _define_flag  # noqa: E402
+
+_define_flag("obs_requests_capacity", 256,
+             "finished per-request timeline retention ring (oldest "
+             "evicted); live requests are always tracked")
+_define_flag("obs_request_events_max", 512,
+             "per-request timeline event cap — decode ticks beyond it "
+             "are dropped (counted), the lifecycle events always record")
+_define_flag("obs_audit_capacity", 64,
+             "bounded retention for SLO-breach audit entries (ring AND "
+             "the per-process JSONL file cap)")
+_define_flag("obs_audit_dir", "",
+             "directory for the SLO-breach audit JSONL "
+             "(request_audit-<pid>.jsonl); empty keeps the audit "
+             "in-memory only")
+_define_flag("obs_profile_dir", "",
+             "output directory for on-demand jax.profiler captures; "
+             "empty derives paddle_tpu_profile-<pid>-<n> under the "
+             "system temp dir")
+_define_flag("obs_profile_default_steps", 5,
+             "steps one capture spans when the trigger names no count "
+             "(SIGUSR2, /control/profile without ?steps=)")
+
+_LAZY_SUBMODULES = ("request_trace", "profiling")
+_LAZY_NAMES = {
+    "RequestContext": "request_trace", "RequestTracer": "request_trace",
+    "exemplar_for_quantile": "request_trace",
+    "get_exemplar_store": "request_trace",
+    "get_request_tracer": "request_trace",
+    "requests_payload": "request_trace",
+    "ProfileController": "profiling",
+    "get_profile_controller": "profiling",
+    "request_capture": "profiling",
+}
+
+
+def __getattr__(name):
+    import importlib
+    if name in _LAZY_SUBMODULES:
+        return importlib.import_module(f".{name}", __name__)
+    mod = _LAZY_NAMES.get(name)
+    if mod is not None:
+        return getattr(importlib.import_module(f".{mod}", __name__), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 __all__ = [
     "enabled", "enable", "disable",
     "Counter", "Gauge", "Histogram", "Registry",
@@ -58,4 +109,9 @@ __all__ = [
     "MetricsServer", "start_http_server", "stop_http_server",
     "catalog", "goodput", "perf", "flight_recorder",
     "GoodputTracker", "goodput_section", "FlightRecorder",
+    "request_trace", "RequestContext", "RequestTracer",
+    "get_request_tracer", "get_exemplar_store", "exemplar_for_quantile",
+    "requests_payload",
+    "profiling", "ProfileController", "get_profile_controller",
+    "request_capture",
 ]

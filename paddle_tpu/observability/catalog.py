@@ -173,6 +173,29 @@ CATALOG = {
         "counter", ("trigger",),
         "post-mortem JSON dumps written (exception / watchdog / sigterm "
         "/ manual)"),
+    # -- per-request tracing (observability.request_trace) -----------------
+    "serving_request_queue_seconds": (
+        "histogram", (), "time from add_request to first slot admission "
+                         "(queue wait; re-admissions after preemption "
+                         "don't re-observe)"),
+    "serving_request_traces_total": (
+        "counter", (), "finished request timelines moved to the "
+                       "retention ring (serve via /request/<id>.json)"),
+    "serving_request_slo_audits_total": (
+        "counter", ("reason",),
+        "finished requests breaching FLAGS_obs_slo_{ttft,tpot}_ms whose "
+        "full timeline was auto-dumped to the audit log"),
+    "serving_request_exemplars_total": (
+        "counter", (), "TTFT/TPOT exemplar attachments — extreme "
+                       "histogram observations linked to a request_id"),
+    "serving_request_events_dropped_total": (
+        "counter", (), "per-request timeline events dropped by "
+                       "FLAGS_obs_request_events_max (decode ticks only; "
+                       "lifecycle events always record)"),
+    # -- on-demand device profiling (observability.profiling) --------------
+    "obs_profile_captures_total": (
+        "counter", (), "windowed jax.profiler device captures completed "
+                       "(/control/profile, SIGUSR2, or request_capture)"),
 }
 
 # Histogram bucket overrides: (lo, hi, per_decade) for metrics whose
@@ -193,6 +216,10 @@ SPANS = (
     # step), moe.autotune wraps a first-encounter tiling measurement,
     # moe.gmm one candidate's timed run (real device time).
     "moe.dispatch", "moe.autotune", "moe.gmm",
+    # one completed span per finished request (t0 = add_request, t1 =
+    # finish) whose request_id arg lets Perfetto filter a single
+    # request's lifetime out of /trace.json
+    "serving.request",
 )
 
 

@@ -134,7 +134,10 @@ def render_prometheus(registry: Optional[Registry] = None) -> str:
 
 
 def snapshot(registry: Optional[Registry] = None) -> Dict:
-    """One-shot JSON-serializable view of every series."""
+    """One-shot JSON-serializable view of every series. Histogram
+    families that attached exemplars (request_trace TTFT/TPOT) carry
+    them under ``exemplars`` — a snapshot file or crash post-mortem
+    then links its own p99 to a request_id without the live process."""
     reg = registry or get_registry()
     metrics = []
     for fam in reg.families():
@@ -155,9 +158,24 @@ def snapshot(registry: Optional[Registry] = None) -> Dict:
                 s["sum"] = total_sum
                 s["count"] = total
             fam_out["series"].append(s)
+        if fam.kind == "histogram":
+            exs = _family_exemplars(fam)
+            if exs:
+                fam_out["exemplars"] = exs
         metrics.append(fam_out)
     return {"version": 1, "unix_time": time.time(), "pid": os.getpid(),
             "metrics": metrics}
+
+
+def _family_exemplars(fam):
+    """Bucket exemplars of one histogram family (empty list when the
+    metric never attached any — only the request-trace call sites do)."""
+    from .request_trace import get_exemplar_store
+
+    try:
+        return get_exemplar_store().exemplars(fam.name, fam.bounds)
+    except Exception:
+        return []
 
 
 def dump_snapshot(path: str, registry: Optional[Registry] = None) -> str:

@@ -105,6 +105,16 @@ class FlightRecorder:
             out["goodput"] = goodput.get_tracker().report()
         except Exception:            # a broken tracker must not block dumps
             pass
+        try:
+            # which requests were in flight (and which were slow) when
+            # the process died — live rows ride with partial summaries
+            from . import request_trace
+
+            rp = request_trace.requests_payload()
+            if rp["requests"] or rp["audit"]:
+                out["requests"] = rp
+        except Exception:          # a broken tracer must not block dumps
+            pass
         return out
 
     def dump(self, path: Optional[str] = None, trigger: str = "manual",

@@ -36,6 +36,30 @@ __all__ = ["Span", "SpanTracer", "trace_span", "get_tracer",
 _T0_PERF = time.perf_counter()
 _T0_WALL = time.time()
 
+# While an on-demand device capture is live (observability.profiling),
+# this holds a callable name -> context manager (jax TraceAnnotation) so
+# host spans land inside the device trace. None the rest of the time —
+# trace_span pays one global read for the correlation hook.
+_ANNOTATION_FACTORY = None
+
+
+def _set_annotation_factory(fn) -> None:
+    global _ANNOTATION_FACTORY
+    _ANNOTATION_FACTORY = fn
+
+
+def _json_safe(v):
+    """Span-arg values must survive json.dump: JSON scalars and plain
+    containers pass through (containers recursively sanitized), anything
+    else (numpy scalars, arrays, objects) is stringified."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, (list, tuple)):
+        return [_json_safe(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _json_safe(x) for k, x in v.items()}
+    return str(v)
+
 
 class Span:
     __slots__ = ("name", "t0", "t1", "tid", "depth", "attrs")
@@ -104,8 +128,13 @@ class SpanTracer:
         events = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
                    "args": {"name": "paddle_tpu"}}]
         for s in self.spans():
-            args = {k: v for k, v in s.attrs.items()}
-            args["depth"] = s.depth
+            # keep EVERY span arg: values that aren't JSON scalars (a
+            # numpy int riding in from an instrumented call site) are
+            # stringified rather than dropped — and rather than aborting
+            # the whole export at json.dump time; a user arg literally
+            # named "depth" wins over the synthetic nesting field
+            args = {k: _json_safe(v) for k, v in s.attrs.items()}
+            args.setdefault("depth", s.depth)
             events.append({
                 "name": s.name, "ph": "X", "cat": "obs",
                 "pid": pid, "tid": s.tid,
@@ -150,24 +179,35 @@ class trace_span:  # noqa: N801 — context manager, lowercase like the verb
     the span you want on the timeline.
     """
 
-    __slots__ = ("name", "attrs", "_t0", "_stack")
+    __slots__ = ("name", "attrs", "_t0", "_stack", "_ann")
 
     def __init__(self, name: str, **attrs):
         self.name = name
         self.attrs = attrs
         self._t0 = None
         self._stack = None
+        self._ann = None
 
     def __enter__(self):
         # reset every entry: a reused instance must not inherit a stale
         # start time (or stack) from a previous — possibly enabled — use
         self._t0 = None
         self._stack = None
+        self._ann = None
         if not state.enabled():
             return self
         tr = _default_tracer
         self._stack = tr._stack()
         self._stack.append(self.name)
+        if _ANNOTATION_FACTORY is not None:
+            # a device capture is live (observability.profiling): mirror
+            # the span as a jax TraceAnnotation so the device trace shows
+            # which ops ran under which host phase
+            try:
+                self._ann = _ANNOTATION_FACTORY(self.name)
+                self._ann.__enter__()
+            except Exception:
+                self._ann = None
         self._t0 = time.perf_counter()
         return self
 
@@ -175,6 +215,12 @@ class trace_span:  # noqa: N801 — context manager, lowercase like the verb
         if self._t0 is None:
             return False
         t1 = time.perf_counter()
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(exc_type, exc, tb)
+            except Exception:
+                pass
+            self._ann = None
         stack = self._stack
         depth = len(stack) - 1
         if stack and stack[-1] == self.name:

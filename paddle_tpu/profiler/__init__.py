@@ -214,6 +214,20 @@ def RecordEvent(name: str, event_type=None):
             _get_tracer().record(name, t0, t1, {"src": "RecordEvent"})
 
 
+def on_demand_capture(steps: Optional[int] = None,
+                      out_dir: Optional[str] = None):
+    """Arm a windowed device capture on the observability control plane
+    (the same machinery behind ``GET /control/profile?steps=N`` and
+    SIGUSR2): the capture starts at the next engine/train step boundary
+    and stops ``steps`` boundaries later, so the trace always covers
+    whole steps. Returns the controller's status dict. Scheduled
+    multi-phase captures stay with :class:`Profiler`; this is the
+    "grab me N steps from the live job RIGHT NOW" path."""
+    from ..observability import profiling as _obs_profiling
+
+    return _obs_profiling.request_capture(steps=steps, out_dir=out_dir)
+
+
 def load_profiler_result(path):
     """Load a chrome trace written by export_chrome_tracing back into an
     EventLedger (parity surface: profiler.load_profiler_result; XPlane

@@ -66,6 +66,8 @@ from ..models.llama import (LlamaConfig, _apply_rope, _attention,
                             _rms_norm, _wmat)  # noqa: F401
 from ..observability import flight_recorder as _flight
 from ..observability import perf as _perf
+from ..observability import profiling as _profiling
+from ..observability import request_trace as _rt
 from ..observability import trace_span
 from ..observability.catalog import instrument as _instrument
 
@@ -617,6 +619,12 @@ class LLMEngine:
         if _obs.enabled():
             self._obs_t_add[rid] = time.perf_counter()
             _M_QUEUE_DEPTH.set(len(self.queue))
+            # the request_id minted here IS the distributed-trace id: it
+            # follows the request through slots, preemptions and
+            # re-admissions (observability.request_trace)
+            _rt.get_request_tracer().submit(
+                rid, prompt_tokens=len(req.prompt),
+                max_new_tokens=req.max_new_tokens)
         return rid
 
     def has_work(self) -> bool:
@@ -675,6 +683,10 @@ class LLMEngine:
             _M_PREEMPTIONS.inc()
             _flight.record("preemption", req_id=req.req_id,
                            generated=len(req.generated))
+            if _obs.enabled():
+                _rt.get_request_tracer().record(
+                    req.req_id, "preempt", slot=slot,
+                    generated=len(req.generated))
         elif req is not None:
             self.results[req.req_id] = req.generated + out
             _M_FINISHED.inc()
@@ -687,15 +699,24 @@ class LLMEngine:
             # there is no decode cadence to measure (an exact-0
             # observation would drag the SLO gauge optimistically)
             t_add = self._obs_t_add.pop(req.req_id, None)
+            tracer = _rt.get_request_tracer() if _obs.enabled() else None
             if t_add is not None and (req.generated or out):
-                _M_TTFT.observe(now - t_add)
+                if tracer is not None:
+                    tracer.record(req.req_id, "first_token")
+                _rt.observe_with_exemplar(_M_TTFT, now - t_add,
+                                          req.req_id)
             elif t_first is not None:
                 # TPOT = decode latency after first-token visibility, per
                 # subsequent token (the depth-1 pipeline batches
                 # readbacks; the histogram tracks steady-state cadence)
                 n_out = len(req.generated) + len(out)
                 if n_out > 1:
-                    _M_TPOT.observe((now - t_first) / (n_out - 1))
+                    _rt.observe_with_exemplar(
+                        _M_TPOT, (now - t_first) / (n_out - 1),
+                        req.req_id)
+            if tracer is not None:
+                tracer.finish(req.req_id,
+                              tokens=len(self.results[req.req_id]))
 
     def _admit(self):
         """Admit every queued request a free slot and free blocks can
@@ -763,13 +784,24 @@ class LLMEngine:
                  sampled and any(r.top_p < 1.0 for _, r, _, _, _ in wave
                                  if r.temperature > 0))
         self._key, sub = jax.random.split(self._key)
+        wave_rids = [r.req_id for _, r, _, _, _ in wave]
+        if _obs.enabled():
+            tracer = _rt.get_request_tracer()
+            for slot, req, tl, _ctx, _blocks in wave:
+                # "admitted" first time, "resumed" after a preemption —
+                # the tracer keys on whether this id was admitted before
+                tracer.admitted(req.req_id, slot=slot, context_tokens=tl)
         with trace_span("serving.prefill", bucket=bucket, batch=B,
-                        wave=len(wave)):
+                        wave=len(wave), request_ids=wave_rids):
             tok_dev, self.pools = self._prefill_fn(bucket, B, flags)(
                 self.params, jnp.asarray(toks), jnp.asarray(blk_ids),
                 jnp.asarray(true_lens), self.pools,
                 jnp.asarray(temps), jnp.asarray(top_ks),
                 jnp.asarray(top_ps), sub)
+        if _obs.enabled():
+            for slot, req, _tl, _ctx, _blocks in wave:
+                _rt.get_request_tracer().record(
+                    req.req_id, "prefill", bucket=bucket, batch=B)
         _M_ADMISSIONS.inc(len(wave))
         for i, (slot, req, _, _, _) in enumerate(wave):
             # reference the WHOLE [B] first-token array + row index: the
@@ -1021,7 +1053,8 @@ class LLMEngine:
                     allow_compile=False)
             self._last_decode_flops = self._decode_flops[vk]
         with trace_span("serving.decode", slots=len(active_slots),
-                        steps=self.decode_steps, prefix_bucket=nbk * self.bs):
+                        steps=self.decode_steps, prefix_bucket=nbk * self.bs,
+                        request_ids=[r.req_id for r in reqs]):
             (toks, c_last, c_len, c_done, c_rem, c_key,
              self.pools) = decode(
                 self.params, c_last, c_len, c_done, c_rem, c_key, v_act,
@@ -1104,9 +1137,15 @@ class LLMEngine:
 
         Observability (FLAGS_obs_enabled): each call lands a
         ``serving.step`` span (prefill/decode/readback nested inside),
-        a step-duration + tokens/sec observation, TTFT for requests whose
-        first token became visible, and the queue/slot/KV-pool gauges.
-        Disabled, this wrapper costs one boolean check."""
+        a step-duration + tokens/sec observation, TTFT (with a
+        request_id exemplar) for requests whose first token became
+        visible, a per-request decode tick on the timeline, and the
+        queue/slot/KV-pool gauges. Disabled, this wrapper costs one
+        boolean check (plus the idle profiling-tick global read)."""
+        # on-demand device-capture window boundary (near-zero when no
+        # capture is armed; deliberately OUTSIDE the enabled() gate — a
+        # capture is an explicit operator action, not ambient telemetry)
+        _profiling.step_tick()
         if not _obs.enabled():
             return self._step_inner()
         t0 = time.perf_counter()
@@ -1119,11 +1158,19 @@ class LLMEngine:
             _M_TOKENS.inc(len(emitted))
             if dt > 0:
                 _M_TPS.observe(len(emitted) / dt)
+            tracer = _rt.get_request_tracer()
+            step_toks: Dict[int, int] = {}
             for rid, _tok in emitted:
+                step_toks[rid] = step_toks.get(rid, 0) + 1
                 t_add = self._obs_t_add.pop(rid, None)
                 if t_add is not None:
-                    _M_TTFT.observe(now - t_add)
+                    tracer.record(rid, "first_token")
+                    _rt.observe_with_exemplar(_M_TTFT, now - t_add, rid)
                     self._obs_t_first[rid] = now
+            for rid, n in step_toks.items():
+                # one decode tick per request per step (finished
+                # requests already left the live table — no-op there)
+                tracer.record(rid, "decode", tokens=n)
         if self._last_decode_flops:
             m = _perf.mfu(self._last_decode_flops, dt)
             if m is not None:

@@ -347,4 +347,8 @@ def test_obs_dump_demo_serving_smoke(tmp_path):
                  "serving_decode_recompiles_total",
                  "serving_decode_kv_read_bytes"):
         assert name in out, (name, out[-2000:])
+    # r7: the demo ends with the per-request table + exemplar pointer
+    assert "requests: 3 traced" in out, out[-2000:]
+    assert "ttft_ms" in out and "preempt" in out
+    assert "exemplar: request" in out
     assert (tmp_path / "snapshot.json").exists()

@@ -23,6 +23,14 @@ Two modes (slow-lane tooling, like tools/chaos_run.py):
   is set, or by ``observability.flight_recorder.dump``)::
 
       python tools/obs_dump.py --postmortem /tmp/obs/postmortem-1234-1.json
+
+- print the per-request table (timelines + TTFT/TPOT exemplars) from a
+  live exposition server's ``/requests.json`` — or a saved copy — worst
+  request first; ``--watch`` refreshes it top-style::
+
+      python tools/obs_dump.py --requests http://127.0.0.1:9464
+      python tools/obs_dump.py --requests reqs.json --sort tpot
+      python tools/obs_dump.py --requests http://127.0.0.1:9464 --watch
 """
 import argparse
 import os
@@ -58,6 +66,95 @@ def print_table(snap, out=sys.stdout):
     for name, kind, lbl, val in rows:
         out.write(f"{name:{w0}}  {kind:{w1}}  {lbl:{w2}}  {val}\n")
     return rows
+
+
+def _fmt_ms(v):
+    return f"{v:.1f}" if isinstance(v, (int, float)) else "-"
+
+
+def print_request_table(payload, out=sys.stdout):
+    """Render a ``/requests.json`` payload (requests_payload format):
+    one row per request, worst first, plus the exemplar pointers that
+    turn a p99 reading into a request_id."""
+    rows = payload.get("requests") or []
+    out.write(f"requests: {len(rows)} traced, "
+              f"{payload.get('live', 0)} live "
+              f"(sort={payload.get('sort', 'ttft')})\n")
+    if not rows:
+        out.write("(no traced requests — enable observability and "
+                  "serve traffic)\n")
+        return rows
+    hdr = (f"{'request':>8} {'state':>6} {'queue_ms':>9} {'ttft_ms':>9} "
+           f"{'tpot_ms':>8} {'tok/s':>8} {'tokens':>6} {'preempt':>7}\n")
+    out.write(hdr)
+    out.write("-" * (len(hdr) - 1) + "\n")
+    for r in rows:
+        tps = r.get("decode_tps")
+        tps_s = f"{tps:.1f}" if isinstance(tps, (int, float)) else "-"
+        out.write(f"{str(r.get('request_id')):>8} "
+                  f"{'live' if r.get('live') else 'done':>6} "
+                  f"{_fmt_ms(r.get('queue_ms')):>9} "
+                  f"{_fmt_ms(r.get('ttft_ms')):>9} "
+                  f"{_fmt_ms(r.get('tpot_ms')):>8} "
+                  f"{tps_s:>8} "
+                  f"{r.get('tokens', 0):>6} "
+                  f"{r.get('preemptions', 0):>7}\n")
+    for name, qs in (payload.get("exemplar_quantiles") or {}).items():
+        for q, ex in qs.items():
+            out.write(f"{q} {name} exemplar: request "
+                      f"{ex.get('request_id')} "
+                      f"({ex.get('value', 0) * 1e3:.1f} ms) — "
+                      f"GET /request/{ex.get('request_id')}.json\n")
+    audits = payload.get("audit") or []
+    if audits:
+        out.write(f"SLO audit entries: {len(audits)} (latest: request "
+                  f"{audits[-1].get('request_id')} "
+                  f"{'+'.join(audits[-1].get('reasons', []))})\n")
+    return rows
+
+
+def _fetch_requests(src, sort):
+    """The payload behind --requests: a URL (live server, ?sort= added)
+    or a saved JSON file."""
+    import json
+    import urllib.parse
+    import urllib.request
+
+    if src.startswith(("http://", "https://")):
+        # append /requests.json to the PATH (a caller-supplied query
+        # string must survive, not have the path glued onto it)
+        parts = urllib.parse.urlsplit(src)
+        path = parts.path.rstrip("/")
+        if not path.endswith("/requests.json"):
+            path += "/requests.json"
+        query = f"{parts.query}&sort={sort}" if parts.query \
+            else f"sort={sort}"
+        url = urllib.parse.urlunsplit(
+            (parts.scheme, parts.netloc, path, query, ""))
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return json.load(r)
+    with open(src) as f:
+        return json.load(f)
+
+
+def requests_mode(src, sort, watch, interval):
+    if not watch:
+        print_request_table(_fetch_requests(src, sort))
+        return 0
+    import io as _io
+    import time as _time
+
+    try:
+        while True:
+            payload = _fetch_requests(src, sort)
+            buf = _io.StringIO()
+            print_request_table(payload, out=buf)
+            # top-style refresh: clear + home, one atomic write
+            sys.stdout.write("\x1b[2J\x1b[H" + buf.getvalue())
+            sys.stdout.flush()
+            _time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
 
 
 def demo_serving():
@@ -102,6 +199,8 @@ def demo_serving():
           f"{int(reg.counter('serving_decode_recompiles_total').labels().value)}"
           "; kv bytes/call: "
           f"{int(reg.gauge('serving_decode_kv_read_bytes').labels().value)}")
+    print()
+    print_request_table(obs.requests_payload())
 
 
 def demo_moe():
@@ -222,6 +321,10 @@ def print_postmortem(path, out=sys.stdout):
         detail = "  ".join(f"{k}={v}" for k, v in rest.items())
         out.write(f"  {ev['t'] - t_end:+9.3f}s  {ev['kind']:20s} "
                   f"{detail}\n")
+    reqs = doc.get("requests")
+    if reqs:
+        out.write("\nrequests at dump:\n")
+        print_request_table(reqs, out=out)
     metrics = doc.get("metrics")
     if metrics:
         out.write("\nmetrics at dump:\n")
@@ -234,6 +337,23 @@ def main():
                     help="print the table from an existing JSON snapshot")
     ap.add_argument("--postmortem", default=None,
                     help="pretty-print a flight-recorder post-mortem dump")
+    ap.add_argument("--requests", default=None, metavar="URL_OR_FILE",
+                    help="print the per-request table from a live "
+                         "exposition server base URL (/requests.json is "
+                         "appended) or a saved payload file")
+    ap.add_argument("--sort", default="ttft",
+                    choices=("ttft", "tpot", "queue", "tokens",
+                             "finished"),
+                    help="--requests sort column (worst/highest first)")
+    ap.add_argument("--watch", action="store_true",
+                    help="with --requests URL: refresh the table "
+                         "top-style until interrupted")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="--watch refresh period in seconds")
+    ap.add_argument("--flags", default=None, metavar="PREFIX",
+                    nargs="?", const="obs_",
+                    help="print registered FLAGS_* (value/default/help); "
+                         "optional prefix filter, default obs_")
     ap.add_argument("--demo", choices=("serving", "train", "moe",
                                        "goodput"),
                     default=None,
@@ -250,9 +370,21 @@ def main():
     if args.postmortem:
         print_postmortem(args.postmortem)
         return 0
+    if args.requests:
+        return requests_mode(args.requests, args.sort, args.watch,
+                             args.interval)
+    if args.flags is not None:
+        import paddle_tpu.observability  # noqa: F401  (registers FLAGS_obs_*)
+        from paddle_tpu.framework.flags import flag_entries
+
+        for name, (value, default, help_) in flag_entries(
+                args.flags).items():
+            mark = "" if value == default else f"  (default {default!r})"
+            print(f"FLAGS_{name} = {value!r}{mark}\n    {help_}")
+        return 0
     if args.demo is None:
-        ap.error("pass --snapshot PATH, --postmortem PATH or "
-                 "--demo {serving,train,moe,goodput}")
+        ap.error("pass --snapshot PATH, --postmortem PATH, --requests "
+                 "URL_OR_FILE or --demo {serving,train,moe,goodput}")
 
     import paddle_tpu.observability as obs
 
