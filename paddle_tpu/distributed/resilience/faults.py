@@ -23,10 +23,18 @@ import jax.numpy as jnp
 
 from ...framework.flags import define_flag, get_flag
 
-__all__ = ["FaultInjector", "SimulatedCrash", "FAULT_KINDS"]
+__all__ = ["FaultInjector", "SimulatedCrash", "FAULT_KINDS",
+           "SERVING_FAULT_KINDS"]
+
+# serving-path kinds (LLMEngine(injector=...)): readback_fail crashes the
+# decode readback (SimulatedCrash — ResilientEngine's recovery surface),
+# slow_step stalls one engine step host-side (SLO/watchdog pressure),
+# pool_squeeze steals half the free KV blocks for two steps (external
+# pool pressure — the preemption/swap path's trigger)
+SERVING_FAULT_KINDS = ("readback_fail", "slow_step", "pool_squeeze")
 
 FAULT_KINDS = ("nan_grad", "inf_grad", "crash", "collective_timeout",
-               "storage_fail")
+               "storage_fail") + SERVING_FAULT_KINDS
 
 define_flag("ft_fault_schedule", "",
             "comma list of kind@step faults to inject, e.g. "

@@ -1,4 +1,4 @@
-"""Retry with exponential backoff for transient bootstrap failures.
+"""Retry with exponential backoff + full jitter for transient failures.
 
 Rendezvous and collective init are the classic transient-failure zone:
 the master's port is in TIME_WAIT, a peer pod is still booting, the GCS
@@ -6,9 +6,18 @@ endpoint drops the first connection. The reference retries these inside
 its C++ socket layer (socket.cpp retry loop); here one policy serves
 ``distributed.store`` (TCPStore connect) and ``distributed.env``
 (jax.distributed.initialize).
+
+Jitter matters at fleet scale: a pod-wide preemption restarts N replicas
+off the SAME failure at the SAME instant, and a fixed exponential
+schedule has all N reconnect in lockstep — every retry wave is a
+synchronized thundering herd against the TCPStore that just came back.
+Each delay is therefore drawn uniformly from ``(0, cap]`` where ``cap``
+is the exponential envelope (AWS "full jitter"): the herd spreads over
+the whole window while the envelope still bounds total wait.
 """
 from __future__ import annotations
 
+import random
 import time
 from typing import Callable, Tuple, Type
 
@@ -21,6 +30,10 @@ define_flag("ft_bootstrap_retries", 3,
             "backoff); 0 disables retries")
 define_flag("ft_bootstrap_backoff", 0.1,
             "base delay in seconds for bootstrap retry backoff")
+define_flag("ft_bootstrap_jitter", True,
+            "full jitter on the bootstrap backoff: each delay is uniform "
+            "in (0, envelope] so restarting replicas spread instead of "
+            "thundering the store in lockstep")
 
 
 def retry_call(fn: Callable, *args,
@@ -28,16 +41,24 @@ def retry_call(fn: Callable, *args,
                factor: float = 2.0, max_delay: float = 10.0,
                exceptions: Tuple[Type[BaseException], ...] = (Exception,),
                on_retry: Callable = None, sleep: Callable = time.sleep,
+               jitter: bool = None, rand: Callable[[], float] = None,
                **kwargs):
     """Call ``fn(*args, **kwargs)``; on an exception in ``exceptions``,
-    retry up to ``retries`` more times with delays
-    ``base_delay * factor**attempt`` (capped at ``max_delay``). The last
-    failure re-raises. ``on_retry(attempt, exc)`` observes each retry;
-    ``sleep`` is injectable for tests."""
+    retry up to ``retries`` more times. The attempt's delay envelope is
+    ``min(max_delay, base_delay * factor**attempt)``; with ``jitter``
+    (default: ``FLAGS_ft_bootstrap_jitter``) the actual delay is drawn
+    uniformly from (0, envelope] — ``rand`` is injectable (a seeded
+    ``random.Random(...).random``) for deterministic tests, as is
+    ``sleep``. The last failure re-raises; ``on_retry(attempt, exc)``
+    observes each retry."""
     if retries is None:
         retries = get_flag("ft_bootstrap_retries")
     if base_delay is None:
         base_delay = get_flag("ft_bootstrap_backoff")
+    if jitter is None:
+        jitter = get_flag("ft_bootstrap_jitter")
+    if rand is None:
+        rand = random.random
     attempt = 0
     while True:
         try:
@@ -47,5 +68,6 @@ def retry_call(fn: Callable, *args,
                 raise
             if on_retry is not None:
                 on_retry(attempt, e)
-            sleep(min(max_delay, base_delay * (factor ** attempt)))
+            cap = min(max_delay, base_delay * (factor ** attempt))
+            sleep(cap * rand() if jitter else cap)
             attempt += 1

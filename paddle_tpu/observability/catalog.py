@@ -50,6 +50,34 @@ CATALOG = {
     "serving_decode_kv_read_bytes": (
         "gauge", (), "K/V pool bytes one decode call gathers at the "
                      "current prefix bucket (int8 pools halve this)"),
+    # -- serving survivability (admission, deadlines, kv_swap, recovery) ---
+    "serving_shed_total": (
+        "counter", ("reason",),
+        "requests rejected by admission control (queue_full / "
+        "rate_limited / pool_pressure) — overload degrades, never "
+        "collapses"),
+    "serving_deadline_exceeded_total": (
+        "counter", (), "requests evicted at their per-request deadline "
+                       "(queued or mid-decode; KV blocks freed, partial "
+                       "tokens delivered)"),
+    "serving_kv_swap_out_total": (
+        "counter", (), "preempted slots whose KV blocks moved to the "
+                       "host-RAM swap tier instead of being discarded"),
+    "serving_kv_swap_in_total": (
+        "counter", (), "re-admissions restored from the host swap tier "
+                       "(one h2d block copy instead of a full "
+                       "re-prefill)"),
+    "serving_kv_swap_fallback_total": (
+        "counter", ("reason",),
+        "preemptions that fell back to recompute (host_pool_full / "
+        "nothing_to_keep)"),
+    "serving_kv_swap_host_bytes": (
+        "gauge", (), "bytes resident in the pinned host-RAM KV swap "
+                     "pool"),
+    "serving_engine_recoveries_total": (
+        "counter", (), "crashed engine steps recovered by "
+                       "ResilientEngine (poisoned in-flight wave "
+                       "dropped, requests re-enqueued)"),
     # -- training (ResilientTrainLoop) ------------------------------------
     "train_steps_total": (
         "counter", (), "committed optimizer steps"),

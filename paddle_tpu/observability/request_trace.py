@@ -115,8 +115,14 @@ class RequestContext:
         if tokens is None:
             tokens = sum(int(ev.get("tokens", 0)) for ev in self.events
                          if ev["kind"] in ("decode", "first_token"))
+        # terminal disposition ("finished" / "shed" / "deadline_exceeded"
+        # from the engine's finish reason); None while the request lives
+        # or when the finisher predates reason reporting
+        reason = next((str(ev["reason"]) for ev in reversed(self.events)
+                       if ev["kind"] == "finish" and "reason" in ev), None)
         s = {
             "request_id": self.request_id,
+            "reason": reason,
             "queued_unix": t_q,
             "finished_unix": t_end,
             "duration_ms": (t_end - t_q) * 1e3,

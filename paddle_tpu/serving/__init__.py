@@ -9,7 +9,19 @@ TPU-native re-design (engine.py): instead of a fused CUDA kernel called from
 user-managed buffers, the engine owns ONE jit-compiled decode step over a
 static slot batch (any mix of live requests recompiles nothing), a host-side
 block allocator with admission/preemption, and bucketed prefill programs.
-"""
-from .engine import LLMEngine, Request
 
-__all__ = ["LLMEngine", "Request"]
+Survivability layer (admission.py / kv_swap.py / resilient.py): bounded
+admission with per-tenant rate limits and typed load shedding
+(ShedError), per-request deadlines, preempt-to-host KV swap instead of
+recompute, and a crash-recovering ResilientEngine wrapper — see
+docs/serving.md §Degraded modes.
+"""
+from .admission import (AdmissionConfig, AdmissionController, ShedError,
+                        TokenBucket)
+from .engine import LLMEngine, Request
+from .kv_swap import HostKVPool
+from .resilient import ResilientEngine
+
+__all__ = ["LLMEngine", "Request", "ResilientEngine", "AdmissionConfig",
+           "AdmissionController", "ShedError", "TokenBucket",
+           "HostKVPool"]

@@ -60,6 +60,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import observability as _obs
+from ..distributed.resilience.faults import SimulatedCrash
 from ..kernels.quant_matmul import (attn_pv, attn_qk, quantize_kv,
                                     weight_only_matmul as _wo_mm)
 from ..models.llama import (LlamaConfig, _apply_rope, _attention,
@@ -70,6 +71,8 @@ from ..observability import profiling as _profiling
 from ..observability import request_trace as _rt
 from ..observability import trace_span
 from ..observability.catalog import instrument as _instrument
+from .admission import AdmissionConfig, AdmissionController, ShedError
+from .kv_swap import HostKVPool
 
 __all__ = ["LLMEngine", "Request"]
 
@@ -91,6 +94,8 @@ _M_DECODE_RECOMPILES = _instrument("serving_decode_recompiles_total")
 _M_KV_READ_BYTES = _instrument("serving_decode_kv_read_bytes")
 _M_TPOT = _instrument("serving_tpot_seconds")
 _M_SERVING_MFU = _instrument("serving_mfu")
+_M_DEADLINE = _instrument("serving_deadline_exceeded_total")
+_M_SWAP_FALLBACK = _instrument("serving_kv_swap_fallback_total")
 
 
 @dataclasses.dataclass
@@ -102,6 +107,14 @@ class Request:
     top_k: int = 0
     top_p: float = 1.0
     eos_token_id: Optional[int] = None
+    # latency budget in seconds from add_request; past it the request is
+    # evicted (queued or mid-decode), its KV blocks freed, partial tokens
+    # delivered with finish reason "deadline_exceeded". None = no deadline.
+    deadline_s: Optional[float] = None
+    # admission-control tenant for the per-tenant token-bucket rate limit
+    tenant: str = "default"
+    # absolute perf_counter deadline, stamped by add_request
+    t_deadline: Optional[float] = None
     # tokens generated before a preemption; a re-admission prefills
     # prompt+generated so already-streamed tokens are never re-emitted
     # (vLLM recompute semantics)
@@ -460,7 +473,8 @@ class LLMEngine:
                  block_size: int = 16, max_model_len: int = 512,
                  num_blocks: Optional[int] = None,
                  prompt_buckets: Optional[List[int]] = None, seed: int = 0,
-                 mesh=None, decode_steps: int = 1, kv_dtype=None):
+                 mesh=None, decode_steps: int = 1, kv_dtype=None,
+                 admission=None, kv_swap_bytes: int = 0, injector=None):
         """``params`` may be dense (bf16/f32) or int8 weight-only
         (llama.quantize_params) — quantized leaves feed the decode/prefill
         matmuls unconverted (kernels/quant_matmul.weight_only_matmul).
@@ -482,6 +496,25 @@ class LLMEngine:
         ``"int8"`` quantizes them with per-entry scales (dequant fused
         into the bucketed attention contractions) — half the decode KV
         traffic and double the effective block capacity at the same HBM.
+
+        ``admission``: an :class:`AdmissionConfig` (or a prebuilt
+        :class:`AdmissionController`) enabling load shedding —
+        ``add_request`` raises :class:`ShedError` (typed: queue_full /
+        rate_limited / pool_pressure) instead of queueing unboundedly
+        under sustained overload. ``None`` admits everything.
+
+        ``kv_swap_bytes``: capacity of the pinned host-RAM KV swap tier
+        (:mod:`paddle_tpu.serving.kv_swap`). Non-zero turns preemption
+        from recompute into swap: the victim's pool blocks move to host
+        memory and re-admission restores them bit-exactly with one h2d
+        copy instead of a full re-prefill; recompute remains the
+        fallback when the host pool is full. 0 keeps pure recompute.
+
+        ``injector``: a resilience ``FaultInjector`` whose serving kinds
+        (``readback_fail`` / ``slow_step`` / ``pool_squeeze``, keyed by
+        engine step index) fire inside the step loop — the seeded chaos
+        surface behind ``tools/chaos_run.py --serving`` and
+        :class:`~paddle_tpu.serving.resilient.ResilientEngine`.
 
         Pipelining caveat: the engine dispatches call k+1 before reading
         call k's tokens only when every in-flight slot is GUARANTEED
@@ -592,6 +625,39 @@ class LLMEngine:
         # None = analysis unavailable on this jax/backend
         self._decode_flops: Dict = {}
         self._last_decode_flops = None
+        # -- survivability layer (deadlines / shedding / swap / chaos) ----
+        self.admission = (AdmissionController(admission)
+                          if isinstance(admission, AdmissionConfig)
+                          else admission)
+        self.swap_pool = (HostKVPool(kv_swap_bytes) if kv_swap_bytes
+                          else None)
+        self.injector = injector
+        # terminal disposition per request id: every id that entered
+        # add_request ends in exactly one of finished / shed /
+        # deadline_exceeded (the chaos-suite contract)
+        self.finish_reasons: Dict[int, str] = {}
+        self._step_idx = 0
+        # blocks held hostage by an injected pool_squeeze, with their
+        # release step — counted by block_accounting so the free+backed+
+        # squeezed invariant holds THROUGH the fault
+        self._squeezed: List = []
+        # swap-ins whose carry lanes await their host-known state
+        # ((slot, req_id); the recompute path uses _pending_adm instead)
+        self._pending_swapin: List = []
+        # slots (re)admitted via swap since the last dispatch: their
+        # rem_start must come from host state, never the previous
+        # record's chained countdown (the slot id may be recycled)
+        self._fresh_swapins: set = set()
+        self._swapin_cache: Dict = {}
+        # requests currently carrying a deadline — the per-step expiry
+        # sweep is skipped entirely at 0, so deadline-free traffic pays
+        # nothing for the feature (no O(queue) scan in the hot loop)
+        self._deadline_live = 0
+        # every (rid, tok) pair committed host-side THIS step, in commit
+        # order — the crash-salvage buffer: a step that raises after
+        # committing tokens must still deliver them exactly once
+        # (ResilientEngine returns this on recovery)
+        self._step_emitted: List = []
 
     # -- public api ---------------------------------------------------------
     @property
@@ -615,7 +681,29 @@ class LLMEngine:
             raise ValueError(
                 f"request {rid}: prompt length {len(req.prompt)} exceeds "
                 f"the largest prompt bucket {self.buckets[-1]}")
+        if req.deadline_s is not None:
+            req.t_deadline = time.perf_counter() + float(req.deadline_s)
+        if self.admission is not None:
+            reason = self.admission.check(
+                req, queue_depth=len(self.queue),
+                free_frac=len(self.free_blocks) / max(1, self.nb - 1))
+            if reason is not None:
+                # reject-newest load shedding: fail THIS request in
+                # microseconds (typed, maps to HTTP 429/503) so the
+                # admitted ones keep their latency
+                self.finish_reasons[rid] = "shed"
+                _flight.record("request_shed", req_id=rid, reason=reason)
+                if _obs.enabled():
+                    tracer = _rt.get_request_tracer()
+                    tracer.submit(rid, prompt_tokens=len(req.prompt),
+                                  max_new_tokens=req.max_new_tokens,
+                                  tenant=req.tenant)
+                    tracer.finish(rid, tokens=0, reason="shed",
+                                  shed_reason=reason)
+                raise ShedError(reason, rid)
         self.queue.append(req)
+        if req.t_deadline is not None:
+            self._deadline_live += 1
         if _obs.enabled():
             self._obs_t_add[rid] = time.perf_counter()
             _M_QUEUE_DEPTH.set(len(self.queue))
@@ -657,8 +745,16 @@ class LLMEngine:
             self._prefill[key] = fn
         return fn
 
-    def _free_slot(self, slot: int, requeue: bool = False):
+    def _free_slot(self, slot: int, requeue: bool = False,
+                   reason: str = "finished", swap: bool = True):
         req = self.slot_req[slot]
+        out = self.slot_out[slot]
+        swapped = False
+        if requeue and req is not None and swap \
+                and self.swap_pool is not None:
+            # swap-instead-of-recompute: move the victim's blocks to the
+            # host tier BEFORE they are freed (fallback: plain recompute)
+            swapped = self._swap_out(slot, req, out)
         for j in range(int(self.n_alloc[slot])):
             self.free_blocks.append(int(self.table[slot, j]))
         self.table[slot, :] = 0
@@ -667,29 +763,42 @@ class LLMEngine:
         self.slot_req[slot] = None
         if slot in self.admit_order:
             self.admit_order.remove(slot)
-        out = self.slot_out[slot]
         self.slot_out[slot] = []
         self._table_dirty = True
         self._slots_dirty = True
         # an admission whose first token was never read back dies with the
         # slot (recompute semantics: re-admission prefills and re-samples)
         self._pending_adm = [e for e in self._pending_adm if e[0] != slot]
+        self._pending_swapin = [e for e in self._pending_swapin
+                                if e[0] != slot]
+        self._fresh_swapins.discard(slot)
         if requeue and req is not None:
-            # recompute-preemption: carry generated tokens so re-admission
-            # prefills prompt+generated — streamed tokens stay valid and
-            # are never re-emitted
+            # preemption: carry generated tokens so re-admission continues
+            # from prompt+generated — streamed tokens stay valid and are
+            # never re-emitted (swap-in restores their KV; recompute
+            # re-prefills it)
             req.generated.extend(out)
             self.queue.appendleft(req)
             _M_PREEMPTIONS.inc()
             _flight.record("preemption", req_id=req.req_id,
-                           generated=len(req.generated))
+                           generated=len(req.generated), swapped=swapped)
             if _obs.enabled():
                 _rt.get_request_tracer().record(
                     req.req_id, "preempt", slot=slot,
-                    generated=len(req.generated))
+                    generated=len(req.generated), swapped=swapped)
         elif req is not None:
             self.results[req.req_id] = req.generated + out
-            _M_FINISHED.inc()
+            self.finish_reasons[req.req_id] = reason
+            if req.t_deadline is not None:
+                self._deadline_live = max(0, self._deadline_live - 1)
+            if self.swap_pool is not None:
+                self.swap_pool.discard(req.req_id)
+            if reason == "deadline_exceeded":
+                _M_DEADLINE.inc()
+                _flight.record("deadline_exceeded", req_id=req.req_id,
+                               tokens=len(self.results[req.req_id]))
+            else:
+                _M_FINISHED.inc()
             now = time.perf_counter()
             t_first = self._obs_t_first.pop(req.req_id, None)
             # a request that finishes in the SAME step its first token
@@ -716,7 +825,179 @@ class LLMEngine:
                         req.req_id)
             if tracer is not None:
                 tracer.finish(req.req_id,
-                              tokens=len(self.results[req.req_id]))
+                              tokens=len(self.results[req.req_id]),
+                              reason=reason)
+
+    # -- survivability: swap, deadlines, chaos ------------------------------
+    def _swap_out(self, slot: int, req: Request, out: List[int]) -> bool:
+        """Copy the slot's live KV blocks to the host tier. Keeps
+        ``len(ctx) - 1`` positions where ``ctx = prompt + generated +
+        out``: the context tail is the re-admission's next decode input,
+        whose K/V the first restored decode step rewrites — so a slot
+        whose sampled-but-unread first token died with it (KV covers ALL
+        of ctx) and a mid-decode victim (KV covers ctx[:-1]) restore
+        through one invariant. Returns False on fallback (host pool
+        full / nothing to keep) — the caller then recomputes."""
+        n_keep = len(req.prompt) + len(req.generated) + len(out) - 1
+        if n_keep <= 0 or self.lengths[slot] < n_keep:
+            # every swap-enabled preemption lands in swap_out OR fallback
+            # — an uncounted recompute would hide a swap-tier regression
+            _M_SWAP_FALLBACK.inc(reason="nothing_to_keep")
+            return False
+        nb_keep = -(-n_keep // self.bs)
+        blocks = np.asarray(self.table[slot, :nb_keep], np.int32)
+        # one bounded d2h per pool entry: int8 payload AND scales move
+        # verbatim, so the restore is bit-exact (no requantization drift)
+        data = {name: np.asarray(jax.device_get(pool[:, blocks]))
+                for name, pool in self.pools.items()}
+        return self.swap_pool.put(req.req_id, data, n_tokens=n_keep)
+
+    def _swapin_fn(self, nb: int):
+        """One compiled restore per block count: scatter every host pool
+        entry back into freshly allocated blocks, pools donated (the
+        multi-GB pools are patched in place, never copied)."""
+        fn = self._swapin_cache.get(nb)
+        if fn is None:
+            names = sorted(self.pools)
+
+            def restore(pools, blk, *data):
+                pools = dict(pools)
+                for name, d in zip(names, data):
+                    pools[name] = pools[name].at[:, blk].set(d)
+                return pools
+
+            fn = self._swapin_cache[nb] = jax.jit(restore,
+                                                  donate_argnums=(0,))
+        return fn
+
+    def _swap_in(self, slot: int, req: Request, ent) -> None:
+        """Re-admit a preempted request from its host-tier KV: allocate
+        blocks, restore the payload, and rebuild host bookkeeping — a
+        short h2d instead of a full re-prefill."""
+        blocks = [self.free_blocks.popleft()
+                  for _ in range(max(1, ent.n_blocks))]
+        self.table[slot, :len(blocks)] = blocks
+        self.n_alloc[slot] = len(blocks)
+        self.lengths[slot] = ent.n_tokens
+        self.slot_req[slot] = req
+        self.admit_order.append(slot)
+        self._table_dirty = True
+        self._slots_dirty = True
+        if ent.n_blocks:
+            names = sorted(ent.data)
+            blk = jnp.asarray(np.asarray(blocks[:ent.n_blocks], np.int32))
+            self.pools = self._swapin_fn(ent.n_blocks)(
+                self.pools, blk, *[jnp.asarray(ent.data[n])
+                                   for n in names])
+        self._pending_swapin.append((slot, req.req_id))
+        self._fresh_swapins.add(slot)
+        _M_ADMISSIONS.inc()
+        _flight.record("kv_swap_in", req_id=req.req_id,
+                       tokens=ent.n_tokens, blocks=ent.n_blocks)
+        if _obs.enabled():
+            _rt.get_request_tracer().admitted(
+                req.req_id, slot=slot, context_tokens=ent.n_tokens,
+                swapped_in=True)
+
+    def _finish_expired(self, req: Request, out: List[int],
+                        queued: bool) -> None:
+        """Terminal bookkeeping for a deadline-evicted request (partial
+        tokens delivered; its trace closes with deadline_exceeded)."""
+        rid = req.req_id
+        self.results[rid] = out
+        self.finish_reasons[rid] = "deadline_exceeded"
+        self._deadline_live = max(0, self._deadline_live - 1)
+        if self.swap_pool is not None:
+            self.swap_pool.discard(rid)
+        _M_DEADLINE.inc()
+        _flight.record("deadline_exceeded", req_id=rid, queued=queued,
+                       tokens=len(out))
+        self._obs_t_add.pop(rid, None)
+        self._obs_t_first.pop(rid, None)
+        if _obs.enabled():
+            _rt.get_request_tracer().finish(
+                rid, tokens=len(out), reason="deadline_exceeded")
+
+    def _expire_deadlines(self) -> None:
+        """Evict every request past its deadline — queued (cheap) and
+        in-slot (KV blocks freed; the in-flight record's lanes for the
+        slot are skipped at readback via the (slot, rid) snapshot
+        check). Free when no live request carries a deadline."""
+        if not self._deadline_live:
+            return
+        now = time.perf_counter()
+        if any(r.t_deadline is not None and now >= r.t_deadline
+               for r in self.queue):
+            kept = deque()
+            for req in self.queue:
+                if req.t_deadline is not None and now >= req.t_deadline:
+                    self._finish_expired(req, list(req.generated),
+                                         queued=True)
+                else:
+                    kept.append(req)
+            self.queue = kept
+        for slot in self._active_slots():
+            req = self.slot_req[slot]
+            if req.t_deadline is not None and now >= req.t_deadline:
+                self._free_slot(slot, reason="deadline_exceeded")
+
+    def _apply_faults(self) -> None:
+        """Release expired pool squeezes, then fire this step's injected
+        serving faults (slow_step / pool_squeeze here; readback_fail at
+        the readback site in :meth:`_process`)."""
+        if self._squeezed:
+            keep = []
+            for release_step, blocks in self._squeezed:
+                if self._step_idx >= release_step:
+                    self.free_blocks.extend(blocks)
+                else:
+                    keep.append((release_step, blocks))
+            self._squeezed = keep
+        inj = self.injector
+        if inj is None:
+            return
+        if inj.fires("slow_step", self._step_idx):
+            _flight.record("injected_slow_step", step=self._step_idx)
+            time.sleep(0.02)
+        if inj.fires("pool_squeeze", self._step_idx):
+            n = min(max(1, (self.nb - 1) // 2), len(self.free_blocks))
+            taken = [self.free_blocks.popleft() for _ in range(n)]
+            if taken:
+                self._squeezed.append((self._step_idx + 2, taken))
+            _flight.record("injected_pool_squeeze", step=self._step_idx,
+                           blocks=len(taken))
+
+    def recover_crashed_step(self) -> None:
+        """Recovery surface for a crashed ``step()`` (ResilientEngine):
+        drop the poisoned in-flight wave — its tokens were never
+        host-visible, so the stream stays exactly-once — and requeue
+        every in-flight request from its traced host state for a
+        recompute re-admission (the pools' contents are suspect, so the
+        swap tier is bypassed). The device carry is rebuilt from host
+        state at the next dispatch."""
+        self._inflight = None
+        self._pending_adm = []
+        self._pending_swapin = []
+        self._fresh_swapins = set()
+        self._carry = None
+        self._slots_dirty = True
+        for slot in self._active_slots():
+            self._free_slot(slot, requeue=True, swap=False)
+
+    def block_accounting(self) -> Dict[str, int]:
+        """Device block-pool ledger: ``free + backed + squeezed ==
+        total`` at every step boundary, whatever mix of eviction / shed
+        / preempt-swap / crash-requeue ran — the leak-regression
+        invariant. ``swapped_host_blocks`` rides along for the host tier
+        (those blocks were freed on device; they are NOT in the sum)."""
+        return {
+            "total": self.nb - 1,
+            "free": len(self.free_blocks),
+            "backed": int(sum(int(n) for n in self.n_alloc)),
+            "squeezed": sum(len(b) for _, b in self._squeezed),
+            "swapped_host_blocks": (self.swap_pool.swapped_blocks
+                                    if self.swap_pool is not None else 0),
+        }
 
     def _admit(self):
         """Admit every queued request a free slot and free blocks can
@@ -734,13 +1015,34 @@ class LLMEngine:
             if slot is None:
                 break
             req = self.queue[0]
+            ent = (self.swap_pool.get(req.req_id)
+                   if self.swap_pool is not None else None)
+            if ent is not None:
+                # swap-in re-admission: restore the preempted KV blocks
+                # from the host tier — no prefill, no sampled first token
+                # (the tail of prompt+generated is the next decode input)
+                if len(self.free_blocks) < max(1, ent.n_blocks):
+                    if not any(r is not None for r in self.slot_req) \
+                            and not self._squeezed:
+                        raise RuntimeError(
+                            f"request {req.req_id}: swap-in needs "
+                            f"{ent.n_blocks} blocks but the pool only has "
+                            f"{self.nb - 1} usable")
+                    break                    # blocks busy: wait for frees
+                self.queue.popleft()
+                self._swap_in(slot, req, self.swap_pool.pop(req.req_id))
+                continue
             ctx = req.prompt + req.generated   # re-admission continues
             true_len = len(ctx)
             # only the blocks the true prompt occupies; the bucket's pad
             # tail scatters into the trash block (never read: causality)
             need = max(1, -(-true_len // self.bs))
             if len(self.free_blocks) < need:
-                if not any(r is not None for r in self.slot_req):
+                if not any(r is not None for r in self.slot_req) \
+                        and not self._squeezed:
+                    # (an injected pool_squeeze releases its hostage
+                    # blocks in a step or two — starvation then is
+                    # pressure, not an impossible request)
                     raise RuntimeError(
                         f"request {req.req_id}: prefill needs {need} blocks "
                         f"but the pool only has {self.nb - 1} usable — the "
@@ -888,9 +1190,12 @@ class LLMEngine:
                         break
                     continue
                 victim = self.admit_order[-1]
-                if victim == slot and len(self.admit_order) == 1:
+                if victim == slot and len(self.admit_order) == 1 \
+                        and not self._squeezed:
                     # alone and starved: nothing else will ever free a
-                    # block — preempting ourselves would livelock
+                    # block — preempting ourselves would livelock. (Under
+                    # an injected pool_squeeze the hostage blocks return
+                    # in a step or two: self-preempt and wait instead.)
                     raise RuntimeError(
                         f"request {self.slot_req[slot].req_id}: the block "
                         f"pool ({self.nb - 1} usable blocks) is too small "
@@ -917,8 +1222,12 @@ class LLMEngine:
             pend = {s for s, _, _, _ in self._pending_adm}
             for i in active_slots:
                 req = self.slot_req[i]
+                # swap-in slots continue from the context tail (their KV
+                # was restored, not re-prefilled); pend slots get a
+                # placeholder overwritten by _apply_admissions
                 last[i] = self.slot_out[i][-1] if self.slot_out[i] else \
-                    req.prompt[-1]            # placeholder for pend slots
+                    (req.generated[-1] if req.generated
+                     else req.prompt[-1])
                 budgets[i] = req.max_new_tokens - len(req.generated) \
                     - len(self.slot_out[i]) - (1 if i in pend else 0)
             self._key, sub = jax.random.split(self._key)
@@ -952,6 +1261,34 @@ class LLMEngine:
                     jnp.asarray(slot_of_row), jnp.asarray(lens_new),
                     jnp.asarray(rems_new), jnp.asarray(upd))
             self._carry = (c_last, c_len, c_done, c_rem, c_key)
+        if self._pending_swapin:
+            # swap-in lanes: the same [max_slots]-pinned scatter as a
+            # prefill wave, but the "wave token" is host-known (the tail
+            # of prompt+generated — no prefill sampled a first token).
+            # Also exact after a carry-None rebuild (idempotent values).
+            c_last, c_len, c_done, c_rem, c_key = self._carry
+            slot_of_row = np.full(self.N, self.N, np.int32)  # N → dropped
+            upd = np.zeros(self.N, bool)
+            toks = np.zeros(self.N, np.int32)
+            lens_new = np.zeros(self.N, np.int32)
+            rems_new = np.zeros(self.N, np.int32)
+            for row, (s, rid) in enumerate(self._pending_swapin):
+                req = self.slot_req[s]
+                if req is None or req.req_id != rid:
+                    continue          # freed again before any dispatch
+                slot_of_row[row] = s
+                upd[s] = True
+                toks[row] = (req.generated[-1] if req.generated
+                             else req.prompt[-1])
+                lens_new[s] = int(self.lengths[s])
+                rems_new[s] = req.max_new_tokens - len(req.generated)
+            self._pending_swapin = []
+            if upd.any():
+                c_last, c_len, c_done, c_rem = _apply_admissions(
+                    c_last, c_len, c_done, c_rem, jnp.asarray(toks),
+                    jnp.asarray(slot_of_row), jnp.asarray(lens_new),
+                    jnp.asarray(rems_new), jnp.asarray(upd))
+                self._carry = (c_last, c_len, c_done, c_rem, c_key)
         if self._slots_dirty or self._slot_vecs is None:
             temps = np.zeros(self.N, np.float32)
             top_ks = np.zeros(self.N, np.int32)
@@ -1006,6 +1343,11 @@ class LLMEngine:
             req = self.slot_req[i]
             if i in pend:
                 rem_start[i] = req.max_new_tokens - len(req.generated) - 1
+            elif i in self._fresh_swapins:
+                # swap-in since the last dispatch: the slot id may be
+                # recycled from the previous record — its budget comes
+                # from host state, never the stale chained countdown
+                rem_start[i] = req.max_new_tokens - len(req.generated)
             elif prev is not None and i in prev["rem_start"]:
                 rem_start[i] = prev["rem_start"][i] - self.decode_steps
             else:
@@ -1068,6 +1410,7 @@ class LLMEngine:
             "rem_start": rem_start,
         }
         self._pending_adm = []
+        self._fresh_swapins = set()
         return prev
 
     def _process(self, rec):
@@ -1083,6 +1426,16 @@ class LLMEngine:
         gets hang detection + emergency-hook checkpointing for free."""
         from ..distributed.watchdog import guarded
 
+        if self.injector is not None and \
+                self.injector.fires("readback_fail", self._step_idx):
+            # the injectable stand-in for a wedged device / dead tunnel at
+            # the engine's one blocking sync; ResilientEngine's recovery
+            # contract (drop the wave, requeue from traced state) is
+            # proven against exactly this raise
+            _flight.record("injected_readback_fail", step=self._step_idx)
+            raise SimulatedCrash(
+                f"injected readback failure at serving step "
+                f"{self._step_idx}")
         with guarded("serving-decode-readback"), \
                 trace_span("serving.readback"):
             return self._process_guarded(rec)
@@ -1104,6 +1457,10 @@ class LLMEngine:
                     continue              # preempted before its call ran
                 tok = int(tok)
                 emitted.append((rid, tok))
+                # commit point: host-visible from here on — mirrored into
+                # the step's salvage buffer so a crash later in this SAME
+                # step still delivers it (ResilientEngine)
+                self._step_emitted.append((rid, tok))
                 self._emit(slot, tok)
         toks_host = np.asarray(jax.device_get(rec["toks"]))  # [K, N]
         for slot, rid in rec["snapshot"]:
@@ -1116,6 +1473,7 @@ class LLMEngine:
                     break          # slot went done mid-scan
                 self.lengths[slot] += 1     # its K/V was appended
                 emitted.append((rid, tok))
+                self._step_emitted.append((rid, tok))
                 if self._emit(slot, tok):
                     break          # freed: later entries are -1 anyway
         return emitted
@@ -1185,6 +1543,13 @@ class LLMEngine:
 
     def _step_inner(self):
         emitted = []
+        self._step_emitted = []
+        self._step_idx += 1
+        # chaos + deadlines run before admission: an injected squeeze
+        # shapes this step's block budget, and an expired request must
+        # not occupy the slot a live one could take
+        self._apply_faults()
+        self._expire_deadlines()
         # stale FLOPs from an earlier dispatch must not divide a
         # no-decode step's wall time (a bogus MFU spike on idle steps)
         self._last_decode_flops = None

@@ -1,0 +1,116 @@
+"""Host-DRAM KV swap tier: preempt → swap out, re-admit → swap in.
+
+The engine's original preemption policy is vLLM's recompute: a squeezed
+slot frees its blocks and the request re-prefills ``prompt + generated``
+from scratch on re-admission. Correct, but under a sustained pool
+squeeze it turns into a preemption *storm* — every preemption throws
+away computed KV and re-buys it at full prefill FLOPs, which squeezes
+the pool harder (ROADMAP item 5; nncase's heterogeneous-storage LLM
+deployment is the same diagnosis one tier down).
+
+The TPU-native fix is a pinned host-RAM tier under HBM: a preempted
+slot's pool blocks — the int8 payload AND its per-entry scales, so the
+restore is bit-exact — are ``device_get`` into a bounded
+:class:`HostKVPool`, and re-admission ``device_put``-scatters them back
+into freshly allocated blocks instead of re-prefilling. A swap-in costs
+one h2d copy of the blocks; a recompute costs the full prefill forward.
+When the host pool is full, preemption falls back to recompute — the
+tier degrades, it never breaks.
+
+Accounting contract: swapped KV holds NO device blocks (they were freed
+at swap-out) — the engine's device invariant stays
+``free + backed + squeezed == pool size`` while the host tier tracks
+its own bytes/blocks (``serving_kv_swap_host_bytes``).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..observability.catalog import instrument as _instrument
+
+__all__ = ["HostKVPool", "SwapEntry"]
+
+_M_SWAP_OUT = _instrument("serving_kv_swap_out_total")
+_M_SWAP_IN = _instrument("serving_kv_swap_in_total")
+_M_SWAP_FALLBACK = _instrument("serving_kv_swap_fallback_total")
+_M_SWAP_BYTES = _instrument("serving_kv_swap_host_bytes")
+
+
+class SwapEntry:
+    """One preempted request's KV blocks on the host: a dict of numpy
+    arrays (one per engine pool entry — k/v payload plus ks/vs scales
+    under int8 pools), each shaped ``[L, n_blocks, block_size, ...]``."""
+
+    __slots__ = ("data", "n_tokens", "n_blocks", "nbytes")
+
+    def __init__(self, data: Dict, n_tokens: int):
+        self.data = data
+        self.n_tokens = int(n_tokens)
+        self.n_blocks = int(next(iter(data.values())).shape[1])
+        self.nbytes = int(sum(a.nbytes for a in data.values()))
+
+
+class HostKVPool:
+    """Bounded pinned-host-RAM pool of swapped-out KV, keyed by req_id.
+
+    ``put`` refuses (and counts a recompute fallback) rather than exceed
+    ``capacity_bytes`` — the swap tier must never become the OOM.
+    """
+
+    def __init__(self, capacity_bytes: int):
+        self.capacity_bytes = int(capacity_bytes)
+        self._entries: Dict = {}
+        self._bytes = 0
+
+    # -- engine-facing ----------------------------------------------------
+    def put(self, rid, data: Dict, n_tokens: int) -> bool:
+        """Store one request's blocks; ``False`` (+ fallback counter) when
+        the pool lacks room. A re-preemption of the same rid replaces its
+        previous entry."""
+        ent = SwapEntry(data, n_tokens)
+        old = self._entries.pop(rid, None)
+        if old is not None:
+            self._bytes -= old.nbytes
+        if self._bytes + ent.nbytes > self.capacity_bytes:
+            _M_SWAP_FALLBACK.inc(reason="host_pool_full")
+            _M_SWAP_BYTES.set(self._bytes)
+            return False
+        self._entries[rid] = ent
+        self._bytes += ent.nbytes
+        _M_SWAP_OUT.inc()
+        _M_SWAP_BYTES.set(self._bytes)
+        return True
+
+    def get(self, rid) -> Optional[SwapEntry]:
+        """Peek (no removal): the engine checks block availability before
+        committing to the swap-in."""
+        return self._entries.get(rid)
+
+    def pop(self, rid) -> Optional[SwapEntry]:
+        """Remove and return the entry — the swap-in commit point."""
+        ent = self._entries.pop(rid, None)
+        if ent is not None:
+            self._bytes -= ent.nbytes
+            _M_SWAP_IN.inc()
+            _M_SWAP_BYTES.set(self._bytes)
+        return ent
+
+    def discard(self, rid) -> None:
+        """Drop a request's entry without a swap-in (it finished, shed,
+        or expired while queued)."""
+        ent = self._entries.pop(rid, None)
+        if ent is not None:
+            self._bytes -= ent.nbytes
+            _M_SWAP_BYTES.set(self._bytes)
+
+    # -- accounting -------------------------------------------------------
+    @property
+    def bytes_used(self) -> int:
+        return self._bytes
+
+    @property
+    def swapped_blocks(self) -> int:
+        return sum(e.n_blocks for e in self._entries.values())
+
+    def __len__(self) -> int:
+        return len(self._entries)

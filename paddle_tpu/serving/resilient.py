@@ -1,0 +1,100 @@
+"""Crash-surviving wrapper over :class:`~paddle_tpu.serving.LLMEngine`.
+
+A long-lived serving process meets failures training never sees twice:
+a wedged readback, a device reset mid-call, an injected chaos fault.
+The engine's own state machine is host-side and always consistent at
+step boundaries, so the recovery move is cheap and total: drop the
+poisoned in-flight wave (its tokens were never host-visible — the
+stream stays exactly-once), requeue every in-flight request from its
+traced host state (``prompt + generated + slot_out``: everything already
+streamed is preserved and never re-emitted), rebuild the device carry
+from scratch, and keep serving. The device pools' contents are suspect
+after a crash, so the requeue is always recompute — the KV swap tier is
+deliberately bypassed on this path.
+
+    eng = LLMEngine(params, cfg, injector=FaultInjector("readback_fail@4"))
+    results = ResilientEngine(eng).run()    # the crash is a blip, not an outage
+
+Pairs with the seeded serving faults in
+:mod:`paddle_tpu.distributed.resilience.faults` (``readback_fail`` /
+``slow_step`` / ``pool_squeeze``) — ``tools/chaos_run.py --serving``
+drives the full menu and asserts finish-or-shed with zero block leaks.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple, Type
+
+from ..distributed.resilience.faults import SimulatedCrash
+from ..observability import flight_recorder as _flight
+from ..observability.catalog import instrument as _instrument
+
+__all__ = ["ResilientEngine"]
+
+_M_RECOVERIES = _instrument("serving_engine_recoveries_total")
+
+
+class ResilientEngine:
+    """Catch a crashed ``step()``, recover the engine, keep serving.
+
+    ``recoverable``: exception types treated as a crashed step (default:
+    the injectable :class:`SimulatedCrash`; widen to e.g. your backend's
+    runtime-error type in production). Anything else propagates.
+    ``max_recoveries`` bounds the crash budget — a deterministically
+    crashing engine must surface, not spin.
+    """
+
+    def __init__(self, engine,
+                 recoverable: Tuple[Type[BaseException], ...]
+                 = (SimulatedCrash,),
+                 max_recoveries: int = 8):
+        self.engine = engine
+        self.recoverable = tuple(recoverable)
+        self.max_recoveries = int(max_recoveries)
+        self.recoveries = 0
+
+    # -- engine surface ---------------------------------------------------
+    def add_request(self, prompt, **kw) -> int:
+        return self.engine.add_request(prompt, **kw)
+
+    def has_work(self) -> bool:
+        return self.engine.has_work()
+
+    @property
+    def results(self) -> Dict[int, List[int]]:
+        return self.engine.results
+
+    @property
+    def finish_reasons(self) -> Dict[int, str]:
+        return self.engine.finish_reasons
+
+    # -- the wrapper ------------------------------------------------------
+    def step(self):
+        """One engine step; on a recoverable crash, drop the poisoned
+        wave, requeue its requests, and return the tokens the step had
+        already committed before it died. A step can raise AFTER an
+        earlier readback in it committed tokens host-side (slot_out /
+        generated) — those ride the engine's salvage buffer and are
+        delivered here exactly once (the requeue moves them to
+        ``generated``, so re-admission never re-emits them); only the
+        never-host-visible in-flight wave is dropped."""
+        try:
+            return self.engine.step()
+        except self.recoverable as e:
+            self.recoveries += 1
+            if self.recoveries > self.max_recoveries:
+                raise
+            _M_RECOVERIES.inc()
+            _flight.record("serving_step_recovered",
+                           error=f"{type(e).__name__}: {e}"[:160],
+                           recoveries=self.recoveries,
+                           salvaged=len(self.engine._step_emitted))
+            salvaged = list(self.engine._step_emitted)
+            self.engine.recover_crashed_step()
+            return salvaged
+
+    def run(self) -> Dict[int, List[int]]:
+        while self.engine.has_work():
+            self.step()
+        if self.engine._inflight is not None:   # defensive, as engine.run
+            self.engine._process_inflight()
+        return self.engine.results

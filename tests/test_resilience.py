@@ -207,11 +207,41 @@ def test_retry_backoff_exponential_then_success():
             raise ConnectionError("not yet")
         return "up"
 
+    # jitter off: the raw exponential envelope is the contract here
     assert retry_call(flaky, retries=5, base_delay=0.1,
                       exceptions=(ConnectionError,),
-                      sleep=delays.append) == "up"
+                      sleep=delays.append, jitter=False) == "up"
     assert len(calls) == 3
     assert delays == [pytest.approx(0.1), pytest.approx(0.2)]
+
+
+def test_retry_full_jitter_spreads_replicas():
+    """Full jitter (the default): every delay lands in (0, envelope] and
+    two 'replicas' retrying off the same failure draw DIFFERENT
+    schedules — the restart herd spreads instead of thundering the
+    store in lockstep."""
+    import random
+
+    def boom():
+        raise ConnectionError("down")
+
+    def delays_for(seed):
+        delays = []
+        with pytest.raises(ConnectionError):
+            retry_call(boom, retries=4, base_delay=0.1,
+                       exceptions=(ConnectionError,), sleep=delays.append,
+                       rand=random.Random(seed).random)
+        return delays
+
+    a, b = delays_for(1), delays_for(2)
+    envelopes = [0.1, 0.2, 0.4, 0.8]
+    for d in (a, b):
+        assert len(d) == 4
+        assert all(0.0 <= x <= cap for x, cap in zip(d, envelopes))
+        assert len(set(d)) > 1          # the schedule itself is spread
+        # jittered: not the bare exponential ladder
+        assert d != pytest.approx(envelopes)
+    assert a != b                       # two replicas diverge
 
 
 def test_retry_gives_up_and_reraises():

@@ -345,10 +345,18 @@ def test_obs_dump_demo_serving_smoke(tmp_path):
     assert "int8 weights + int8 KV pools" in out
     for name in ("serving_decode_prefix_bucket",
                  "serving_decode_recompiles_total",
-                 "serving_decode_kv_read_bytes"):
+                 "serving_decode_kv_read_bytes",
+                 # r8: the degraded-mode counters ride the same demo
+                 "serving_shed_total",
+                 "serving_kv_swap_out_total",
+                 "serving_kv_swap_in_total"):
         assert name in out, (name, out[-2000:])
+    # r8: one shed, one expired deadline, at least one preempt→swap
+    assert "load shed: request" in out
+    assert "deadline_exceeded=1" in out
     # r7: the demo ends with the per-request table + exemplar pointer
-    assert "requests: 3 traced" in out, out[-2000:]
+    assert "requests: 4 traced" in out, out[-2000:]
     assert "ttft_ms" in out and "preempt" in out
+    assert "shed" in out and "deadline" in out     # reason column
     assert "exemplar: request" in out
     assert (tmp_path / "snapshot.json").exists()

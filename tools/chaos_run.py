@@ -1,19 +1,32 @@
 #!/usr/bin/env python
-"""Chaos run: a tiny llama pretrain loop under a seeded random fault
-schedule, asserting final-state parity with a clean run.
+"""Chaos run: seeded fault schedules against the training loop or the
+serving engine, asserting recovery invariants.
 
-The CI-grade end-to-end for distributed/resilience: the driver plays the
-role of the elastic launcher — every SimulatedCrash kills the "process"
-(the ResilientTrainLoop) and a fresh loop auto-resumes from the newest
-valid checkpoint; after the first crash the newest checkpoint is
-deliberately corrupted to exercise the fallback tier. A run passes when
-the faulted job reaches the SAME final parameters (allclose), the same
-final eval loss, and the same dataloader position as an uninterrupted
-run of equal total steps.
+Training mode (default) — the CI-grade end-to-end for
+distributed/resilience: the driver plays the role of the elastic
+launcher — every SimulatedCrash kills the "process" (the
+ResilientTrainLoop) and a fresh loop auto-resumes from the newest valid
+checkpoint; after the first crash the newest checkpoint is deliberately
+corrupted to exercise the fallback tier. A run passes when the faulted
+job reaches the SAME final parameters (allclose), the same final eval
+loss, and the same dataloader position as an uninterrupted run of equal
+total steps.
 
     JAX_PLATFORMS=cpu python tools/chaos_run.py --steps 12 --seed 7
 
+Serving mode (``--serving``) — the same idea for the survivability
+layer: a seeded schedule of readback crashes, pool squeezes, and slow
+steps fires inside an LLMEngine loop while an over-capacity request
+stream (some with unmeetable deadlines) hits a bounded admission queue.
+A run passes when EVERY submitted request ends in exactly one of
+{finished, shed, deadline_exceeded}, the block-pool ledger balances
+``free + backed + squeezed == total`` at every step boundary (zero KV
+block leaks), and the host swap tier drains to empty.
+
+    JAX_PLATFORMS=cpu python tools/chaos_run.py --serving --steps 24 --seed 7
+
 Wired into the suite as tests/test_resilience.py::test_chaos_run_llama_parity
+and tests/test_serving_resilience.py::test_chaos_run_serving
 (slow lane: PADDLE_TPU_FULL_TESTS=1).
 """
 import argparse
@@ -26,16 +39,134 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np  # noqa: E402
 
 
+def serving_main(args):
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu.observability as obs
+    from paddle_tpu.distributed.resilience import FaultInjector
+    from paddle_tpu.models import llama
+    from paddle_tpu.serving import (AdmissionConfig, LLMEngine,
+                                    ResilientEngine, ShedError)
+
+    cfg = dataclasses.replace(
+        llama.tiny_llama(vocab=64, hidden=32, layers=2, heads=4, kv_heads=2,
+                         seq=128, ffn=64),
+        dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(args.seed))
+
+    # seeded random schedule over the serving fault menu, with the
+    # canonical pair guaranteed: a readback crash and a pool squeeze
+    inj = FaultInjector.random_schedule(
+        seed=args.seed, n_steps=args.steps,
+        kinds=("readback_fail", "pool_squeeze", "slow_step"),
+        rate=args.rate)
+    menu = [("readback_fail", max(2, args.steps // 3)),
+            ("pool_squeeze", max(3, args.steps // 2))]
+    inj = FaultInjector(inj.pending + menu)
+    print(f"fault schedule: {inj.pending}")
+
+    obs.enable()
+    # num_blocks=5 with two slots decoding 6-15 fresh tokens each: pool
+    # pressure (and the injected squeezes) MUST preempt — the swap tier
+    # is load-bearing in this run, not decorative
+    eng = LLMEngine(params, cfg, max_slots=2, block_size=8,
+                    max_model_len=64, num_blocks=5, prompt_buckets=[8, 32],
+                    kv_swap_bytes=1 << 20,
+                    admission=AdmissionConfig(max_queue=3),
+                    injector=inj)
+    reng = ResilientEngine(eng)
+    rng = np.random.default_rng(args.seed)
+
+    all_ids, streamed = [], {}
+    submitted = 0
+    ok = True
+    while eng.has_work() or submitted < args.requests:
+        # offered load: up to two submissions per step (over capacity for
+        # 2 slots), every 5th with a deadline that cannot be met
+        for _ in range(2):
+            if submitted >= args.requests:
+                break
+            submitted += 1
+            kw = {"deadline_s": 0.0} if submitted % 5 == 0 else {}
+            prompt = rng.integers(1, 64,
+                                  size=int(rng.integers(3, 14))).tolist()
+            try:
+                rid = eng.add_request(
+                    prompt, max_new_tokens=int(rng.integers(6, 16)), **kw)
+                streamed[rid] = []
+            except ShedError as e:
+                rid = e.req_id
+            all_ids.append(rid)
+        for rid, tok in reng.step():
+            streamed[rid].append(tok)
+        acct = eng.block_accounting()
+        if acct["free"] + acct["backed"] + acct["squeezed"] \
+                != acct["total"]:
+            print(f"block ledger out of balance at step "
+                  f"{eng._step_idx}: {acct}")
+            ok = False
+            break
+
+    reasons = eng.finish_reasons
+    counts = {}
+    for r in reasons.values():
+        counts[r] = counts.get(r, 0) + 1
+    reg = obs.get_registry()
+    print(f"serving chaos: {submitted} offered, {counts} | "
+          f"recoveries={reng.recoveries} "
+          f"swap_out={int(reg.counter('serving_kv_swap_out_total').labels().value)} "
+          f"swap_in={int(reg.counter('serving_kv_swap_in_total').labels().value)} "
+          f"faults fired={inj.fired}")
+
+    terminal = {"finished", "shed", "deadline_exceeded"}
+    if set(reasons) != set(all_ids):
+        missing = set(all_ids) - set(reasons)
+        print(f"requests without a terminal state: {sorted(missing)}")
+        ok = False
+    if not set(reasons.values()) <= terminal:
+        print(f"non-terminal reasons: {set(reasons.values()) - terminal}")
+        ok = False
+    # exactly-once streaming for every request that was never crash-hit:
+    # results must extend what was streamed (a recovered crash loses only
+    # never-host-visible tokens)
+    for rid, toks in streamed.items():
+        if rid in eng.results and eng.results[rid][:len(toks)] != toks:
+            print(f"request {rid}: stream/result mismatch")
+            ok = False
+    acct = eng.block_accounting()
+    if not (acct["free"] == acct["total"] and acct["squeezed"] == 0
+            and acct["swapped_host_blocks"] == 0):
+        print(f"drained ledger not clean: {acct}")
+        ok = False
+    if eng.swap_pool.bytes_used != 0:
+        print(f"host swap pool leaked {eng.swap_pool.bytes_used} bytes")
+        ok = False
+
+    print("SERVING_CHAOS: OK" if ok else "SERVING_CHAOS: FAIL")
+    return 0 if ok else 1
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--serving", action="store_true",
+                    help="run the serving-engine chaos suite instead of "
+                         "the train-loop parity run")
     ap.add_argument("--steps", type=int, default=12)
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--rate", type=float, default=0.2,
                     help="per-step fault probability for the random schedule")
+    ap.add_argument("--requests", type=int, default=14,
+                    help="--serving: requests offered over the run")
     ap.add_argument("--workdir", default=None)
     ap.add_argument("--no-corrupt-newest", action="store_true",
                     help="skip the corrupt-newest-checkpoint tier")
     args = ap.parse_args()
+
+    if args.serving:
+        return serving_main(args)
 
     import jax
     import jax.numpy as jnp

@@ -85,12 +85,17 @@ def print_request_table(payload, out=sys.stdout):
                   "serve traffic)\n")
         return rows
     hdr = (f"{'request':>8} {'state':>6} {'queue_ms':>9} {'ttft_ms':>9} "
-           f"{'tpot_ms':>8} {'tok/s':>8} {'tokens':>6} {'preempt':>7}\n")
+           f"{'tpot_ms':>8} {'tok/s':>8} {'tokens':>6} {'preempt':>7} "
+           f"{'reason':>9}\n")
     out.write(hdr)
     out.write("-" * (len(hdr) - 1) + "\n")
     for r in rows:
         tps = r.get("decode_tps")
         tps_s = f"{tps:.1f}" if isinstance(tps, (int, float)) else "-"
+        # terminal disposition (finished/shed/deadline_exceeded);
+        # live rows and pre-r8 payloads have none
+        reason = r.get("reason") or "-"
+        reason = {"deadline_exceeded": "deadline"}.get(reason, reason)
         out.write(f"{str(r.get('request_id')):>8} "
                   f"{'live' if r.get('live') else 'done':>6} "
                   f"{_fmt_ms(r.get('queue_ms')):>9} "
@@ -98,7 +103,8 @@ def print_request_table(payload, out=sys.stdout):
                   f"{_fmt_ms(r.get('tpot_ms')):>8} "
                   f"{tps_s:>8} "
                   f"{r.get('tokens', 0):>6} "
-                  f"{r.get('preemptions', 0):>7}\n")
+                  f"{r.get('preemptions', 0):>7} "
+                  f"{reason[:9]:>9}\n")
     for name, qs in (payload.get("exemplar_quantiles") or {}).items():
         for q, ex in qs.items():
             out.write(f"{q} {name} exemplar: request "
@@ -158,11 +164,13 @@ def requests_mode(src, sort, watch, interval):
 
 
 def demo_serving():
-    """int8-everywhere serving demo: int8 weight-only params AND int8 KV
-    pools through the ragged prefix-bucketed decode path — the table (and
-    the explicit line below) shows the r6 decode metrics:
-    serving_decode_prefix_bucket / serving_decode_recompiles_total /
-    serving_decode_kv_read_bytes."""
+    """int8-everywhere serving demo under fire: int8 weight-only params
+    AND int8 KV pools through the ragged prefix-bucketed decode path,
+    with the r8 survivability layer engaged — a bounded admission queue
+    sheds the over-offered request, one request expires at its deadline,
+    and pool pressure preempts a slot whose KV swaps to the host tier
+    and back. The table shows the r6 decode metrics plus
+    serving_{shed,deadline_exceeded,kv_swap_*}_total."""
     import dataclasses
 
     import jax
@@ -171,7 +179,7 @@ def demo_serving():
 
     import paddle_tpu.observability as obs
     from paddle_tpu.models import llama
-    from paddle_tpu.serving import LLMEngine
+    from paddle_tpu.serving import AdmissionConfig, LLMEngine, ShedError
 
     cfg = dataclasses.replace(
         llama.tiny_llama(vocab=64, hidden=32, layers=2, heads=4, kv_heads=2,
@@ -180,14 +188,26 @@ def demo_serving():
     params = jax.jit(llama.quantize_params)(
         llama.init_params(cfg, jax.random.PRNGKey(0)))
     rng = np.random.default_rng(0)
-    # max_model_len >> prompt lengths: the prefix bucket must track the
-    # ragged lengths (1-2 blocks), never the 16-block allocation maximum
+    # num_blocks=5 with two 8-token prompts decoding 16 fresh tokens each:
+    # the pool MUST preempt mid-run — with the host tier enabled the
+    # victim swaps out and back instead of re-prefilling
     eng = LLMEngine(params, cfg, max_slots=2, block_size=8,
-                    max_model_len=128, prompt_buckets=[8, 32],
-                    kv_dtype="int8")
-    for n, k in ((3, 6), (7, 5), (12, 4)):
-        eng.add_request(rng.integers(1, 64, size=n).tolist(),
-                        max_new_tokens=k)
+                    max_model_len=64, num_blocks=5, prompt_buckets=[8, 32],
+                    kv_dtype="int8", kv_swap_bytes=1 << 20,
+                    admission=AdmissionConfig(max_queue=3))
+    for _ in range(2):
+        eng.add_request(rng.integers(1, 64, size=8).tolist(),
+                        max_new_tokens=16)
+    # third queued request: a deadline that has already passed — evicted
+    # with finish reason deadline_exceeded on its trace
+    eng.add_request(rng.integers(1, 64, size=4).tolist(),
+                    max_new_tokens=4, deadline_s=0.0)
+    # fourth: the bounded queue (max_queue=3) sheds it with a typed error
+    try:
+        eng.add_request(rng.integers(1, 64, size=4).tolist(),
+                        max_new_tokens=4)
+    except ShedError as e:
+        print(f"load shed: {e}")
     results = eng.run()
     reg = obs.get_registry()
     print(f"demo serving: {len(results)} requests, "
@@ -199,6 +219,16 @@ def demo_serving():
           f"{int(reg.counter('serving_decode_recompiles_total').labels().value)}"
           "; kv bytes/call: "
           f"{int(reg.gauge('serving_decode_kv_read_bytes').labels().value)}")
+
+    def _c(name, **lbl):
+        return int(reg.counter(name).labels(**lbl).value)
+
+    print("degraded modes: "
+          f"shed={_c('serving_shed_total', reason='queue_full')} "
+          f"deadline_exceeded={_c('serving_deadline_exceeded_total')} "
+          f"kv_swap_out={_c('serving_kv_swap_out_total')} "
+          f"kv_swap_in={_c('serving_kv_swap_in_total')}")
+    print(f"finish reasons: {eng.finish_reasons}")
     print()
     print_request_table(obs.requests_payload())
 
