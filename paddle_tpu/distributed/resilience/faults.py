@@ -33,8 +33,13 @@ __all__ = ["FaultInjector", "SimulatedCrash", "FAULT_KINDS",
 # pool pressure — the preemption/swap path's trigger)
 SERVING_FAULT_KINDS = ("readback_fail", "slow_step", "pool_squeeze")
 
-FAULT_KINDS = ("nan_grad", "inf_grad", "crash", "collective_timeout",
-               "storage_fail") + SERVING_FAULT_KINDS
+# nan_inject poisons ONE named layer group of the model state for one
+# attempt (the forward then goes NaN from that layer on) — the seeded,
+# targeted fault behind the numerics observatory's NaN-provenance test:
+# the post-mortem must name exactly the injected layer. Schedule syntax
+# carries the target as "nan_inject:<layer>@<step>" (default layer 0).
+FAULT_KINDS = ("nan_grad", "inf_grad", "nan_inject", "crash",
+               "collective_timeout", "storage_fail") + SERVING_FAULT_KINDS
 
 define_flag("ft_fault_schedule", "",
             "comma list of kind@step faults to inject, e.g. "
@@ -50,17 +55,39 @@ class SimulatedCrash(RuntimeError):
     prove auto-resume by constructing a fresh loop."""
 
 
+# kinds that carry a ":<arg>" payload, with their arg validator — the
+# only one today is nan_inject's target layer index
+_ARG_KINDS = {"nan_inject": lambda a: a == "" or a.isdigit()}
+
+
+def _validate_kind(kind: str) -> None:
+    """Reject unknown kinds and payloads on kinds that take none, at
+    schedule-construction time — a typo'd schedule must fail loudly,
+    never validate-then-silently-never-fire."""
+    base, sep, arg = kind.partition(":")
+    if base not in FAULT_KINDS:
+        raise ValueError(
+            f"unknown fault kind {base!r} (have: {FAULT_KINDS})")
+    if sep:
+        check = _ARG_KINDS.get(base)
+        if check is None:
+            raise ValueError(
+                f"fault kind {base!r} takes no ':<arg>' payload "
+                f"(got {kind!r})")
+        if not check(arg):
+            raise ValueError(
+                f"bad arg {arg!r} for fault kind {base!r} "
+                f"(nan_inject wants a layer index, e.g. 'nan_inject:3')")
+
+
 def _parse_schedule(spec: str) -> List[Tuple[str, int]]:
     out = []
     for item in filter(None, (s.strip() for s in spec.split(","))):
         kind, _, step = item.partition("@")
-        if kind not in FAULT_KINDS:
-            raise ValueError(
-                f"unknown fault kind {kind!r} (have: {FAULT_KINDS})")
         if not step.isdigit():
             raise ValueError(f"bad fault entry {item!r}: want kind@step")
         out.append((kind, int(step)))
-    return out
+    return out                 # kinds validate in FaultInjector.__init__
 
 
 class FaultInjector:
@@ -77,6 +104,9 @@ class FaultInjector:
             schedule = _parse_schedule(schedule)
         self._pending: Dict[int, List[str]] = {}
         for kind, step in schedule:
+            # one validation point for string AND pair schedules: a
+            # typo'd kind fails at construction, never silently-no-fire
+            _validate_kind(str(kind))
             self._pending.setdefault(int(step), []).append(kind)
         self.fired: List[Tuple[str, int]] = []   # audit log, in fire order
 
@@ -117,6 +147,23 @@ class FaultInjector:
             return True
         return False
 
+    def take_arg(self, kind: str, step: int) -> Optional[str]:
+        """Pop one ``kind`` (or ``kind:<arg>``) fault scheduled at
+        ``step``; returns its arg string (``""`` when none) or ``None``
+        when nothing is scheduled — one-shot like :meth:`fires`, so a
+        rollback-retry of the step does not re-trip it."""
+        kinds = self._pending.get(int(step), [])
+        for entry in kinds:
+            base, _, arg = entry.partition(":")
+            if base != kind:
+                continue
+            kinds.remove(entry)
+            if not kinds:
+                self._pending.pop(int(step), None)
+            self.fired.append((entry, int(step)))
+            return arg
+        return None
+
     # -- fault realizations (what the loop applies when a kind fires) -----
     @staticmethod
     def poison(tree, kind: str = "nan_grad"):
@@ -129,6 +176,45 @@ class FaultInjector:
                 return jnp.full_like(x, bad)
             return x
         return jax.tree_util.tree_map(p, tree)
+
+    @staticmethod
+    def poison_layer(tree, layer: int, kind: str = "nan_grad"):
+        """The targeted realization behind ``nan_inject``: NaN (or Inf)
+        the ``layer``-th slice of every stacked float leaf under a
+        ``"layers"`` mapping — the forward then produces non-finite
+        activations from exactly that layer on, which is what lets the
+        numerics provenance ladder prove it names the right layer.
+        Returns a poisoned COPY (pytrees are immutable); the caller
+        feeds it to one attempt and keeps its clean state for the
+        retry. Leaves outside a ``layers`` key (embeddings, heads) are
+        untouched. A target no leaf covers raises — a chaos drill that
+        silently poisons nothing (while the injection event was already
+        logged) would fake its own evidence."""
+        from jax.tree_util import DictKey, tree_map_with_path
+
+        if layer < 0:
+            raise ValueError(f"poison_layer: layer must be >= 0, got "
+                             f"{layer} (negative indices would poison "
+                             "the wrong rung of the provenance ladder)")
+        bad = jnp.inf if kind == "inf_grad" else jnp.nan
+        hits = []
+
+        def p(path, x):
+            if (any(isinstance(e, DictKey) and e.key == "layers"
+                    for e in path)
+                    and hasattr(x, "dtype")
+                    and jnp.issubdtype(x.dtype, jnp.floating)
+                    and x.ndim >= 1 and x.shape[0] > layer):
+                hits.append(path)
+                return x.at[layer].set(bad)
+            return x
+        out = tree_map_with_path(p, tree)
+        if not hits:
+            raise ValueError(
+                f"poison_layer: no stacked float leaf under a 'layers' "
+                f"mapping covers layer {layer} — wrong target or wrong "
+                "state tree")
+        return out
 
     def storage_hook(self, step: int):
         """``fail_hook`` for :func:`atomic_ckpt.save_checkpoint`: raises

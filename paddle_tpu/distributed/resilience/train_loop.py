@@ -38,6 +38,7 @@ import numpy as np
 
 from ...observability import flight_recorder as _flight
 from ...observability import goodput as _goodput
+from ...observability import numerics as _numerics
 from ...observability import perf as _perf
 from ...observability import profiling as _profiling
 from ...observability import state as _obs_state
@@ -268,6 +269,20 @@ class ResilientTrainLoop:
             self._event("crash_injected")
             raise SimulatedCrash(f"injected crash at step {self.step}")
         hang = inj is not None and inj.fires("collective_timeout", self.step)
+        state_in = self.state
+        if inj is not None:
+            tgt = inj.take_arg("nan_inject", self.step)
+            if tgt is not None:
+                # targeted NaN: poison ONE layer group of this attempt's
+                # input state (self.state stays clean — the retry after
+                # the rollback recovers bit-exactly; take_arg is
+                # one-shot). The forward goes non-finite from exactly
+                # that layer, which the numerics provenance ladder must
+                # then name.
+                layer = int(tgt or 0)
+                self._event("nan_injected", layer=layer)
+                _flight.record("nan_inject", step=self.step, layer=layer)
+                state_in = FaultInjector.poison_layer(self.state, layer)
         with self._guard():
             if hang:
                 self._event("hang_injected", seconds=self.hang_seconds)
@@ -275,9 +290,9 @@ class ResilientTrainLoop:
             if self.rng_key is not None:
                 import jax
                 key = jax.random.fold_in(self.rng_key, self.step)
-                new_state, loss = self.step_fn(self.state, batch, key)
+                new_state, loss = self.step_fn(state_in, batch, key)
             else:
-                new_state, loss = self.step_fn(self.state, batch)
+                new_state, loss = self.step_fn(state_in, batch)
             poison = None
             if inj is not None:
                 if inj.fires("nan_grad", self.step):
@@ -361,6 +376,10 @@ class ResilientTrainLoop:
             # on-demand device-capture window boundary (profiling
             # control plane; one module-global read when nothing armed)
             _profiling.step_tick()
+            # numerics epoch boundary: per-layer stat rungs landed by
+            # THIS attempt carry this epoch, scoping the provenance walk
+            # below to it (one global read when numerics is off)
+            num_epoch = _numerics.step_mark()
             t0 = time.perf_counter()
             with trace_span("train.step", step=self.step, retry=retries):
                 new_state, loss_val = self._attempt(batch)
@@ -384,11 +403,18 @@ class ResilientTrainLoop:
                 return
             # roll back: new_state is dropped, self.state is the snapshot
             _goodput.account("rollback_retry", dt)
+            # NaN provenance: walk this attempt's stats ladder for the
+            # first layer whose NaN/Inf count went nonzero — the answer
+            # to "which layer went bad first" rides the rollback flight
+            # event and (via numerics.payload) the JSON post-mortem.
+            # Off the hot path by construction: a rollback is an
+            # incident, the sync inside provenance() is deliberate.
+            first_bad = _numerics.provenance(num_epoch)
+            bad_kw = {} if first_bad is None else {"first_bad": first_bad}
             _flight.record("rollback", step=self.step, reason=bad,
-                           retry=retries,
-                           loss=repr(loss_val))
+                           retry=retries, loss=repr(loss_val), **bad_kw)
             self._event("rollback", reason=bad, loss=loss_val,
-                        retry=retries)
+                        retry=retries, **bad_kw)
             _M_ROLLBACKS.inc(reason=bad)
             retries += 1
             self.total_retries += 1

@@ -61,6 +61,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..framework.flags import define_flag, get_flag
+from ..observability import numerics as _numerics
 from ..observability import trace_span
 from ..observability.catalog import instrument as _instrument
 from .gmm_autotune import (  # noqa: F401  (re-exported for back-compat)
@@ -174,6 +175,15 @@ def fused_routing(x: jax.Array, router_w: jax.Array,
     ``routing=`` — so the router, the aux loss, and the scatter prep
     are one fused computation instead of three."""
     logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
+    # numerics probe on the router logits (trace-time gated, zero ops
+    # when off): a diverging router is the classic MoE blowup source,
+    # and its NaNs surface HERE before they smear across every expert.
+    # Visibility contract: this site sits inside the scanned layer
+    # body, so it lands in forward/serving programs and in remat'd
+    # training bodies (the recompute re-runs it) — an un-checkpointed
+    # grad drops it (see numerics.record_stats); the per-layer ladder
+    # in models/ covers training regardless.
+    _numerics.record_stats("moe.router_logits", logits)
     return routing_from_logits(logits, top_k)
 
 

@@ -49,6 +49,7 @@ import jax
 import jax.numpy as jnp
 
 from ..framework.flags import define_flag, get_flag
+from ..observability import numerics as _numerics
 from ..observability.catalog import instrument as _instrument
 from .quant_matmul import is_quantized_weight
 
@@ -406,22 +407,32 @@ def fused_moe_ffn(x, weights, idx, e_gate, e_up, e_down,
                   and _kernel_tn(2 * f, h, Wcat.dtype.itemsize,
                                  x.dtype.itemsize) is not None
                   and A >= _KTM)
+    y = None
     if use_kernel:
         try:
             y = _fused_padded(x, ws, tok, esorted, gs, inv2d, Wcat, s_gu,
                               Wd, s_down, E, f, dt)
             _M_FUSED.labels(path="pallas").inc()
-            return y.astype(dt)
         except Exception:
             _M_FUSED.labels(path="xla_fallback").inc()
     else:
         _M_FUSED.labels(path="xla").inc()
 
-    xs = _gather_rows(x, tok, inv2d)
-    gu = _grouped(xs, Wcat, gs, full_rows=True)
-    zw = _elementwise_core(gu, s_gu, ws, s_down, esorted, f, dt)
-    ys = _grouped(zw, Wd, gs, full_rows=True)
-    return _combine_rows(ys, inv2d, tok).astype(dt)
+    if y is None:
+        xs = _gather_rows(x, tok, inv2d)
+        gu = _grouped(xs, Wcat, gs, full_rows=True)
+        zw = _elementwise_core(gu, s_gu, ws, s_down, esorted, f, dt)
+        ys = _grouped(zw, Wd, gs, full_rows=True)
+        y = _combine_rows(ys, inv2d, tok)
+    # routed-output health probe (trace-time gated, zero ops off): with
+    # int8 experts this is where a blown scale or a saturating expert
+    # first becomes visible. Deliberately OUTSIDE the kernel try block:
+    # a probe failure must surface, not masquerade as a Pallas fallback.
+    # Lands in forward/serving programs and remat'd training bodies;
+    # un-checkpointed grad drops in-scan probes (the models' ladder
+    # covers training) — see numerics.record_stats.
+    _numerics.record_stats("moe.routed_out", y)
+    return y.astype(dt)
 
 
 def _pad_layout(gs, tok, ws, esorted, inv2d, E: int, tm: int = _KTM):

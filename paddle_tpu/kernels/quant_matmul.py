@@ -32,8 +32,20 @@ import jax.numpy as jnp
 __all__ = [
     "weight_only_matmul", "quantize_kv", "dequantize_kv",
     "attn_qk", "attn_pv", "mixed_dot_supported",
-    "quantize_grouped", "is_quantized_weight",
+    "quantize_grouped", "is_quantized_weight", "dequantize_channels",
 ]
+
+
+def dequantize_channels(q, scale, axis: int):
+    """f32 reconstruction of a per-channel int8 tensor: ``q *
+    expand_dims(scale, axis)`` where ``axis`` is the dim the scale was
+    reduced over — the shared inverse of :func:`quantize_grouped`
+    (``axis``), :func:`quantize_kv` (``axis=-1``) and
+    ``models.llama.quantize_params`` (``axis=-2``). Also the
+    reconstruction the numerics observatory's paired quant-error probes
+    measure against (observability.numerics.record_quant_error)."""
+    return (q.astype(jnp.float32)
+            * jnp.expand_dims(scale.astype(jnp.float32), axis))
 
 
 @functools.lru_cache(maxsize=1)
@@ -90,8 +102,7 @@ def dequantize_grouped(w, axis: int, dtype):
     """Materialize the dense weights of a :func:`quantize_grouped` leaf
     (the slow exact fallback — paths that can't keep the int8 operand
     resident, e.g. the shard_map expert-parallel forms)."""
-    return (w["q"].astype(jnp.float32)
-            * jnp.expand_dims(w["s"], axis)).astype(dtype)
+    return dequantize_channels(w["q"], w["s"], axis).astype(dtype)
 
 
 def weight_only_matmul(x, w, out_dtype):
@@ -138,7 +149,7 @@ def quantize_kv(x):
 
 
 def dequantize_kv(q, scale, dtype):
-    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+    return dequantize_channels(q, scale, -1).astype(dtype)
 
 
 # ---------------------------------------------------------------------------

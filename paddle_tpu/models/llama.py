@@ -39,6 +39,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..observability import numerics as _numerics
+
 __all__ = [
     "LlamaConfig", "llama3_8b", "tiny_llama", "init_params", "forward",
     "loss_fn", "param_specs", "make_shardings", "make_serving_shardings",
@@ -174,6 +176,16 @@ def quantize_params(params, include_lm_head: bool = True):
                      for k, v in params["layers"].items()}
     if include_lm_head and "lm_head" in params:
         out["lm_head"] = q(params["lm_head"])
+    if _numerics.active():
+        # paired pre/post-quant probe: the weight-only site's relative
+        # error lands in numerics_quant_error{site="weight_only"} (the
+        # scale rides axis -2 — one scale per output channel)
+        pairs = [(params["layers"][k], out["layers"][k]["q"],
+                  out["layers"][k]["s"], -2) for k in _QUANT_KEYS]
+        if include_lm_head and "lm_head" in params:
+            pairs.append((params["lm_head"], out["lm_head"]["q"],
+                          out["lm_head"]["s"], -2))
+        _numerics.record_quant_error("weight_only", pairs)
     return out
 
 
@@ -484,6 +496,10 @@ def hidden_states(params, tokens, config: LlamaConfig):
 
     mesh = _ACT_MESH
     pp = dict(mesh.shape).get("pp", 1) if mesh is not None else 1
+    # NOTE: the pipeline-parallel branch below carries NO numerics
+    # ladder — stage bodies run inside the manual-'pp' shard_map region
+    # where the ys side-channel doesn't compose. NaN provenance is a
+    # pp=1 feature for now (documented in docs/observability.md).
     if pp > 1 and c.pipeline_microbatches > 0:
         from ..distributed.pipeline import (pipeline_apply,
                                             pipeline_apply_interleaved)
@@ -502,6 +518,20 @@ def hidden_states(params, tokens, config: LlamaConfig):
         else:
             x = pipeline_apply(stage_fn, params["layers"], x, mesh,
                                c.pipeline_microbatches, "pp")
+    elif _numerics.active():
+        # numerics ladder: each layer's output contributes one stats
+        # rung (absmax/rms/NaN count) via the scan's ys — the rungs
+        # accumulate into one [L, 5] device buffer shipped off-graph by
+        # a single async outfeed, and the provenance walk names the
+        # first rung whose NaN/Inf count goes nonzero. Trace-time
+        # gated: with FLAGS_obs_numerics off this branch never exists
+        # and the scan below lowers to the identical jaxpr.
+        def ladder_fn(carry, layer_params):
+            out = body(carry, layer_params)
+            return out, _numerics.tensor_stats(out)
+
+        x, ladder = jax.lax.scan(ladder_fn, x, params["layers"])
+        _numerics.ladder_record("llama.layer", ladder)
     else:
         x, _ = jax.lax.scan(scan_fn, x, params["layers"])
     return _rms_norm(x, params["final_norm"], c.rms_eps)

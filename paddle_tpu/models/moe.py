@@ -38,6 +38,7 @@ __all__ = [
     "quantize_expert_params",
 ]
 
+from ..observability import numerics as _numerics
 from ..observability import trace_span
 from .llama import (  # reuse the dense-transformer scaffolding
     TrainState, _apply_rope, _attention, _constrain, _rms_norm, _rope_tables,
@@ -211,6 +212,18 @@ def quantize_expert_params(params, config: MoEConfig = None):
     layers["e_up"] = quantize_grouped(params["layers"]["e_up"], 2)
     layers["e_down"] = quantize_grouped(params["layers"]["e_down"], 3)
     out["layers"] = layers
+    if _numerics.active():
+        # paired pre/post-quant probe for the expert-int8 site: one
+        # aggregated relative-error landing over gate/up/down
+        # (numerics_quant_error{site="expert_int8"})
+        _numerics.record_quant_error("expert_int8", [
+            (params["layers"]["e_gate"], layers["e_gate"]["q"],
+             layers["e_gate"]["s"], 2),
+            (params["layers"]["e_up"], layers["e_up"]["q"],
+             layers["e_up"]["s"], 2),
+            (params["layers"]["e_down"], layers["e_down"]["q"],
+             layers["e_down"]["s"], 3),
+        ])
     return out
 
 
@@ -519,12 +532,29 @@ def hidden_states_with_aux(params, tokens, config: MoEConfig):
             return lambda carry, lp: (inner(carry, lp), None)
         return body
 
+    def scan_layers(body, carry, layers_p, lo):
+        if not _numerics.active():
+            return jax.lax.scan(body, carry, layers_p)[0]
+        # numerics ladder: one stats rung per layer output, riding the
+        # scan's ys into a [L, 5] device buffer shipped by one async
+        # outfeed (rung i lands as global layer lo + i — the
+        # NaN-provenance walk reads these). Trace-time gated: off, the
+        # plain scan above is the identical jaxpr.
+
+        def ladder_fn(carry, lp):
+            out, _ys = body(carry, lp)
+            return out, _numerics.tensor_stats(out[0])
+
+        out, ladder = jax.lax.scan(ladder_fn, carry, layers_p)
+        _numerics.ladder_record("moe.layer", ladder, offset=lo)
+        return out
+
     tree = params["layers"]
     if n_dense > 0:
         head_p = jax.tree_util.tree_map(lambda a: a[:n_dense], tree)
-        (x, aux), _ = jax.lax.scan(make_body(True), (x, aux), head_p)
+        (x, aux) = scan_layers(make_body(True), (x, aux), head_p, 0)
     tail_p = jax.tree_util.tree_map(lambda a: a[n_dense:], tree)
-    (x, aux), _ = jax.lax.scan(make_body(False), (x, aux), tail_p)
+    (x, aux) = scan_layers(make_body(False), (x, aux), tail_p, n_dense)
     return _rms_norm(x, params["final_norm"], c.rms_eps), aux
 
 

@@ -47,12 +47,12 @@ from .goodput import (  # noqa: F401
 )
 from .flight_recorder import FlightRecorder  # noqa: F401
 
-# request_trace / profiling are PEP 562 lazy: they are only needed by the
-# serving engine and the HTTP control plane (which import the submodules
-# directly), and loading them here would eat the package's import-cost
-# budget for every instrumented module that wants plain counters. Their
-# flags live HERE so set_flags / obs_dump --flags see them before either
-# module loads.
+# request_trace / profiling / numerics are PEP 562 lazy: they are only
+# needed by the serving engine, the HTTP control plane and the numerics
+# probes (which import the submodules directly), and loading them here
+# would eat the package's import-cost budget for every instrumented
+# module that wants plain counters. Their flags live HERE so set_flags /
+# obs_dump --flags see them before any of the modules load.
 from ..framework.flags import define_flag as _define_flag  # noqa: E402
 
 _define_flag("obs_requests_capacity", 256,
@@ -75,8 +75,18 @@ _define_flag("obs_profile_dir", "",
 _define_flag("obs_profile_default_steps", 5,
              "steps one capture spans when the trigger names no count "
              "(SIGUSR2, /control/profile without ?steps=)")
+_define_flag("obs_numerics", False,
+             "numerics observatory: on-device tensor stats + int8 "
+             "quant-error probes + the per-layer NaN-provenance ladder "
+             "(observability.numerics). Read at TRACE time — with it "
+             "off instrumented functions lower to the identical jaxpr; "
+             "requires the master FLAGS_obs_enabled switch too")
+_define_flag("obs_numerics_capacity", 512,
+             "bounded retention for landed numerics stat vectors "
+             "(oldest evicted; the provenance walk and the obs_dump "
+             "stats table read this ring)")
 
-_LAZY_SUBMODULES = ("request_trace", "profiling")
+_LAZY_SUBMODULES = ("request_trace", "profiling", "numerics")
 _LAZY_NAMES = {
     "RequestContext": "request_trace", "RequestTracer": "request_trace",
     "exemplar_for_quantile": "request_trace",
@@ -86,6 +96,8 @@ _LAZY_NAMES = {
     "ProfileController": "profiling",
     "get_profile_controller": "profiling",
     "request_capture": "profiling",
+    "tensor_stats": "numerics",
+    "record_quant_error": "numerics",
 }
 
 
@@ -114,4 +126,5 @@ __all__ = [
     "requests_payload",
     "profiling", "ProfileController", "get_profile_controller",
     "request_capture",
+    "numerics", "tensor_stats", "record_quant_error",
 ]

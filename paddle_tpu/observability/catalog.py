@@ -224,6 +224,20 @@ CATALOG = {
     "obs_profile_captures_total": (
         "counter", (), "windowed jax.profiler device captures completed "
                        "(/control/profile, SIGUSR2, or request_capture)"),
+    # -- numerics observatory (observability.numerics) ----------------------
+    "numerics_quant_error": (
+        "gauge", ("site",),
+        "relative RMS int8 reconstruction error of the last paired "
+        "pre/post-quant probe per site (weight_only / expert_int8 / "
+        "kv_int8) — the per-site error budget"),
+    "numerics_events_total": (
+        "counter", ("site",),
+        "numerics stat vectors landed in the host ring (async outfeed "
+        "from in-graph probes; FLAGS_obs_numerics)"),
+    "numerics_nan_total": (
+        "counter", ("site",),
+        "landed stat vectors whose NaN/Inf count was nonzero — the "
+        "alertable health signal behind the provenance walk"),
 }
 
 # Histogram bucket overrides: (lo, hi, per_decade) for metrics whose

@@ -115,6 +115,16 @@ class FlightRecorder:
                 out["requests"] = rp
         except Exception:          # a broken tracer must not block dumps
             pass
+        try:
+            # the numerics stats table + the last NaN-provenance verdict
+            # (which layer went bad first) ride the post-mortem too
+            from . import numerics
+
+            npay = numerics.payload()
+            if npay["rows"] or npay["provenance"]:
+                out["numerics"] = npay
+        except Exception:          # a broken probe must not block dumps
+            pass
         return out
 
     def dump(self, path: Optional[str] = None, trigger: str = "manual",

@@ -1,0 +1,383 @@
+"""Numerics observatory: on-device tensor stats + quant-error telemetry.
+
+The repo runs int8 in three hot paths (weight-only matmuls, int8 expert
+weights, int8 KV pools) and PR 1's NaN/spike rollback fires on a scalar
+loss check — nothing measured the quantization error any int8 site
+introduces, and a rollback never said WHICH layer went bad first. This
+module is that measurement layer:
+
+- :func:`tensor_stats` computes absmax / rms / NaN+Inf count /
+  int8-overflow fraction of a tensor as one tiny fused reduction
+  *inside the jitted graph*;
+- :func:`record_stats` / :func:`ladder_tap` / :func:`record_quant_error`
+  ship the resulting stat vector to the host through jax's async
+  debug-callback outfeed — the device never blocks on the host, the host
+  never syncs the device; stat vectors land in a bounded ring
+  (``FLAGS_obs_numerics_capacity``) a consumer reads at step boundaries;
+- :func:`record_quant_error` additionally pairs a pre-quant tensor with
+  its int8 form and lands the relative RMS reconstruction error in the
+  ``numerics_quant_error{site=...}`` gauge — one gauge per int8 site
+  (``weight_only`` / ``expert_int8`` / ``kv_int8``), the per-site error
+  budget nncase (PAPERS.md) makes first-class;
+- :func:`provenance` walks the last step's per-layer stats ladder
+  (``ladder_tap`` entries from models/llama + models/moe) and names the
+  FIRST layer whose NaN/Inf count went nonzero — the train loop attaches
+  it to the rollback flight event and the JSON post-mortem.
+
+Cost contract: everything is behind ``FLAGS_obs_numerics`` (master obs
+switch must also be on). The gate is read at TRACE time — with it off an
+instrumented function lowers to the *identical jaxpr* as the
+uninstrumented one (zero device ops, asserted in tests); with it on each
+site adds one small reduction + an async outfeed. Programs compiled
+while the flag was off keep their compiled form: flip the flag before
+building the jit (or construct a fresh engine) to instrument.
+
+Module import stays stdlib-only (jax is imported lazily inside
+functions) so the observability package keeps its no-heavy-deps
+contract; the ``FLAGS_obs_numerics_*`` flags are defined eagerly in the
+package ``__init__`` (PEP 562 — loading plain counters never pays for
+this module).
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..framework.flags import get_flag, set_flags, watch_flag
+from . import state
+from .catalog import instrument as _instrument
+
+__all__ = [
+    "STAT_FIELDS", "enabled", "active", "enable", "disable",
+    "tensor_stats", "record_stats", "ladder_record", "record_quant_error",
+    "step_mark", "epoch", "flush", "entries", "rows", "latest",
+    "provenance", "payload", "clear",
+]
+
+# one stat vector per landing: the fixed schema every probe emits
+# (quant_err is -1 for plain stats probes)
+STAT_FIELDS = ("absmax", "rms", "nan_inf", "overflow_frac", "quant_err")
+
+_M_EVENTS = _instrument("numerics_events_total")
+_M_NAN = _instrument("numerics_nan_total")
+_M_QERR = _instrument("numerics_quant_error")
+
+# hot-path switch: one module-global read per instrumented trace site
+# (get_flag takes a lock); kept in sync with FLAGS_obs_numerics through
+# watch_flag, same contract as state._ENABLED in PR 2
+_ENABLED = bool(get_flag("obs_numerics"))
+
+_lock = threading.Lock()
+_RING: collections.deque = collections.deque(
+    maxlen=int(get_flag("obs_numerics_capacity")))
+_EPOCH = 0                       # step counter stamped onto landings
+_LAST_PROVENANCE: Optional[str] = None
+
+
+def _on_flag(value) -> None:
+    global _ENABLED
+    _ENABLED = bool(value)
+
+
+watch_flag("obs_numerics", _on_flag)
+
+
+def _resize(capacity) -> None:
+    global _RING
+    with _lock:
+        _RING = collections.deque(_RING, maxlen=int(capacity))
+
+
+watch_flag("obs_numerics_capacity", _resize)
+
+
+def enabled() -> bool:
+    """True when FLAGS_obs_numerics is on (ignores the master switch)."""
+    return _ENABLED
+
+
+def active() -> bool:
+    """The trace-time gate: numerics AND the master obs switch are on.
+    Instrumented call sites check this while tracing — off means zero
+    ops added (the jaxpr is identical to the uninstrumented one)."""
+    return _ENABLED and state.enabled()
+
+
+def enable() -> None:
+    set_flags({"obs_numerics": True})
+
+
+def disable() -> None:
+    set_flags({"obs_numerics": False})
+
+
+# ---------------------------------------------------------------------------
+# in-graph stat reductions
+# ---------------------------------------------------------------------------
+
+def _expand(scale, axis: int):
+    import jax.numpy as jnp
+
+    return jnp.expand_dims(scale, axis)
+
+
+def tensor_stats(x, scale=None, axis: int = -1):
+    """[5] f32 stat vector of ``x``: absmax, rms, NaN+Inf count, and the
+    int8-overflow fraction — one small fused reduction, safe to call
+    inside any jitted program. Non-finite elements are counted, then
+    masked to 0 so absmax/rms stay meaningful alongside them.
+
+    ``scale`` (optional, with ``axis`` naming the dim it was reduced
+    over — the :func:`~paddle_tpu.kernels.quant_matmul.quantize_grouped`
+    convention) measures overflow against the ACTUAL quantization grid:
+    the fraction of elements whose ``|x| / scale`` rounds outside
+    [-127, 127]. Without it, overflow is measured against a unit grid
+    (|x| > 127) — the "would this clip if cast to int8 raw" signal.
+    The quant_err slot is -1 (set only by :func:`record_quant_error`)."""
+    import jax.numpy as jnp
+
+    xf = x.astype(jnp.float32)
+    finite = jnp.isfinite(xf)
+    n_bad = jnp.sum(~finite).astype(jnp.float32)
+    xz = jnp.where(finite, xf, 0.0)
+    ax = jnp.abs(xz)
+    absmax = jnp.max(ax)
+    rms = jnp.sqrt(jnp.mean(xz * xz))
+    if scale is not None:
+        grid = jnp.maximum(_expand(scale, axis).astype(jnp.float32), 1e-30)
+        over = jnp.mean((ax / grid > 127.5).astype(jnp.float32))
+    else:
+        over = jnp.mean((ax > 127.0).astype(jnp.float32))
+    return jnp.stack([absmax, rms, n_bad, over,
+                      jnp.full((), -1.0, jnp.float32)])
+
+
+def _ship(site: str, vec, layer) -> None:
+    """Outfeed one stat vector: ``jax.debug.callback`` streams the value
+    to :func:`_land` when the device produces it — asynchronous (never a
+    device sync on the hot path), transform-safe (survives jit / scan /
+    grad / remat; a remat recompute re-lands identical values, which the
+    latest-wins ring absorbs)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    jax.debug.callback(functools.partial(_land, site), vec,
+                       jnp.asarray(-1 if layer is None else layer,
+                                   jnp.int32),
+                       ordered=False)
+
+
+def record_stats(site: str, x, scale=None, axis: int = -1,
+                 layer=None) -> None:
+    """Probe one tensor: compute :func:`tensor_stats` in-graph and ship
+    it to the host ring under ``site``. A trace-time no-op (zero ops
+    added) unless :func:`active`.
+
+    Caveat (this jax version): a probe placed inside a ``lax.scan``
+    body is dropped by autodiff's partial-eval unless the body is
+    ``jax.checkpoint``-ed — scanned per-layer ladders therefore ride
+    the scan's ys into :func:`ladder_record` instead."""
+    if not active():
+        return
+    _ship(site, tensor_stats(x, scale=scale, axis=axis), layer)
+
+
+def ladder_record(site: str, stats_rows, offset: int = 0) -> None:
+    """Ship a stacked ``[L, 5]`` per-layer stats ladder in ONE landing.
+
+    The models compute :func:`tensor_stats` of each scanned layer's
+    output as the scan's ys — the rungs accumulate into one small
+    device buffer that leaves the graph through a single async outfeed
+    here (row ``i`` lands as layer ``offset + i``). This is the ladder
+    :func:`provenance` walks for the first NaN layer. The caller checks
+    :func:`active` (it also gates building the ys)."""
+    import functools
+
+    import jax
+
+    jax.debug.callback(functools.partial(_land_ladder, site, int(offset)),
+                       stats_rows, ordered=False)
+
+
+def record_quant_error(site: str, pairs: Sequence[Tuple]) -> None:
+    """Paired pre/post-quant probe for one int8 site. ``pairs`` is a
+    sequence of ``(pre, q, scale, axis)`` — the float tensor, its int8
+    form, the per-channel scales, and the axis the scale was reduced
+    over (:func:`quantize_grouped` / :func:`quantize_kv` conventions,
+    so reconstruction is ``q * expand_dims(scale, axis)``). All pairs
+    aggregate into ONE landing: the stats of the pre-quant tensors plus
+    the combined relative RMS reconstruction error
+    ``sqrt(sum (pre - deq)^2 / sum pre^2)``, which lands in the
+    ``numerics_quant_error{site=...}`` gauge. Trace-time no-op unless
+    :func:`active`."""
+    if not active():
+        return
+    import jax.numpy as jnp
+
+    from ..kernels.quant_matmul import dequantize_channels
+
+    sq_err = jnp.zeros((), jnp.float32)
+    sq = jnp.zeros((), jnp.float32)
+    absmax = jnp.zeros((), jnp.float32)
+    n_bad = jnp.zeros((), jnp.float32)
+    n_over = jnp.zeros((), jnp.float32)
+    n_elems = 0
+    for pre, q, scale, axis in pairs:
+        pf = pre.astype(jnp.float32)
+        finite = jnp.isfinite(pf)
+        n_bad = n_bad + jnp.sum(~finite).astype(jnp.float32)
+        pz = jnp.where(finite, pf, 0.0)
+        deq = dequantize_channels(q, scale, axis).astype(jnp.float32)
+        d = pz - deq
+        sq_err = sq_err + jnp.sum(d * d)
+        sq = sq + jnp.sum(pz * pz)
+        absmax = jnp.maximum(absmax, jnp.max(jnp.abs(pz)))
+        grid = jnp.maximum(_expand(scale, axis).astype(jnp.float32),
+                           1e-30)
+        n_over = n_over + jnp.sum(
+            (jnp.abs(pz) / grid > 127.5).astype(jnp.float32))
+        n_elems += int(pre.size)
+    n = max(n_elems, 1)
+    rms = jnp.sqrt(sq / n)
+    rel = jnp.sqrt(sq_err / jnp.maximum(sq, 1e-30))
+    _ship(site, jnp.stack([absmax, rms, n_bad, n_over / n, rel]), None)
+
+
+# ---------------------------------------------------------------------------
+# host side: the landing ring + consumers
+# ---------------------------------------------------------------------------
+
+def _entry(site: str, layer: int, v) -> Dict:
+    return {"t": time.time(), "site": str(site), "layer": int(layer),
+            "epoch": _EPOCH,
+            "absmax": float(v[0]), "rms": float(v[1]),
+            "nan_inf": int(v[2]), "overflow_frac": float(v[3]),
+            "quant_err": (float(v[4]) if v[4] >= 0 else None)}
+
+
+def _commit(entry: Dict) -> None:
+    with _lock:
+        _RING.append(entry)
+    site = entry["site"]
+    _M_EVENTS.inc(site=site)
+    if entry["nan_inf"]:
+        _M_NAN.inc(site=site)
+    if entry["quant_err"] is not None:
+        _M_QERR.set(entry["quant_err"], site=site)
+
+
+def _land(site: str, vec, layer) -> None:
+    """Host landing for one stat vector (runs on jax's callback thread;
+    may arrive out of order and after the step that produced it)."""
+    if not _ENABLED:               # disabled mid-flight: drop, don't record
+        return
+    import numpy as np
+
+    _commit(_entry(site, int(layer), np.asarray(vec, dtype=np.float64)))
+
+
+def _land_ladder(site: str, offset: int, mat) -> None:
+    """Host landing for one [L, 5] stats ladder — row i is layer
+    ``offset + i``."""
+    if not _ENABLED:
+        return
+    import numpy as np
+
+    m = np.asarray(mat, dtype=np.float64)
+    for i in range(m.shape[0]):
+        _commit(_entry(site, offset + i, m[i]))
+
+
+def step_mark() -> int:
+    """Advance the step epoch stamped onto subsequent landings; the
+    train loop calls this at each attempt boundary so
+    :func:`provenance` can scope its walk to one step. Returns the new
+    epoch (0 and free when inactive)."""
+    global _EPOCH
+    if not _ENABLED:
+        return 0
+    _EPOCH += 1
+    return _EPOCH
+
+
+def epoch() -> int:
+    return _EPOCH
+
+
+def flush() -> None:
+    """Wait for every in-flight stat vector to land (jax effects
+    barrier). The one deliberate sync — consumers call it at step
+    boundaries / incident time, never inside the hot path."""
+    try:
+        import jax
+
+        jax.effects_barrier()
+    except Exception:
+        pass
+
+
+def entries() -> List[Dict]:
+    with _lock:
+        return list(_RING)
+
+
+def rows() -> List[Dict]:
+    """Latest landing per (site, layer) — the obs_dump stats table.
+    Sites sort alphabetically, ladder rungs by layer index."""
+    last: Dict[Tuple[str, int], Dict] = {}
+    for e in entries():
+        last[(e["site"], e["layer"])] = e
+    return [last[k] for k in sorted(last)]
+
+
+def latest(site: str, layer: Optional[int] = None) -> Optional[Dict]:
+    for e in reversed(entries()):
+        if e["site"] == site and (layer is None or e["layer"] == layer):
+            return e
+    return None
+
+
+def provenance(since_epoch: Optional[int] = None) -> Optional[str]:
+    """Walk the stats ladder for the first bad layer: among ladder
+    landings (layer >= 0) at ``since_epoch`` or later (default: the
+    newest epoch present), the entry with nonzero NaN/Inf count and the
+    SMALLEST layer index — NaNs propagate forward through the stack, so
+    the earliest rung names the layer that went bad first (two
+    simultaneously-bad layers resolve to the earlier one). Returns
+    ``"<site>:<layer>"`` or ``None``. Flushes in-flight landings
+    first — this runs on the rollback/incident path, not the hot one."""
+    global _LAST_PROVENANCE
+    if not _ENABLED:
+        return None
+    flush()
+    ladder = [e for e in entries() if e["layer"] >= 0]
+    if since_epoch is not None:
+        ladder = [e for e in ladder if e["epoch"] >= since_epoch]
+    elif ladder:
+        newest = max(e["epoch"] for e in ladder)
+        ladder = [e for e in ladder if e["epoch"] == newest]
+    bad = [e for e in ladder if e["nan_inf"] > 0]
+    if not bad:
+        return None
+    first = min(bad, key=lambda e: (e["layer"], e["t"]))
+    _LAST_PROVENANCE = f"{first['site']}:{first['layer']}"
+    return _LAST_PROVENANCE
+
+
+def payload() -> Dict:
+    """The post-mortem embed: the stats table plus the last provenance
+    verdict (what the flight recorder attaches on crash)."""
+    return {"rows": rows(), "provenance": _LAST_PROVENANCE}
+
+
+def clear() -> None:
+    """Drop every landed entry and reset the epoch (test isolation)."""
+    global _EPOCH, _LAST_PROVENANCE
+    with _lock:
+        _RING.clear()
+    _EPOCH = 0
+    _LAST_PROVENANCE = None

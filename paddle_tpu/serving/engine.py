@@ -66,6 +66,7 @@ from ..kernels.quant_matmul import (attn_pv, attn_qk, quantize_kv,
 from ..models.llama import (LlamaConfig, _apply_rope, _attention,
                             _rms_norm, _wmat)  # noqa: F401
 from ..observability import flight_recorder as _flight
+from ..observability import numerics as _nm
 from ..observability import perf as _perf
 from ..observability import profiling as _profiling
 from ..observability import request_trace as _rt
@@ -186,7 +187,8 @@ def _apply_admissions(c_last, c_len, c_done, c_rem, wave_toks, slot_of_row,
 
 def _paged_prefill(params, tokens, blk_ids, true_len, pools,
                    temps, top_ks, top_ps, key, *, config: LlamaConfig,
-                   sample_flags=(True, True, True), kv_int8: bool = False):
+                   sample_flags=(True, True, True), kv_int8: bool = False,
+                   numerics: bool = False):
     """Prefill a WAVE of admissions in one compiled program: causal
     forward over the padded prompt batch, every layer's K/V written into
     the slots' pool blocks by ONE batched scatter, and each request's
@@ -261,6 +263,12 @@ def _paged_prefill(params, tokens, blk_ids, true_len, pools,
     if kv_int8:
         qk, sk = quantize_kv(k_stack)
         qv, sv = quantize_kv(v_stack)
+        if numerics:
+            # paired pre/post-quant probe for the int8-KV site: one tiny
+            # fused reduction over this wave's K/V, shipped async — the
+            # numerics_quant_error{site="kv_int8"} error budget
+            _nm.record_quant_error("kv_int8", [(k_stack, qk, sk, -1),
+                                               (v_stack, qv, sv, -1)])
         pools["k"] = pools["k"].at[:, flat].set(qk)
         pools["v"] = pools["v"].at[:, flat].set(qv)
         pools["ks"] = pools["ks"].at[:, flat].set(sk)
@@ -282,7 +290,8 @@ def _paged_prefill(params, tokens, blk_ids, true_len, pools,
 def _paged_decode(params, last_tokens, lengths, done0, budgets, key, active,
                   block_table, pools, temps, top_ks, top_ps,
                   eos_ids, *, config: LlamaConfig, n_steps: int,
-                  sample_flags=(True, True, True), kv_int8: bool = False):
+                  sample_flags=(True, True, True), kv_int8: bool = False,
+                  numerics: bool = False):
     """``n_steps`` decode iterations in ONE compiled program (multi-step
     scheduling): the host loop syncs once per call instead of once per
     token — through a remote-attached chip the per-step d2h round-trip
@@ -445,6 +454,11 @@ def _paged_decode(params, last_tokens, lengths, done0, budgets, key, active,
     if kv_int8:
         rq_k, rs_k = quantize_kv(ring_k)
         rq_v, rs_v = quantize_kv(ring_v)
+        if numerics:
+            # decode-writeback rung of the kv_int8 error budget (the
+            # ring is small — the reduction is noise next to the scan)
+            _nm.record_quant_error("kv_int8", [(ring_k, rq_k, rs_k, -1),
+                                               (ring_v, rq_v, rs_v, -1)])
         pools["k"] = pools["k"].at[:, phys, off].set(rq_k)
         pools["v"] = pools["v"].at[:, phys, off].set(rq_v)
         pools["ks"] = pools["ks"].at[:, phys, off].set(rs_k)
@@ -737,10 +751,16 @@ class LLMEngine:
         key = (bucket, B, flags)
         fn = self._prefill.get(key)
         if fn is None:
-            fn = jax.jit(functools.partial(_paged_prefill,
-                                           config=self.config,
-                                           sample_flags=flags,
-                                           kv_int8=self.kv_int8),
+            # the numerics gate is baked at variant-compile time (the
+            # probes are trace-time ops): variants compiled while
+            # FLAGS_obs_numerics was off keep their compiled form —
+            # flip the flag before the engine serves to instrument
+            fn = jax.jit(functools.partial(
+                             _paged_prefill,
+                             config=self.config,
+                             sample_flags=flags,
+                             kv_int8=self.kv_int8,
+                             numerics=self.kv_int8 and _nm.active()),
                          donate_argnums=(4,))
             self._prefill[key] = fn
         return fn
@@ -1372,11 +1392,15 @@ class LLMEngine:
                                  if r.temperature > 0))
         decode = self._decode_cache.get((nbk, flags))
         if decode is None:
+            # numerics gate baked per variant, like _prefill_fn (the key
+            # stays (bucket, flags): a mid-run flag flip instruments new
+            # variants only — documented in docs/observability.md)
             decode = self._decode_cache[(nbk, flags)] = jax.jit(
                 functools.partial(_paged_decode, config=self.config,
                                   n_steps=self.decode_steps,
                                   sample_flags=flags,
-                                  kv_int8=self.kv_int8),
+                                  kv_int8=self.kv_int8,
+                                  numerics=self.kv_int8 and _nm.active()),
                 donate_argnums=(8,))
             _M_DECODE_RECOMPILES.inc()
         if _obs.enabled():

@@ -576,6 +576,49 @@ def test_bench_diff_failed_new_round_is_a_regression(tmp_path):
     assert proc.returncode == 1 and "no parsed metrics" in out
 
 
+def test_bench_diff_check_next_committed_round_is_armed():
+    """The tier-1 sentinel: --check against the NEXT bench round in the
+    repo. Today the file does not exist, so the check reports pending
+    and passes; the moment BENCH_r06.json is committed this same test
+    diffs it against the newest earlier usable round and fails the
+    suite on any regression beyond the band — a 0.92x can no longer sit
+    unnoticed for two rounds."""
+    proc = _run_bench_diff("--check", os.path.join(REPO, "BENCH_r06.json"))
+    out = proc.stdout.decode()
+    assert proc.returncode == 0, out
+    # whichever state the repo is in, the check made a decision
+    assert ("pending" in out or "no regression" in out
+            or "first usable round" in out), out
+
+
+def test_bench_diff_check_flags_the_real_r05_regression():
+    """--check on the committed r05 anchors on the newest earlier
+    usable round and flags the MoE regression — proof the armed mode
+    actually bites once the round exists."""
+    proc = _run_bench_diff("--check", os.path.join(REPO, "BENCH_r05.json"))
+    out = proc.stdout.decode()
+    assert proc.returncode == 1, out
+    assert "moe-dropless_pretrain" in out and "REGRESSION" in out
+
+
+def test_bench_diff_check_first_round_and_band(tmp_path):
+    pa = tmp_path / "BENCH_r01.json"
+    pa.write_text(json.dumps(
+        {"n": 1, "rc": 0,
+         "parsed": {"metrics": [{"metric": "m1", "value": 100.0}]}}))
+    proc = _run_bench_diff("--check", str(pa))
+    assert proc.returncode == 0
+    assert "first usable round" in proc.stdout.decode()
+    pb = tmp_path / "BENCH_r02.json"
+    pb.write_text(json.dumps(
+        {"n": 2, "rc": 0,
+         "parsed": {"metrics": [{"metric": "m1", "value": 98.0}]}}))
+    proc = _run_bench_diff("--check", str(pb))
+    assert proc.returncode == 0, proc.stdout.decode()   # inside ±3%
+    proc = _run_bench_diff("--check", str(pb), "--band", "1.5")
+    assert proc.returncode == 1                         # band bites
+
+
 # ---------------------------------------------------------------------------
 # obs_dump --requests (file mode)
 # ---------------------------------------------------------------------------

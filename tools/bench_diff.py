@@ -21,8 +21,17 @@ metrics (noted in the output). A NEW side without metrics is itself
 reported as a regression — a bench that stopped producing numbers is
 the worst kind of slowdown.
 
-Exit codes: 0 ok (within band), 1 regression (or unusable new round),
-2 usage/IO error.
+``--check ROUND.json`` is the CI arming of the sentinel: validate ONE
+named round against the newest earlier usable round in its directory.
+A round file that does not exist yet exits 0 ("pending") — so a tier-1
+test can commit ``--check BENCH_r06.json`` today and the check arms
+itself the moment that round lands; a landed round that regressed then
+fails the suite at the round it happens, not two rounds later::
+
+    python tools/bench_diff.py --check BENCH_r06.json
+
+Exit codes: 0 ok (within band / pending / first round), 1 regression
+(or unusable new round), 2 usage/IO error.
 """
 from __future__ import annotations
 
@@ -75,6 +84,27 @@ def find_rounds(directory: str) -> List[str]:
     return sorted(paths, key=round_number)
 
 
+def newest_earlier_usable(path: str) -> Tuple[Optional[str], Dict[str, Dict]]:
+    """The newest round in ``path``'s directory with a LOWER round
+    number and usable metrics — the shared walk-back behind the failed-
+    round anchoring and ``--check``. Unreadable candidate rounds are
+    skipped (one corrupt old file must not kill the sentinel)."""
+    n = round_number(path)
+    if n is None:
+        return None, {}
+    for prev in reversed(find_rounds(os.path.dirname(path) or ".")):
+        pn = round_number(prev)
+        if pn is None or pn >= n:
+            continue
+        try:
+            rows = metric_rows(load_round(prev))
+        except (OSError, json.JSONDecodeError):
+            continue
+        if rows:
+            return prev, rows
+    return None, {}
+
+
 def resolve_old(old_path: str, notes: List[str]) -> Tuple[str, Dict[str, Dict]]:
     """The old anchor: ``old_path`` itself when it has metrics, else the
     newest EARLIER round in the same directory that does (a failed round
@@ -86,18 +116,10 @@ def resolve_old(old_path: str, notes: List[str]) -> Tuple[str, Dict[str, Dict]]:
     notes.append(
         f"note: {os.path.basename(old_path)} has no parsed metrics "
         f"(rc={doc.get('rc')}) — walking back to an earlier round")
-    n = round_number(old_path)
-    if n is not None:
-        for prev in reversed(find_rounds(os.path.dirname(old_path)
-                                         or ".")):
-            pn = round_number(prev)
-            if pn is not None and pn < n:
-                rows = metric_rows(load_round(prev))
-                if rows:
-                    notes.append(
-                        f"note: baseline round = "
-                        f"{os.path.basename(prev)}")
-                    return prev, rows
+    prev, rows = newest_earlier_usable(old_path)
+    if prev is not None:
+        notes.append(f"note: baseline round = {os.path.basename(prev)}")
+        return prev, rows
     return old_path, {}
 
 
@@ -149,6 +171,48 @@ def render_table(entries: List[Dict], old_name: str, new_name: str,
                   f"{delta:>8}  {e['status']}{mark}\n")
 
 
+def check_round(path: str, band: float) -> int:
+    """``--check``: validate one round against its newest earlier usable
+    round. Missing file = pending (0); no earlier usable round = first
+    round (0); regression beyond the band = 1."""
+    name = os.path.basename(path)
+    if round_number(path) is None:
+        # a misnamed target would stay 'pending' forever — a sentinel
+        # that can never arm is a config error, not a pass
+        print(f"bench_diff: --check target {name!r} does not match "
+              "BENCH_r<N>.json", file=sys.stderr)
+        return 2
+    if not os.path.exists(path):
+        print(f"check: {name} not produced yet — pending (the check "
+              "arms itself when the round lands)")
+        return 0
+    try:
+        new_doc = load_round(path)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"bench_diff: {e}", file=sys.stderr)
+        return 2
+    new_rows = metric_rows(new_doc)
+    if not new_rows:
+        print(f"REGRESSION: {name} has no parsed metrics "
+              f"(rc={new_doc.get('rc')}) — the bench itself failed")
+        return 1
+    old_path, old_rows = newest_earlier_usable(path)
+    if not old_rows:
+        print(f"check: {name} is the first usable round under "
+              f"{os.path.dirname(path) or '.'!r} — nothing to diff")
+        return 0
+    entries = diff_rows(old_rows, new_rows, band)
+    render_table(entries, os.path.basename(old_path), name, band)
+    regressed = [e for e in entries if e["status"] == "regressed"]
+    if regressed:
+        names = ", ".join(e["metric"] for e in regressed)
+        print(f"\nREGRESSION: {len(regressed)} metric(s) beyond the "
+              f"-{band:.1%} band: {names}")
+        return 1
+    print("\nok: no regression beyond the noise band")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="diff two bench rounds; nonzero exit on regression")
@@ -161,8 +225,18 @@ def main(argv=None) -> int:
     ap.add_argument("--band", type=float, default=3.0,
                     help="noise band in percent (default 3.0): deltas "
                          "inside ±band%% are ok")
+    ap.add_argument("--check", default=None, metavar="ROUND.json",
+                    help="validate ONE round against the newest earlier "
+                         "usable round in its directory; a round not "
+                         "produced yet is 'pending' (exit 0) — the "
+                         "tier-1 sentinel mode")
     args = ap.parse_args(argv)
     band = args.band / 100.0
+
+    if args.check is not None:
+        if args.old is not None or args.new is not None:
+            ap.error("--check takes no positional rounds")
+        return check_round(args.check, band)
 
     if (args.old is None) != (args.new is None):
         ap.error("pass both OLD and NEW, or neither (auto mode)")

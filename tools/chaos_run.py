@@ -2,17 +2,20 @@
 """Chaos run: seeded fault schedules against the training loop or the
 serving engine, asserting recovery invariants.
 
-Training mode (default) — the CI-grade end-to-end for
-distributed/resilience: the driver plays the role of the elastic
-launcher — every SimulatedCrash kills the "process" (the
+Training mode (default; ``--train`` names it explicitly) — the CI-grade
+end-to-end for distributed/resilience: the driver plays the role of the
+elastic launcher — every SimulatedCrash kills the "process" (the
 ResilientTrainLoop) and a fresh loop auto-resumes from the newest valid
 checkpoint; after the first crash the newest checkpoint is deliberately
 corrupted to exercise the fallback tier. A run passes when the faulted
 job reaches the SAME final parameters (allclose), the same final eval
 loss, and the same dataloader position as an uninterrupted run of equal
-total steps.
+total steps. The schedule also carries a targeted ``nan_inject`` whose
+rollback must carry NaN provenance: the numerics stats ladder
+(observability.numerics) has to name EXACTLY the injected layer in the
+rollback event and the flight-recorder post-mortem.
 
-    JAX_PLATFORMS=cpu python tools/chaos_run.py --steps 12 --seed 7
+    JAX_PLATFORMS=cpu python tools/chaos_run.py --train --steps 12 --seed 7
 
 Serving mode (``--serving``) — the same idea for the survivability
 layer: a seeded schedule of readback crashes, pool squeezes, and slow
@@ -151,9 +154,13 @@ def serving_main(args):
 
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--serving", action="store_true",
-                    help="run the serving-engine chaos suite instead of "
-                         "the train-loop parity run")
+    mode = ap.add_mutually_exclusive_group()
+    mode.add_argument("--serving", action="store_true",
+                      help="run the serving-engine chaos suite instead "
+                           "of the train-loop parity run")
+    mode.add_argument("--train", action="store_true",
+                      help="run the train-loop chaos parity suite "
+                           "(the default; the flag names it explicitly)")
     ap.add_argument("--steps", type=int, default=12)
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--rate", type=float, default=0.2,
@@ -171,13 +178,20 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    import paddle_tpu.observability as obs
     from paddle_tpu.models import llama
+    from paddle_tpu.observability import numerics
     from paddle_tpu.distributed.resilience import (FaultInjector,
                                                    ResilientTrainLoop,
                                                    ResumableIterator,
                                                    SimulatedCrash,
                                                    atomic_ckpt)
 
+    # numerics on for BOTH runs (stat probes never change the math, so
+    # parity still holds bit-exactly) — the nan_inject below must leave
+    # a provenance trail naming its layer
+    obs.enable()
+    numerics.enable()
     cfg = llama.tiny_llama(vocab=64, hidden=32, layers=2, heads=4,
                            kv_heads=2, seq=16, ffn=64)
     steps = args.steps
@@ -208,7 +222,10 @@ def main():
     inj = FaultInjector.random_schedule(
         seed=args.seed, n_steps=steps,
         kinds=("nan_grad", "storage_fail"), rate=args.rate)
-    menu = [("nan_grad", max(1, steps // 3)), ("crash", 2 * steps // 3)]
+    nan_layer = 1
+    menu = [("nan_grad", max(1, steps // 3)),
+            (f"nan_inject:{nan_layer}", max(2, steps // 2)),
+            ("crash", 2 * steps // 3)]
     inj = FaultInjector(inj.pending + menu)
     print(f"fault schedule: {inj.pending}")
 
@@ -247,6 +264,26 @@ def main():
     print(f"chaos eval loss {chaos_loss:.6f}")
 
     ok = True
+    # NaN provenance end-to-end: the nan_inject rollback must have named
+    # the injected layer, in the rollback event AND the post-mortem
+    want = f"llama.layer:{nan_layer}"
+    pm_path = os.path.join(workdir, "postmortem.json")
+    obs.flight_recorder.dump(pm_path)
+    import json
+    with open(pm_path) as f:
+        pm = json.load(f)
+    got = (pm.get("numerics") or {}).get("provenance")
+    print(f"nan_inject provenance: post-mortem names {got!r} "
+          f"(injected {want!r})")
+    if got != want:
+        print(f"PROVENANCE: FAIL (expected {want!r})")
+        ok = False
+    named = [e for e in pm.get("events", [])
+             if e.get("kind") == "rollback" and e.get("first_bad") == want]
+    if not named:
+        print("PROVENANCE: FAIL (no rollback flight event carries "
+              f"first_bad={want!r})")
+        ok = False
     for a, b in zip(jax.tree_util.tree_leaves(s_clean.params),
                     jax.tree_util.tree_leaves(s_chaos.params)):
         if not np.allclose(np.asarray(a), np.asarray(b),

@@ -17,6 +17,7 @@ Two modes (slow-lane tooling, like tools/chaos_run.py):
       JAX_PLATFORMS=cpu python tools/obs_dump.py --demo train --out /tmp/obs
       JAX_PLATFORMS=cpu python tools/obs_dump.py --demo moe --out /tmp/obs
       JAX_PLATFORMS=cpu python tools/obs_dump.py --demo goodput --out /tmp/obs
+      JAX_PLATFORMS=cpu python tools/obs_dump.py --demo numerics --out /tmp/obs
 
 - pretty-print a crash flight-recorder dump (written on unhandled
   exception / watchdog timeout / SIGTERM when FLAGS_obs_postmortem_dir
@@ -116,6 +117,31 @@ def print_request_table(payload, out=sys.stdout):
         out.write(f"SLO audit entries: {len(audits)} (latest: request "
                   f"{audits[-1].get('request_id')} "
                   f"{'+'.join(audits[-1].get('reasons', []))})\n")
+    return rows
+
+
+def print_numerics_table(rows, out=sys.stdout):
+    """Render the numerics stats table (observability.numerics.rows
+    format): one row per (site, layer) with absmax/rms/NaN-count/
+    overflow columns, plus the relative quant error for the paired
+    pre/post-quant probe sites."""
+    out.write(f"numerics: {len(rows)} stat row(s)\n")
+    if not rows:
+        out.write("(no landed stats — set FLAGS_obs_numerics and run an "
+                  "instrumented workload)\n")
+        return rows
+    w = max([len(r["site"]) for r in rows] + [len("site")])
+    hdr = (f"{'site':{w}} {'layer':>5} {'absmax':>10} {'rms':>10} "
+           f"{'nan/inf':>7} {'overflow':>8} {'quant_err':>9}\n")
+    out.write(hdr)
+    out.write("-" * (len(hdr) - 1) + "\n")
+    for r in rows:
+        layer = str(r["layer"]) if r["layer"] >= 0 else "-"
+        qerr = (f"{r['quant_err']:.2e}" if r["quant_err"] is not None
+                else "-")
+        out.write(f"{r['site']:{w}} {layer:>5} {r['absmax']:>10.4g} "
+                  f"{r['rms']:>10.4g} {r['nan_inf']:>7d} "
+                  f"{r['overflow_frac']:>8.2%} {qerr:>9}\n")
     return rows
 
 
@@ -317,6 +343,73 @@ def demo_goodput(workdir):
           "(pretty-print with tools/obs_dump.py --postmortem)")
 
 
+def demo_numerics(workdir):
+    """Numerics-observatory demo: all three int8 sites report their
+    quant-error budget (weight_only from llama.quantize_params,
+    expert_int8 from moe.quantize_expert_params, kv_int8 from an int8-KV
+    engine run), then a seeded ``nan_inject`` chaos step shows the
+    per-layer stats ladder naming the poisoned layer in the rollback's
+    provenance — the stats table prints it all."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu.observability as obs
+    from paddle_tpu.distributed.resilience import (FaultInjector,
+                                                   ResilientTrainLoop)
+    from paddle_tpu.models import llama, moe
+    from paddle_tpu.observability import numerics
+    from paddle_tpu.serving import LLMEngine
+
+    numerics.enable()
+    cfg = dataclasses.replace(
+        llama.tiny_llama(vocab=64, hidden=32, layers=2, heads=4, kv_heads=2,
+                         seq=128, ffn=64),
+        dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+    # site 1: weight-only int8 (quantize pairs the pre/post tensors)
+    qparams = jax.jit(llama.quantize_params)(params)
+    # site 2: int8 expert weights
+    moe.quantize_expert_params(
+        moe.init_params(moe.tiny_moe(), jax.random.PRNGKey(1)))
+    # site 3: int8 KV pools through a short int8-everywhere serving run
+    rng = np.random.default_rng(0)
+    eng = LLMEngine(qparams, cfg, max_slots=2, block_size=8,
+                    max_model_len=64, prompt_buckets=[8, 32],
+                    kv_dtype="int8")
+    for _ in range(2):
+        eng.add_request(rng.integers(1, 64, size=8).tolist(),
+                        max_new_tokens=8)
+    results = eng.run()
+
+    # provenance: a seeded nan_inject poisons layer 1 for one attempt;
+    # the ladder names it on the rollback, the retry recovers
+    state = llama.init_train_state(cfg, jax.random.PRNGKey(2))
+    batches = [jnp.asarray(rng.integers(1, 64, size=(2, 16)), jnp.int32)
+               for _ in range(4)]
+    step = jax.jit(lambda s, t: llama.train_step(s, t, cfg, lr=1e-3))
+    loop = ResilientTrainLoop(step, state, batches,
+                              injector=FaultInjector("nan_inject:1@1"))
+    loop.run(len(batches))
+    rollbacks = [e for e in loop.events if e["kind"] == "rollback"]
+    numerics.flush()
+    print(f"demo numerics: {len(results)} requests served int8-KV, "
+          f"{loop.step} train steps, {len(rollbacks)} rollback(s)")
+    first_bad = rollbacks[0].get("first_bad") if rollbacks else None
+    print(f"nan_inject provenance: first bad layer = {first_bad}")
+    reg = obs.get_registry()
+    for site in ("weight_only", "expert_int8", "kv_int8"):
+        v = reg.gauge("numerics_quant_error").labels(site=site).value
+        print(f"quant-error budget {site}: {v:.2e}")
+    print()
+    print_numerics_table(numerics.rows())
+    pm = obs.flight_recorder.dump(os.path.join(workdir, "postmortem.json"))
+    print(f"\npost-mortem (numerics section embedded): {pm}")
+
+
 def print_postmortem(path, out=sys.stdout):
     """Pretty-print one flight-recorder post-mortem JSON."""
     import json
@@ -355,6 +448,13 @@ def print_postmortem(path, out=sys.stdout):
     if reqs:
         out.write("\nrequests at dump:\n")
         print_request_table(reqs, out=out)
+    num = doc.get("numerics")
+    if num:
+        out.write("\nnumerics at dump:\n")
+        if num.get("provenance"):
+            out.write(f"NaN provenance: first bad layer = "
+                      f"{num['provenance']}\n")
+        print_numerics_table(num.get("rows") or [], out=out)
     metrics = doc.get("metrics")
     if metrics:
         out.write("\nmetrics at dump:\n")
@@ -385,7 +485,7 @@ def main():
                     help="print registered FLAGS_* (value/default/help); "
                          "optional prefix filter, default obs_")
     ap.add_argument("--demo", choices=("serving", "train", "moe",
-                                       "goodput"),
+                                       "goodput", "numerics"),
                     default=None,
                     help="run a tiny built-in workload with obs enabled")
     ap.add_argument("--out", default="./obs_dump",
@@ -426,6 +526,8 @@ def main():
         demo_moe()
     elif args.demo == "goodput":
         demo_goodput(args.out)
+    elif args.demo == "numerics":
+        demo_numerics(args.out)
     else:
         demo_train(args.out)
     snap_path = obs.dump_snapshot(os.path.join(args.out, "snapshot.json"))
