@@ -370,6 +370,19 @@ def _apply_rope(x, cos, sin):
     return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
 
 
+def _apply_rope_at(x, cos, sin):
+    """Rotate-half RoPE with PER-ROW positions: ``cos``/``sin`` are
+    [B, S, D/2] (each batch row carries its own absolute offsets — the
+    serving engine's chunked/suffix prefill, where row b's chunk starts
+    ``hist_len[b]`` tokens into its sequence). ``_apply_rope`` stays the
+    shared-position fast path."""
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    c = cos[:, :, None, :].astype(x.dtype)
+    s = sin[:, :, None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
 def _attention(q, k, v, config: LlamaConfig):
     """Causal GQA attention. [B, S, H, D] layout. Uses the Pallas flash
     kernel on TPU when shapes allow (kernels/pallas_attention.py — the

@@ -78,6 +78,29 @@ CATALOG = {
         "counter", (), "crashed engine steps recovered by "
                        "ResilientEngine (poisoned in-flight wave "
                        "dropped, requests re-enqueued)"),
+    # -- serving prefix cache + chunked prefill (serving.prefix_cache) -----
+    "serving_prefix_cache_hits_total": (
+        "counter", (), "admissions whose prompt matched >= 1 cached "
+                       "prefix block (the matched blocks are pinned, "
+                       "only the suffix prefills)"),
+    "serving_prefix_cache_misses_total": (
+        "counter", (), "admissions with no cached prefix block "
+                       "(cold prefill of the full prompt)"),
+    "serving_prefix_cache_evictions_total": (
+        "counter", ("kind",),
+        "cached blocks reclaimed under pool pressure (spill = payload "
+        "moved to the pinned-host tier, node stays matchable; drop = "
+        "node + subtree discarded)"),
+    "serving_prefill_tokens_skipped_total": (
+        "counter", (), "prompt tokens served from the prefix cache "
+                       "instead of being re-prefilled (the cache's "
+                       "FLOP savings, in tokens)"),
+    "serving_prefix_cache_blocks": (
+        "gauge", (), "device-resident KV blocks owned by the prefix "
+                     "cache (refcounted; evicted LRU at refcount 0)"),
+    "serving_prefix_cache_host_bytes": (
+        "gauge", (), "bytes of spilled prefix-cache blocks resident in "
+                     "the pinned host tier (HostKVPool kind=\"prefix\")"),
     # -- training (ResilientTrainLoop) ------------------------------------
     "train_steps_total": (
         "counter", (), "committed optimizer steps"),

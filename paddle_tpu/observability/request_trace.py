@@ -120,9 +120,16 @@ class RequestContext:
         # or when the finisher predates reason reporting
         reason = next((str(ev["reason"]) for ev in reversed(self.events)
                        if ev["kind"] == "finish" and "reason" in ev), None)
+        # prompt tokens served from the prefix cache at the FIRST slot
+        # admission (re-admissions after preemption restore or recompute
+        # — the initial hit is the one that shaped TTFT)
+        cached = next((int(ev["cached_tokens"]) for ev in self.events
+                       if ev["kind"] in ("admitted", "resumed")
+                       and "cached_tokens" in ev), 0)
         s = {
             "request_id": self.request_id,
             "reason": reason,
+            "cached_tokens": cached,
             "queued_unix": t_q,
             "finished_unix": t_end,
             "duration_ms": (t_end - t_q) * 1e3,

@@ -15,13 +15,20 @@ admission with per-tenant rate limits and typed load shedding
 (ShedError), per-request deadlines, preempt-to-host KV swap instead of
 recompute, and a crash-recovering ResilientEngine wrapper — see
 docs/serving.md §Degraded modes.
+
+Prefix caching + chunked prefill (prefix_cache.py, r10): a refcounted
+radix index over the block pool so shared system prompts and multi-turn
+prefixes skip prefill (LRU eviction at refcount 0, host spill/restore),
+and fixed-token prefill chunks interleaved with decode waves so TTFT
+stays bounded under mixed traffic — see docs/serving.md §Prefix caching.
 """
 from .admission import (AdmissionConfig, AdmissionController, ShedError,
                         TokenBucket)
 from .engine import LLMEngine, Request
 from .kv_swap import HostKVPool
+from .prefix_cache import PrefixCache
 from .resilient import ResilientEngine
 
 __all__ = ["LLMEngine", "Request", "ResilientEngine", "AdmissionConfig",
            "AdmissionController", "ShedError", "TokenBucket",
-           "HostKVPool"]
+           "HostKVPool", "PrefixCache"]

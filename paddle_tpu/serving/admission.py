@@ -17,7 +17,10 @@ burns its tenant's rate budget):
 1. ``queue_full``   — admission queue at ``max_queue`` entries;
 2. ``pool_pressure`` — free KV blocks below ``shed_free_frac`` of the
    pool while work is queued: a new admission would only trade
-   preemptions with the requests already inside;
+   preemptions with the requests already inside. The engine's
+   ``free_frac`` is CACHE-AWARE: refcount-0 prefix-cache blocks are
+   reclaimable on demand (spill/drop, serving/prefix_cache.py), so a
+   pool that merely looks full of evictable prefixes never sheds;
 3. ``rate_limited`` — the request's tenant bucket lacks
    ``prompt + max_new_tokens`` tokens (cost model: every admitted token
    occupies slot time, prefill or decode).

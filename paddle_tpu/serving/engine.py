@@ -45,6 +45,13 @@ TPU-native design — everything the chip executes has STATIC shapes:
   vectors through the compiled step: varying them never recompiles.
 - Pools are donated through both prefill and decode (jax donate_argnums),
   so the multi-GB cache is updated in place, never copied per token.
+- Prefix caching + chunked prefill (optional, r10): ``prefix_cache=True``
+  indexes full prompt blocks in a refcounted radix trie
+  (serving/prefix_cache.py) so admissions sharing a system prompt or
+  multi-turn prefix pin the cached blocks and prefill only the suffix;
+  ``prefill_chunk=K`` splits long suffixes into K-token chunks fed one
+  per step between decode waves, so prefill cost scales with NEW tokens
+  and never monopolizes a step.
 """
 from __future__ import annotations
 
@@ -63,8 +70,8 @@ from .. import observability as _obs
 from ..distributed.resilience.faults import SimulatedCrash
 from ..kernels.quant_matmul import (attn_pv, attn_qk, quantize_kv,
                                     weight_only_matmul as _wo_mm)
-from ..models.llama import (LlamaConfig, _apply_rope, _attention,
-                            _rms_norm, _wmat)  # noqa: F401
+from ..models.llama import (LlamaConfig, _apply_rope, _apply_rope_at,
+                            _attention, _rms_norm, _wmat)  # noqa: F401
 from ..observability import flight_recorder as _flight
 from ..observability import numerics as _nm
 from ..observability import perf as _perf
@@ -74,6 +81,7 @@ from ..observability import trace_span
 from ..observability.catalog import instrument as _instrument
 from .admission import AdmissionConfig, AdmissionController, ShedError
 from .kv_swap import HostKVPool
+from .prefix_cache import PrefixCache
 
 __all__ = ["LLMEngine", "Request"]
 
@@ -186,9 +194,10 @@ def _apply_admissions(c_last, c_len, c_done, c_rem, wave_toks, slot_of_row,
 
 
 def _paged_prefill(params, tokens, blk_ids, true_len, pools,
-                   temps, top_ks, top_ps, key, *, config: LlamaConfig,
+                   temps, top_ks, top_ps, key, hist_len=None,
+                   ctx_tbl=None, *, config: LlamaConfig,
                    sample_flags=(True, True, True), kv_int8: bool = False,
-                   numerics: bool = False):
+                   numerics: bool = False, prefix_nbk: int = 0):
     """Prefill a WAVE of admissions in one compiled program: causal
     forward over the padded prompt batch, every layer's K/V written into
     the slots' pool blocks by ONE batched scatter, and each request's
@@ -216,6 +225,20 @@ def _paged_prefill(params, tokens, blk_ids, true_len, pools,
     roofline to exactly this). Pad positions beyond true_len land in the
     trash block, and causality keeps them out of the true-last-token's
     context.
+
+    Suffix/chunked prefill (``prefix_nbk > 0``, r10): the wave prefills
+    only a PIECE of each row's context — tokens ``[hist_len[b],
+    hist_len[b] + true_len[b])`` — against KV already resident in the
+    pools (a matched prefix-cache path and/or this slot's earlier
+    chunks). ``ctx_tbl`` [B, prefix_nbk] names the history's physical
+    blocks (power-of-two bucketed like the decode table; pad rows point
+    at the trash block and mask via ``hist_len``); the history K/V is
+    gathered ONCE up front, each piece token attends to
+    (masked history) + (causal within the piece), and RoPE offsets by
+    ``hist_len`` per row. With ``prefix_nbk == 0`` the program is the
+    original full-prompt prefill, bit for bit — cold traffic never pays
+    for the feature. The compiled family stays bounded: (prompt bucket)
+    x (2 batch forms) x (<= 8 flag tuples) x (log2 history buckets).
     """
     c = config
     dt = c.dtype
@@ -223,11 +246,37 @@ def _paged_prefill(params, tokens, blk_ids, true_len, pools,
     bs = pools["k"].shape[2]
     nb = S // bs
     x = params["embed"].astype(dt)[tokens]
-    pos = jnp.arange(S, dtype=jnp.float32)
     freq = c.rope_theta ** (-jnp.arange(0, c.head_dim, 2, jnp.float32)
                             / c.head_dim)
-    ang = pos[:, None] * freq[None, :]
-    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    if prefix_nbk:
+        Lc, Hkv, D = c.num_layers, c.num_kv_heads, c.head_dim
+        G = c.num_heads // c.num_kv_heads
+        Pp = prefix_nbk * bs
+        scale = 1.0 / math.sqrt(D)
+        # per-row absolute positions: row b's piece starts hist_len[b]
+        # tokens into its sequence
+        pos = (hist_len.astype(jnp.float32)[:, None]
+               + jnp.arange(S, dtype=jnp.float32)[None, :])
+        ang = pos[:, :, None] * freq[None, None, :]        # [B, S, D/2]
+        cos, sin = jnp.cos(ang), jnp.sin(ang)
+        # one dense gather of every row's history (the decode hoist,
+        # applied to prefill); int8 pools dequantize here — prefill is
+        # compute-bound, the simple form wins over fused-scale dots
+        kpre = pools["k"][:, ctx_tbl].reshape(Lc, B, Pp, Hkv, D)
+        vpre = pools["v"][:, ctx_tbl].reshape(Lc, B, Pp, Hkv, D)
+        if kv_int8:
+            ksc = pools["ks"][:, ctx_tbl].reshape(Lc, B, Pp, Hkv)
+            vsc = pools["vs"][:, ctx_tbl].reshape(Lc, B, Pp, Hkv)
+            kpre = kpre.astype(dt) * ksc[..., None].astype(dt)
+            vpre = vpre.astype(dt) * vsc[..., None].astype(dt)
+        # [B,1,1,1,Pp] over scores [B,Hkv,G,S,Pp]
+        pre_mask = (jnp.arange(Pp)[None, :]
+                    < hist_len[:, None])[:, None, None, None, :]
+        in_mask = jnp.tril(jnp.ones((S, S), bool))[None, None, None]
+    else:
+        pos = jnp.arange(S, dtype=jnp.float32)
+        ang = pos[:, None] * freq[None, :]
+        cos, sin = jnp.cos(ang), jnp.sin(ang)
 
     k_all, v_all = [], []
     for l in range(c.num_layers):
@@ -238,13 +287,37 @@ def _paged_prefill(params, tokens, blk_ids, true_len, pools,
                                             c.head_dim)
         v = _wo_mm(hn, p["wv"], dt).reshape(B, S, c.num_kv_heads,
                                             c.head_dim)
-        q = _apply_rope(q, cos, sin)
-        k = _apply_rope(k, cos, sin)
+        if prefix_nbk:
+            q = _apply_rope_at(q, cos, sin)
+            k = _apply_rope_at(k, cos, sin)
+        else:
+            q = _apply_rope(q, cos, sin)
+            k = _apply_rope(k, cos, sin)
         k_all.append(k)
         v_all.append(v)
-        # plain causal GQA attention — the model's own core (llama._attention)
-        att = _attention(q, k, v, c).reshape(B, S,
-                                             c.num_heads * c.head_dim)
+        if prefix_nbk:
+            # piece attention: softmax over [history ; causal in-piece],
+            # the decode program's concat structure at prefill width —
+            # masked history positions contribute an exact 0.0
+            qg = q.reshape(B, S, Hkv, G, D)
+            s_pre = jnp.einsum("bshgd,bphd->bhgsp", qg, kpre[l],
+                               preferred_element_type=jnp.float32) * scale
+            s_in = jnp.einsum("bshgd,bthd->bhgst", qg, k,
+                              preferred_element_type=jnp.float32) * scale
+            s_pre = jnp.where(pre_mask, s_pre, -1e30)
+            s_in = jnp.where(in_mask, s_in, -1e30)
+            probs = jax.nn.softmax(
+                jnp.concatenate([s_pre, s_in], axis=-1), axis=-1)
+            att = (jnp.einsum("bhgsp,bphd->bshgd",
+                              probs[..., :Pp].astype(dt), vpre[l])
+                   + jnp.einsum("bhgst,bthd->bshgd",
+                                probs[..., Pp:].astype(dt), v))
+            att = att.reshape(B, S, c.num_heads * c.head_dim).astype(dt)
+        else:
+            # plain causal GQA attention — the model's own core
+            # (llama._attention)
+            att = _attention(q, k, v, c).reshape(B, S,
+                                                 c.num_heads * c.head_dim)
         x = x + _wo_mm(att, p["wo"], dt)
         hn = _rms_norm(x, p["mlp_norm"], c.rms_eps)
         gate = jax.nn.silu(_wo_mm(hn, p["w_gate"], dt))
@@ -488,7 +561,9 @@ class LLMEngine:
                  num_blocks: Optional[int] = None,
                  prompt_buckets: Optional[List[int]] = None, seed: int = 0,
                  mesh=None, decode_steps: int = 1, kv_dtype=None,
-                 admission=None, kv_swap_bytes: int = 0, injector=None):
+                 admission=None, kv_swap_bytes: int = 0, injector=None,
+                 prefix_cache: bool = False, prefill_chunk: int = 0,
+                 prefix_cache_host_bytes: int = 0):
         """``params`` may be dense (bf16/f32) or int8 weight-only
         (llama.quantize_params) — quantized leaves feed the decode/prefill
         matmuls unconverted (kernels/quant_matmul.weight_only_matmul).
@@ -529,6 +604,22 @@ class LLMEngine:
         engine step index) fire inside the step loop — the seeded chaos
         surface behind ``tools/chaos_run.py --serving`` and
         :class:`~paddle_tpu.serving.resilient.ResilientEngine`.
+
+        ``prefix_cache``: a refcounted radix index over the block pool
+        (:mod:`paddle_tpu.serving.prefix_cache`) — ``add_request``
+        matches the longest cached prefix at block granularity, pins
+        those blocks into the slot's table, and prefills ONLY the
+        suffix. Cached blocks are LRU-evicted at refcount 0 under pool
+        pressure, spilling to a pinned host tier of
+        ``prefix_cache_host_bytes`` (0 = drop instead of spill) and
+        restoring on a later match.
+
+        ``prefill_chunk``: split suffix prefills longer than this many
+        tokens into fixed-size chunks (rounded up to a block-size
+        multiple), one chunk per engine step, interleaved with the
+        decode waves of the other slots — a long prefill stops
+        monopolizing a step, so TTFT stays bounded under mixed traffic.
+        0 = one-shot suffix prefill (the pre-r10 behavior).
 
         Pipelining caveat: the engine dispatches call k+1 before reading
         call k's tokens only when every in-flight slot is GUARANTEED
@@ -672,6 +763,30 @@ class LLMEngine:
         # committing tokens must still deliver them exactly once
         # (ResilientEngine returns this on recovery)
         self._step_emitted: List = []
+        # -- prefix cache + chunked prefill (r10) -------------------------
+        if prefill_chunk:
+            # chunks start and (except the final one) end on block
+            # boundaries, so cached prefixes and chunk history stay
+            # block-aligned — round up rather than reject
+            prefill_chunk = -(-int(prefill_chunk) // block_size) \
+                * block_size
+            if prefill_chunk > self.buckets[-1]:
+                raise ValueError(
+                    f"prefill_chunk {prefill_chunk} exceeds the largest "
+                    f"prompt bucket {self.buckets[-1]}")
+        self.prefill_chunk = prefill_chunk
+        self.prefix_cache = (
+            PrefixCache(block_size,
+                        HostKVPool(prefix_cache_host_bytes, kind="prefix")
+                        if prefix_cache_host_bytes else None)
+            if prefix_cache else None)
+        # trie nodes each slot has pinned, in block-table order: the
+        # first len(_pinned[slot]) table entries are cache-owned (shared,
+        # never freed by the slot — unpinned instead)
+        self._pinned: List[List] = [[] for _ in range(self.N)]
+        # slots mid-chunked-prefill: slot -> {"ctx", "pos", "rid"};
+        # excluded from decode dispatch until the final chunk lands
+        self._chunks: Dict[int, Dict] = {}
 
     # -- public api ---------------------------------------------------------
     @property
@@ -698,9 +813,12 @@ class LLMEngine:
         if req.deadline_s is not None:
             req.t_deadline = time.perf_counter() + float(req.deadline_s)
         if self.admission is not None:
+            # cache-aware pressure: refcount-0 cached blocks are
+            # reclaimable (spill/drop), so they count as headroom — a
+            # full-looking pool of evictable prefixes must not shed
             reason = self.admission.check(
                 req, queue_depth=len(self.queue),
-                free_frac=len(self.free_blocks) / max(1, self.nb - 1))
+                free_frac=self._avail_blocks() / max(1, self.nb - 1))
             if reason is not None:
                 # reject-newest load shedding: fail THIS request in
                 # microseconds (typed, maps to HTTP 429/503) so the
@@ -747,8 +865,8 @@ class LLMEngine:
         raise ValueError(f"prompt length {n} exceeds largest bucket "
                          f"{self.buckets[-1]}")
 
-    def _prefill_fn(self, bucket: int, B: int, flags):
-        key = (bucket, B, flags)
+    def _prefill_fn(self, bucket: int, B: int, flags, prefix_nbk: int = 0):
+        key = (bucket, B, flags, prefix_nbk)
         fn = self._prefill.get(key)
         if fn is None:
             # the numerics gate is baked at variant-compile time (the
@@ -760,10 +878,53 @@ class LLMEngine:
                              config=self.config,
                              sample_flags=flags,
                              kv_int8=self.kv_int8,
-                             numerics=self.kv_int8 and _nm.active()),
+                             numerics=self.kv_int8 and _nm.active(),
+                             prefix_nbk=prefix_nbk),
                          donate_argnums=(4,))
             self._prefill[key] = fn
         return fn
+
+    # -- block allocation over the free list + the prefix cache ------------
+    def _avail_blocks(self) -> int:
+        """Blocks an allocation could obtain right now: the free list
+        plus every refcount-0 cached block (reclaimable by spill/drop)."""
+        n = len(self.free_blocks)
+        if self.prefix_cache is not None:
+            n += self.prefix_cache.evictable_blocks
+        return n
+
+    def _take_up_to(self, k: int) -> List[int]:
+        """Pop up to ``k`` free blocks, reclaiming from the prefix cache
+        (LRU spill-then-drop) when the free list runs short — ONE
+        reclaim sweep and one batched d2h however many blocks are
+        needed, never a sweep per block."""
+        if len(self.free_blocks) < k and self.prefix_cache is not None:
+            self.free_blocks.extend(self.prefix_cache.reclaim(
+                k - len(self.free_blocks), self._fetch_blocks))
+        out: List[int] = []
+        while self.free_blocks and len(out) < k:
+            out.append(self.free_blocks.popleft())
+        return out
+
+    def _fetch_blocks(self, blks: List[int]) -> Dict:
+        """d2h a batch of blocks from every pool entry in one gather per
+        entry (payload AND scales under int8 pools — the spill/restore
+        round-trip is bit-exact). Returns arrays stacked on the block
+        axis, the layout :meth:`PrefixCache.reclaim` slices per node."""
+        idx = np.asarray(blks, np.int32)
+        return {name: np.asarray(jax.device_get(pool[:, idx]))
+                for name, pool in self.pools.items()}
+
+    def _restore_blocks(self, blks: List[int], datas: List[Dict]) -> None:
+        """h2d a matched path's spilled blocks in ONE batched scatter
+        (the kv_swap restore at block count len(blks), pools donated) —
+        never a transfer per block on the admission path."""
+        names = sorted(datas[0])
+        stacked = {n: np.concatenate([np.asarray(d[n]) for d in datas],
+                                     axis=1) for n in names}
+        self.pools = self._swapin_fn(len(blks))(
+            self.pools, jnp.asarray(np.asarray(blks, np.int32)),
+            *[jnp.asarray(stacked[n]) for n in names])
 
     def _free_slot(self, slot: int, requeue: bool = False,
                    reason: str = "finished", swap: bool = True):
@@ -775,8 +936,30 @@ class LLMEngine:
             # swap-instead-of-recompute: move the victim's blocks to the
             # host tier BEFORE they are freed (fallback: plain recompute)
             swapped = self._swap_out(slot, req, out)
-        for j in range(int(self.n_alloc[slot])):
+        # blocks [0, keep) are cache-owned: shared, unpinned below, never
+        # freed here. A finishing request first offers its decode-grown
+        # FULL blocks to the trie (multi-turn prefix reuse: the next turn
+        # re-sends prompt+answer and matches them) — adopted blocks
+        # transfer ownership to the cache instead of the free list.
+        keep = len(self._pinned[slot])
+        if not requeue and req is not None and reason == "finished" \
+                and self.prefix_cache is not None:
+            # KV is valid for the first self.lengths positions only (the
+            # final emitted token's KV was never written)
+            full = int(self.lengths[slot]) // self.bs
+            if full > keep:
+                ctx_all = req.prompt + req.generated + out
+                adopted = self.prefix_cache.extend(
+                    ctx_all, keep,
+                    [int(self.table[slot, j]) for j in range(keep, full)],
+                    pin=False)
+                keep += len(adopted)
+        for j in range(keep, int(self.n_alloc[slot])):
             self.free_blocks.append(int(self.table[slot, j]))
+        if self._pinned[slot]:
+            self.prefix_cache.unpin(self._pinned[slot])
+            self._pinned[slot] = []
+        self._chunks.pop(slot, None)
         self.table[slot, :] = 0
         self.n_alloc[slot] = 0
         self.lengths[slot] = 0
@@ -894,8 +1077,10 @@ class LLMEngine:
         """Re-admit a preempted request from its host-tier KV: allocate
         blocks, restore the payload, and rebuild host bookkeeping — a
         short h2d instead of a full re-prefill."""
-        blocks = [self.free_blocks.popleft()
-                  for _ in range(max(1, ent.n_blocks))]
+        blocks = self._take_up_to(max(1, ent.n_blocks))
+        assert len(blocks) == max(1, ent.n_blocks), \
+            "swap-in allocated past _avail_blocks"
+        self._pinned[slot] = []      # restored KV is slot-private
         self.table[slot, :len(blocks)] = blocks
         self.n_alloc[slot] = len(blocks)
         self.lengths[slot] = ent.n_tokens
@@ -1003,18 +1188,32 @@ class LLMEngine:
         self._slots_dirty = True
         for slot in self._active_slots():
             self._free_slot(slot, requeue=True, swap=False)
+        self._chunks = {}
+        if self.prefix_cache is not None:
+            # cached KV is as suspect as the rest of the pools: drop the
+            # whole trie (host tier included) and recycle its blocks
+            self.free_blocks.extend(self.prefix_cache.clear())
 
     def block_accounting(self) -> Dict[str, int]:
-        """Device block-pool ledger: ``free + backed + squeezed ==
-        total`` at every step boundary, whatever mix of eviction / shed
-        / preempt-swap / crash-requeue ran — the leak-regression
-        invariant. ``swapped_host_blocks`` rides along for the host tier
-        (those blocks were freed on device; they are NOT in the sum)."""
+        """Device block-pool ledger: ``free + backed + cached +
+        squeezed == total`` at every step boundary, whatever mix of
+        eviction / shed / preempt-swap / cache-spill / crash-requeue ran
+        — the leak-regression invariant. ``backed`` counts blocks a slot
+        owns PRIVATELY; a cache-owned block counts once under ``cached``
+        however many slots pin it. ``host_spilled_blocks`` (prefix-cache
+        blocks resident only in the host tier) and
+        ``swapped_host_blocks`` ride along — those blocks were freed on
+        device and are NOT in the sum."""
+        pc = self.prefix_cache
         return {
             "total": self.nb - 1,
             "free": len(self.free_blocks),
-            "backed": int(sum(int(n) for n in self.n_alloc)),
+            "backed": int(sum(int(self.n_alloc[i]) - len(self._pinned[i])
+                              for i in range(self.N))),
+            "cached": pc.device_blocks if pc is not None else 0,
             "squeezed": sum(len(b) for _, b in self._squeezed),
+            "host_spilled_blocks": (pc.host_blocks if pc is not None
+                                    else 0),
             "swapped_host_blocks": (self.swap_pool.swapped_blocks
                                     if self.swap_pool is not None else 0),
         }
@@ -1027,8 +1226,17 @@ class LLMEngine:
         never hit a batch-size-shaped recompile). NO host sync: each
         first generated token is sampled inside the prefill program and
         rides to the host one decode call later (``_pending_adm`` → the
-        next dispatch record)."""
-        wave = []           # (slot, req, true_len, ctx, blocks)
+        next dispatch record).
+
+        With the prefix cache on, each admission first matches the
+        longest cached prefix at block granularity (capped at
+        ``(len(ctx)-1)//bs`` so at least one token always prefills and
+        yields the sampling hidden state), pins those blocks into the
+        slot's table, and prefills ONLY the suffix. Suffixes longer than
+        ``prefill_chunk`` enter chunked mode: the wave carries their
+        first chunk and :meth:`_advance_chunks` feeds one chunk per step
+        until the final chunk samples the first token."""
+        wave = []           # rows: (slot, req, ctx, hist, piece, final)
         while self.queue and len(wave) < self.N:
             slot = next((i for i in range(self.N)
                          if self.slot_req[i] is None), None)
@@ -1041,7 +1249,7 @@ class LLMEngine:
                 # swap-in re-admission: restore the preempted KV blocks
                 # from the host tier — no prefill, no sampled first token
                 # (the tail of prompt+generated is the next decode input)
-                if len(self.free_blocks) < max(1, ent.n_blocks):
+                if self._avail_blocks() < max(1, ent.n_blocks):
                     if not any(r is not None for r in self.slot_req) \
                             and not self._squeezed:
                         raise RuntimeError(
@@ -1054,10 +1262,21 @@ class LLMEngine:
                 continue
             ctx = req.prompt + req.generated   # re-admission continues
             true_len = len(ctx)
+            nodes, cached_blocks = [], []
+            if self.prefix_cache is not None:
+                # longest cached prefix, pinned; host-resident blocks on
+                # the path restore through the free list (one h2d each)
+                nodes, cached_blocks = self.prefix_cache.match_and_pin(
+                    ctx, (true_len - 1) // self.bs,
+                    self._take_up_to, self._restore_blocks)
+            m = len(nodes)
+            hist = m * self.bs
             # only the blocks the true prompt occupies; the bucket's pad
             # tail scatters into the trash block (never read: causality)
-            need = max(1, -(-true_len // self.bs))
-            if len(self.free_blocks) < need:
+            need = max(1, -(-true_len // self.bs)) - m
+            if self._avail_blocks() < need:
+                if nodes:
+                    self.prefix_cache.unpin(nodes)
                 if not any(r is not None for r in self.slot_req) \
                         and not self._squeezed:
                     # (an injected pool_squeeze releases its hostage
@@ -1069,67 +1288,147 @@ class LLMEngine:
                         "block pool is too small for this request")
                 break                        # blocks busy: wait for frees
             self.queue.popleft()
-            blocks = [self.free_blocks.popleft() for _ in range(need)]
+            blocks = cached_blocks + self._take_up_to(need)
             self.table[slot, :len(blocks)] = blocks
             self.n_alloc[slot] = len(blocks)
-            self.lengths[slot] = true_len
+            self.lengths[slot] = hist        # grows as pieces land
             self.slot_req[slot] = req
             self.admit_order.append(slot)
+            self._pinned[slot] = nodes
             self._table_dirty = True
             self._slots_dirty = True
-            wave.append((slot, req, true_len, ctx, blocks))
-        if not wave:
+            if self.prefix_cache is not None:
+                self.prefix_cache.note_lookup(hist)
+            suffix = true_len - hist
+            piece = (min(suffix, self.prefill_chunk)
+                     if self.prefill_chunk else suffix)
+            if _obs.enabled():
+                # "admitted" first time, "resumed" after a preemption —
+                # the tracer keys on whether this id was admitted before
+                _rt.get_request_tracer().admitted(
+                    req.req_id, slot=slot, context_tokens=true_len,
+                    cached_tokens=hist)
+            wave.append((slot, req, ctx, hist, piece,
+                         piece == suffix))
+        if wave:
+            _M_ADMISSIONS.inc(len(wave))
+            self._dispatch_prefill(wave)
+
+    def _advance_chunks(self):
+        """Feed every mid-prefill slot its next chunk — ONE chunk per
+        slot per step, so long prefills interleave with the other slots'
+        decode waves instead of monopolizing the step (bounded TTFT
+        under mixed traffic). The final chunk samples the request's
+        first token and hands the slot to the decode path."""
+        if not self._chunks:
             return
-        bucket = self._bucket_for(max(tl for _, _, tl, _, _ in wave))
+        rows = []
+        for slot in sorted(self._chunks):
+            st = self._chunks[slot]
+            req = self.slot_req[slot]
+            if req is None or req.req_id != st["rid"]:
+                self._chunks.pop(slot)     # freed since (defensive)
+                continue
+            ctx, pos = st["ctx"], st["pos"]
+            piece = min(self.prefill_chunk, len(ctx) - pos)
+            rows.append((slot, req, ctx, pos, piece,
+                         pos + piece == len(ctx)))
+        if rows:
+            self._dispatch_prefill(rows)
+
+    def _dispatch_prefill(self, rows):
+        """Dispatch one compiled prefill program for a wave of context
+        PIECES — full prompts, cache-hit suffixes, and chunk
+        continuations mix freely in one call. Rows whose piece completes
+        the context (``final``) keep their in-program-sampled first
+        token (``_pending_adm``); chunk rows discard it and stay in
+        ``_chunks``. The variant key (bucket, batch form, flags, history
+        bucket) keeps the compiled family bounded — chunking and the
+        cache extend the EXISTING (bucket, flags) cache with one
+        log-bounded axis, not a new family."""
+        bucket = self._bucket_for(max(piece for *_x, piece, _f in rows))
         # two batch variants only: 1 (steady-state churn admits one slot
         # at a time — full-width padding would pay max_slots× the prefill
         # FLOPs) and max_slots (bursts). Bounded compiles, bounded waste.
-        B = 1 if len(wave) == 1 else self.N
-        nb = bucket // self.bs
+        B = 1 if len(rows) == 1 else self.N
+        nbp = bucket // self.bs
+        hist_blocks = max(hist // self.bs for _s, _r, _c, hist, _p, _f
+                          in rows)
+        pnbk = ((1 << (hist_blocks - 1).bit_length()) if hist_blocks
+                else 0)
         toks = np.zeros((B, bucket), np.int32)
-        blk_ids = np.zeros((B, nb), np.int32)   # pad rows: all trash
+        blk_ids = np.zeros((B, nbp), np.int32)  # pad rows: all trash
         true_lens = np.ones(B, np.int32)
+        hist_lens = np.zeros(B, np.int32)
+        ctx_tbl = np.zeros((B, pnbk), np.int32) if pnbk else None
         temps = np.zeros(B, np.float32)
         top_ks = np.zeros(B, np.int32)
         top_ps = np.ones(B, np.float32)
-        for i, (slot, req, tl, ctx, blocks) in enumerate(wave):
-            toks[i, :tl] = ctx
-            blk_ids[i, :len(blocks)] = blocks
-            true_lens[i] = tl
-            temps[i] = req.temperature
-            top_ks[i] = req.top_k
-            top_ps[i] = req.top_p
-        sampled = any(r.temperature > 0 for _, r, _, _, _ in wave)
+        for i, (slot, req, ctx, hist, piece, final) in enumerate(rows):
+            b0 = hist // self.bs
+            nblk = -(-(hist + piece) // self.bs) - b0
+            toks[i, :piece] = ctx[hist:hist + piece]
+            blk_ids[i, :nblk] = self.table[slot, b0:b0 + nblk]
+            true_lens[i] = piece
+            hist_lens[i] = hist
+            if pnbk and b0:
+                ctx_tbl[i, :b0] = self.table[slot, :b0]
+            if final:        # non-final rows sample a discarded argmax
+                temps[i] = req.temperature
+                top_ks[i] = req.top_k
+                top_ps[i] = req.top_p
+        finals = [r for _s, r, _c, _h, _p, final in rows if final]
+        sampled = any(r.temperature > 0 for r in finals)
         flags = (sampled,
-                 sampled and any(r.top_k > 0 for _, r, _, _, _ in wave
+                 sampled and any(r.top_k > 0 for r in finals
                                  if r.temperature > 0),
-                 sampled and any(r.top_p < 1.0 for _, r, _, _, _ in wave
+                 sampled and any(r.top_p < 1.0 for r in finals
                                  if r.temperature > 0))
         self._key, sub = jax.random.split(self._key)
-        wave_rids = [r.req_id for _, r, _, _, _ in wave]
-        if _obs.enabled():
-            tracer = _rt.get_request_tracer()
-            for slot, req, tl, _ctx, _blocks in wave:
-                # "admitted" first time, "resumed" after a preemption —
-                # the tracer keys on whether this id was admitted before
-                tracer.admitted(req.req_id, slot=slot, context_tokens=tl)
-        with trace_span("serving.prefill", bucket=bucket, batch=B,
-                        wave=len(wave), request_ids=wave_rids):
-            tok_dev, self.pools = self._prefill_fn(bucket, B, flags)(
-                self.params, jnp.asarray(toks), jnp.asarray(blk_ids),
+        wave_rids = [r.req_id for _s, r, _c, _h, _p, _f in rows]
+        args = [self.params, jnp.asarray(toks), jnp.asarray(blk_ids),
                 jnp.asarray(true_lens), self.pools,
                 jnp.asarray(temps), jnp.asarray(top_ks),
-                jnp.asarray(top_ps), sub)
-        if _obs.enabled():
-            for slot, req, _tl, _ctx, _blocks in wave:
-                _rt.get_request_tracer().record(
-                    req.req_id, "prefill", bucket=bucket, batch=B)
-        _M_ADMISSIONS.inc(len(wave))
-        for i, (slot, req, _, _, _) in enumerate(wave):
-            # reference the WHOLE [B] first-token array + row index: the
-            # readback then fetches one array per wave, not one tiny
-            # transfer per admission (8 tunnel RTTs measured per wave)
-            self._pending_adm.append((slot, req.req_id, tok_dev, i))
+                jnp.asarray(top_ps), sub]
+        if pnbk:
+            args += [jnp.asarray(hist_lens), jnp.asarray(ctx_tbl)]
+        with trace_span("serving.prefill", bucket=bucket, batch=B,
+                        wave=len(rows), prefix_bucket=pnbk * self.bs,
+                        request_ids=wave_rids):
+            tok_dev, self.pools = self._prefill_fn(
+                bucket, B, flags, pnbk)(*args)
+        tracer = _rt.get_request_tracer() if _obs.enabled() else None
+        for i, (slot, req, ctx, hist, piece, final) in enumerate(rows):
+            self.lengths[slot] = hist + piece
+            if final:
+                if self._chunks.pop(slot, None) is not None:
+                    self._slots_dirty = True   # rejoins the decode mask
+                # reference the WHOLE [B] first-token array + row index:
+                # the readback then fetches one array per wave, not one
+                # tiny transfer per admission (8 tunnel RTTs measured
+                # per wave)
+                self._pending_adm.append((slot, req.req_id, tok_dev, i))
+            else:
+                if slot not in self._chunks:
+                    self._slots_dirty = True   # leaves the decode mask
+                self._chunks[slot] = {"ctx": ctx, "pos": hist + piece,
+                                      "rid": req.req_id}
+            if tracer is not None:
+                tracer.record(req.req_id, "prefill", bucket=bucket,
+                              batch=B, chunk_start=hist, chunk=piece)
+            if self.prefix_cache is not None \
+                    and len(self._pinned[slot]) == hist // self.bs:
+                # adopt this piece's FULL blocks into the trie (pinned:
+                # the slot itself holds them); adoption stays contiguous
+                # with the pinned head — a gap (another request cached
+                # the same block first) ends adoption for this slot
+                b0 = hist // self.bs
+                full = (hist + piece) // self.bs
+                if full > b0:
+                    self._pinned[slot].extend(self.prefix_cache.extend(
+                        ctx, b0,
+                        [int(self.table[slot, j]) for j in range(b0, full)],
+                        pin=True))
 
     def _emit(self, slot: int, tok: int) -> bool:
         """Record a generated token; free the slot when the request is done.
@@ -1157,17 +1456,24 @@ class LLMEngine:
         steps = max(1, min(self.decode_steps + lag, remaining + lag))
         horizon = int(self.lengths[slot]) + steps - 1
         last_blk = min(horizon, self.max_model_len - 1) // self.bs
-        while int(self.n_alloc[slot]) <= last_blk:
-            if not self.free_blocks:
-                return False
-            self.table[slot, int(self.n_alloc[slot])] = \
-                self.free_blocks.popleft()
+        need = last_blk + 1 - int(self.n_alloc[slot])
+        if need <= 0:
+            return True
+        got = self._take_up_to(need)     # one reclaim sweep for the lot
+        for blk in got:
+            self.table[slot, int(self.n_alloc[slot])] = blk
             self.n_alloc[slot] += 1
             self._table_dirty = True
-        return True
+        return len(got) == need
 
     def _active_slots(self):
         return [i for i in range(self.N) if self.slot_req[i] is not None]
+
+    def _decode_slots(self):
+        """Slots the decode call covers: active and not mid-chunked-
+        prefill (a chunking slot joins once its final chunk lands)."""
+        return [i for i in range(self.N) if self.slot_req[i] is not None
+                and i not in self._chunks]
 
     def _spec_safe(self) -> bool:
         """True iff dispatching the next decode call BEFORE reading the
@@ -1194,7 +1500,10 @@ class LLMEngine:
         decode_steps — if generous backing fails, the pipeline is drained
         so preemption decisions see exact state."""
         emitted = []
-        for slot in list(self._active_slots()):
+        # chunking slots never appear here (_decode_slots excludes them;
+        # their whole context was preallocated at admission — nothing to
+        # back until they decode)
+        for slot in list(self._decode_slots()):
             if self.slot_req[slot] is None:
                 continue                      # already preempted as a victim
             while True:
@@ -1563,6 +1872,8 @@ class LLMEngine:
         _M_ACTIVE_SLOTS.set(sum(r is not None for r in self.slot_req))
         _M_KV_BLOCKS.set(self.nb - 1)
         _M_KV_USED.set(self.nb - 1 - len(self.free_blocks))
+        if self.prefix_cache is not None:
+            self.prefix_cache.update_gauges()
         return emitted
 
     def _step_inner(self):
@@ -1577,17 +1888,22 @@ class LLMEngine:
         # stale FLOPs from an earlier dispatch must not divide a
         # no-decode step's wall time (a bogus MFU spike on idle steps)
         self._last_decode_flops = None
+        # one chunk per mid-prefill slot BEFORE admission/decode: the
+        # chunk program and this step's decode wave share the step, so a
+        # long prefill never monopolizes it (bounded TTFT for the slots
+        # already decoding)
+        self._advance_chunks()
         self._admit()
         if self._inflight is not None and not self._spec_safe():
             emitted += self._process_inflight()
             self._admit()          # freed slots: refill before dispatching
-        active = self._active_slots()
+        active = self._decode_slots()
         if not active:
             if self._inflight is not None:
                 emitted += self._process_inflight()
             return emitted
         emitted += self._back_or_preempt()
-        active = self._active_slots()
+        active = self._decode_slots()
         if not active:
             return emitted
         self._refresh_carry(active)

@@ -34,6 +34,7 @@ _M_SWAP_OUT = _instrument("serving_kv_swap_out_total")
 _M_SWAP_IN = _instrument("serving_kv_swap_in_total")
 _M_SWAP_FALLBACK = _instrument("serving_kv_swap_fallback_total")
 _M_SWAP_BYTES = _instrument("serving_kv_swap_host_bytes")
+_M_PREFIX_BYTES = _instrument("serving_prefix_cache_host_bytes")
 
 
 class SwapEntry:
@@ -55,10 +56,22 @@ class HostKVPool:
 
     ``put`` refuses (and counts a recompute fallback) rather than exceed
     ``capacity_bytes`` — the swap tier must never become the OOM.
+
+    ``kind`` selects the metric surface: ``"swap"`` (default) emits the
+    preemption-swap counters and ``serving_kv_swap_host_bytes``;
+    ``"prefix"`` is the prefix-cache spill tier
+    (:mod:`paddle_tpu.serving.prefix_cache`) — it drives only
+    ``serving_prefix_cache_host_bytes`` (the cache counts its own
+    spills under ``serving_prefix_cache_evictions_total``).
     """
 
-    def __init__(self, capacity_bytes: int):
+    def __init__(self, capacity_bytes: int, kind: str = "swap"):
+        if kind not in ("swap", "prefix"):
+            raise ValueError(f"HostKVPool kind must be 'swap' or "
+                             f"'prefix', got {kind!r}")
         self.capacity_bytes = int(capacity_bytes)
+        self.kind = kind
+        self._g_bytes = _M_SWAP_BYTES if kind == "swap" else _M_PREFIX_BYTES
         self._entries: Dict = {}
         self._bytes = 0
 
@@ -72,13 +85,15 @@ class HostKVPool:
         if old is not None:
             self._bytes -= old.nbytes
         if self._bytes + ent.nbytes > self.capacity_bytes:
-            _M_SWAP_FALLBACK.inc(reason="host_pool_full")
-            _M_SWAP_BYTES.set(self._bytes)
+            if self.kind == "swap":
+                _M_SWAP_FALLBACK.inc(reason="host_pool_full")
+            self._g_bytes.set(self._bytes)
             return False
         self._entries[rid] = ent
         self._bytes += ent.nbytes
-        _M_SWAP_OUT.inc()
-        _M_SWAP_BYTES.set(self._bytes)
+        if self.kind == "swap":
+            _M_SWAP_OUT.inc()
+        self._g_bytes.set(self._bytes)
         return True
 
     def get(self, rid) -> Optional[SwapEntry]:
@@ -91,8 +106,9 @@ class HostKVPool:
         ent = self._entries.pop(rid, None)
         if ent is not None:
             self._bytes -= ent.nbytes
-            _M_SWAP_IN.inc()
-            _M_SWAP_BYTES.set(self._bytes)
+            if self.kind == "swap":
+                _M_SWAP_IN.inc()
+            self._g_bytes.set(self._bytes)
         return ent
 
     def discard(self, rid) -> None:
@@ -101,7 +117,7 @@ class HostKVPool:
         ent = self._entries.pop(rid, None)
         if ent is not None:
             self._bytes -= ent.nbytes
-            _M_SWAP_BYTES.set(self._bytes)
+            self._g_bytes.set(self._bytes)
 
     # -- accounting -------------------------------------------------------
     @property

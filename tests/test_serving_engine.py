@@ -349,14 +349,22 @@ def test_obs_dump_demo_serving_smoke(tmp_path):
                  # r8: the degraded-mode counters ride the same demo
                  "serving_shed_total",
                  "serving_kv_swap_out_total",
-                 "serving_kv_swap_in_total"):
+                 "serving_kv_swap_in_total",
+                 # r10: the prefix-cache family rides along
+                 "serving_prefix_cache_hits_total",
+                 "serving_prefill_tokens_skipped_total",
+                 "serving_prefix_cache_blocks"):
         assert name in out, (name, out[-2000:])
     # r8: one shed, one expired deadline, at least one preempt→swap
     assert "load shed: request" in out
     assert "deadline_exceeded=1" in out
+    # r10: the re-sent first prompt hits the cache and skips its prefix
+    assert "prefix cache: hits=1" in out, out[-2000:]
+    assert "prefill_tokens_skipped=8" in out
     # r7: the demo ends with the per-request table + exemplar pointer
-    assert "requests: 4 traced" in out, out[-2000:]
-    assert "ttft_ms" in out and "preempt" in out
+    # (5 rows: the r10 cache-hit request rides the original four)
+    assert "requests: 5 traced" in out, out[-2000:]
+    assert "ttft_ms" in out and "preempt" in out and "cached" in out
     assert "shed" in out and "deadline" in out     # reason column
     assert "exemplar: request" in out
     assert (tmp_path / "snapshot.json").exists()

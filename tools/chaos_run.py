@@ -20,11 +20,14 @@ rollback event and the flight-recorder post-mortem.
 Serving mode (``--serving``) — the same idea for the survivability
 layer: a seeded schedule of readback crashes, pool squeezes, and slow
 steps fires inside an LLMEngine loop while an over-capacity request
-stream (some with unmeetable deadlines) hits a bounded admission queue.
-A run passes when EVERY submitted request ends in exactly one of
-{finished, shed, deadline_exceeded}, the block-pool ledger balances
-``free + backed + squeezed == total`` at every step boundary (zero KV
-block leaks), and the host swap tier drains to empty.
+stream (some with unmeetable deadlines, half sharing a system-prompt
+prefix) hits a bounded admission queue WITH the prefix cache and
+chunked prefill on. A run passes when EVERY submitted request ends in
+exactly one of {finished, shed, deadline_exceeded}, the block-pool
+ledger balances ``free + backed + cached + squeezed == total`` at every
+step boundary (zero KV block leaks — a pool_squeeze stealing blocks
+while the cache holds others must still balance), the host swap tier
+drains to empty, and the shared prefix actually hit the cache.
 
     JAX_PLATFORMS=cpu python tools/chaos_run.py --serving --steps 24 --seed 7
 
@@ -72,30 +75,37 @@ def serving_main(args):
     print(f"fault schedule: {inj.pending}")
 
     obs.enable()
-    # num_blocks=5 with two slots decoding 6-15 fresh tokens each: pool
+    # num_blocks=7 with two slots decoding 6-15 fresh tokens each: pool
     # pressure (and the injected squeezes) MUST preempt — the swap tier
-    # is load-bearing in this run, not decorative
+    # is load-bearing in this run, not decorative. The r10 prefix cache
+    # + chunked prefill run ON here: half the prompts share an 8-token
+    # system prefix, so cache hits, refcount-0 evictions under squeeze,
+    # and host spill/restore all fire inside the fault storm.
     eng = LLMEngine(params, cfg, max_slots=2, block_size=8,
-                    max_model_len=64, num_blocks=5, prompt_buckets=[8, 32],
+                    max_model_len=64, num_blocks=7, prompt_buckets=[8, 32],
                     kv_swap_bytes=1 << 20,
                     admission=AdmissionConfig(max_queue=3),
-                    injector=inj)
+                    injector=inj, prefix_cache=True, prefill_chunk=8,
+                    prefix_cache_host_bytes=1 << 20)
     reng = ResilientEngine(eng)
     rng = np.random.default_rng(args.seed)
+    shared = rng.integers(1, 64, size=8).tolist()
 
     all_ids, streamed = [], {}
     submitted = 0
     ok = True
     while eng.has_work() or submitted < args.requests:
         # offered load: up to two submissions per step (over capacity for
-        # 2 slots), every 5th with a deadline that cannot be met
+        # 2 slots), every 5th with a deadline that cannot be met, every
+        # 2nd sharing the system prefix (the cache's food)
         for _ in range(2):
             if submitted >= args.requests:
                 break
             submitted += 1
             kw = {"deadline_s": 0.0} if submitted % 5 == 0 else {}
-            prompt = rng.integers(1, 64,
-                                  size=int(rng.integers(3, 14))).tolist()
+            tail = rng.integers(1, 64,
+                                size=int(rng.integers(3, 14))).tolist()
+            prompt = shared + tail if submitted % 2 == 0 else tail
             try:
                 rid = eng.add_request(
                     prompt, max_new_tokens=int(rng.integers(6, 16)), **kw)
@@ -106,8 +116,8 @@ def serving_main(args):
         for rid, tok in reng.step():
             streamed[rid].append(tok)
         acct = eng.block_accounting()
-        if acct["free"] + acct["backed"] + acct["squeezed"] \
-                != acct["total"]:
+        if acct["free"] + acct["backed"] + acct["cached"] \
+                + acct["squeezed"] != acct["total"]:
             print(f"block ledger out of balance at step "
                   f"{eng._step_idx}: {acct}")
             ok = False
@@ -118,11 +128,15 @@ def serving_main(args):
     for r in reasons.values():
         counts[r] = counts.get(r, 0) + 1
     reg = obs.get_registry()
+    pc = eng.prefix_cache
     print(f"serving chaos: {submitted} offered, {counts} | "
           f"recoveries={reng.recoveries} "
           f"swap_out={int(reg.counter('serving_kv_swap_out_total').labels().value)} "
           f"swap_in={int(reg.counter('serving_kv_swap_in_total').labels().value)} "
           f"faults fired={inj.fired}")
+    print(f"prefix cache: hits={pc.hits} misses={pc.misses} "
+          f"prefill_tokens_skipped={pc.tokens_skipped} "
+          f"device_blocks={pc.device_blocks} host_blocks={pc.host_blocks}")
 
     terminal = {"finished", "shed", "deadline_exceeded"}
     if set(reasons) != set(all_ids):
@@ -140,12 +154,22 @@ def serving_main(args):
             print(f"request {rid}: stream/result mismatch")
             ok = False
     acct = eng.block_accounting()
-    if not (acct["free"] == acct["total"] and acct["squeezed"] == 0
+    # drained: every block is free or parked in the (refcount-0) cache —
+    # cached blocks are a feature at idle, backed/squeezed are leaks
+    if not (acct["free"] + acct["cached"] == acct["total"]
+            and acct["backed"] == 0 and acct["squeezed"] == 0
             and acct["swapped_host_blocks"] == 0):
         print(f"drained ledger not clean: {acct}")
         ok = False
+    if any(nd.refcount for nd in pc._iter_nodes()):
+        print("drained cache still holds pinned nodes")
+        ok = False
     if eng.swap_pool.bytes_used != 0:
         print(f"host swap pool leaked {eng.swap_pool.bytes_used} bytes")
+        ok = False
+    if pc.hits < 1 or pc.tokens_skipped < 1:
+        print(f"shared-prefix workload never hit the cache "
+              f"(hits={pc.hits}, skipped={pc.tokens_skipped})")
         ok = False
 
     print("SERVING_CHAOS: OK" if ok else "SERVING_CHAOS: FAIL")

@@ -86,8 +86,8 @@ def print_request_table(payload, out=sys.stdout):
                   "serve traffic)\n")
         return rows
     hdr = (f"{'request':>8} {'state':>6} {'queue_ms':>9} {'ttft_ms':>9} "
-           f"{'tpot_ms':>8} {'tok/s':>8} {'tokens':>6} {'preempt':>7} "
-           f"{'reason':>9}\n")
+           f"{'tpot_ms':>8} {'tok/s':>8} {'tokens':>6} {'cached':>6} "
+           f"{'preempt':>7} {'reason':>9}\n")
     out.write(hdr)
     out.write("-" * (len(hdr) - 1) + "\n")
     for r in rows:
@@ -104,6 +104,7 @@ def print_request_table(payload, out=sys.stdout):
                   f"{_fmt_ms(r.get('tpot_ms')):>8} "
                   f"{tps_s:>8} "
                   f"{r.get('tokens', 0):>6} "
+                  f"{r.get('cached_tokens', 0):>6} "
                   f"{r.get('preemptions', 0):>7} "
                   f"{reason[:9]:>9}\n")
     for name, qs in (payload.get("exemplar_quantiles") or {}).items():
@@ -195,8 +196,11 @@ def demo_serving():
     with the r8 survivability layer engaged — a bounded admission queue
     sheds the over-offered request, one request expires at its deadline,
     and pool pressure preempts a slot whose KV swaps to the host tier
-    and back. The table shows the r6 decode metrics plus
-    serving_{shed,deadline_exceeded,kv_swap_*}_total."""
+    and back — and the r10 prefix cache on: a follow-up request re-sends
+    the first prompt and skips its cached prefix blocks entirely. The
+    table shows the r6 decode metrics plus
+    serving_{shed,deadline_exceeded,kv_swap_*}_total and the
+    serving_prefix_cache_* family."""
     import dataclasses
 
     import jax
@@ -220,10 +224,12 @@ def demo_serving():
     eng = LLMEngine(params, cfg, max_slots=2, block_size=8,
                     max_model_len=64, num_blocks=5, prompt_buckets=[8, 32],
                     kv_dtype="int8", kv_swap_bytes=1 << 20,
-                    admission=AdmissionConfig(max_queue=3))
-    for _ in range(2):
-        eng.add_request(rng.integers(1, 64, size=8).tolist(),
-                        max_new_tokens=16)
+                    admission=AdmissionConfig(max_queue=3),
+                    prefix_cache=True, prefix_cache_host_bytes=1 << 20)
+    first_prompt = rng.integers(1, 64, size=12).tolist()
+    eng.add_request(first_prompt, max_new_tokens=16)
+    eng.add_request(rng.integers(1, 64, size=8).tolist(),
+                    max_new_tokens=16)
     # third queued request: a deadline that has already passed — evicted
     # with finish reason deadline_exceeded on its trace
     eng.add_request(rng.integers(1, 64, size=4).tolist(),
@@ -234,6 +240,11 @@ def demo_serving():
                         max_new_tokens=4)
     except ShedError as e:
         print(f"load shed: {e}")
+    results = eng.run()
+    # re-send the first prompt: its full blocks stayed in the prefix
+    # cache after the request finished, so this admission pins them and
+    # prefills only the one-block suffix (a cache HIT)
+    eng.add_request(first_prompt, max_new_tokens=4)
     results = eng.run()
     reg = obs.get_registry()
     print(f"demo serving: {len(results)} requests, "
@@ -254,6 +265,13 @@ def demo_serving():
           f"deadline_exceeded={_c('serving_deadline_exceeded_total')} "
           f"kv_swap_out={_c('serving_kv_swap_out_total')} "
           f"kv_swap_in={_c('serving_kv_swap_in_total')}")
+    print("prefix cache: "
+          f"hits={_c('serving_prefix_cache_hits_total')} "
+          f"misses={_c('serving_prefix_cache_misses_total')} "
+          f"prefill_tokens_skipped="
+          f"{_c('serving_prefill_tokens_skipped_total')} "
+          "cached_blocks="
+          f"{int(reg.gauge('serving_prefix_cache_blocks').labels().value)}")
     print(f"finish reasons: {eng.finish_reasons}")
     print()
     print_request_table(obs.requests_payload())
