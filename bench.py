@@ -707,6 +707,78 @@ def bench_serving(dev, results):
                                       if p95_off is not None else None),
         }))
 
+    def attempt_mixedlen(make_params):
+        """Mixed short/long decode lengths (r12): the ragged Pallas
+        block-walk decode kernel vs the host-side bucketed path on the
+        SAME workload. Half the slots decode near 128-token contexts,
+        half near 900 — exactly where the bucketed path hurts: its
+        power-of-two ceiling covers max(lengths), so the short slots pay
+        the long slots' gather/attention. The ragged kernel walks each
+        slot at its true length and compiles ONE variant. Reports kept
+        tok/s (vs_baseline = ragged/bucketed), the engines' cumulative
+        KV-traffic estimates (kv_read_bytes_total: per-call pool reads,
+        the bucket-waste evidence) and the compiled decode-variant
+        counts."""
+        if jax.default_backend() != "tpu":
+            # forcing decode_kernel="ragged" off-TPU would time the
+            # Pallas INTERPRETER at 2.6B scale (the engine's auto path
+            # falls back to bucketed for the same reason) — skip the
+            # row rather than wedge the whole serving section
+            return
+        params = make_params()
+        new_tok = 64
+        rng0 = np.random.default_rng(0)
+        lens = [int(x) for x in
+                np.concatenate([rng0.integers(64, 160, size=SLOTS),
+                                rng0.integers(704, 900, size=SLOTS)])]
+        rng0.shuffle(lens)
+        reqs = [rng0.integers(1, 32768, size=ln).tolist() for ln in lens]
+
+        def run(kernel):
+            eng = LLMEngine(params, cfg, max_slots=SLOTS, block_size=64,
+                            max_model_len=1024,
+                            prompt_buckets=[128, 512, 1024],
+                            decode_steps=16, kv_dtype="int8",
+                            decode_kernel=kernel)
+            # steady-state measurement: one UNTIMED pass of the exact
+            # timed workload first, so every prefill bucket and every
+            # decode variant either path will touch (the bucketed
+            # family shrinks buckets as long slots drain — a fresh
+            # 2.6B variant compile inside the window would deflate
+            # tps_b and inflate the acceptance ratio) is compiled
+            # before the clock starts. The compile-family size itself
+            # is reported separately via the variant counts.
+            for p in reqs:
+                eng.add_request(p, max_new_tokens=new_tok,
+                                temperature=0.0)
+            eng.run()
+            eng.kv_read_bytes_total = 0
+            t0 = time.perf_counter()
+            rids = [eng.add_request(p, max_new_tokens=new_tok,
+                                    temperature=0.0) for p in reqs]
+            out = eng.run()
+            dt = time.perf_counter() - t0
+            gen = sum(len(out[r]) for r in rids)
+            return (gen / dt, eng.kv_read_bytes_total,
+                    len(eng._decode_cache))
+
+        tps_b, kvb_b, var_b = run("bucketed")
+        _release()
+        tps_r, kvb_r, var_r = run("ragged")
+        results.append(_efficiency({
+            "metric": "llama-2.6b_serving_mixedlen_tokens_per_sec",
+            "value": round(tps_r, 1),
+            "unit": "tokens/s",
+            # acceptance (ROADMAP 3): ragged beats bucketed at mixed
+            # lengths — vs_baseline is the ragged/bucketed ratio
+            "vs_baseline": round(tps_r / max(tps_b, 1e-9), 4),
+            "bucketed_tokens_per_sec": round(tps_b, 1),
+            "kv_read_bytes_ragged": int(kvb_r),
+            "kv_read_bytes_bucketed": int(kvb_b),
+            "decode_variants_ragged": int(var_r),
+            "decode_variants_bucketed": int(var_b),
+        }))
+
     try:
         _retry(lambda: attempt("bf16", lambda: _init_bf16_params(cfg)))
         _release()
@@ -734,6 +806,11 @@ def bench_serving(dev, results):
         # shared-system-prompt clients: the r10 prefix cache + chunked
         # prefill vs the same workload cold (ISSUE 11 acceptance row)
         _retry(lambda: attempt_sharedprefix(
+            lambda: jax.jit(llama.quantize_params)(_init_bf16_params(cfg))))
+        _release()
+        # mixed short/long decode lengths: the r12 ragged Pallas kernel
+        # vs the bucketed path on the same workload (ISSUE 12 row)
+        _retry(lambda: attempt_mixedlen(
             lambda: jax.jit(llama.quantize_params)(_init_bf16_params(cfg))))
     except Exception as e:
         results.append({"metric": "serving_bench_failed", "value": 0.0,

@@ -3,15 +3,36 @@
 Parity: the reference's blocked decode kernel
 (phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu, python surface
 incubate/nn/functional/block_multihead_attention) whose cache is paged:
-physical blocks of block_size tokens + per-sequence block tables. Also the
-direction of "Ragged Paged Attention" (PAPERS.md) — TPU-friendly paged decode.
+physical blocks of block_size tokens + per-sequence block tables. The
+ragged kernel below is the "Ragged Paged Attention" direction (PAPERS.md
+lead paper, arXiv 2604.15464) done natively.
 
 TPU-native: the cache is one [num_blocks, block_size, H, D] pool per k/v;
 a block_table [B, max_blocks] maps logical sequence positions to pool
-blocks. A decode step gathers each sequence's blocks (static max_blocks →
-static shapes), masks beyond the true length, and computes the attention in
-f32 — everything jit-able with zero dynamic shapes, so one compiled step
-serves any batch composition.
+blocks. Two decode strategies live here, with different compile/variant
+stories:
+
+- XLA gather path (:func:`paged_attention` / the engine's hoisted-dense
+  program): each sequence's blocks are gathered into a dense buffer of a
+  STATIC width and positions past the true length are softmax-masked.
+  Exact, but the static width must come from somewhere — the serving
+  engine picks a power-of-two prefix bucket host-side, so attention cost
+  scales with ``max(lengths)`` rounded up to the bucket ceiling and the
+  compile cache carries one variant per (bucket, sampling-flags) pair
+  (bounded at ``log2(max_blocks)+1 × 8``, but a recompile family all the
+  same). This is the off-TPU / interpret fallback.
+- Ragged Pallas path (:func:`ragged_paged_decode` /
+  :func:`ragged_decode_partial`): one program per slot walks the slot's
+  block table at its TRUE length — blocks past ``ceil(len/bs)`` are
+  never visited (the walk's trip count ends there: no DMA, no FLOPs),
+  the tail inside the last block is masked, and the softmax runs online
+  across the walk, so nothing is
+  ever gathered to a static horizon. Lengths are a runtime operand, not
+  a shape: ONE compiled variant serves any batch composition, and the
+  per-step KV read scales with the actual tokens resident, not any
+  bucket ceiling. int8 pools stream unconverted and dequantize
+  in-register via their per-entry scales (the quant_matmul scale-folding
+  math).
 """
 from __future__ import annotations
 
@@ -26,7 +47,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["PagedKVCache", "paged_cache_init", "paged_append",
            "paged_attention", "paged_append_token", "paged_append_blocks",
-           "paged_decode_attention"]
+           "paged_decode_attention", "ragged_decode_partial",
+           "ragged_paged_decode"]
 
 
 def _interpret() -> bool:
@@ -327,6 +349,225 @@ def paged_decode_attention(q, cache: PagedKVCache, layer=0) -> jax.Array:
     )(jnp.asarray(layer, jnp.int32)[None], cache.block_table,
       cache.lengths, qg, kp, vp)
     return out.reshape(N, Hq, D)
+
+
+# ---------------------------------------------------------------------------
+# Ragged paged attention — the true-length block walk (arXiv 2604.15464).
+#
+# Grid: one program per slot; the program's kv-head groups are walked
+# in-register inside the block loop rather than as a grid axis, because
+# the pool layout keeps (Hkv, D) as the Mosaic-tiled pair — a per-head
+# DMA would slice the tiled Hkv dim (illegal), and a per-(slot, head)
+# grid re-DMAing whole [bs, Hkv, D] blocks would multiply the KV read
+# bytes by Hkv on a bandwidth-bound path. Each real block is DMA'd
+# exactly once (double-buffered: block b+1 streams while b computes) and
+# every kv head consumes it while it is VMEM-resident.
+# ---------------------------------------------------------------------------
+
+
+def _ragged_decode_kernel(layer_ref, table_ref, lens_ref, q_ref,
+                          k_pool_ref, v_pool_ref, *rest, block_size,
+                          n_kv, max_blocks, kv_int8):
+    """Grid (N,): walk slot n's block table up to ``ceil(lens[n]/bs)``
+    REAL blocks with an online softmax. Blocks past the length are never
+    visited — the fori_loop trip count ends the walk there (program size
+    stays O(1) in the table width) and the ``pl.when`` prefetch guard
+    stops the DMA stream at the last real block — so a slot's cost
+    scales with its true length whatever the table width. The tail
+    inside the last block is masked to -1e30 before the running max, so
+    its exp is exactly 0.0 (bucketed-path exactness argument, applied
+    per block). int8 pools: the [bs, Hkv, D] payload
+    blocks and [bs, Hkv] per-entry scale blocks stream as stored; the
+    payload widens in-register (int8 -> q dtype is exact) and the K
+    scale multiplies the f32 scores / the V scale folds into the
+    probabilities — attn_qk / attn_pv's scale-folding math, inlined.
+
+    Emits the online-softmax PARTIAL state per (slot, kv head, q-in-
+    group): unnormalized ``acc`` (f32 [N, Hkv, G, D]), running max ``m``
+    and sum ``l`` (f32 [N, Hkv, G]) — the flash-decoding combine
+    contract, so a caller can merge in-flight tokens (the engine's
+    in-call ring) before normalizing. A slot with length 0 emits
+    (acc=0, m=-1e30, l=0), the identity of the combine."""
+    if kv_int8:
+        (ks_pool_ref, vs_pool_ref, acc_ref, m_ref, l_ref,
+         kbuf, vbuf, ksbuf, vsbuf, accs, ms, ls, sems) = rest
+    else:
+        (acc_ref, m_ref, l_ref, kbuf, vbuf, accs, ms, ls, sems) = rest
+    n = pl.program_id(0)
+    lyr = layer_ref[0]
+    ln = lens_ref[n]
+    sm_scale = 1.0 / math.sqrt(q_ref.shape[-1])
+    ms[:] = jnp.full(ms.shape, -1e30, jnp.float32)
+    ls[:] = jnp.zeros(ls.shape, jnp.float32)
+    accs[:] = jnp.zeros(accs.shape, jnp.float32)
+
+    def copies(b, slot):
+        blk = table_ref[n, b]
+        cps = [pltpu.make_async_copy(k_pool_ref.at[lyr, blk],
+                                     kbuf.at[slot], sems.at[0, slot]),
+               pltpu.make_async_copy(v_pool_ref.at[lyr, blk],
+                                     vbuf.at[slot], sems.at[1, slot])]
+        if kv_int8:
+            cps += [pltpu.make_async_copy(ks_pool_ref.at[lyr, blk],
+                                          ksbuf.at[slot], sems.at[2, slot]),
+                    pltpu.make_async_copy(vs_pool_ref.at[lyr, blk],
+                                          vsbuf.at[slot], sems.at[3, slot])]
+        return cps
+
+    # the walk's trip count IS the skip mechanism: blocks past the
+    # length are never visited, so program size stays O(1) in the table
+    # width (a python unroll over max_blocks would emit mb x Hkv copies
+    # of the DMA+MXU body — a compile cliff at long max_model_len)
+    nblk = jnp.minimum((ln + block_size - 1) // block_size, max_blocks)
+
+    @pl.when(nblk > 0)
+    def _():
+        for cp in copies(0, 0):
+            cp.start()
+
+    def walk(b, _):
+        sl = jax.lax.rem(b, 2)
+        # prefetch block b+1 into the other slot while b computes (the
+        # standard two-slot pipeline; pl.when ends the stream exactly at
+        # the slot's last real block)
+        @pl.when(b + 1 < nblk)
+        def _():
+            for cp in copies(b + 1, 1 - sl):
+                cp.start()
+
+        for cp in copies(b, sl):
+            cp.wait()
+        col = (jax.lax.broadcasted_iota(jnp.int32, (1, block_size), 1)
+               + b * block_size)
+        live = col < ln                                      # [1, bs]
+        for h in range(n_kv):                    # static kv-head groups
+            qh = q_ref[0, h]                                 # [G, D]
+            kh = kbuf[sl][:, h]                              # [bs, D]
+            if kv_int8:
+                kh = kh.astype(qh.dtype)         # int8 widen: exact
+            s = jax.lax.dot_general(
+                qh, kh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * sm_scale
+            if kv_int8:
+                s = s * ksbuf[sl][:, h][None, :]
+            s = jnp.where(live, s, jnp.float32(-1e30))       # [G, bs]
+            m_prev = ms[h]                                   # [G]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new[:, None])
+            ls[h] = ls[h] * alpha + jnp.sum(p, axis=-1)
+            vh = vbuf[sl][:, h]
+            if kv_int8:
+                # V scale rides the probabilities (it varies along the
+                # contracted axis) and int8 V widens in-register
+                p = p * vsbuf[sl][:, h][None, :]
+                vh = vh.astype(jnp.float32)
+            else:
+                p = p.astype(vh.dtype)
+            pv = jax.lax.dot_general(
+                p, vh, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)          # [G, D]
+            accs[h] = accs[h] * alpha[:, None] + pv
+            ms[h] = m_new
+        return 0
+
+    jax.lax.fori_loop(0, nblk, walk, 0)
+
+    acc_ref[0] = accs[:]
+    m_ref[0] = ms[:]
+    l_ref[0] = ls[:]
+
+
+def ragged_decode_partial(q, k_pool, v_pool, block_table, lengths, *,
+                          layer=0, ks_pool=None, vs_pool=None):
+    """Ragged block-walk decode attention over each slot's TRUE length —
+    partial (flash-decoding) form. q: [N, Hq, D]; pools:
+    [L, NB, BS, Hkv, D] or 4D (bf16/f32, or int8 with per-entry f32
+    scale pools ks/vs [L, NB, BS, Hkv] or 3D); block_table: [N, MB]
+    int32; lengths: [N] runtime operand — NOT a shape. Returns the
+    online-softmax partials ``(acc [N, Hkv, G, D] f32, m [N, Hkv, G]
+    f32, l [N, Hkv, G] f32)`` so callers can merge extra keys (the
+    serving engine's in-call ring) before normalizing; use
+    :func:`ragged_paged_decode` for the normalized one-shot form.
+
+    One compiled variant serves ANY length mix: the table width MB is
+    the only shape, and slots read exactly ``ceil(lengths[n]/BS)``
+    blocks of it. VMEM use is two double-buffered blocks + the [Hkv, G,
+    D] accumulators, independent of context length — no long-context
+    staging-buffer cliff like :func:`paged_decode_attention`'s."""
+    N, Hq, D = q.shape
+    kp, vp = _as5d(k_pool), _as5d(v_pool)
+    bs, Hkv = kp.shape[2], kp.shape[3]
+    mb = block_table.shape[1]
+    assert Hq % Hkv == 0, (Hq, Hkv)
+    G = Hq // Hkv
+    kv_int8 = kp.dtype == jnp.int8
+    if kv_int8 and (ks_pool is None or vs_pool is None):
+        raise ValueError("int8 pools require ks_pool/vs_pool scales")
+    qg = q.reshape(N, Hkv, G, D)
+
+    in_specs = [
+        pl.BlockSpec((1, Hkv, G, D), lambda n, l, t, ln: (n, 0, 0, 0)),
+        pl.BlockSpec(memory_space=pl.ANY),     # pools stay in HBM
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    inputs = [qg, kp, vp]
+    scratch = [pltpu.VMEM((2, bs, Hkv, D), kp.dtype),
+               pltpu.VMEM((2, bs, Hkv, D), vp.dtype)]
+    if kv_int8:
+        ksp = ks_pool if ks_pool.ndim == 4 else ks_pool[None]
+        vsp = vs_pool if vs_pool.ndim == 4 else vs_pool[None]
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY),
+                     pl.BlockSpec(memory_space=pl.ANY)]
+        inputs += [ksp.astype(jnp.float32), vsp.astype(jnp.float32)]
+        scratch += [pltpu.VMEM((2, bs, Hkv), jnp.float32),
+                    pltpu.VMEM((2, bs, Hkv), jnp.float32)]
+    scratch += [pltpu.VMEM((Hkv, G, D), jnp.float32),
+                pltpu.VMEM((Hkv, G), jnp.float32),
+                pltpu.VMEM((Hkv, G), jnp.float32),
+                pltpu.SemaphoreType.DMA((4 if kv_int8 else 2, 2))]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(N,),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, Hkv, G, D), lambda n, l, t, ln: (n, 0, 0, 0)),
+            pl.BlockSpec((1, Hkv, G), lambda n, l, t, ln: (n, 0, 0)),
+            pl.BlockSpec((1, Hkv, G), lambda n, l, t, ln: (n, 0, 0)),
+        ],
+        scratch_shapes=scratch,
+    )
+    acc, m, l = pl.pallas_call(
+        functools.partial(_ragged_decode_kernel, block_size=bs, n_kv=Hkv,
+                          max_blocks=mb, kv_int8=kv_int8),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((N, Hkv, G, D), jnp.float32),
+                   jax.ShapeDtypeStruct((N, Hkv, G), jnp.float32),
+                   jax.ShapeDtypeStruct((N, Hkv, G), jnp.float32)],
+        interpret=_interpret(),
+    )(jnp.asarray(layer, jnp.int32)[None], block_table.astype(jnp.int32),
+      lengths.astype(jnp.int32), *inputs)
+    return acc, m, l
+
+
+def ragged_paged_decode(q, cache: PagedKVCache, layer=0, ks_pool=None,
+                        vs_pool=None) -> jax.Array:
+    """Normalized ragged decode attention: q [N, Hq, D] -> [N, Hq, D],
+    attending each slot's first ``cache.lengths[n]`` pool positions via
+    the true-length block walk (:func:`ragged_decode_partial`). Same
+    contract as :func:`paged_attention` — which remains the XLA gather
+    reference and the numerics oracle in tests — but lengths are a
+    runtime operand: one compiled program serves any length mix, reads
+    no block past any slot's length, and holds only two blocks in VMEM
+    however long the context. Zero-length slots return 0."""
+    N, Hq, D = q.shape
+    acc, m, l = ragged_decode_partial(
+        q, cache.k_pool, cache.v_pool, cache.block_table, cache.lengths,
+        layer=layer, ks_pool=ks_pool, vs_pool=vs_pool)
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    out = jnp.where((l > 0)[..., None], out, 0.0)
+    return out.reshape(N, Hq, D).astype(q.dtype)
 
 
 def paged_attention(q, cache: PagedKVCache) -> jax.Array:

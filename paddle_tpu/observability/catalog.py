@@ -40,16 +40,30 @@ CATALOG = {
     "serving_step_seconds": (
         "histogram", (), "wall time of one LLMEngine.step call"),
     "serving_decode_prefix_bucket": (
-        "gauge", (), "ragged prefix horizon (tokens) of the decode "
-                     "variant dispatched last — power-of-two block "
-                     "buckets over max(lengths)+decode_steps"),
+        "gauge", (), "prefix horizon (tokens) of the decode dispatched "
+                     "last — power-of-two bucket ceiling on the "
+                     "bucketed path, true max(lengths) rounded to a "
+                     "block on the ragged-kernel path"),
     "serving_decode_recompiles_total": (
-        "counter", (), "decode program variants compiled "
-                       "((prefix bucket, sampling flags) tuples; bounded "
-                       "at log2(blocks/slot) x 8)"),
+        "counter", (), "decode program variants compiled (ragged path: "
+                       "one per sampling-flag tuple, <= 8; bucketed "
+                       "fallback: (prefix bucket, flags) tuples, "
+                       "bounded at log2(blocks/slot) x 8)"),
     "serving_decode_kv_read_bytes": (
-        "gauge", (), "K/V pool bytes one decode call gathers at the "
-                     "current prefix bucket (int8 pools halve this)"),
+        "gauge", (), "K/V pool bytes one decode attention pass reads — "
+                     "bucket ceiling x slots on the bucketed path, the "
+                     "slots' true-length block walks on the ragged path "
+                     "(int8 pools halve either)"),
+    "serving_decode_kernel_total": (
+        "counter", ("path",),
+        "decode dispatches by attention path (ragged = true-length "
+        "Pallas block-walk kernel, bucketed = power-of-two dense "
+        "gather, dense = gather at the full allocation horizon) — the "
+        "off-TPU fallback is counted here, never silent"),
+    "serving_decode_variants": (
+        "gauge", (), "compiled decode program variants currently cached "
+                     "(ragged path: exactly one per (batch, "
+                     "sampling-flags) set — test-enforced)"),
     # -- serving survivability (admission, deadlines, kv_swap, recovery) ---
     "serving_shed_total": (
         "counter", ("reason",),

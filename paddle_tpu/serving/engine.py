@@ -7,19 +7,26 @@ behavioral model its serving stacks build on top.
 
 TPU-native design — everything the chip executes has STATIC shapes:
 
-- ONE compiled decode step PER PREFIX BUCKET over ``max_slots`` sequence
-  slots. A slot is a row of the batch; requests come and go, the program
-  never retraces on slot churn. Idle slots write their K/V to a reserved
-  trash block and are masked out of sampling.
-- Ragged/length-bucketed prefix attention: the decode call's dense prefix
-  gather spans only the smallest power-of-two BLOCK COUNT covering
-  ``max(lengths) + decode_steps`` across the active slots (plus the
-  in-flight pipeline lag), not the ``max_model_len`` allocation maximum —
-  short-context steady state stops paying full-model-len gather bandwidth
-  and attention FLOPs. The bucket is picked host-side from the engine's
-  exact ``self.lengths``; the compiled-variant set stays bounded at
-  (log2 buckets) x (<= 8 sampling-flag tuples), mirrored by the
-  ``serving_decode_prefix_bucket`` gauge and the
+- ONE compiled decode step over ``max_slots`` sequence slots. A slot is
+  a row of the batch; requests come and go, the program never retraces
+  on slot churn. Idle slots write their K/V to a reserved trash block
+  and are masked out of sampling.
+- Ragged paged attention (r12, the TPU default): decode attention runs
+  the Pallas true-length block-walk kernel
+  (kernels/paged_attention.ragged_decode_partial) — per-slot programs
+  read exactly ``ceil(length/bs)`` real blocks with an online softmax,
+  lengths ride as a RUNTIME operand and the block table ships at full
+  width, so the decode compile cache holds ONE variant per
+  (batch, sampling-flags) set and per-step KV reads scale with the
+  tokens actually resident. Off-TPU (or forced via
+  ``decode_kernel="bucketed"``) the r6 fallback applies instead: the
+  dense prefix gather spans the smallest power-of-two BLOCK COUNT
+  covering ``max(lengths) + decode_steps`` across the active slots
+  (plus the in-flight pipeline lag) — bounded at (log2 buckets) x
+  (<= 8 sampling-flag tuples) compiled variants. Either path is
+  counted per dispatch in ``serving_decode_kernel_total{path}`` and
+  mirrored by the ``serving_decode_prefix_bucket`` /
+  ``serving_decode_variants`` gauges and the
   ``serving_decode_recompiles_total`` counter.
 - Bucketed prefill: prompts pad to the smallest configured bucket, one
   compiled program per bucket (the guard-cache analogue of the reference's
@@ -68,6 +75,7 @@ import numpy as np
 
 from .. import observability as _obs
 from ..distributed.resilience.faults import SimulatedCrash
+from ..kernels.paged_attention import ragged_decode_partial
 from ..kernels.quant_matmul import (attn_pv, attn_qk, quantize_kv,
                                     weight_only_matmul as _wo_mm)
 from ..models.llama import (LlamaConfig, _apply_rope, _apply_rope_at,
@@ -105,6 +113,8 @@ _M_TPOT = _instrument("serving_tpot_seconds")
 _M_SERVING_MFU = _instrument("serving_mfu")
 _M_DEADLINE = _instrument("serving_deadline_exceeded_total")
 _M_SWAP_FALLBACK = _instrument("serving_kv_swap_fallback_total")
+_M_DECODE_KERNEL = _instrument("serving_decode_kernel_total")
+_M_DECODE_VARIANTS = _instrument("serving_decode_variants")
 
 
 @dataclasses.dataclass
@@ -364,7 +374,7 @@ def _paged_decode(params, last_tokens, lengths, done0, budgets, key, active,
                   block_table, pools, temps, top_ks, top_ps,
                   eos_ids, *, config: LlamaConfig, n_steps: int,
                   sample_flags=(True, True, True), kv_int8: bool = False,
-                  numerics: bool = False):
+                  numerics: bool = False, ragged: bool = False):
     """``n_steps`` decode iterations in ONE compiled program (multi-step
     scheduling): the host loop syncs once per call instead of once per
     token — through a remote-attached chip the per-step d2h round-trip
@@ -396,6 +406,24 @@ def _paged_decode(params, last_tokens, lengths, done0, budgets, key, active,
     half the gather/attention KV bytes. The in-call ring stays model
     dtype and is quantized once at writeback.
 
+    Ragged Pallas path (``ragged``, r12 — the default on TPU): no dense
+    hoist at all. ``block_table`` arrives at FULL width [N, mb] (one
+    static shape forever) and ``lengths`` is a runtime operand: each
+    step, each layer calls kernels/paged_attention.ragged_decode_partial,
+    whose per-slot program walks the slot's block table at its TRUE
+    length (blocks past ``ceil(len/bs)`` are never visited — the walk's
+    trip count ends there: no DMA, no FLOPs) with an online softmax,
+    streaming int8 blocks unconverted
+    and dequantizing in-register. The kernel's partial state (acc, m, l)
+    merges with the in-call ring's scores via the flash-decoding combine
+    — mathematically the same softmax over [prefix ; ring], computed
+    blockwise. Consequences: the compile cache loses its prefix-bucket
+    axis entirely (ONE variant per sampling-flag set), per-step KV reads
+    scale with the tokens actually resident, and inactive / mid-chunk
+    slots walk zero blocks (their lengths are zeroed going in). The
+    writeback scatter and kv_int8 numerics probes are shared with the
+    bucketed path verbatim.
+
     The (last, lengths, done, budgets, key) quintet is a device-resident
     carry: the engine feeds each call the previous call's outputs
     untouched while the slot composition is unchanged, so steady-state
@@ -423,15 +451,21 @@ def _paged_decode(params, last_tokens, lengths, done0, budgets, key, active,
     lens0 = lengths                       # frozen prefix lengths
     scale = 1.0 / math.sqrt(D)
 
-    # ---- hoist: one dense gather of every slot's (frozen) prefix --------
-    # (int8 pools: the dense arrays stay int8 — half the bytes moved)
-    kd = k_pool[:, block_table].reshape(Lc, N, P, Hkv, D)
-    vd = v_pool[:, block_table].reshape(Lc, N, P, Hkv, D)
-    if kv_int8:
-        ksc = pools["ks"][:, block_table].reshape(Lc, N, P, Hkv)
-        vsc = pools["vs"][:, block_table].reshape(Lc, N, P, Hkv)
-    pre_mask = (jnp.arange(P)[None, :]
-                < lens0[:, None])[:, None, None, :]       # [N,1,1,P]
+    if ragged:
+        # true-length walk: no gather, no mask — the kernel reads only
+        # real blocks. Slots outside the decode set (inactive or
+        # mid-chunked-prefill) walk zero blocks.
+        walk_lens = jnp.where(active, lens0.astype(jnp.int32), 0)
+    else:
+        # ---- hoist: one dense gather of every slot's (frozen) prefix ----
+        # (int8 pools: the dense arrays stay int8 — half the bytes moved)
+        kd = k_pool[:, block_table].reshape(Lc, N, P, Hkv, D)
+        vd = v_pool[:, block_table].reshape(Lc, N, P, Hkv, D)
+        if kv_int8:
+            ksc = pools["ks"][:, block_table].reshape(Lc, N, P, Hkv)
+            vsc = pools["vs"][:, block_table].reshape(Lc, N, P, Hkv)
+        pre_mask = (jnp.arange(P)[None, :]
+                    < lens0[:, None])[:, None, None, :]   # [N,1,1,P]
 
     freq = c.rope_theta ** (-jnp.arange(0, c.head_dim, 2, jnp.float32)
                             / c.head_dim)
@@ -474,17 +508,37 @@ def _paged_decode(params, last_tokens, lengths, done0, budgets, key, active,
             rv = jax.lax.dynamic_update_slice(
                 rv, vv[None, :, None], (l, 0, t, 0, 0))
             qg = q.reshape(N, Hkv, G, D)
-            s_pre = attn_qk(qg, kd[l], ksc[l] if kv_int8 else None) * scale
             s_rng = jnp.einsum("nhgd,nshd->nhgs", qg, rk[l],
                                preferred_element_type=jnp.float32) * scale
-            s_pre = jnp.where(pre_mask, s_pre, -1e30)
             s_rng = jnp.where(ring_mask, s_rng, -1e30)
-            probs = jax.nn.softmax(
-                jnp.concatenate([s_pre, s_rng], axis=-1), axis=-1)
-            p_rng = probs[..., P:].astype(dt)
-            att = (attn_pv(probs[..., :P], vd[l],
-                           vsc[l] if kv_int8 else None, out_dtype=dt)
-                   + jnp.einsum("nhgs,nshd->nhgd", p_rng, rv[l]))
+            if ragged:
+                # flash-decoding combine: the kernel's online-softmax
+                # partials over the pool prefix merge with the in-call
+                # ring's scores — one softmax over [prefix ; ring],
+                # computed blockwise (exact up to f32 rounding). The
+                # ring always holds >= 1 live position, so l_tot >= 1.
+                acc_p, m_p, l_p = ragged_decode_partial(
+                    q, pools["k"], pools["v"], block_table, walk_lens,
+                    layer=l, ks_pool=pools.get("ks"),
+                    vs_pool=pools.get("vs"))
+                m_tot = jnp.maximum(m_p, jnp.max(s_rng, axis=-1))
+                corr = jnp.exp(m_p - m_tot)
+                p_rng = jnp.exp(s_rng - m_tot[..., None])
+                l_tot = l_p * corr + jnp.sum(p_rng, axis=-1)
+                acc_tot = (acc_p * corr[..., None]
+                           + jnp.einsum("nhgs,nshd->nhgd", p_rng, rv[l],
+                                        preferred_element_type=jnp.float32))
+                att = acc_tot / l_tot[..., None]
+            else:
+                s_pre = attn_qk(qg, kd[l],
+                                ksc[l] if kv_int8 else None) * scale
+                s_pre = jnp.where(pre_mask, s_pre, -1e30)
+                probs = jax.nn.softmax(
+                    jnp.concatenate([s_pre, s_rng], axis=-1), axis=-1)
+                p_rng = probs[..., P:].astype(dt)
+                att = (attn_pv(probs[..., :P], vd[l],
+                               vsc[l] if kv_int8 else None, out_dtype=dt)
+                       + jnp.einsum("nhgs,nshd->nhgd", p_rng, rv[l]))
             att = att.reshape(N, 1, Hkv * G * D).astype(dt)
             x = x + _wo_mm(att, p["wo"], dt)
             hn = _rms_norm(x, p["mlp_norm"], c.rms_eps)
@@ -563,7 +617,8 @@ class LLMEngine:
                  mesh=None, decode_steps: int = 1, kv_dtype=None,
                  admission=None, kv_swap_bytes: int = 0, injector=None,
                  prefix_cache: bool = False, prefill_chunk: int = 0,
-                 prefix_cache_host_bytes: int = 0):
+                 prefix_cache_host_bytes: int = 0,
+                 decode_kernel: str = "auto"):
         """``params`` may be dense (bf16/f32) or int8 weight-only
         (llama.quantize_params) — quantized leaves feed the decode/prefill
         matmuls unconverted (kernels/quant_matmul.weight_only_matmul).
@@ -620,6 +675,22 @@ class LLMEngine:
         decode waves of the other slots — a long prefill stops
         monopolizing a step, so TTFT stays bounded under mixed traffic.
         0 = one-shot suffix prefill (the pre-r10 behavior).
+
+        ``decode_kernel``: which decode attention path serves the slots
+        (r12). ``"ragged"`` — the Pallas true-length block-walk kernel
+        (kernels/paged_attention.ragged_decode_partial): lengths become
+        a runtime operand, the block table ships at full width, and the
+        decode compile cache collapses to ONE variant per (batch,
+        sampling-flags) set. ``"bucketed"`` — the r6 host-side
+        power-of-two prefix buckets over the hoisted dense gather.
+        ``"auto"`` (default) picks ragged on an unsharded TPU backend
+        and bucketed elsewhere (off-TPU the kernel would run in the
+        Pallas interpreter — correct but slow; under a 'tp' mesh GSPMD
+        can't partition it); the choice is counted per dispatch in
+        ``serving_decode_kernel_total{path}``, never silent.
+        Both paths share admission, writeback, preemption, the prefix
+        cache, chunked prefill, swap and the numerics probes; greedy
+        token streams are parity-tested identical.
 
         Pipelining caveat: the engine dispatches call k+1 before reading
         call k's tokens only when every in-flight slot is GUARANTEED
@@ -700,12 +771,32 @@ class LLMEngine:
         self._key = jax.random.PRNGKey(seed)
         self._prefill = {}
         self.decode_steps = max(1, int(decode_steps))
-        # one compiled decode variant per (prefix-bucket, sampling-flag)
-        # tuple — flags stay ≤8 (an all-greedy slot mix must not pay
-        # top-k/top-p's full-vocab sorts) and prefix buckets are
-        # power-of-two block counts (≤ log2(mb)+2 values), so the variant
-        # set is bounded however the workload mixes lengths
+        if decode_kernel not in ("auto", "ragged", "bucketed"):
+            raise ValueError(
+                f"decode_kernel must be 'auto', 'ragged' or 'bucketed', "
+                f"got {decode_kernel!r}")
+        if decode_kernel == "ragged" and mesh is not None:
+            # GSPMD cannot partition the Pallas block-walk over a 'tp'
+            # mesh (the kernel would run replicated against sharded
+            # pools); tp serving keeps the bucketed path, which shards
+            # through its plain gathers/dots. Fail loudly rather than
+            # compile something silently wrong.
+            raise ValueError(
+                "decode_kernel='ragged' does not compose with a tp mesh "
+                "yet — use 'auto' (falls back to bucketed) or 'bucketed'")
+        self.decode_kernel = decode_kernel
+        # decode compile cache. Ragged path (r12): keyed ("ragged",
+        # flags) — ONE variant per sampling-flag tuple (≤8 total; an
+        # all-greedy slot mix must not pay top-k/top-p's full-vocab
+        # sorts), since lengths are a runtime operand and the table
+        # ships at full width. Bucketed fallback: keyed (prefix-bucket,
+        # flags) — power-of-two block counts (≤ log2(mb)+2 values) × ≤8
+        # flag tuples, bounded however the workload mixes lengths.
         self._decode_cache: Dict = {}
+        # cumulative host estimate of decode-call KV pool traffic (see
+        # _dispatch_decode) — bench evidence, kept whether or not the
+        # metrics registry is enabled
+        self.kv_read_bytes_total = 0
         # device-resident decode carry (last/lengths/done/budgets/key) +
         # static per-slot vectors; the carry chains from call to call and
         # is only rebuilt from host state when the pipeline is drained
@@ -1660,6 +1751,23 @@ class LLMEngine:
         nbk = 1 << (need - 1).bit_length()
         return min(nbk, self.mb)        # mb >= need, so the clamp is safe
 
+    def _use_ragged(self) -> bool:
+        """True when decode dispatches the ragged Pallas block-walk
+        kernel: forced by ``decode_kernel="ragged"``, or picked by
+        ``"auto"`` on a TPU backend. Off-TPU ``auto`` keeps the bucketed
+        dense-gather path (the kernel would run interpreted), as does a
+        'tp' mesh (GSPMD can't partition the kernel); the choice is
+        counted per dispatch in serving_decode_kernel_total{path}."""
+        return self.decode_kernel == "ragged" or (
+            self.decode_kernel == "auto" and self.mesh is None
+            and jax.default_backend() == "tpu")
+
+    def _pool_block_bytes(self) -> int:
+        """Bytes one physical block occupies across every pool entry and
+        layer (int8 pools: payload + scales)."""
+        return sum(a.shape[0] * int(np.prod(a.shape[2:])) * a.dtype.itemsize
+                   for a in self.pools.values())
+
     def _dispatch_decode(self, active_slots):
         """Enqueue one multi-step decode call and record it as in-flight.
         rem_start tracks each slot's EXACT remaining budget at the start
@@ -1682,7 +1790,11 @@ class LLMEngine:
             else:
                 rem_start[i] = req.max_new_tokens - len(req.generated) \
                     - len(self.slot_out[i])
-        nbk = self._prefix_blocks(active_slots)
+        ragged = self._use_ragged()
+        # ragged: the table ships at FULL width — one static shape
+        # forever, lengths ride as a runtime operand (no bucket axis in
+        # the compile key). Bucketed: host-side power-of-two slice.
+        nbk = self.mb if ragged else self._prefix_blocks(active_slots)
         if self._table_dirty:
             self._table_dev = {}
             self._table_dirty = False
@@ -1699,36 +1811,81 @@ class LLMEngine:
                                  if r.temperature > 0),
                  sampled and any(r.top_p < 1.0 for r in reqs
                                  if r.temperature > 0))
-        decode = self._decode_cache.get((nbk, flags))
+        vk = ("ragged", flags) if ragged else (nbk, flags)
+        decode = self._decode_cache.get(vk)
         if decode is None:
             # numerics gate baked per variant, like _prefill_fn (the key
-            # stays (bucket, flags): a mid-run flag flip instruments new
-            # variants only — documented in docs/observability.md)
-            decode = self._decode_cache[(nbk, flags)] = jax.jit(
+            # stays ("ragged"|bucket, flags): a mid-run flag flip
+            # instruments new variants only — docs/observability.md)
+            decode = self._decode_cache[vk] = jax.jit(
                 functools.partial(_paged_decode, config=self.config,
                                   n_steps=self.decode_steps,
                                   sample_flags=flags,
                                   kv_int8=self.kv_int8,
-                                  numerics=self.kv_int8 and _nm.active()),
+                                  numerics=self.kv_int8 and _nm.active(),
+                                  ragged=ragged),
                 donate_argnums=(8,))
             _M_DECODE_RECOMPILES.inc()
+        # path + traffic accounting (host ints — kept whether or not the
+        # registry is on, so bench rows can report evidence without
+        # perturbing the measured workload with full telemetry)
+        path = ("ragged" if ragged
+                else ("dense" if nbk >= self.mb else "bucketed"))
+        _M_DECODE_KERNEL.inc(path=path)
+        _M_DECODE_VARIANTS.set(len(self._decode_cache))
+        pb = self._pool_block_bytes()
+        if ragged:
+            # every scan step re-walks each slot's true-length blocks.
+            # The kernel walks the DEVICE carry lengths, which lag the
+            # host's view by up to decode_steps for slots chained
+            # behind an unread call — add the lag (the _prefix_blocks
+            # convention) so the estimate matches the true walk
+            snap = ({s for s, _ in prev["snapshot"]}
+                    if prev is not None else ())
+            lens = {i: int(self.lengths[i])
+                    + (self.decode_steps if i in snap else 0)
+                    for i in active_slots}
+            walk = sum(-(-ln // self.bs) for ln in lens.values())
+            kv_call_bytes = walk * pb * self.decode_steps
+            step_bytes = walk * pb
+            horizon = max(lens.values(), default=0)
+            bucket_tokens = -(-horizon // self.bs) * self.bs
+        else:
+            # one dense gather (pool read + dense write) + one dense
+            # read per scan step, all at the bucket ceiling
+            step_bytes = pb * self.N * nbk
+            kv_call_bytes = step_bytes * (2 + self.decode_steps)
+            bucket_tokens = nbk * self.bs
+        self.kv_read_bytes_total += kv_call_bytes
         if _obs.enabled():
-            _M_PREFIX_BUCKET.set(nbk * self.bs)
-            _M_KV_READ_BYTES.set(sum(
-                a.shape[0] * self.N * nbk
-                * int(np.prod(a.shape[2:])) * a.dtype.itemsize
-                for a in self.pools.values()))
+            _M_PREFIX_BUCKET.set(bucket_tokens)
+            _M_KV_READ_BYTES.set(step_bytes)
             # cost-model FLOPs once per compiled variant (lower() is a
             # trace; allow_compile=False so MFU never compiles twice)
-            vk = (nbk, flags)
             if vk not in self._decode_flops:
                 self._decode_flops[vk] = _perf.flops_of(
                     decode, self.params, c_last, c_len, c_done, c_rem,
                     c_key, v_act, tbl, self.pools, v_t, v_k, v_p, v_eos,
                     allow_compile=False)
-            self._last_decode_flops = self._decode_flops[vk]
+            flops = self._decode_flops[vk]
+            if flops and ragged:
+                # the cost model can't see inside the Mosaic custom
+                # call, and the walk's FLOPs depend on runtime lengths
+                # anyway: add the prefix-attention term analytically —
+                # QK + PV = 4*Hq*D per walked token, per layer, per
+                # scan step (the ring/matmul/MLP terms are plain XLA
+                # ops the cost analysis already counted)
+                flops += (4 * self.config.num_heads * self.config.head_dim
+                          * walk * self.bs * self.config.num_layers
+                          * self.decode_steps)
+            self._last_decode_flops = flops
         with trace_span("serving.decode", slots=len(active_slots),
-                        steps=self.decode_steps, prefix_bucket=nbk * self.bs,
+                        steps=self.decode_steps,
+                        # the true dispatched horizon (ragged: max real
+                        # length; bucketed: the ceiling) — matches the
+                        # serving_decode_prefix_bucket gauge, never the
+                        # full-width table shape
+                        prefix_bucket=bucket_tokens,
                         request_ids=[r.req_id for r in reqs]):
             (toks, c_last, c_len, c_done, c_rem, c_key,
              self.pools) = decode(

@@ -1,0 +1,316 @@
+"""r12 ragged paged-attention Pallas decode kernel (arXiv 2604.15464).
+
+Contracts under test (interpret mode — the chip lane is
+tests_tpu/test_ragged_decode_tpu.py):
+- the true-length block walk matches the dense gather reference
+  (paged_attention) across mixed lengths including length-1 and exact
+  block-boundary lengths, for f32 and bf16 pools;
+- masked-tail exactness: garbage in the tail of the last block and in
+  blocks past the length changes NOTHING (bit-identical output — the
+  masked exp is exactly 0.0);
+- int8 KV pools: the in-kernel scale folding (attn_qk/attn_pv math)
+  matches dequantize-then-attend;
+- prefix-cache-hit shaped tables: slots sharing physical history blocks;
+- through the engine: greedy token streams ragged ≡ bucketed, bf16 and
+  int8 KV, including a prefix-cache-hit admission and a swap-in restore;
+- the decode compile cache holds exactly ONE variant per sampling-flag
+  set on the ragged path (the acceptance bound), while the off-TPU
+  fallback is counted in serving_decode_kernel_total — never silent.
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401  (forces the CPU/virtual-device conftest setup)
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.kernels.paged_attention import (PagedKVCache,
+                                                paged_attention,
+                                                ragged_decode_partial,
+                                                ragged_paged_decode)
+from paddle_tpu.kernels.quant_matmul import dequantize_kv, quantize_kv
+from paddle_tpu.models import llama
+from paddle_tpu.serving import LLMEngine
+
+BS, HKV, G, D, MB = 4, 2, 2, 16, 4
+
+
+def _mk(rng, n_slots, dtype, lens):
+    nb = n_slots * MB + 1
+    kp = jnp.asarray(rng.standard_normal((nb, BS, HKV, D)), dtype)
+    vp = jnp.asarray(rng.standard_normal((nb, BS, HKV, D)), dtype)
+    table = jnp.asarray(rng.permutation(np.arange(1, nb)).reshape(n_slots,
+                                                                  MB),
+                        jnp.int32)
+    q = jnp.asarray(rng.standard_normal((n_slots, G * HKV, D)), dtype)
+    return q, PagedKVCache(kp, vp, table, jnp.asarray(lens, jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# kernel-level parity (interpret mode)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("dtype,atol", [(jnp.float32, 1e-5),
+                                        (jnp.bfloat16, 3e-2)])
+def test_ragged_kernel_matches_dense_reference(dtype, atol):
+    """Mixed lengths — 1 token, one exact block, a mid-block tail, and
+    the full table — against the XLA gather reference."""
+    rng = np.random.default_rng(0)
+    q, cache = _mk(rng, 4, dtype, [1, BS, 2 * BS + 3, MB * BS])
+    want = paged_attention(q, cache)
+    got = ragged_paged_decode(q, cache)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32), atol=atol)
+
+
+def test_ragged_masked_tail_bit_exact():
+    """Poisoning every position past each slot's length (the last
+    block's tail AND whole out-of-range blocks) must not change a single
+    bit: masked columns underflow to an exact 0.0 and skipped blocks are
+    never read."""
+    rng = np.random.default_rng(1)
+    lens = [3, BS + 1, 2 * BS]
+    q, cache = _mk(rng, 3, jnp.float32, lens)
+    clean = ragged_paged_decode(q, cache)
+    kp = np.array(cache.k_pool)
+    vp = np.array(cache.v_pool)
+    for n, ln in enumerate(lens):
+        tbl = np.asarray(cache.block_table[n])
+        for b in range(MB):
+            lo = max(0, ln - b * BS)
+            kp[tbl[b], lo:] = 1e4      # garbage tail / whole block
+            vp[tbl[b], lo:] = -1e4
+    poisoned = ragged_paged_decode(q, PagedKVCache(
+        jnp.asarray(kp), jnp.asarray(vp), cache.block_table, cache.lengths))
+    np.testing.assert_array_equal(np.asarray(clean), np.asarray(poisoned))
+
+
+def test_ragged_int8_matches_dequant_reference():
+    """int8 pools stream unconverted; the per-entry K scale multiplies
+    the scores and the V scale folds into the probabilities — the result
+    must match dequantizing the pools first (the attn_qk/attn_pv
+    contract, in-kernel)."""
+    rng = np.random.default_rng(2)
+    q, cache = _mk(rng, 3, jnp.float32, [2, BS + 3, 3 * BS])
+    qk, ks = quantize_kv(cache.k_pool)
+    qv, vs = quantize_kv(cache.v_pool)
+    got = ragged_paged_decode(q, PagedKVCache(qk, qv, cache.block_table,
+                                              cache.lengths),
+                              ks_pool=ks, vs_pool=vs)
+    want = paged_attention(q, PagedKVCache(
+        dequantize_kv(qk, ks, jnp.float32),
+        dequantize_kv(qv, vs, jnp.float32),
+        cache.block_table, cache.lengths))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_ragged_shared_history_blocks():
+    """Prefix-cache-hit shape: two slots pin the SAME physical history
+    blocks (refcounted trie nodes) and diverge in their private tails —
+    the walk reads shared blocks per slot, no aliasing surprises."""
+    rng = np.random.default_rng(3)
+    q, cache = _mk(rng, 2, jnp.float32, [2 * BS + 2, 3 * BS + 1])
+    tbl = np.array(cache.block_table)
+    tbl[1, :2] = tbl[0, :2]            # shared 2-block history
+    cache = PagedKVCache(cache.k_pool, cache.v_pool, jnp.asarray(tbl),
+                         cache.lengths)
+    np.testing.assert_allclose(np.asarray(ragged_paged_decode(q, cache)),
+                               np.asarray(paged_attention(q, cache)),
+                               atol=1e-5)
+
+
+def test_ragged_layered_pool_layer_select_and_zero_length():
+    """The engine's pools are [L, NB, BS, Hkv, D]: ``layer`` must select
+    the right plane; a zero-length slot emits exactly 0 (the combine
+    identity) and the partial state (acc=0, m=-1e30, l=0)."""
+    rng = np.random.default_rng(4)
+    q, cache = _mk(rng, 2, jnp.float32, [0, BS + 2])
+    kp = jnp.stack([jnp.zeros_like(cache.k_pool), cache.k_pool])
+    vp = jnp.stack([jnp.zeros_like(cache.v_pool), cache.v_pool])
+    got = ragged_paged_decode(q, PagedKVCache(kp, vp, cache.block_table,
+                                              cache.lengths), layer=1)
+    want = paged_attention(q, cache)
+    assert np.all(np.asarray(got[0]) == 0.0)
+    np.testing.assert_allclose(np.asarray(got[1]), np.asarray(want[1]),
+                               atol=1e-5)
+    acc, m, l = ragged_decode_partial(q, kp, vp, cache.block_table,
+                                      cache.lengths, layer=1)
+    assert np.all(np.asarray(acc[0]) == 0.0)
+    assert np.all(np.asarray(l[0]) == 0.0)
+    assert np.all(np.asarray(m[0]) == -1e30)
+
+
+# ---------------------------------------------------------------------------
+# engine integration: ragged ≡ bucketed greedy streams, one variant
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def model():
+    cfg = dataclasses.replace(
+        llama.tiny_llama(vocab=64, hidden=32, layers=2, heads=4, kv_heads=2,
+                         seq=128, ffn=64),
+        dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _streams(params, cfg, kernel, prompts, n_new, **kw):
+    eng = LLMEngine(params, cfg, max_slots=2, block_size=8,
+                    max_model_len=64, prompt_buckets=[8, 32],
+                    decode_steps=3, decode_kernel=kernel, **kw)
+    ids = [eng.add_request(p, max_new_tokens=k)
+           for p, k in zip(prompts, n_new)]
+    out = eng.run()
+    return [out[i] for i in ids], eng
+
+
+@pytest.mark.parametrize("kv", [None, "int8"])
+def test_engine_greedy_streams_ragged_equals_bucketed(model, kv):
+    """The acceptance parity: greedy token streams through the ragged
+    kernel are bit-identical to the bucketed path's, bf16-config and
+    int8-KV, over mixed lengths incl. a 1-token prompt and an exact
+    block-boundary prompt."""
+    cfg, params = model
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 64, size=n).tolist() for n in (1, 8, 13)]
+    a, _ = _streams(params, cfg, "bucketed", prompts, (6, 5, 6),
+                    kv_dtype=kv)
+    b, eng = _streams(params, cfg, "ragged", prompts, (6, 5, 6),
+                      kv_dtype=kv)
+    assert a == b
+    assert all(k[0] == "ragged" for k in eng._decode_cache)
+
+
+def test_engine_ragged_prefix_cache_hit_parity(model):
+    """A finished prompt re-sent through the prefix cache (pinned
+    history blocks, suffix-only prefill) must stream the same tokens on
+    both decode paths — the cached history folds into the same
+    true-length walk, no special prefix_nbk axis."""
+    cfg, params = model
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(1, 64, size=17).tolist()
+
+    def run(kernel):
+        eng = LLMEngine(params, cfg, max_slots=2, block_size=8,
+                        max_model_len=64, prompt_buckets=[8, 32],
+                        decode_steps=2, kv_dtype="int8",
+                        prefix_cache=True, decode_kernel=kernel)
+        r1 = eng.add_request(prompt, max_new_tokens=5)
+        eng.run()
+        r2 = eng.add_request(prompt, max_new_tokens=5)  # cache hit
+        out = eng.run()
+        assert eng.prefix_cache.hits >= 1
+        return out[r1], out[r2]
+
+    assert run("bucketed") == run("ragged")
+
+
+def test_engine_ragged_chunked_prefill_parity(model):
+    """Chunked prefill interleaved with decode waves: mid-chunk slots
+    are excluded from the ragged walk (zeroed lengths) until their
+    final chunk lands, and the streams match the bucketed path."""
+    cfg, params = model
+    rng = np.random.default_rng(8)
+    long_p = rng.integers(1, 64, size=26).tolist()
+    short_p = rng.integers(1, 64, size=5).tolist()
+
+    def run(kernel):
+        eng = LLMEngine(params, cfg, max_slots=2, block_size=8,
+                        max_model_len=64, prompt_buckets=[8, 32],
+                        decode_steps=2, prefix_cache=True,
+                        prefill_chunk=8, decode_kernel=kernel)
+        r1 = eng.add_request(short_p, max_new_tokens=8)
+        r2 = eng.add_request(long_p, max_new_tokens=4)
+        out = eng.run()
+        return out[r1], out[r2]
+
+    assert run("bucketed") == run("ragged")
+
+
+def test_engine_ragged_swap_in_parity(model):
+    """Pool pressure preempts the newest slot into the host KV tier;
+    its swap-in restore (bit-exact blocks, no re-prefill) must continue
+    the stream identically under the ragged kernel."""
+    import paddle_tpu.observability as obs
+
+    cfg, params = model
+    rng = np.random.default_rng(6)
+    prompts = [rng.integers(1, 64, size=8).tolist() for _ in range(2)]
+
+    def run(kernel):
+        obs.get_registry().reset()
+        obs.enable()
+        try:
+            eng = LLMEngine(params, cfg, max_slots=2, block_size=8,
+                            max_model_len=64, num_blocks=5,
+                            prompt_buckets=[8], kv_dtype="int8",
+                            kv_swap_bytes=1 << 20, decode_kernel=kernel)
+            ids = [eng.add_request(p, max_new_tokens=16) for p in prompts]
+            out = eng.run()
+            reg = obs.get_registry()
+            assert reg.counter(
+                "serving_kv_swap_in_total").labels().value >= 1
+            return [out[i] for i in ids]
+        finally:
+            obs.disable()
+            obs.get_registry().reset()
+
+    assert run("bucketed") == run("ragged")
+
+
+def test_engine_ragged_one_variant_per_flag_set(model):
+    """The acceptance bound: across mixed and GROWING lengths the ragged
+    decode cache never grows a length axis — exactly one compiled
+    variant per sampling-flag set, while the same workload compiles
+    multiple prefix buckets on the bucketed path."""
+    cfg, params = model
+    rng = np.random.default_rng(7)
+
+    def run(kernel):
+        eng = LLMEngine(params, cfg, max_slots=2, block_size=8,
+                        max_model_len=128, prompt_buckets=[8, 32],
+                        decode_steps=2, decode_kernel=kernel)
+        for i, (n, k) in enumerate(((2, 4), (10, 6), (30, 8))):
+            eng.add_request(rng.integers(1, 64, size=n).tolist(),
+                            max_new_tokens=k)
+            eng.run()          # separate runs force horizon growth
+        return eng
+
+    ragged = run("ragged")
+    assert len(ragged._decode_cache) == 1, sorted(ragged._decode_cache)
+    assert all(k[0] == "ragged" for k in ragged._decode_cache)
+    bucketed = run("bucketed")
+    assert len(bucketed._decode_cache) > 1       # the family ragged kills
+    # a sampled request adds exactly one more flag-set variant
+    ragged.add_request(rng.integers(1, 64, size=5).tolist(),
+                       max_new_tokens=3, temperature=0.9)
+    ragged.run()
+    assert len(ragged._decode_cache) == 2, sorted(ragged._decode_cache)
+
+
+def test_engine_fallback_counted_never_silent(model):
+    """decode_kernel="auto" off-TPU serves the bucketed path and COUNTS
+    it in serving_decode_kernel_total{path}; serving_decode_variants
+    mirrors the compile cache."""
+    import paddle_tpu.observability as obs
+
+    cfg, params = model
+    obs.get_registry().reset()
+    obs.enable()
+    try:
+        eng = LLMEngine(params, cfg, max_slots=2, block_size=8,
+                        max_model_len=128, prompt_buckets=[8])
+        assert not eng._use_ragged()       # CPU backend under tier-1
+        eng.add_request(list(range(1, 6)), max_new_tokens=4)
+        eng.run()
+        reg = obs.get_registry()
+        c = reg.counter("serving_decode_kernel_total")
+        assert c.labels(path="bucketed").value \
+            + c.labels(path="dense").value >= 1
+        assert c.labels(path="ragged").value == 0
+        assert reg.gauge("serving_decode_variants").labels().value \
+            == len(eng._decode_cache) >= 1
+        assert eng.kv_read_bytes_total > 0
+    finally:
+        obs.disable()
+        obs.get_registry().reset()

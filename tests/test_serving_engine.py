@@ -346,6 +346,10 @@ def test_obs_dump_demo_serving_smoke(tmp_path):
     for name in ("serving_decode_prefix_bucket",
                  "serving_decode_recompiles_total",
                  "serving_decode_kv_read_bytes",
+                 # r12: the decode kernel-path counters (this CPU demo
+                 # counts the ragged kernel's bucketed fallback)
+                 "serving_decode_kernel_total",
+                 "serving_decode_variants",
                  # r8: the degraded-mode counters ride the same demo
                  "serving_shed_total",
                  "serving_kv_swap_out_total",
@@ -355,6 +359,9 @@ def test_obs_dump_demo_serving_smoke(tmp_path):
                  "serving_prefill_tokens_skipped_total",
                  "serving_prefix_cache_blocks"):
         assert name in out, (name, out[-2000:])
+    # r12: the kernel-path line — off-TPU the bucketed fallback serves
+    # every dispatch and the ragged count stays 0
+    assert "decode kernel paths: ragged=0" in out, out[-2000:]
     # r8: one shed, one expired deadline, at least one preempt→swap
     assert "load shed: request" in out
     assert "deadline_exceeded=1" in out

@@ -192,7 +192,9 @@ def requests_mode(src, sort, watch, interval):
 
 def demo_serving():
     """int8-everywhere serving demo under fire: int8 weight-only params
-    AND int8 KV pools through the ragged prefix-bucketed decode path,
+    AND int8 KV pools through the decode path (off-TPU this counts the
+    bucketed fallback of the r12 ragged kernel in
+    serving_decode_kernel_total{path} — the choice is never silent),
     with the r8 survivability layer engaged — a bounded admission queue
     sheds the over-offered request, one request expires at its deadline,
     and pool pressure preempts a slot whose KV swaps to the host tier
@@ -259,6 +261,17 @@ def demo_serving():
 
     def _c(name, **lbl):
         return int(reg.counter(name).labels(**lbl).value)
+
+    # r12: which attention path served the decode dispatches (the ragged
+    # Pallas kernel is the TPU default; this CPU demo counts its
+    # bucketed fallback — the choice is never silent) and how many
+    # compiled decode variants the cache holds
+    print("decode kernel paths: "
+          f"ragged={_c('serving_decode_kernel_total', path='ragged')} "
+          f"bucketed={_c('serving_decode_kernel_total', path='bucketed')} "
+          f"dense={_c('serving_decode_kernel_total', path='dense')}; "
+          "decode variants: "
+          f"{int(reg.gauge('serving_decode_variants').labels().value)}")
 
     print("degraded modes: "
           f"shed={_c('serving_shed_total', reason='queue_full')} "
