@@ -779,6 +779,72 @@ def bench_serving(dev, results):
             "decode_variants_bucketed": int(var_b),
         }))
 
+    def attempt_spec(make_params):
+        """Speculative-decoding row (r13): draft-then-verify vs the
+        plain engine on the SAME greedy workload. The draft is the
+        int8-quantized target (same config) — the nncase pairing: ~half
+        the weight bytes per draft step on a bandwidth-bound chip, with
+        near-1 acceptance because it IS the target modulo quantization
+        error. Reports kept tok/s (vs_baseline = spec/plain), the
+        measured acceptance rate, committed tokens per verify call, and
+        the draft/verify step counts — the evidence bench_diff --check
+        guards from the next chip round."""
+        from paddle_tpu.models import llama as _llama
+        params = make_params()
+        draft = jax.jit(_llama.quantize_params)(params)
+        new_tok = 96
+        rng0 = np.random.default_rng(0)
+        reqs = [rng0.integers(1, 32768, size=int(ln)).tolist()
+                for ln in rng0.integers(64, 448, size=2 * SLOTS)]
+
+        def run(spec_on):
+            eng = LLMEngine(params, cfg, max_slots=SLOTS, block_size=64,
+                            max_model_len=1024,
+                            prompt_buckets=[128, 512, 1024],
+                            decode_steps=16,
+                            draft_params=draft if spec_on else None,
+                            draft_config=cfg if spec_on else None,
+                            spec_tokens=6)
+            # one untimed pass compiles every prefill bucket and every
+            # draft/verify (or decode) variant the workload touches
+            for p in reqs:
+                eng.add_request(p, max_new_tokens=new_tok,
+                                temperature=0.0)
+            eng.run()
+            base = (eng.spec_proposed, eng.spec_accepted,
+                    eng.spec_committed, eng.spec_verify_calls,
+                    eng.spec_draft_steps)
+            t0 = time.perf_counter()
+            rids = [eng.add_request(p, max_new_tokens=new_tok,
+                                    temperature=0.0) for p in reqs]
+            out = eng.run()
+            dt = time.perf_counter() - t0
+            gen = sum(len(out[r]) for r in rids)
+            stats = dict(proposed=eng.spec_proposed - base[0],
+                         accepted=eng.spec_accepted - base[1],
+                         committed=eng.spec_committed - base[2],
+                         verify_calls=eng.spec_verify_calls - base[3],
+                         draft_steps=eng.spec_draft_steps - base[4])
+            return gen / dt, stats
+
+        tps_off, _ = run(spec_on=False)
+        _release()
+        tps_on, st = run(spec_on=True)
+        results.append(_efficiency({
+            "metric": "llama-2.6b_serving_spec_tokens_per_sec",
+            "value": round(tps_on, 1),
+            "unit": "tokens/s",
+            # acceptance (ROADMAP 4): >= 1.5x at acceptance >= 60%
+            "vs_baseline": round(tps_on / max(tps_off, 1e-9), 4),
+            "spec_off_tokens_per_sec": round(tps_off, 1),
+            "acceptance_rate": round(
+                st["accepted"] / max(1, st["proposed"]), 3),
+            "tokens_per_verify": round(
+                st["committed"] / max(1, st["verify_calls"]), 2),
+            "draft_steps": int(st["draft_steps"]),
+            "verify_calls": int(st["verify_calls"]),
+        }))
+
     try:
         _retry(lambda: attempt("bf16", lambda: _init_bf16_params(cfg)))
         _release()
@@ -812,6 +878,10 @@ def bench_serving(dev, results):
         # vs the bucketed path on the same workload (ISSUE 12 row)
         _retry(lambda: attempt_mixedlen(
             lambda: jax.jit(llama.quantize_params)(_init_bf16_params(cfg))))
+        _release()
+        # speculative decoding: int8 draft / bf16 target, spec on vs
+        # off on the same greedy workload (ISSUE 13 row, ROADMAP 4)
+        _retry(lambda: attempt_spec(lambda: _init_bf16_params(cfg)))
     except Exception as e:
         results.append({"metric": "serving_bench_failed", "value": 0.0,
                         "unit": "tokens/s", "vs_baseline": 0.0,

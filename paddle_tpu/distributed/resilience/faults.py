@@ -30,8 +30,12 @@ __all__ = ["FaultInjector", "SimulatedCrash", "FAULT_KINDS",
 # decode readback (SimulatedCrash — ResilientEngine's recovery surface),
 # slow_step stalls one engine step host-side (SLO/watchdog pressure),
 # pool_squeeze steals half the free KV blocks for two steps (external
-# pool pressure — the preemption/swap path's trigger)
-SERVING_FAULT_KINDS = ("readback_fail", "slow_step", "pool_squeeze")
+# pool pressure — the preemption/swap path's trigger), spec_verify_fail
+# crashes a speculative wave between its verify dispatch and readback
+# (nothing of the wave is host-visible yet: recovery must roll back to
+# the last committed token with zero emitted-stream divergence)
+SERVING_FAULT_KINDS = ("readback_fail", "slow_step", "pool_squeeze",
+                       "spec_verify_fail")
 
 # nan_inject poisons ONE named layer group of the model state for one
 # attempt (the forward then goes NaN from that layer on) — the seeded,

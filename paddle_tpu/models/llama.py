@@ -42,7 +42,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..observability import numerics as _numerics
 
 __all__ = [
-    "LlamaConfig", "llama3_8b", "tiny_llama", "init_params", "forward",
+    "LlamaConfig", "llama3_8b", "tiny_llama", "draft_config",
+    "init_params", "forward",
     "loss_fn", "param_specs", "make_shardings", "make_serving_shardings",
     "num_params",
     "TrainState", "init_train_state", "train_step", "make_mesh",
@@ -107,6 +108,40 @@ def tiny_llama(vocab=256, hidden=64, layers=2, heads=4, kv_heads=2,
         num_layers=layers, num_heads=heads, num_kv_heads=kv_heads,
         head_dim=hidden // heads, max_seq_len=seq, remat=False,
         use_flash=False)
+
+
+def draft_config(target: LlamaConfig, *, num_layers: Optional[int] = None,
+                 hidden_size: Optional[int] = None,
+                 intermediate_size: Optional[int] = None,
+                 num_heads: Optional[int] = None,
+                 num_kv_heads: Optional[int] = None,
+                 head_dim: Optional[int] = None) -> LlamaConfig:
+    """A draft-model config compatible with ``target`` for speculative
+    decoding (serving/engine.py r13): same vocabulary (the two models
+    MUST share a tokenizer — the engine enforces it), same max context
+    and compute dtype, with the capacity knobs shrunk. Defaults halve
+    the depth and width — the classic ~1/8-cost draft; RoPE theta is
+    inherited (a draft is free to differ, but keeping it makes a
+    layer-sliced or distilled draft's positional geometry line up).
+
+    >>> dcfg = llama.draft_config(cfg, num_layers=4)
+    >>> eng = LLMEngine(params, cfg, draft_params=dp, draft_config=dcfg)
+    """
+    t = target
+    hidden = hidden_size if hidden_size is not None else t.hidden_size // 2
+    heads = num_heads if num_heads is not None else max(1, t.num_heads // 2)
+    return dataclasses.replace(
+        t,
+        num_layers=(num_layers if num_layers is not None
+                    else max(1, t.num_layers // 2)),
+        hidden_size=hidden,
+        intermediate_size=(intermediate_size if intermediate_size
+                           is not None else t.intermediate_size // 2),
+        num_heads=heads,
+        num_kv_heads=(num_kv_heads if num_kv_heads is not None
+                      else max(1, min(t.num_kv_heads, heads))),
+        head_dim=(head_dim if head_dim is not None else hidden // heads),
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -843,9 +878,17 @@ def _cached_attention(q, k_cache, v_cache, pos, config: LlamaConfig):
     return out.reshape(B, S, Hq, D)
 
 
-def forward_with_cache(params, tokens, cache, config: LlamaConfig):
+def forward_with_cache(params, tokens, cache, config: LlamaConfig,
+                       logits_all: bool = False):
     """Append `tokens` [B, S_new] to the cache, return (logits_last, cache).
-    Works for prefill (S_new = prompt len) and decode (S_new = 1)."""
+    Works for prefill (S_new = prompt len) and decode (S_new = 1).
+
+    ``logits_all=True`` returns logits at EVERY position ([B, S_new,
+    vocab] instead of [B, vocab]) — the speculative-decoding verify
+    primitive: score a piece of k draft tokens in one batched forward
+    and read the model's next-token distribution after each of them
+    (serving/engine.py runs the paged-pool analogue; this is the
+    fixed-batch reference the parity tests check against)."""
     c = config
     dt = c.dtype
     B, S = tokens.shape
@@ -889,10 +932,11 @@ def forward_with_cache(params, tokens, cache, config: LlamaConfig):
         x = x + _wo_mm(gate * _wo_mm(hn, p["w_up"], dt), p["w_down"], dt)
 
     x = _rms_norm(x, params["final_norm"], c.rms_eps)
+    xh = x if logits_all else x[:, -1]
     if c.tie_embeddings:
-        logits = (x[:, -1] @ params["embed"].astype(dt).T).astype(jnp.float32)
+        logits = (xh @ params["embed"].astype(dt).T).astype(jnp.float32)
     else:
-        logits = _wo_mm(x[:, -1], params["lm_head"], dt).astype(jnp.float32)
+        logits = _wo_mm(xh, params["lm_head"], dt).astype(jnp.float32)
     cache = {"k": ck, "v": cv, "pos": pos + S}
     return logits, cache
 

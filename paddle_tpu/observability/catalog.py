@@ -64,6 +64,23 @@ CATALOG = {
         "gauge", (), "compiled decode program variants currently cached "
                      "(ragged path: exactly one per (batch, "
                      "sampling-flags) set — test-enforced)"),
+    # -- serving speculative decoding (r13, draft-then-verify waves) -------
+    "serving_spec_proposed_total": (
+        "counter", (), "draft tokens proposed to the target's batched "
+                       "verify (spec_tokens per slot per wave, clamped "
+                       "to each slot's remaining budget)"),
+    "serving_spec_accepted_total": (
+        "counter", (), "proposed draft tokens the target's greedy "
+                       "verify agreed with (the accepted prefix; "
+                       "acceptance rate = accepted / proposed)"),
+    "serving_spec_acceptance_rate": (
+        "gauge", (), "cumulative draft-token acceptance rate "
+                     "(accepted / proposed since engine start) — the "
+                     "speculative speedup's one load-bearing number"),
+    "serving_spec_tokens_per_wave": (
+        "gauge", (), "cumulative committed tokens per draft-verify "
+                     "wave (> 1 means each target verify call emits "
+                     "more than one token — the mechanism working)"),
     # -- serving survivability (admission, deadlines, kv_swap, recovery) ---
     "serving_shed_total": (
         "counter", ("reason",),
@@ -299,6 +316,10 @@ SPANS = (
     # finish) whose request_id arg lets Perfetto filter a single
     # request's lifetime out of /trace.json
     "serving.request",
+    # speculative decoding (r13): one spec_draft (the k-step draft
+    # proposal call) + one spec_verify (the batched target scoring
+    # call) per wave, nested inside serving.step
+    "serving.spec_draft", "serving.spec_verify",
 )
 
 

@@ -21,6 +21,15 @@ radix index over the block pool so shared system prompts and multi-turn
 prefixes skip prefill (LRU eviction at refcount 0, host spill/restore),
 and fixed-token prefill chunks interleaved with decode waves so TTFT
 stays bounded under mixed traffic — see docs/serving.md §Prefix caching.
+
+Draft-model speculative decoding (engine.py, r13): the engine hosts a
+second, smaller llama (``draft_params``/``draft_config``) whose KV pools
+share the target's physical blocks; greedy decode waves run
+draft-then-verify — k draft proposals scored by ONE batched
+prefill-shaped target call, longest agreeing prefix committed — for up
+to ``spec_tokens`` tokens per target forward with token streams exactly
+equal to non-speculative greedy — see docs/serving.md §Speculative
+decoding.
 """
 from .admission import (AdmissionConfig, AdmissionController, ShedError,
                         TokenBucket)

@@ -59,6 +59,23 @@ TPU-native design — everything the chip executes has STATIC shapes:
   ``prefill_chunk=K`` splits long suffixes into K-token chunks fed one
   per step between decode waves, so prefill cost scales with NEW tokens
   and never monopolizes a step.
+- Draft-model speculative decoding (optional, r13): the engine hosts a
+  SECOND, smaller llama (``draft_params``/``draft_config``) whose KV
+  pools ride in the same pool dict under ``dk``/``dv`` keys, indexed by
+  the SAME physical block ids as the target pools — one block backs
+  both models' KV for its token range, so the block ledger, the prefix
+  cache's spill/restore, preemption swap and crash recovery all cover
+  the draft for free. Per greedy decode wave the draft autoregressively
+  proposes ``spec_tokens`` tokens per slot (the existing ``_paged_decode``
+  program at draft scale), the target scores all proposals in ONE
+  batched prefill-shaped verify call (``_spec_verify``: dense history
+  gather + causal in-piece attention, greedy argmax at every position),
+  and the host commits the longest agreeing prefix — decode cost per
+  committed token approaches draft cost + 1/k of a verify, instead of
+  one full target pass per token. Rejected-suffix KV (both pools) rolls
+  back by the length invariant: positions >= ``lengths`` are never read
+  and the next wave overwrites them. ``spec=False`` or no draft leaves
+  the one-token path byte-identical.
 """
 from __future__ import annotations
 
@@ -115,6 +132,10 @@ _M_DEADLINE = _instrument("serving_deadline_exceeded_total")
 _M_SWAP_FALLBACK = _instrument("serving_kv_swap_fallback_total")
 _M_DECODE_KERNEL = _instrument("serving_decode_kernel_total")
 _M_DECODE_VARIANTS = _instrument("serving_decode_variants")
+_M_SPEC_PROPOSED = _instrument("serving_spec_proposed_total")
+_M_SPEC_ACCEPTED = _instrument("serving_spec_accepted_total")
+_M_SPEC_ACCEPT_RATE = _instrument("serving_spec_acceptance_rate")
+_M_SPEC_TOKENS_PER_WAVE = _instrument("serving_spec_tokens_per_wave")
 
 
 @dataclasses.dataclass
@@ -207,7 +228,8 @@ def _paged_prefill(params, tokens, blk_ids, true_len, pools,
                    temps, top_ks, top_ps, key, hist_len=None,
                    ctx_tbl=None, *, config: LlamaConfig,
                    sample_flags=(True, True, True), kv_int8: bool = False,
-                   numerics: bool = False, prefix_nbk: int = 0):
+                   numerics: bool = False, prefix_nbk: int = 0,
+                   kv_prefix: str = ""):
     """Prefill a WAVE of admissions in one compiled program: causal
     forward over the padded prompt batch, every layer's K/V written into
     the slots' pool blocks by ONE batched scatter, and each request's
@@ -249,11 +271,21 @@ def _paged_prefill(params, tokens, blk_ids, true_len, pools,
     original full-prompt prefill, bit for bit — cold traffic never pays
     for the feature. The compiled family stays bounded: (prompt bucket)
     x (2 batch forms) x (<= 8 flag tuples) x (log2 history buckets).
+
+    ``kv_prefix`` (r13 speculative decoding) selects which pool entries
+    this program reads/writes: ``""`` = the target model's ``k``/``v``
+    (plus ``ks``/``vs`` under int8), ``"d"`` = the draft model's
+    ``dk``/``dv``. The draft prefill is the SAME program over the draft
+    params/config, dispatched right after the target's so both models'
+    KV cover every prefilled position (the draft's sampled token is
+    discarded — the target samples the stream).
     """
     c = config
     dt = c.dtype
+    pk, pv = kv_prefix + "k", kv_prefix + "v"
+    pks, pvs = kv_prefix + "ks", kv_prefix + "vs"
     B, S = tokens.shape
-    bs = pools["k"].shape[2]
+    bs = pools[pk].shape[2]
     nb = S // bs
     x = params["embed"].astype(dt)[tokens]
     freq = c.rope_theta ** (-jnp.arange(0, c.head_dim, 2, jnp.float32)
@@ -272,11 +304,11 @@ def _paged_prefill(params, tokens, blk_ids, true_len, pools,
         # one dense gather of every row's history (the decode hoist,
         # applied to prefill); int8 pools dequantize here — prefill is
         # compute-bound, the simple form wins over fused-scale dots
-        kpre = pools["k"][:, ctx_tbl].reshape(Lc, B, Pp, Hkv, D)
-        vpre = pools["v"][:, ctx_tbl].reshape(Lc, B, Pp, Hkv, D)
+        kpre = pools[pk][:, ctx_tbl].reshape(Lc, B, Pp, Hkv, D)
+        vpre = pools[pv][:, ctx_tbl].reshape(Lc, B, Pp, Hkv, D)
         if kv_int8:
-            ksc = pools["ks"][:, ctx_tbl].reshape(Lc, B, Pp, Hkv)
-            vsc = pools["vs"][:, ctx_tbl].reshape(Lc, B, Pp, Hkv)
+            ksc = pools[pks][:, ctx_tbl].reshape(Lc, B, Pp, Hkv)
+            vsc = pools[pvs][:, ctx_tbl].reshape(Lc, B, Pp, Hkv)
             kpre = kpre.astype(dt) * ksc[..., None].astype(dt)
             vpre = vpre.astype(dt) * vsc[..., None].astype(dt)
         # [B,1,1,1,Pp] over scores [B,Hkv,G,S,Pp]
@@ -352,13 +384,13 @@ def _paged_prefill(params, tokens, blk_ids, true_len, pools,
             # numerics_quant_error{site="kv_int8"} error budget
             _nm.record_quant_error("kv_int8", [(k_stack, qk, sk, -1),
                                                (v_stack, qv, sv, -1)])
-        pools["k"] = pools["k"].at[:, flat].set(qk)
-        pools["v"] = pools["v"].at[:, flat].set(qv)
-        pools["ks"] = pools["ks"].at[:, flat].set(sk)
-        pools["vs"] = pools["vs"].at[:, flat].set(sv)
+        pools[pk] = pools[pk].at[:, flat].set(qk)
+        pools[pv] = pools[pv].at[:, flat].set(qv)
+        pools[pks] = pools[pks].at[:, flat].set(sk)
+        pools[pvs] = pools[pvs].at[:, flat].set(sv)
     else:
-        pools["k"] = pools["k"].at[:, flat].set(k_stack)
-        pools["v"] = pools["v"].at[:, flat].set(v_stack)
+        pools[pk] = pools[pk].at[:, flat].set(k_stack)
+        pools[pv] = pools[pv].at[:, flat].set(v_stack)
 
     x = _rms_norm(x, params["final_norm"], c.rms_eps)
     last_h = x[jnp.arange(B), jnp.maximum(true_len - 1, 0)]
@@ -374,7 +406,8 @@ def _paged_decode(params, last_tokens, lengths, done0, budgets, key, active,
                   block_table, pools, temps, top_ks, top_ps,
                   eos_ids, *, config: LlamaConfig, n_steps: int,
                   sample_flags=(True, True, True), kv_int8: bool = False,
-                  numerics: bool = False, ragged: bool = False):
+                  numerics: bool = False, ragged: bool = False,
+                  kv_prefix: str = ""):
     """``n_steps`` decode iterations in ONE compiled program (multi-step
     scheduling): the host loop syncs once per call instead of once per
     token — through a remote-attached chip the per-step d2h round-trip
@@ -437,12 +470,20 @@ def _paged_decode(params, last_tokens, lengths, done0, budgets, key, active,
     eos_ids: [N] (-1 = no eos); budgets: [N] tokens each slot may still
     emit. Returns (emitted [n_steps, N] int32 with -1 padding, last,
     lengths, done, budgets, key, pools).
+
+    ``kv_prefix`` (r13): ``"d"`` runs this program as the speculative
+    DRAFT proposal loop — draft params/config, greedy flags, the draft's
+    ``dk``/``dv`` pool entries — reusing the identical ragged/bucketed
+    machinery at draft scale. Target pool entries pass through the
+    donated dict untouched.
     """
     c = config
     dt = c.dtype
+    pk, pv = kv_prefix + "k", kv_prefix + "v"
+    pks, pvs = kv_prefix + "ks", kv_prefix + "vs"
     Lc = c.num_layers
     N, MB = block_table.shape
-    k_pool, v_pool = pools["k"], pools["v"]
+    k_pool, v_pool = pools[pk], pools[pv]
     bs = k_pool.shape[2]
     Hkv, D = k_pool.shape[3], k_pool.shape[4]
     G = c.num_heads // c.num_kv_heads
@@ -462,8 +503,8 @@ def _paged_decode(params, last_tokens, lengths, done0, budgets, key, active,
         kd = k_pool[:, block_table].reshape(Lc, N, P, Hkv, D)
         vd = v_pool[:, block_table].reshape(Lc, N, P, Hkv, D)
         if kv_int8:
-            ksc = pools["ks"][:, block_table].reshape(Lc, N, P, Hkv)
-            vsc = pools["vs"][:, block_table].reshape(Lc, N, P, Hkv)
+            ksc = pools[pks][:, block_table].reshape(Lc, N, P, Hkv)
+            vsc = pools[pvs][:, block_table].reshape(Lc, N, P, Hkv)
         pre_mask = (jnp.arange(P)[None, :]
                     < lens0[:, None])[:, None, None, :]   # [N,1,1,P]
 
@@ -518,9 +559,9 @@ def _paged_decode(params, last_tokens, lengths, done0, budgets, key, active,
                 # computed blockwise (exact up to f32 rounding). The
                 # ring always holds >= 1 live position, so l_tot >= 1.
                 acc_p, m_p, l_p = ragged_decode_partial(
-                    q, pools["k"], pools["v"], block_table, walk_lens,
-                    layer=l, ks_pool=pools.get("ks"),
-                    vs_pool=pools.get("vs"))
+                    q, pools[pk], pools[pv], block_table, walk_lens,
+                    layer=l, ks_pool=pools.get(pks),
+                    vs_pool=pools.get(pvs))
                 m_tot = jnp.maximum(m_p, jnp.max(s_rng, axis=-1))
                 corr = jnp.exp(m_p - m_tot)
                 p_rng = jnp.exp(s_rng - m_tot[..., None])
@@ -586,14 +627,174 @@ def _paged_decode(params, last_tokens, lengths, done0, budgets, key, active,
             # ring is small — the reduction is noise next to the scan)
             _nm.record_quant_error("kv_int8", [(ring_k, rq_k, rs_k, -1),
                                                (ring_v, rq_v, rs_v, -1)])
-        pools["k"] = pools["k"].at[:, phys, off].set(rq_k)
-        pools["v"] = pools["v"].at[:, phys, off].set(rq_v)
-        pools["ks"] = pools["ks"].at[:, phys, off].set(rs_k)
-        pools["vs"] = pools["vs"].at[:, phys, off].set(rs_v)
+        pools[pk] = pools[pk].at[:, phys, off].set(rq_k)
+        pools[pv] = pools[pv].at[:, phys, off].set(rq_v)
+        pools[pks] = pools[pks].at[:, phys, off].set(rs_k)
+        pools[pvs] = pools[pvs].at[:, phys, off].set(rs_v)
     else:
-        pools["k"] = pools["k"].at[:, phys, off].set(ring_k)
-        pools["v"] = pools["v"].at[:, phys, off].set(ring_v)
+        pools[pk] = pools[pk].at[:, phys, off].set(ring_k)
+        pools[pv] = pools[pv].at[:, phys, off].set(ring_v)
     return (emitted, last_tokens, lens_end, done0, budgets, key, pools)
+
+
+def _spec_verify(params, block_table, last, draft_toks, lengths, active,
+                 pools, *, config: LlamaConfig, n_spec: int,
+                 kv_int8: bool = False, numerics: bool = False,
+                 max_model_len: int = 0):
+    """Score a speculative wave in ONE target forward: for every slot the
+    piece ``[last, d_1 .. d_k]`` (k = ``n_spec``) runs a prefill-shaped
+    pass against the slot's resident KV — the chunked-prefill program's
+    structure (dense history gather over the power-of-two ``block_table``
+    bucket, per-row RoPE offsets at ``lengths``, softmax over
+    [masked history ; causal in-piece]) at the fixed piece width k+1 —
+    and returns the target's GREEDY token at ALL k+1 positions:
+    ``out[b, j]`` is what the target would emit after consuming piece
+    token j. The host accepts the longest prefix where the draft agreed
+    (MPK's collapse-many-small-launches argument: k draft steps verify
+    in one launch whose arithmetic intensity is prefill's, not
+    decode's).
+
+    Writeback is decode-shaped, not prefill-shaped: pieces start at
+    ``lengths[b]``, which is NOT block-aligned mid-decode, so each
+    position scatters individually via its (physical block, offset)
+    pair. ALL k+1 positions write — a later host commit of c <= k
+    tokens simply leaves positions >= lengths+c stale, which the length
+    invariant makes unreadable and the next wave overwrites (that IS
+    the rejected-suffix rollback). Inactive rows and positions past
+    ``max_model_len`` divert to trash block 0.
+
+    draft_toks: [k, N] (the draft call's emitted grid, fed back without
+    a host round-trip); returns (greedy [N, k+1] int32, pools).
+    """
+    c = config
+    dt = c.dtype
+    N, nbk = block_table.shape
+    S = n_spec + 1
+    bs = pools["k"].shape[2]
+    Lc, Hkv, D = c.num_layers, c.num_kv_heads, c.head_dim
+    G = c.num_heads // c.num_kv_heads
+    Pp = nbk * bs
+    scale = 1.0 / math.sqrt(D)
+
+    tokens = jnp.concatenate(
+        [last[:, None], draft_toks.T.astype(jnp.int32)], axis=1)  # [N, S]
+    tokens = jnp.clip(tokens, 0, c.vocab_size - 1)   # -1 pads embed-safe
+    hist = jnp.where(active, lengths.astype(jnp.int32), 0)
+
+    x = params["embed"].astype(dt)[tokens]
+    freq = c.rope_theta ** (-jnp.arange(0, c.head_dim, 2, jnp.float32)
+                            / c.head_dim)
+    pos = (hist.astype(jnp.float32)[:, None]
+           + jnp.arange(S, dtype=jnp.float32)[None, :])
+    ang = pos[:, :, None] * freq[None, None, :]       # [N, S, D/2]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    pre_mask = (jnp.arange(Pp)[None, :]
+                < hist[:, None])[:, None, None, None, :]
+    in_mask = jnp.tril(jnp.ones((S, S), bool))[None, None, None]
+
+    k_all, v_all = [], []
+    for l in range(Lc):
+        p = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
+        hn = _rms_norm(x, p["attn_norm"], c.rms_eps)
+        q = _wo_mm(hn, p["wq"], dt).reshape(N, S, c.num_heads, D)
+        k = _wo_mm(hn, p["wk"], dt).reshape(N, S, Hkv, D)
+        v = _wo_mm(hn, p["wv"], dt).reshape(N, S, Hkv, D)
+        q = _apply_rope_at(q, cos, sin)
+        k = _apply_rope_at(k, cos, sin)
+        k_all.append(k)
+        v_all.append(v)
+        # the prefill piece attention verbatim: int8 history dequantizes
+        # up front (verify is prefill-shaped — compute-bound, the simple
+        # form wins over fused-scale dots)
+        kpre = pools["k"][l][block_table].reshape(N, Pp, Hkv, D)
+        vpre = pools["v"][l][block_table].reshape(N, Pp, Hkv, D)
+        if kv_int8:
+            ksc = pools["ks"][l][block_table].reshape(N, Pp, Hkv)
+            vsc = pools["vs"][l][block_table].reshape(N, Pp, Hkv)
+            kpre = kpre.astype(dt) * ksc[..., None].astype(dt)
+            vpre = vpre.astype(dt) * vsc[..., None].astype(dt)
+        qg = q.reshape(N, S, Hkv, G, D)
+        s_pre = jnp.einsum("bshgd,bphd->bhgsp", qg, kpre,
+                           preferred_element_type=jnp.float32) * scale
+        if kv_int8:
+            # in-piece K/V BELOW the diagonal must read as the
+            # step-wise decode path would read them: from the pool,
+            # int8-quantized. Round-trip the piece through quantize_kv
+            # (the exact writeback transform) for t < s; the diagonal
+            # (each position's own K/V — the decode ring) stays raw.
+            # Without this, verify attends unquantized neighbors and
+            # the ~1% quant delta can flip near-tie argmaxes vs the
+            # non-speculative stream.
+            qk_p, sk_p = quantize_kv(k)
+            qv_p, sv_p = quantize_kv(v)
+            k_rt = qk_p.astype(dt) * sk_p[..., None].astype(dt)
+            v_rt = qv_p.astype(dt) * sv_p[..., None].astype(dt)
+        else:
+            k_rt, v_rt = k, v
+        s_in = jnp.einsum("bshgd,bthd->bhgst", qg, k_rt,
+                          preferred_element_type=jnp.float32) * scale
+        if kv_int8:
+            eye = jnp.eye(S, dtype=bool)[None, None, None]
+            s_diag = jnp.einsum("bshgd,bshd->bhgs", qg, k,
+                                preferred_element_type=jnp.float32) \
+                * scale
+            s_in = jnp.where(eye, s_diag[..., None], s_in)
+        s_pre = jnp.where(pre_mask, s_pre, -1e30)
+        s_in = jnp.where(in_mask, s_in, -1e30)
+        probs = jax.nn.softmax(
+            jnp.concatenate([s_pre, s_in], axis=-1), axis=-1)
+        p_in = probs[..., Pp:].astype(dt)
+        if kv_int8:
+            eye_f = jnp.eye(S, dtype=p_in.dtype)[None, None, None]
+            att_in = (jnp.einsum("bhgst,bthd->bshgd",
+                                 p_in * (1 - eye_f), v_rt)
+                      + jnp.einsum("bhgs,bshd->bshgd",
+                                   jnp.sum(p_in * eye_f, -1), v))
+        else:
+            att_in = jnp.einsum("bhgst,bthd->bshgd", p_in, v)
+        att = jnp.einsum("bhgsp,bphd->bshgd",
+                         probs[..., :Pp].astype(dt), vpre) + att_in
+        att = att.reshape(N, S, c.num_heads * D).astype(dt)
+        x = x + _wo_mm(att, p["wo"], dt)
+        hn = _rms_norm(x, p["mlp_norm"], c.rms_eps)
+        gate = jax.nn.silu(_wo_mm(hn, p["w_gate"], dt))
+        x = x + _wo_mm(gate * _wo_mm(hn, p["w_up"], dt), p["w_down"], dt)
+
+    # positional writeback (the decode ring's scatter at piece width):
+    # invalid lanes — inactive rows, positions past max_model_len —
+    # divert to the trash block
+    j = jnp.arange(S)[None, :]
+    wpos = hist[:, None] + j                              # [N, S]
+    valid = active[:, None] & (wpos < max_model_len)
+    wposc = jnp.minimum(wpos, max_model_len - 1)
+    log_blk = jnp.minimum(wposc // bs, nbk - 1)
+    phys = jnp.take_along_axis(block_table, log_blk, axis=1)
+    phys = jnp.where(valid, phys, 0)
+    off = wposc % bs
+    k_stack = jnp.stack(k_all)                            # [L, N, S, Hkv, D]
+    v_stack = jnp.stack(v_all)
+    pools = dict(pools)
+    if kv_int8:
+        qk, sk = quantize_kv(k_stack)
+        qv, sv = quantize_kv(v_stack)
+        if numerics:
+            # verify-writeback rung of the kv_int8 error budget
+            _nm.record_quant_error("kv_int8", [(k_stack, qk, sk, -1),
+                                               (v_stack, qv, sv, -1)])
+        pools["k"] = pools["k"].at[:, phys, off].set(qk)
+        pools["v"] = pools["v"].at[:, phys, off].set(qv)
+        pools["ks"] = pools["ks"].at[:, phys, off].set(sk)
+        pools["vs"] = pools["vs"].at[:, phys, off].set(sv)
+    else:
+        pools["k"] = pools["k"].at[:, phys, off].set(k_stack)
+        pools["v"] = pools["v"].at[:, phys, off].set(v_stack)
+
+    x = _rms_norm(x, params["final_norm"], c.rms_eps)
+    if c.tie_embeddings:
+        logits = (x @ params["embed"].astype(dt).T).astype(jnp.float32)
+    else:
+        logits = _wo_mm(x, params["lm_head"], dt).astype(jnp.float32)
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), pools
 
 
 # ---------------------------------------------------------------------------
@@ -618,7 +819,9 @@ class LLMEngine:
                  admission=None, kv_swap_bytes: int = 0, injector=None,
                  prefix_cache: bool = False, prefill_chunk: int = 0,
                  prefix_cache_host_bytes: int = 0,
-                 decode_kernel: str = "auto"):
+                 decode_kernel: str = "auto",
+                 draft_params=None, draft_config: Optional[LlamaConfig]
+                 = None, spec_tokens: int = 4, spec: bool = True):
         """``params`` may be dense (bf16/f32) or int8 weight-only
         (llama.quantize_params) — quantized leaves feed the decode/prefill
         matmuls unconverted (kernels/quant_matmul.weight_only_matmul).
@@ -692,13 +895,34 @@ class LLMEngine:
         cache, chunked prefill, swap and the numerics probes; greedy
         token streams are parity-tested identical.
 
+        ``draft_params`` / ``draft_config``: a second, smaller llama —
+        the speculative DRAFT (r13). Greedy decode waves then run
+        draft-then-verify: the draft proposes ``spec_tokens`` tokens per
+        slot (one multi-step draft call), the target verifies all of
+        them in one prefill-shaped batched call, and the longest
+        agreeing prefix commits — up to ``spec_tokens`` tokens per
+        target forward, token streams EXACTLY the non-speculative
+        greedy streams. The draft must share the target's vocabulary;
+        its KV pools ride in the same pool dict (``dk``/``dv``) over
+        the same physical blocks, so the ledger, prefix cache, swap
+        tier and crash recovery need no draft-aware changes. Waves with
+        any sampled (temperature>0) slot, or slots whose draft KV fell
+        behind, fall back to the normal decode path — never wrong,
+        at worst unaccelerated. ``spec=False`` disables the machinery
+        entirely (no draft pools, byte-identical engine).
+
         Pipelining caveat: the engine dispatches call k+1 before reading
         call k's tokens only when every in-flight slot is GUARANTEED
         alive through call k (``_spec_safe``) — which requires
         ``eos_token_id`` unset, since an eos can finish a slot at any
         step. Workloads where every request carries an eos run with a
         synchronous readback between decode calls instead;
-        ``decode_steps`` remains the amortization lever there."""
+        ``decode_steps`` remains the amortization lever there.
+        Speculative waves are the exception either way: acceptance is a
+        host decision, so a spec wave DRAINS the pipeline and syncs
+        once per wave — the draft/verify pair replaces multi-step
+        chaining as the round-trip amortizer (and, unlike the chained
+        path, composes with per-request eos)."""
         c = config
         assert max_model_len % block_size == 0
         self.params = params
@@ -739,6 +963,39 @@ class LLMEngine:
         else:
             self.pools = {"k": jnp.zeros(pool_shape, c.dtype),
                           "v": jnp.zeros(pool_shape, c.dtype)}
+        # -- speculative decoding (r13): the optional draft model --------
+        self._spec_on = spec and draft_params is not None
+        self.spec_k = int(spec_tokens)
+        self.draft_params = draft_params if self._spec_on else None
+        self.draft_config = draft_config if self._spec_on else None
+        if self._spec_on:
+            if draft_config is None:
+                raise ValueError(
+                    "draft_params requires a draft_config")
+            if draft_config.vocab_size != c.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_config.vocab_size} != target "
+                    f"vocab {c.vocab_size} — the two models must share "
+                    "a tokenizer")
+            if self.spec_k < 1:
+                raise ValueError(
+                    f"spec_tokens must be >= 1, got {spec_tokens}")
+            if mesh is not None:
+                raise NotImplementedError(
+                    "speculative decoding does not compose with a tp "
+                    "mesh yet — serve the draft pair unsharded")
+            dc = draft_config
+            # draft KV pools share the target's physical block grid
+            # (same nb/bs, same block ids): one block backs BOTH
+            # models' KV for its token range, so block accounting,
+            # prefix-cache spill/restore, preemption swap and crash
+            # recovery cover the draft with zero new bookkeeping. Draft
+            # pools stay in the draft dtype (the draft is small — int8
+            # draft WEIGHTS are the bandwidth lever, not its KV).
+            dshape = (dc.num_layers, self.nb, block_size,
+                      dc.num_kv_heads, dc.head_dim)
+            self.pools["dk"] = jnp.zeros(dshape, dc.dtype)
+            self.pools["dv"] = jnp.zeros(dshape, dc.dtype)
         self.mesh = mesh
         if mesh is not None:
             from jax.sharding import NamedSharding
@@ -878,6 +1135,24 @@ class LLMEngine:
         # slots mid-chunked-prefill: slot -> {"ctx", "pos", "rid"};
         # excluded from decode dispatch until the final chunk lands
         self._chunks: Dict[int, Dict] = {}
+        # -- speculative decoding state (r13) -----------------------------
+        # per-slot draft-KV coverage: the draft participates in a spec
+        # wave only while its KV covers exactly [0, lengths) — a slot
+        # advanced by the NORMAL decode path (sampled mix in the wave)
+        # goes stale (-1) until a re-prefill resets it. Staleness is a
+        # throughput concern only: proposals from bad draft KV still
+        # verify against the target, they just stop being accepted.
+        self._draft_len = np.zeros(self.N, np.int64)
+        self._spec_draft_cache: Dict = {}    # ("ragged"|nbk) → draft fn
+        self._spec_verify_cache: Dict = {}   # nbk → verify fn
+        # host-side spec evidence (kept whether or not the metrics
+        # registry is enabled — bench rows read these)
+        self.spec_proposed = 0      # draft tokens offered to verify
+        self.spec_accepted = 0      # of those, accepted by the target
+        self.spec_committed = 0     # tokens committed by spec waves
+        self.spec_waves = 0         # draft+verify wave count
+        self.spec_draft_steps = 0   # draft decode steps run (waves * k)
+        self.spec_verify_calls = 0  # batched target verify calls
 
     # -- public api ---------------------------------------------------------
     @property
@@ -956,8 +1231,16 @@ class LLMEngine:
         raise ValueError(f"prompt length {n} exceeds largest bucket "
                          f"{self.buckets[-1]}")
 
-    def _prefill_fn(self, bucket: int, B: int, flags, prefix_nbk: int = 0):
-        key = (bucket, B, flags, prefix_nbk)
+    def _prefill_fn(self, bucket: int, B: int, flags, prefix_nbk: int = 0,
+                    draft: bool = False):
+        if draft:
+            # draft prefill: greedy flags always (its sampled token is
+            # discarded) so the draft never multiplies the flag axis
+            flags = (False, False, False)
+        # target keys stay the documented 4-tuple; the draft adds a
+        # parallel family, one tag deeper
+        key = ((bucket, B, flags, prefix_nbk) if not draft
+               else (bucket, B, flags, prefix_nbk, "draft"))
         fn = self._prefill.get(key)
         if fn is None:
             # the numerics gate is baked at variant-compile time (the
@@ -966,11 +1249,14 @@ class LLMEngine:
             # flip the flag before the engine serves to instrument
             fn = jax.jit(functools.partial(
                              _paged_prefill,
-                             config=self.config,
+                             config=(self.draft_config if draft
+                                     else self.config),
                              sample_flags=flags,
-                             kv_int8=self.kv_int8,
-                             numerics=self.kv_int8 and _nm.active(),
-                             prefix_nbk=prefix_nbk),
+                             kv_int8=self.kv_int8 and not draft,
+                             numerics=(self.kv_int8 and not draft
+                                       and _nm.active()),
+                             prefix_nbk=prefix_nbk,
+                             kv_prefix="d" if draft else ""),
                          donate_argnums=(4,))
             self._prefill[key] = fn
         return fn
@@ -1054,6 +1340,7 @@ class LLMEngine:
         self.table[slot, :] = 0
         self.n_alloc[slot] = 0
         self.lengths[slot] = 0
+        self._draft_len[slot] = 0
         self.slot_req[slot] = None
         if slot in self.admit_order:
             self.admit_order.remove(slot)
@@ -1175,6 +1462,13 @@ class LLMEngine:
         self.table[slot, :len(blocks)] = blocks
         self.n_alloc[slot] = len(blocks)
         self.lengths[slot] = ent.n_tokens
+        if self._spec_on:
+            # the swap moved BOTH models' pool entries verbatim, so the
+            # draft's coverage restores with the target's (a slot whose
+            # draft was stale at swap-out restores stale draft KV —
+            # acceptance-rate noise, never a correctness issue: every
+            # proposal is target-verified)
+            self._draft_len[slot] = ent.n_tokens
         self.slot_req[slot] = req
         self.admit_order.append(slot)
         self._table_dirty = True
@@ -1294,7 +1588,14 @@ class LLMEngine:
         however many slots pin it. ``host_spilled_blocks`` (prefix-cache
         blocks resident only in the host tier) and
         ``swapped_host_blocks`` ride along — those blocks were freed on
-        device and are NOT in the sum."""
+        device and are NOT in the sum.
+
+        Speculative decoding (r13) adds NO terms: the draft's ``dk``/
+        ``dv`` pools are indexed by the same physical block ids as the
+        target's, so every block holding draft KV already IS one of
+        free/backed/cached/squeezed — the invariant is
+        model-count-independent (the chaos suite asserts it per step
+        with spec on)."""
         pc = self.prefix_cache
         return {
             "total": self.nb - 1,
@@ -1488,9 +1789,25 @@ class LLMEngine:
                         request_ids=wave_rids):
             tok_dev, self.pools = self._prefill_fn(
                 bucket, B, flags, pnbk)(*args)
+        if self._spec_on:
+            # the SAME wave through the draft model, right behind the
+            # target's call (pools chain through donation): both models'
+            # KV now cover every prefilled position, so these slots
+            # enter spec waves in sync. The draft's sampled token is
+            # discarded — the target owns the stream.
+            self._key, dsub = jax.random.split(self._key)
+            dargs = [self.draft_params] + args[1:8] + [dsub] + args[9:]
+            dargs[4] = self.pools
+            with trace_span("serving.prefill", bucket=bucket, batch=B,
+                            wave=len(rows), model="draft",
+                            request_ids=wave_rids):
+                _junk, self.pools = self._prefill_fn(
+                    bucket, B, flags, pnbk, draft=True)(*dargs)
         tracer = _rt.get_request_tracer() if _obs.enabled() else None
         for i, (slot, req, ctx, hist, piece, final) in enumerate(rows):
             self.lengths[slot] = hist + piece
+            if self._spec_on:
+                self._draft_len[slot] = hist + piece
             if final:
                 if self._chunks.pop(slot, None) is not None:
                     self._slots_dirty = True   # rejoins the decode mask
@@ -1533,18 +1850,22 @@ class LLMEngine:
             self._free_slot(slot)
         return done
 
-    def _ensure_backed(self, slot: int, lag: int = 0) -> bool:
+    def _ensure_backed(self, slot: int, lag: int = 0,
+                       steps: Optional[int] = None) -> bool:
         """Back every block this slot's next ``decode_steps`` writes can
         touch (clamped to its remaining token budget — a near-finished slot
         must not reserve blocks it can never write). ``lag``: tokens the
         unread in-flight call may already have appended beyond the host's
         view of the length (pipelined dispatch); the horizon covers them
         too, since under-backing silently diverts K/V to the trash block.
+        ``steps`` overrides the per-wave write horizon (a speculative
+        wave commits up to ``spec_k`` tokens, not ``decode_steps``).
         Returns False if the pool is exhausted (caller preempts)."""
         req = self.slot_req[slot]
         remaining = req.max_new_tokens - len(req.generated) \
             - len(self.slot_out[slot])
-        steps = max(1, min(self.decode_steps + lag, remaining + lag))
+        base = self.decode_steps if steps is None else steps
+        steps = max(1, min(base + lag, remaining + lag))
         horizon = int(self.lengths[slot]) + steps - 1
         last_blk = min(horizon, self.max_model_len - 1) // self.bs
         need = last_blk + 1 - int(self.n_alloc[slot])
@@ -1584,12 +1905,14 @@ class LLMEngine:
                 return False
         return True
 
-    def _back_or_preempt(self):
+    def _back_or_preempt(self, steps: Optional[int] = None):
         """Back upcoming writes for every active slot; preempt the newest
         admissions while the pool is short (vLLM recompute policy). With
         an unread call in flight the host length lags by up to
         decode_steps — if generous backing fails, the pipeline is drained
-        so preemption decisions see exact state."""
+        so preemption decisions see exact state. ``steps`` overrides the
+        write horizon (speculative waves back ``spec_k`` positions and
+        run with the pipeline already drained)."""
         emitted = []
         # chunking slots never appear here (_decode_slots excludes them;
         # their whole context was preallocated at admission — nothing to
@@ -1601,7 +1924,8 @@ class LLMEngine:
                 in_snap = self._inflight is not None and any(
                     s == slot for s, _ in self._inflight["snapshot"])
                 if self._ensure_backed(slot,
-                                       self.decode_steps if in_snap else 0):
+                                       self.decode_steps if in_snap else 0,
+                                       steps=steps):
                     break
                 if self._inflight is not None:
                     # exact lengths before evicting anyone
@@ -1762,11 +2086,15 @@ class LLMEngine:
             self.decode_kernel == "auto" and self.mesh is None
             and jax.default_backend() == "tpu")
 
-    def _pool_block_bytes(self) -> int:
-        """Bytes one physical block occupies across every pool entry and
-        layer (int8 pools: payload + scales)."""
+    def _pool_block_bytes(self, draft: bool = False) -> int:
+        """Bytes one physical block occupies across one MODEL's pool
+        entries and layers (int8 pools: payload + scales). The decode
+        KV-traffic estimates count the target's entries only — the
+        draft's ``dk``/``dv`` share the block ids but are read by the
+        draft's own (cheaper) walks."""
+        want = ("dk", "dv") if draft else ("k", "v", "ks", "vs")
         return sum(a.shape[0] * int(np.prod(a.shape[2:])) * a.dtype.itemsize
-                   for a in self.pools.values())
+                   for n, a in self.pools.items() if n in want)
 
     def _dispatch_decode(self, active_slots):
         """Enqueue one multi-step decode call and record it as in-flight.
@@ -1903,6 +2231,228 @@ class LLMEngine:
         self._fresh_swapins = set()
         return prev
 
+    # -- speculative decoding (r13): draft-then-verify waves ---------------
+    def _spec_eligible(self, active) -> bool:
+        """True when the next decode wave can run draft-then-verify:
+        a draft is configured, every decode slot is GREEDY (the
+        accept-longest-prefix rule is exact for argmax sampling only),
+        and every slot's draft KV covers its full context (a slot
+        advanced by the normal path while a sampled request shared its
+        wave is stale until re-prefilled). Ineligible waves take the
+        normal decode path — never wrong, at worst unaccelerated."""
+        if not self._spec_on or not active:
+            return False
+        for i in active:
+            req = self.slot_req[i]
+            if req.temperature > 0:
+                return False
+            if self._draft_len[i] != self.lengths[i]:
+                return False
+        return True
+
+    def _spec_bucket(self, active) -> int:
+        """Power-of-two block count covering every wave slot's history
+        PLUS the verify piece's k+1 writes — the verify table slice
+        (and the draft's, off the ragged path). Same convention as
+        :meth:`_prefix_blocks`, horizon ``spec_k + 1``."""
+        hmax = need = 0
+        for i in active:
+            hmax = max(hmax, int(self.lengths[i]))
+            need = max(need, int(self.n_alloc[i]))
+        horizon = min(hmax + self.spec_k + 1, self.max_model_len)
+        need = max(1, need, -(-horizon // self.bs))
+        nbk = 1 << (need - 1).bit_length()
+        return min(nbk, self.mb)
+
+    def _spec_draft_fn(self, ragged: bool):
+        """The draft proposal program: ``_paged_decode`` at draft scale
+        — draft config, ``spec_k`` fused steps, greedy flags, the
+        ``dk``/``dv`` pool entries. One cached jit per kernel path (the
+        bucketed table width re-specializes inside jax's own cache)."""
+        key = "ragged" if ragged else "bucketed"
+        fn = self._spec_draft_cache.get(key)
+        if fn is None:
+            fn = self._spec_draft_cache[key] = jax.jit(
+                functools.partial(
+                    _paged_decode, config=self.draft_config,
+                    n_steps=self.spec_k,
+                    sample_flags=(False, False, False),
+                    kv_int8=False, numerics=False, ragged=ragged,
+                    kv_prefix="d"),
+                donate_argnums=(8,))
+        return fn
+
+    def _spec_verify_fn(self, nbk: int):
+        """The batched verify program, one variant per history bucket —
+        the log-bounded axis the chunked-prefill family already pays
+        for, with no flag axis (verify is always greedy)."""
+        fn = self._spec_verify_cache.get(nbk)
+        if fn is None:
+            fn = self._spec_verify_cache[nbk] = jax.jit(
+                functools.partial(
+                    _spec_verify, config=self.config,
+                    n_spec=self.spec_k, kv_int8=self.kv_int8,
+                    numerics=self.kv_int8 and _nm.active(),
+                    max_model_len=self.max_model_len),
+                donate_argnums=(6,))
+        return fn
+
+    def _spec_wave(self, active):
+        """One draft-then-verify decode wave: the draft proposes
+        ``spec_k`` tokens per slot in one multi-step call, the target
+        scores every proposal in one prefill-shaped batched call (the
+        draft grid feeds it device-to-device — no host hop between the
+        two), and the host commits the longest agreeing prefix per slot
+        — atomically into lengths, the block tables' backing, the
+        prefix-cache adoption path (via ``_free_slot``/finish) and the
+        emit stream. Capping commits at ``spec_k`` (the "bonus" token
+        of classic speculative sampling is dropped) keeps the draft's
+        KV in exact lockstep with the target's, so the rejected-suffix
+        rollback is pure length bookkeeping: positions >=
+        ``lengths`` in EITHER pool are unreadable and the next wave
+        overwrites them.
+
+        Runs with the pipeline drained — acceptance is a host decision,
+        so the wave syncs once (its amortization is the k-for-1 verify,
+        not call chaining), which is also why spec waves, unlike the
+        chained path, compose with per-request eos."""
+        from ..distributed.watchdog import guarded
+
+        emitted = []
+        if self._pending_adm:
+            adm, self._pending_adm = self._pending_adm, []
+            with guarded("serving-spec-readback"), \
+                    trace_span("serving.readback"):
+                emitted += self._flush_adm(adm)
+        # swap-in carry lanes are host-known state; the spec wave reads
+        # host state directly and invalidates the chained device carry
+        self._pending_swapin = []
+        self._fresh_swapins = set()
+        self._carry = None
+        self._slots_dirty = True
+        emitted += self._back_or_preempt(steps=self.spec_k)
+        active = self._decode_slots()
+        if not active:
+            return emitted
+        k = self.spec_k
+        N = self.N
+        ragged = self._use_ragged()
+        nbk = self._spec_bucket(active)
+        if self._table_dirty:
+            self._table_dev = {}
+            self._table_dirty = False
+
+        def tdev(width):
+            t = self._table_dev.get(width)
+            if t is None:
+                t = self._table_dev[width] = jnp.asarray(
+                    self.table[:, :width])
+            return t
+
+        tbl_v = tdev(nbk)
+        tbl_d = tdev(self.mb) if ragged else tbl_v
+        last = np.zeros(N, np.int32)
+        budgets = np.zeros(N, np.int32)
+        act = np.zeros(N, bool)
+        for i in active:
+            req = self.slot_req[i]
+            out = self.slot_out[i]
+            last[i] = out[-1] if out else (
+                req.generated[-1] if req.generated else req.prompt[-1])
+            # the draft stops proposing at the slot's remaining budget:
+            # tokens past it could never commit, and their writes would
+            # clamp into real blocks near max_model_len
+            budgets[i] = req.max_new_tokens - len(req.generated) \
+                - len(out)
+            act[i] = True
+        walk = sum(-(-int(self.lengths[i]) // self.bs) for i in active)
+        last_j = jnp.asarray(last)
+        lens_j = jnp.asarray(self.lengths, jnp.int32)
+        act_j = jnp.asarray(act)
+        rids = [self.slot_req[i].req_id for i in active]
+        draft_fn = self._spec_draft_fn(ragged)
+        with trace_span("serving.spec_draft", slots=len(active), k=k,
+                        request_ids=rids):
+            (demitted, _dl, _dn, _dd, _db, _dk, self.pools) = draft_fn(
+                self.draft_params, last_j, lens_j, jnp.zeros(N, bool),
+                jnp.asarray(budgets), jax.random.PRNGKey(0), act_j,
+                tbl_d, self.pools, jnp.zeros(N, jnp.float32),
+                jnp.zeros(N, jnp.int32), jnp.ones(N, jnp.float32),
+                jnp.full(N, -1, jnp.int32))
+        verify_fn = self._spec_verify_fn(nbk)
+        with trace_span("serving.spec_verify", slots=len(active), k=k,
+                        prefix_bucket=nbk * self.bs, request_ids=rids):
+            vtoks, self.pools = verify_fn(
+                self.params, tbl_v, last_j, demitted, lens_j, act_j,
+                self.pools)
+        if self.injector is not None and \
+                self.injector.fires("spec_verify_fail", self._step_idx):
+            # chaos surface: a crash between the verify dispatch and
+            # its readback. NOTHING of this wave is host-visible yet,
+            # so recovery (drop + requeue from host state) rolls back
+            # to the last committed token with zero stream divergence
+            _flight.record("injected_spec_verify_fail",
+                           step=self._step_idx)
+            raise SimulatedCrash(
+                f"injected speculative-verify failure at serving step "
+                f"{self._step_idx}")
+        with guarded("serving-spec-readback"), \
+                trace_span("serving.readback"):
+            d_host = np.asarray(jax.device_get(demitted))   # [k, N]
+            v_host = np.asarray(jax.device_get(vtoks))      # [N, k+1]
+        wave_prop = wave_acc = wave_commit = 0
+        for i in active:
+            req = self.slot_req[i]
+            rid = req.req_id
+            rem = req.max_new_tokens - len(req.generated) \
+                - len(self.slot_out[i])
+            prop = min(k, rem)              # what the draft really ran
+            d, g = d_host[:, i], v_host[i]
+            a = 0
+            while a < prop and d[a] == g[a]:
+                a += 1
+            # commit the agreeing prefix + the target's one new token,
+            # capped at k (the draft-KV lockstep invariant) and at the
+            # budget; a == 0 still commits g[0] — a zero-acceptance
+            # draft degenerates to one token per wave, never fewer
+            c = min(a + 1, k, rem)
+            wave_prop += prop
+            wave_acc += a
+            for j in range(c):
+                tok = int(g[j])
+                self.lengths[i] += 1        # verify wrote its K/V
+                self._draft_len[i] += 1     # the draft wrote its too
+                wave_commit += 1
+                emitted.append((rid, tok))
+                self._step_emitted.append((rid, tok))
+                if self._emit(i, tok):
+                    break                   # eos/budget mid-wave
+        self.spec_waves += 1
+        self.spec_verify_calls += 1
+        self.spec_draft_steps += k
+        self.spec_proposed += wave_prop
+        self.spec_accepted += wave_acc
+        self.spec_committed += wave_commit
+        _M_SPEC_PROPOSED.inc(wave_prop)
+        if wave_acc:
+            _M_SPEC_ACCEPTED.inc(wave_acc)
+        # KV-traffic estimate (host ints, registry-independent): the
+        # draft's walks/gathers at draft-pool bytes + the verify's one
+        # dense history gather at target-pool bytes
+        pb_t, pb_d = self._pool_block_bytes(), \
+            self._pool_block_bytes(draft=True)
+        if ragged:
+            self.kv_read_bytes_total += walk * pb_d * k
+        else:
+            self.kv_read_bytes_total += pb_d * N * nbk * (2 + k)
+        self.kv_read_bytes_total += pb_t * N * nbk
+        if _obs.enabled():
+            _M_SPEC_ACCEPT_RATE.set(
+                self.spec_accepted / max(1, self.spec_proposed))
+            _M_SPEC_TOKENS_PER_WAVE.set(
+                self.spec_committed / max(1, self.spec_verify_calls))
+        return emitted
+
     def _process(self, rec):
         """Read back one decode record (first tokens of its admissions,
         then its emitted grid) and update host bookkeeping. Slots whose
@@ -1930,28 +2480,35 @@ class LLMEngine:
                 trace_span("serving.readback"):
             return self._process_guarded(rec)
 
+    def _flush_adm(self, adm):
+        """Read back a list of pending-admission first tokens
+        ((slot, rid, wave_array, row) tuples) and commit them host-side
+        — one readback per distinct wave array, not per admission."""
+        emitted = []
+        uniq = {}
+        for slot, rid, arr, i in adm:
+            uniq.setdefault(id(arr), (arr, []))[1].append(
+                (slot, rid, i))
+        host = {aid: np.asarray(jax.device_get(arr))
+                for aid, (arr, _) in uniq.items()}
+        first = [int(host[id(arr)][i]) for _, _, arr, i in adm]
+        for (slot, rid, _, _), tok in zip(adm, first):
+            req = self.slot_req[slot]
+            if req is None or req.req_id != rid:
+                continue              # preempted before its call ran
+            tok = int(tok)
+            emitted.append((rid, tok))
+            # commit point: host-visible from here on — mirrored into
+            # the step's salvage buffer so a crash later in this SAME
+            # step still delivers it (ResilientEngine)
+            self._step_emitted.append((rid, tok))
+            self._emit(slot, tok)
+        return emitted
+
     def _process_guarded(self, rec):
         emitted = []
         if rec["adm"]:
-            # one readback per distinct wave array, not per admission
-            uniq = {}
-            for slot, rid, arr, i in rec["adm"]:
-                uniq.setdefault(id(arr), (arr, []))[1].append(
-                    (slot, rid, i))
-            host = {aid: np.asarray(jax.device_get(arr))
-                    for aid, (arr, _) in uniq.items()}
-            first = [int(host[id(arr)][i]) for _, _, arr, i in rec["adm"]]
-            for (slot, rid, _, _), tok in zip(rec["adm"], first):
-                req = self.slot_req[slot]
-                if req is None or req.req_id != rid:
-                    continue              # preempted before its call ran
-                tok = int(tok)
-                emitted.append((rid, tok))
-                # commit point: host-visible from here on — mirrored into
-                # the step's salvage buffer so a crash later in this SAME
-                # step still delivers it (ResilientEngine)
-                self._step_emitted.append((rid, tok))
-                self._emit(slot, tok)
+            emitted += self._flush_adm(rec["adm"])
         toks_host = np.asarray(jax.device_get(rec["toks"]))  # [K, N]
         for slot, rid in rec["snapshot"]:
             req = self.slot_req[slot]
@@ -1962,6 +2519,12 @@ class LLMEngine:
                 if tok < 0:
                     break          # slot went done mid-scan
                 self.lengths[slot] += 1     # its K/V was appended
+                if self._spec_on:
+                    # this slot advanced through the NORMAL decode path
+                    # (a sampled slot was in the wave): its draft KV is
+                    # now behind and can't catch up without a
+                    # re-prefill — mark it out of the spec pool
+                    self._draft_len[slot] = -1
                 emitted.append((rid, tok))
                 self._step_emitted.append((rid, tok))
                 if self._emit(slot, tok):
@@ -2051,6 +2614,19 @@ class LLMEngine:
         # already decoding)
         self._advance_chunks()
         self._admit()
+        if self._spec_on:
+            active = self._decode_slots()
+            if active and self._spec_eligible(active):
+                # a spec wave syncs on its own acceptance decision:
+                # drain the depth-1 pipeline first (host state must be
+                # exact), re-admit into any slots that freed, then run
+                # draft → verify → commit
+                if self._inflight is not None:
+                    emitted += self._process_inflight()
+                    self._admit()
+                    active = self._decode_slots()
+                if active and self._spec_eligible(active):
+                    return emitted + self._spec_wave(active)
         if self._inflight is not None and not self._spec_safe():
             emitted += self._process_inflight()
             self._admit()          # freed slots: refill before dispatching

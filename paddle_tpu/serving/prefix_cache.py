@@ -34,6 +34,19 @@ in exactly one of {free, slot-private ("backed"), cache-owned device
 node ("cached"), squeezed}; host-spilled nodes hold NO device block and
 ride along as ``host_spilled_blocks`` —
 ``free + backed + cached + squeezed == total`` at every step boundary.
+
+Speculative decoding (r13) widens what one node's physical block HOLDS,
+not the trie's structure: with a draft model configured, every pool
+block carries BOTH models' KV for its token range (the draft's
+``dk``/``dv`` pool entries are indexed by the same block ids), and the
+engine commits MULTIPLE tokens per decode wave. Commit granularity > 1
+composes because adoption/matching were always block-granular and keyed
+off the engine's ``lengths`` — a spec wave advancing ``lengths`` by c
+tokens can complete several FULL blocks at once and finish-time
+adoption picks them all up in one :meth:`extend` call, while
+rejected-suffix positions (>= ``lengths``) sit only in the always-
+private partial tail and can never enter the trie. Spill/restore moves
+every pool entry verbatim, so a warm hit re-arms the draft too.
 """
 from __future__ import annotations
 
@@ -187,15 +200,19 @@ class PrefixCache:
     def extend(self, tokens: List[int], start_block: int,
                blocks: List[int], pin: bool) -> List[_Node]:
         """Adopt the slot's freshly written FULL blocks into the trie:
-        ``blocks[i]`` holds the KV of token block ``start_block + i``.
-        Walks the existing path to ``start_block`` (it exists whenever
-        ``start_block > 0`` was matched or previously adopted); adoption
-        stops at the first token block another request already cached —
-        the trie keeps ONE physical block per prefix and the caller keeps
-        (and later frees) its duplicate. Returns the adopted nodes, in
-        table order, ``pin=True`` leaving each pinned for the caller
-        (prefill-time adoption) and ``pin=False`` leaving them at
-        refcount 0 (finish-time adoption by a dying slot)."""
+        ``blocks[i]`` holds the KV of token block ``start_block + i``
+        (BOTH models' KV under speculative decoding — the pool entries
+        share block ids). Walks the existing path to ``start_block`` (it
+        exists whenever ``start_block > 0`` was matched or previously
+        adopted); adoption stops at the first token block another
+        request already cached — the trie keeps ONE physical block per
+        prefix and the caller keeps (and later frees) its duplicate.
+        Multi-token commits (spec waves, multi-step decode) can hand
+        several blocks in one call; the loop adopts them in order.
+        Returns the adopted nodes, in table order, ``pin=True`` leaving
+        each pinned for the caller (prefill-time adoption) and
+        ``pin=False`` leaving them at refcount 0 (finish-time adoption
+        by a dying slot)."""
         node = self.root
         for b in range(start_block):
             node = node.children.get(

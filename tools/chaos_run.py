@@ -27,7 +27,13 @@ exactly one of {finished, shed, deadline_exceeded}, the block-pool
 ledger balances ``free + backed + cached + squeezed == total`` at every
 step boundary (zero KV block leaks — a pool_squeeze stealing blocks
 while the cache holds others must still balance), the host swap tier
-drains to empty, and the shared prefix actually hit the cache.
+drains to empty, and the shared prefix actually hit the cache. A second
+phase runs the r13 speculative engine (draft-then-verify waves) under
+``spec_verify_fail`` faults: a crash between the verify dispatch and
+its readback must roll back to the last committed token — the recovered
+streams must equal a clean non-speculative greedy run token-for-token,
+with the ledger balancing throughout (draft KV shares the target's
+blocks, so the 4-term invariant is unchanged with spec on).
 
     JAX_PLATFORMS=cpu python tools/chaos_run.py --serving --steps 24 --seed 7
 
@@ -171,6 +177,59 @@ def serving_main(args):
         print(f"shared-prefix workload never hit the cache "
               f"(hits={pc.hits}, skipped={pc.tokens_skipped})")
         ok = False
+
+    # -- phase 2 (r13): speculative chaos ---------------------------------
+    # a fault injected MID-VERIFY (between the verify dispatch and its
+    # readback) must roll the engine back to the last committed token:
+    # the recovered run's streams must equal a clean non-speculative
+    # run's token-for-token, and the block ledger must balance through
+    # the crash + squeeze storm with the draft pools in play.
+    spec_inj = FaultInjector([("spec_verify_fail", 2),
+                              ("spec_verify_fail", 3),
+                              ("spec_verify_fail", 7),
+                              ("pool_squeeze", 5)])
+    prompts = [rng.integers(1, 64, size=int(rng.integers(3, 14))).tolist()
+               for _ in range(6)]
+    news = [int(rng.integers(6, 16)) for _ in range(6)]
+    ref = LLMEngine(params, cfg, max_slots=2, block_size=8,
+                    max_model_len=64, prompt_buckets=[8, 32])
+    ref_ids = [ref.add_request(p, max_new_tokens=n)
+               for p, n in zip(prompts, news)]
+    ref_out = ref.run()
+    spec = LLMEngine(params, cfg, max_slots=2, block_size=8,
+                     max_model_len=64, num_blocks=9,
+                     prompt_buckets=[8, 32], kv_swap_bytes=1 << 20,
+                     injector=spec_inj, draft_params=params,
+                     draft_config=cfg, spec_tokens=4)
+    rspec = ResilientEngine(spec)
+    sids = [spec.add_request(p, max_new_tokens=n)
+            for p, n in zip(prompts, news)]
+    streamed2 = {rid: [] for rid in sids}
+    while spec.has_work():
+        for rid, tok in rspec.step():
+            streamed2[rid].append(tok)
+        acct = spec.block_accounting()
+        if acct["free"] + acct["backed"] + acct["cached"] \
+                + acct["squeezed"] != acct["total"]:
+            print(f"spec ledger out of balance at step "
+                  f"{spec._step_idx}: {acct}")
+            ok = False
+            break
+    print(f"spec chaos: recoveries={rspec.recoveries} "
+          f"waves={spec.spec_waves} committed={spec.spec_committed} "
+          f"accepted={spec.spec_accepted}/{spec.spec_proposed} "
+          f"faults fired={spec_inj.fired}")
+    if rspec.recoveries < 1:
+        print("no mid-verify crash was recovered — the fault never fired")
+        ok = False
+    for rid, refid in zip(sids, ref_ids):
+        if spec.results.get(rid) != ref_out[refid]:
+            print(f"spec request {rid} diverged from the clean greedy "
+                  f"stream: {spec.results.get(rid)} != {ref_out[refid]}")
+            ok = False
+        if streamed2[rid] != spec.results.get(rid):
+            print(f"spec request {rid}: streamed/result mismatch")
+            ok = False
 
     print("SERVING_CHAOS: OK" if ok else "SERVING_CHAOS: FAIL")
     return 0 if ok else 1

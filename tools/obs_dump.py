@@ -202,7 +202,9 @@ def demo_serving():
     the first prompt and skips its cached prefix blocks entirely. The
     table shows the r6 decode metrics plus
     serving_{shed,deadline_exceeded,kv_swap_*}_total and the
-    serving_prefix_cache_* family."""
+    serving_prefix_cache_* family. A second, speculative engine (r13)
+    then runs a synthetic high-agreement draft and prints the
+    serving_spec_* line — multiple committed tokens per verify call."""
     import dataclasses
 
     import jax
@@ -285,6 +287,27 @@ def demo_serving():
           f"{_c('serving_prefill_tokens_skipped_total')} "
           "cached_blocks="
           f"{int(reg.gauge('serving_prefix_cache_blocks').labels().value)}")
+    # r13: a speculative engine over the same model — the draft here is
+    # the target itself (the synthetic high-agreement draft), so every
+    # wave commits spec_tokens per slot off ONE batched verify call
+    dense_params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    seng = LLMEngine(dense_params, cfg, max_slots=2, block_size=8,
+                     max_model_len=64, prompt_buckets=[8, 32],
+                     draft_params=dense_params, draft_config=cfg,
+                     spec_tokens=4)
+    for _ in range(2):
+        seng.add_request(rng.integers(1, 64, size=6).tolist(),
+                         max_new_tokens=12)
+    seng.run()
+    print("speculative: "
+          f"proposed={_c('serving_spec_proposed_total')} "
+          f"accepted={_c('serving_spec_accepted_total')} "
+          "acceptance="
+          f"{reg.gauge('serving_spec_acceptance_rate').labels().value:.2f} "
+          "tokens/wave="
+          f"{reg.gauge('serving_spec_tokens_per_wave').labels().value:.2f} "
+          f"draft_steps={seng.spec_draft_steps} "
+          f"verify_calls={seng.spec_verify_calls}")
     print(f"finish reasons: {eng.finish_reasons}")
     print()
     print_request_table(obs.requests_payload())
