@@ -845,6 +845,94 @@ def bench_serving(dev, results):
             "verify_calls": int(st["verify_calls"]),
         }))
 
+    def attempt_http(make_params):
+        """HTTP/SSE front-door row (r14): the SAME int8 engine serving
+        concurrent SSE clients over real localhost sockets vs its own
+        direct-call run of the IDENTICAL workload (same engine config,
+        same prompts — a baseline from another config would fold
+        decode_steps/workload differences into the ratio).
+        vs_baseline = http/direct — the front door's tax; near 1.0
+        means the socket/asyncio layer rides the step loop's idle time
+        instead of the chip's. Also reports p95 client-observed TTFB
+        (first SSE frame)."""
+        import json as _json
+        import socket as _socket
+        import threading as _threading
+
+        from paddle_tpu.serving import HTTPFrontDoor
+        params = make_params()
+        new_tok = 64
+        rng0 = np.random.default_rng(0)
+        reqs = [rng0.integers(1, 32768, size=int(ln)).tolist()
+                for ln in rng0.integers(64, 448, size=2 * SLOTS)]
+        eng = LLMEngine(params, cfg, max_slots=SLOTS, block_size=64,
+                        max_model_len=1024,
+                        prompt_buckets=[128, 512, 1024], decode_steps=16,
+                        kv_dtype="int8")
+        # compile everything BEFORE any clock starts
+        for p in reqs:
+            eng.add_request(p, max_new_tokens=new_tok, temperature=0.0)
+        eng.run()
+        # direct-call baseline: the exact workload the HTTP pass serves
+        t0 = time.perf_counter()
+        rids = [eng.add_request(p, max_new_tokens=new_tok,
+                                temperature=0.0) for p in reqs]
+        out = eng.run()
+        base_dt = time.perf_counter() - t0
+        base_tps = sum(len(out[r]) for r in rids) / base_dt
+        front = HTTPFrontDoor(eng)
+        host, port = front.start()
+        stats = {"tokens": 0, "ttfb": []}
+        lock = _threading.Lock()
+
+        def client(prompt):
+            body = _json.dumps({"prompt": prompt,
+                                "max_new_tokens": new_tok}).encode()
+            s = _socket.create_connection((host, port), timeout=600)
+            t_send = time.perf_counter()
+            s.sendall((f"POST /v1/generate HTTP/1.1\r\nHost: b\r\n"
+                       f"Content-Length: {len(body)}\r\n\r\n"
+                       ).encode() + body)
+            buf, first = b"", None
+            while True:
+                c = s.recv(65536)
+                if not c:
+                    break
+                if first is None and b"data:" in buf + c:
+                    first = time.perf_counter() - t_send
+                buf += c
+            s.close()
+            n = buf.count(b'{"token":')
+            with lock:
+                stats["tokens"] += n
+                if first is not None:
+                    stats["ttfb"].append(first)
+
+        try:
+            t0 = time.perf_counter()
+            threads = [_threading.Thread(target=client, args=(p,))
+                       for p in reqs]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+        finally:
+            front.stop()
+        ttfb = sorted(stats["ttfb"])
+        p95 = ttfb[int(0.95 * (len(ttfb) - 1))] if ttfb else None
+        results.append(_efficiency({
+            "metric": "llama-2.6b_serving_http_tokens_per_sec",
+            "value": round(stats["tokens"] / dt, 1),
+            "unit": "tokens/s",
+            "vs_baseline": round(stats["tokens"] / dt
+                                 / max(base_tps, 1e-9), 4),
+            "direct_tokens_per_sec": round(base_tps, 1),
+            "clients": len(reqs),
+            "p95_ttfb_ms": (round(p95 * 1e3, 1) if p95 is not None
+                            else None),
+        }))
+
     try:
         _retry(lambda: attempt("bf16", lambda: _init_bf16_params(cfg)))
         _release()
@@ -882,6 +970,13 @@ def bench_serving(dev, results):
         # speculative decoding: int8 draft / bf16 target, spec on vs
         # off on the same greedy workload (ISSUE 13 row, ROADMAP 4)
         _retry(lambda: attempt_spec(lambda: _init_bf16_params(cfg)))
+        _release()
+        # the same int8 engine behind the r14 HTTP/SSE front door:
+        # concurrent socket clients vs a direct-call run of the same
+        # workload (the front door's tax must be ~zero — it rides the
+        # step loop's idle time)
+        _retry(lambda: attempt_http(
+            lambda: jax.jit(llama.quantize_params)(_init_bf16_params(cfg))))
     except Exception as e:
         results.append({"metric": "serving_bench_failed", "value": 0.0,
                         "unit": "tokens/s", "vs_baseline": 0.0,

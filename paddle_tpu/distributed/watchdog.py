@@ -37,7 +37,8 @@ from ..observability import state as _obs_state
 from ..observability.catalog import instrument as _instrument
 
 __all__ = ["CommWatchdog", "install", "uninstall", "current", "guarded",
-           "register_emergency_hook", "unregister_emergency_hook"]
+           "register_emergency_hook", "unregister_emergency_hook",
+           "run_emergency_hooks"]
 
 _M_HEARTBEAT = _instrument("watchdog_heartbeat_age_seconds")
 _M_TIMEOUTS = _instrument("watchdog_timeouts_total")
@@ -94,6 +95,16 @@ def _run_emergency_hooks(name: str, elapsed: float,
             f"[paddle_tpu watchdog] emergency hooks still running after "
             f"{budget:.0f}s budget — proceeding without them\n")
         sys.stderr.flush()
+
+
+def run_emergency_hooks(name: str, elapsed: float = 0.0,
+                        budget: float = 60.0) -> None:
+    """Run the registered emergency hooks outside a watchdog timeout —
+    the serving front door's graceful drain flushes state through the
+    SAME hook registry the train loop's SIGTERM/watchdog paths use
+    (one place to register "save my work before the process exits"),
+    with the same hard time budget."""
+    _run_emergency_hooks(name, elapsed, budget)
 
 
 class _Task:

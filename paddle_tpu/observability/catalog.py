@@ -81,6 +81,34 @@ CATALOG = {
         "gauge", (), "cumulative committed tokens per draft-verify "
                      "wave (> 1 means each target verify call emits "
                      "more than one token — the mechanism working)"),
+    # -- serving HTTP/SSE front door (serving.http, r14) --------------------
+    "serving_http_requests_total": (
+        "counter", ("code",),
+        "HTTP responses by status code (200 streams, 400 bad request, "
+        "429 rate_limited, 503 queue_full/pool_pressure/draining, "
+        "408 client gone before the response)"),
+    "serving_http_active_streams": (
+        "gauge", (), "in-flight /v1/generate requests the front door "
+                     "currently owns (admitted, not yet terminal)"),
+    "serving_http_client_disconnects_total": (
+        "counter", (), "requests cancelled server-side because the "
+                       "client vanished — mid-stream EOF, failed "
+                       "write, or a reader stalled past "
+                       "FLAGS_serve_client_stall_s (terminal reason "
+                       "client_disconnected; KV blocks free within "
+                       "one engine step)"),
+    "serving_http_send_queue_depth": (
+        "gauge", (), "deepest per-connection SSE send queue at the "
+                     "last stall sweep — frames produced by the "
+                     "engine but not yet drained to the client "
+                     "(backpressure evidence; above "
+                     "FLAGS_serve_send_queue_hwm the stall clock "
+                     "runs)"),
+    "serving_http_drain_seconds": (
+        "histogram", (), "graceful-drain duration: begin_drain/SIGTERM "
+                         "to the last in-flight stream retiring "
+                         "(bounded by FLAGS_serve_drain_s + one "
+                         "cut-straggler step)"),
     # -- serving survivability (admission, deadlines, kv_swap, recovery) ---
     "serving_shed_total": (
         "counter", ("reason",),
@@ -320,6 +348,11 @@ SPANS = (
     # proposal call) + one spec_verify (the batched target scoring
     # call) per wave, nested inside serving.step
     "serving.spec_draft", "serving.spec_verify",
+    # HTTP front door (r14): one span per HTTP exchange (method/path/
+    # code args), recorded flat (depth 0) from the asyncio loop thread
+    # — interleaved coroutines would corrupt the thread-local nesting
+    # stack, so the front door records completed spans directly
+    "serving.http_request",
 )
 
 

@@ -22,6 +22,15 @@ prefixes skip prefill (LRU eviction at refcount 0, host spill/restore),
 and fixed-token prefill chunks interleaved with decode waves so TTFT
 stays bounded under mixed traffic — see docs/serving.md §Prefix caching.
 
+HTTP/SSE front door (http.py, r14): a stdlib asyncio HTTP/1.1 server
+running the engine on a dedicated step-loop thread — SSE token
+streaming with per-connection backpressure and slow-reader stall
+cancellation, disconnect cancellation that frees a dropped client's KV
+blocks within one engine step (terminal reason ``client_disconnected``),
+ShedError mapped to 429/503 + Retry-After with per-tenant limits from
+the ``X-Tenant`` header, graceful SIGTERM drain, and /healthz //readyz
+for orchestrators — see docs/serving.md §Front door.
+
 Draft-model speculative decoding (engine.py, r13): the engine hosts a
 second, smaller llama (``draft_params``/``draft_config``) whose KV pools
 share the target's physical blocks; greedy decode waves run
@@ -34,10 +43,11 @@ decoding.
 from .admission import (AdmissionConfig, AdmissionController, ShedError,
                         TokenBucket)
 from .engine import LLMEngine, Request
+from .http import HTTPFrontDoor
 from .kv_swap import HostKVPool
 from .prefix_cache import PrefixCache
 from .resilient import ResilientEngine
 
 __all__ = ["LLMEngine", "Request", "ResilientEngine", "AdmissionConfig",
            "AdmissionController", "ShedError", "TokenBucket",
-           "HostKVPool", "PrefixCache"]
+           "HostKVPool", "PrefixCache", "HTTPFrontDoor"]

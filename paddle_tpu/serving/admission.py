@@ -132,3 +132,24 @@ class AdmissionController:
         if reason is not None:
             _M_SHED.inc(reason=reason)
         return reason
+
+    def retry_after(self, tenant: str, cost: float) -> float:
+        """Seconds until ``tenant``'s bucket could afford ``cost``
+        tokens — the HTTP front door's ``Retry-After`` derivation for a
+        ``rate_limited`` shed (a 429 that names WHEN to come back beats
+        one that invites an immediate, equally doomed retry). 0.0 when
+        no rate limit applies or the tenant has no bucket yet; a cost
+        beyond the bucket's whole capacity reports the time to fill it
+        (the closest honest answer — the request can never afford more)."""
+        c = self.config
+        if c.rate_tokens_per_s <= 0:
+            return 0.0
+        bucket = self._buckets.get(tenant)
+        if bucket is None:
+            return 0.0
+        now = self._now()
+        tokens = min(bucket.capacity,
+                     bucket.tokens
+                     + (now - bucket.t_last) * bucket.rate)
+        deficit = min(float(cost), bucket.capacity) - tokens
+        return max(0.0, deficit / bucket.rate)

@@ -82,6 +82,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import math
+import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional
@@ -1087,7 +1088,8 @@ class LLMEngine:
         self.injector = injector
         # terminal disposition per request id: every id that entered
         # add_request ends in exactly one of finished / shed /
-        # deadline_exceeded (the chaos-suite contract)
+        # deadline_exceeded / client_disconnected / drained (the
+        # chaos-suite contract)
         self.finish_reasons: Dict[int, str] = {}
         self._step_idx = 0
         # blocks held hostage by an injected pool_squeeze, with their
@@ -1106,6 +1108,15 @@ class LLMEngine:
         # sweep is skipped entirely at 0, so deadline-free traffic pays
         # nothing for the feature (no O(queue) scan in the hot loop)
         self._deadline_live = 0
+        # rid -> reason marked by cancel_request (the HTTP front door's
+        # disconnect/stall/drain hook); applied at the next step boundary
+        # through the deadline-eviction machinery, so a dropped client's
+        # slot and KV blocks free within one engine step. The lock makes
+        # the marker handoff safe from ANY thread (a lock-free dict swap
+        # could lose a marker written between the swap's load and store
+        # — a disconnect that never cancels pins its KV blocks)
+        self._cancels: Dict[int, str] = {}
+        self._cancel_lock = threading.Lock()
         # every (rid, tok) pair committed host-side THIS step, in commit
         # order — the crash-salvage buffer: a step that raises after
         # committing tokens must still deliver them exactly once
@@ -1164,18 +1175,23 @@ class LLMEngine:
         return self.pools["v"]
 
     def add_request(self, prompt: List[int], **kw) -> int:
-        rid = self._next_id
-        self._next_id += 1
-        req = Request(req_id=rid, prompt=list(prompt), **kw)
+        # validate BEFORE minting the id: a rejected request must not
+        # consume a rid, or the "every minted id ends in exactly one
+        # terminal reason" contract (finish_reasons) breaks for every
+        # oversize prompt a client sends — remotely reachable through
+        # the HTTP front door's 400 path
+        req = Request(req_id=self._next_id, prompt=list(prompt), **kw)
         if len(req.prompt) + req.max_new_tokens > self.max_model_len:
             raise ValueError(
-                f"request {rid}: prompt({len(req.prompt)}) + "
+                f"request {req.req_id}: prompt({len(req.prompt)}) + "
                 f"max_new_tokens({req.max_new_tokens}) exceeds "
                 f"max_model_len({self.max_model_len})")
         if len(req.prompt) > self.buckets[-1]:
             raise ValueError(
-                f"request {rid}: prompt length {len(req.prompt)} exceeds "
-                f"the largest prompt bucket {self.buckets[-1]}")
+                f"request {req.req_id}: prompt length {len(req.prompt)} "
+                f"exceeds the largest prompt bucket {self.buckets[-1]}")
+        rid = self._next_id
+        self._next_id += 1
         if req.deadline_s is not None:
             req.t_deadline = time.perf_counter() + float(req.deadline_s)
         if self.admission is not None:
@@ -1207,10 +1223,11 @@ class LLMEngine:
             _M_QUEUE_DEPTH.set(len(self.queue))
             # the request_id minted here IS the distributed-trace id: it
             # follows the request through slots, preemptions and
-            # re-admissions (observability.request_trace)
+            # re-admissions (observability.request_trace); the tenant
+            # rides the meta into the summary (obs_dump --requests)
             _rt.get_request_tracer().submit(
                 rid, prompt_tokens=len(req.prompt),
-                max_new_tokens=req.max_new_tokens)
+                max_new_tokens=req.max_new_tokens, tenant=req.tenant)
         return rid
 
     def has_work(self) -> bool:
@@ -1378,6 +1395,12 @@ class LLMEngine:
                 _M_DEADLINE.inc()
                 _flight.record("deadline_exceeded", req_id=req.req_id,
                                tokens=len(self.results[req.req_id]))
+            elif reason != "finished":
+                # front-door cancellation (client_disconnected / drained):
+                # terminal, partial tokens delivered, but NOT a completed
+                # request — the finished counter must not absorb it
+                _flight.record(reason, req_id=req.req_id,
+                               tokens=len(self.results[req.req_id]))
             else:
                 _M_FINISHED.inc()
             now = time.perf_counter()
@@ -1490,23 +1513,72 @@ class LLMEngine:
                 swapped_in=True)
 
     def _finish_expired(self, req: Request, out: List[int],
-                        queued: bool) -> None:
-        """Terminal bookkeeping for a deadline-evicted request (partial
-        tokens delivered; its trace closes with deadline_exceeded)."""
+                        queued: bool,
+                        reason: str = "deadline_exceeded") -> None:
+        """Terminal bookkeeping for a QUEUED request evicted before any
+        slot (deadline expiry or a front-door cancellation): partial
+        tokens delivered, its trace closes with ``reason``."""
         rid = req.req_id
         self.results[rid] = out
-        self.finish_reasons[rid] = "deadline_exceeded"
-        self._deadline_live = max(0, self._deadline_live - 1)
+        self.finish_reasons[rid] = reason
+        if req.t_deadline is not None:
+            self._deadline_live = max(0, self._deadline_live - 1)
         if self.swap_pool is not None:
             self.swap_pool.discard(rid)
-        _M_DEADLINE.inc()
-        _flight.record("deadline_exceeded", req_id=rid, queued=queued,
+        if reason == "deadline_exceeded":
+            _M_DEADLINE.inc()
+        _flight.record(reason, req_id=rid, queued=queued,
                        tokens=len(out))
         self._obs_t_add.pop(rid, None)
         self._obs_t_first.pop(rid, None)
         if _obs.enabled():
             _rt.get_request_tracer().finish(
-                rid, tokens=len(out), reason="deadline_exceeded")
+                rid, tokens=len(out), reason=reason)
+
+    def cancel_request(self, rid: int,
+                       reason: str = "client_disconnected") -> None:
+        """Mark a live request for cancellation — the HTTP front door's
+        hook for a dropped connection, a stalled reader, or a drain
+        cutoff. Applied at the NEXT step boundary (the engine's state
+        machine is single-owner per step; the marker dict write is
+        atomic, so any thread may call this): queued victims finish
+        immediately with their partial tokens, in-slot victims ride the
+        deadline-eviction path — slot freed, KV blocks returned, the
+        unread in-flight wave's lanes skipped at readback via the
+        (slot, rid) snapshot check. Unknown or already-terminal rids
+        no-op (the disconnect raced the natural finish)."""
+        with self._cancel_lock:
+            self._cancels[rid] = str(reason)
+
+    def _apply_cancels(self) -> None:
+        """Evict every request marked by :meth:`cancel_request` —
+        queued (cheap) and in-slot (KV blocks freed within this step).
+        Free when no cancellation is pending (the unlocked emptiness
+        probe is safe: a marker racing past it is applied next step)."""
+        if not self._cancels:
+            return
+        with self._cancel_lock:
+            cancels, self._cancels = self._cancels, {}
+        live = {req.req_id for req in self.queue} \
+            | {r.req_id for r in self.slot_req if r is not None}
+        cancels = {rid: rsn for rid, rsn in cancels.items()
+                   if rid in live}
+        if not cancels:
+            return
+        if any(req.req_id in cancels for req in self.queue):
+            kept = deque()
+            for req in self.queue:
+                if req.req_id in cancels:
+                    self._finish_expired(req, list(req.generated),
+                                         queued=True,
+                                         reason=cancels[req.req_id])
+                else:
+                    kept.append(req)
+            self.queue = kept
+        for slot in self._active_slots():
+            req = self.slot_req[slot]
+            if req.req_id in cancels:
+                self._free_slot(slot, reason=cancels[req.req_id])
 
     def _expire_deadlines(self) -> None:
         """Evict every request past its deadline — queued (cheap) and
@@ -2600,11 +2672,13 @@ class LLMEngine:
         emitted = []
         self._step_emitted = []
         self._step_idx += 1
-        # chaos + deadlines run before admission: an injected squeeze
-        # shapes this step's block budget, and an expired request must
-        # not occupy the slot a live one could take
+        # chaos + deadlines + front-door cancellations run before
+        # admission: an injected squeeze shapes this step's block
+        # budget, and an expired or disconnected request must not
+        # occupy the slot a live one could take
         self._apply_faults()
         self._expire_deadlines()
+        self._apply_cancels()
         # stale FLOPs from an earlier dispatch must not divide a
         # no-decode step's wall time (a bogus MFU spike on idle steps)
         self._last_decode_flops = None

@@ -1,0 +1,667 @@
+"""r14 HTTP/SSE serving front door: streaming, backpressure, disconnect
+cancellation, overload mapping, graceful drain — the socket-facing
+contracts over paddle_tpu.serving.http.
+
+Contracts under test:
+- the SSE token stream is BYTE-identical to a direct engine run (frame
+  contract sse_token_frame/sse_terminal_frame, greedy parity across
+  model dtype and int8-KV pools);
+- a mid-stream client disconnect cancels the request server-side:
+  terminal reason client_disconnected on the engine + trace, KV blocks
+  freed (ledger-checked), partial tokens retained;
+- a reader whose send queue sits above FLAGS_serve_send_queue_hwm past
+  FLAGS_serve_client_stall_s is cancelled (the sweep is white-box
+  driven: a tiny model's whole stream fits the kernel socket buffers,
+  so a real socket can never back the queue up — the EOF path above
+  covers the socket-integration half);
+- ShedError maps to typed HTTP: queue_full -> 503, rate_limited -> 429
+  (Retry-After derived from the tenant's token bucket; X-Tenant
+  isolates tenants), client timeout_s -> deadline_exceeded partial
+  terminal frame, never a hang;
+- SIGTERM/begin_drain stops admission (503 + Connection: close), lets
+  in-flight streams finish, flips /readyz to 503, and ends with zero
+  active streams;
+- ResilientEngine recoveries surface as `: retrying` SSE comments, with
+  the recovered stream exactly-once.
+"""
+import dataclasses
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401  (forces the CPU/virtual-device conftest setup)
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.distributed.resilience import FaultInjector
+from paddle_tpu.framework.flags import set_flags
+from paddle_tpu.models import llama
+from paddle_tpu.serving import (AdmissionConfig, HTTPFrontDoor, LLMEngine,
+                                ResilientEngine)
+from paddle_tpu.serving.http import (_Stream, sse_retry_frame,
+                                     sse_terminal_frame, sse_token_frame)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = dataclasses.replace(
+        llama.tiny_llama(vocab=64, hidden=32, layers=2, heads=4, kv_heads=2,
+                         seq=128, ffn=64),
+        dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _prompt(rng, n):
+    return rng.integers(1, 64, size=n).tolist()
+
+
+def _engine(params, cfg, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_model_len", 64)
+    kw.setdefault("prompt_buckets", [8, 32])
+    return LLMEngine(params, cfg, **kw)
+
+
+def _post_socket(host, port, doc, headers=(), timeout=120):
+    """Open a raw client connection and send one POST /v1/generate."""
+    s = socket.create_connection((host, port), timeout=timeout)
+    body = json.dumps(doc).encode()
+    head = (f"POST /v1/generate HTTP/1.1\r\nHost: x\r\n"
+            f"Content-Length: {len(body)}\r\n")
+    for k, v in headers:
+        head += f"{k}: {v}\r\n"
+    s.sendall(head.encode() + b"\r\n" + body)
+    return s
+
+
+def _recv_all(s):
+    data = b""
+    while True:
+        c = s.recv(65536)
+        if not c:
+            break
+        data += c
+    s.close()
+    return data
+
+
+def _get(host, port, path):
+    s = socket.create_connection((host, port), timeout=60)
+    s.sendall(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+    return _recv_all(s)
+
+
+def _status(raw: bytes) -> int:
+    return int(raw.split(b" ", 2)[1])
+
+
+def _split_response(raw: bytes):
+    head, _, body = raw.partition(b"\r\n\r\n")
+    return head, body
+
+
+def _read_frames(s, n):
+    """Read until ``n`` SSE ``data:`` frames arrived (frames end with a
+    blank line)."""
+    buf = b""
+    while buf.count(b"data:") < n or not buf.endswith(b"\n\n"):
+        c = s.recv(1)
+        if not c:
+            break
+        buf += c
+    return buf
+
+
+def _wait(pred, timeout=20.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def _assert_blocks_balanced(eng):
+    acct = eng.block_accounting()
+    assert acct["free"] + acct["backed"] + acct["cached"] \
+        + acct["squeezed"] == acct["total"], acct
+
+
+# ---------------------------------------------------------------------------
+# SSE parity: the stream over a socket IS the engine's stream, bytewise
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("variant", [
+    "f32", "f32_int8kv",
+    # the bf16 variant re-derives params + compiles a third engine pair
+    # for the same code path — full-lane only (tier-1 wall-clock budget)
+    pytest.param("bf16", marks=pytest.mark.slow)])
+def test_sse_stream_bytes_match_direct_engine(model, variant):
+    cfg, params = model
+    kv = None
+    if variant == "f32_int8kv":
+        kv = "int8"
+    elif variant == "bf16":
+        cfg = dataclasses.replace(cfg, dtype=jnp.bfloat16)
+        params = jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.bfloat16), params)
+    rng = np.random.default_rng(0)
+    prompt = _prompt(rng, 6)
+
+    ref = _engine(params, cfg, kv_dtype=kv)
+    rid = ref.add_request(list(prompt), max_new_tokens=8)
+    ref_toks = ref.run()[rid]
+
+    eng = _engine(params, cfg, kv_dtype=kv)
+    front = HTTPFrontDoor(eng)
+    host, port = front.start()
+    try:
+        raw = _recv_all(_post_socket(
+            host, port, {"prompt": prompt, "max_new_tokens": 8}))
+        _head, body = _split_response(raw)
+        expect = b"".join(sse_token_frame(t) for t in ref_toks) \
+            + sse_terminal_frame(0, "finished", ref_toks)
+        assert body == expect          # byte-for-byte, not just tokens
+        # non-streaming mode returns the same tokens as one JSON body
+        raw = _recv_all(_post_socket(
+            host, port, {"prompt": prompt, "max_new_tokens": 8,
+                         "stream": False}))
+        _head, body = _split_response(raw)
+        doc = json.loads(body)
+        assert doc["tokens"] == ref_toks
+        assert doc["reason"] == "finished"
+    finally:
+        front.stop()
+    assert eng.finish_reasons == {0: "finished", 1: "finished"}
+    _assert_blocks_balanced(eng)
+
+
+# ---------------------------------------------------------------------------
+# disconnect cancellation
+# ---------------------------------------------------------------------------
+def test_disconnect_cancels_and_frees_blocks(model):
+    import paddle_tpu.observability as obs
+
+    cfg, params = model
+    rng = np.random.default_rng(1)
+    obs.get_registry().reset()
+    obs.enable()
+    try:
+        eng = _engine(params, cfg)
+        front = HTTPFrontDoor(eng)
+        host, port = front.start()
+        try:
+            s = _post_socket(host, port,
+                             {"prompt": _prompt(rng, 6),
+                              "max_new_tokens": 40})
+            buf = _read_frames(s, 2)
+            assert b"data:" in buf
+            s.close()                      # mid-stream disconnect
+            assert _wait(lambda: 0 in eng.finish_reasons)
+            assert eng.finish_reasons[0] == "client_disconnected"
+            # KV blocks freed: nothing backed, ledger balanced
+            acct = eng.block_accounting()
+            assert acct["backed"] == 0
+            _assert_blocks_balanced(eng)
+            # the tokens streamed before the disconnect were delivered
+            # exactly once and retained as the partial result
+            assert len(eng.results[0]) >= 2
+            reg = obs.get_registry()
+            assert reg.counter(
+                "serving_http_client_disconnects_total"
+            ).labels().value >= 1
+            # the trace closed with the new terminal reason
+            tracer = obs.get_request_tracer()
+            doc = tracer.get(0)
+            assert doc["summary"]["reason"] == "client_disconnected"
+        finally:
+            front.stop()
+    finally:
+        obs.disable()
+        obs.get_registry().reset()
+
+
+def test_slow_reader_stall_cancels_server_side(model):
+    """White-box sweep drive: a stream whose send queue reports depth
+    above the high-water mark for longer than the stall budget is
+    cancelled and its KV blocks free at the next step. (Through a real
+    socket a tiny model's whole stream fits in the kernel buffers — the
+    queue can only back up when the writer coroutine blocks in drain(),
+    which needs multi-KB streams — so the sweep is driven directly; the
+    socket-integration half of the disconnect path is covered above.)"""
+    import paddle_tpu.observability as obs
+
+    cfg, params = model
+    rng = np.random.default_rng(2)
+    obs.get_registry().reset()
+    obs.enable()
+    try:
+        eng = _engine(params, cfg)
+        front = HTTPFrontDoor(eng)          # never started: no threads
+        rid = eng.add_request(_prompt(rng, 6), max_new_tokens=8)
+        eng.step()
+
+        class _StuckQueue:
+            def qsize(self):
+                return 99                    # frames nobody drains
+
+        st = _Stream(rid, _StuckQueue(), None)
+        front._streams[rid] = st
+        set_flags({"serve_client_stall_s": 0.02,
+                   "serve_send_queue_hwm": 4})
+        try:
+            front._sweep_stalls()            # arms the stall clock
+            assert st.stall_t0 is not None
+            assert rid not in eng._cancels   # not yet past the budget
+            time.sleep(0.05)
+            front._sweep_stalls()            # past the budget: cancels
+            assert st.cancelled
+            while eng.has_work():
+                eng.step()
+            assert eng.finish_reasons[rid] == "client_disconnected"
+            _assert_blocks_balanced(eng)
+            assert eng.block_accounting()["backed"] == 0
+            reg = obs.get_registry()
+            assert reg.counter(
+                "serving_http_client_disconnects_total"
+            ).labels().value >= 1
+            assert reg.gauge(
+                "serving_http_send_queue_depth").labels().value == 99
+        finally:
+            set_flags({"serve_client_stall_s": 10.0,
+                       "serve_send_queue_hwm": 32})
+    finally:
+        obs.disable()
+        obs.get_registry().reset()
+
+
+# ---------------------------------------------------------------------------
+# overload mapping: ShedError -> 429/503 + Retry-After
+# ---------------------------------------------------------------------------
+def test_queue_full_maps_503_with_retry_after(model):
+    cfg, params = model
+    rng = np.random.default_rng(3)
+    eng = _engine(params, cfg, max_slots=1,
+                  admission=AdmissionConfig(max_queue=1))
+    front = HTTPFrontDoor(eng)
+    host, port = front.start()
+    try:
+        # slot occupied by a long stream, queue filled by a second
+        s1 = _post_socket(host, port, {"prompt": _prompt(rng, 6),
+                                       "max_new_tokens": 30})
+        _read_frames(s1, 1)                  # admitted and decoding
+        done2 = {}
+
+        def queued_client():
+            raw = _recv_all(_post_socket(
+                host, port, {"prompt": _prompt(rng, 6),
+                             "max_new_tokens": 4, "stream": False}))
+            done2["status"] = _status(raw)
+
+        t = threading.Thread(target=queued_client)
+        t.start()
+        assert _wait(lambda: len(eng.queue) >= 1)
+        raw = _recv_all(_post_socket(
+            host, port, {"prompt": _prompt(rng, 6), "max_new_tokens": 4}))
+        head, body = _split_response(raw)
+        assert _status(raw) == 503
+        assert b"Retry-After:" in head
+        assert json.loads(body)["reason"] == "queue_full"
+        _recv_all(s1)
+        t.join(60)
+        assert done2["status"] == 200        # the queued one was served
+    finally:
+        front.stop()
+    assert "shed" in eng.finish_reasons.values()
+
+
+def test_rate_limited_maps_429_per_tenant_bucket(model):
+    cfg, params = model
+    rng = np.random.default_rng(4)
+    # burst 30 at cost 6+20=26 per request: each admission nearly drains
+    # the bucket, so however much the slow compile/refill timing tops it
+    # back up between requests (capped at 30), the request right after
+    # an admitted one always finds < 26 tokens -> rate_limited
+    eng = _engine(params, cfg,
+                  admission=AdmissionConfig(max_queue=16,
+                                            rate_tokens_per_s=2.0,
+                                            burst_tokens=30.0))
+    # warm under a throwaway tenant: compiles everything while leaving
+    # the default tenant's bucket untouched at its full burst
+    warm = eng.add_request(_prompt(rng, 6), max_new_tokens=20,
+                           tenant="warmup")
+    eng.run()
+    assert eng.finish_reasons[warm] == "finished"
+    front = HTTPFrontDoor(eng)
+    host, port = front.start()
+    try:
+        prompt = _prompt(rng, 6)
+        raw = _recv_all(_post_socket(
+            host, port, {"prompt": prompt, "max_new_tokens": 20,
+                         "stream": False}))
+        assert _status(raw) == 200
+        raw = _recv_all(_post_socket(
+            host, port, {"prompt": prompt, "max_new_tokens": 20}))
+        head, body = _split_response(raw)
+        assert _status(raw) == 429
+        m = re.search(rb"Retry-After: (\d+)", head)
+        assert m is not None
+        # deficit/rate: needs ~26 - (0..4) remaining at 2/s -> ~11-13 s
+        assert 1 <= int(m.group(1)) <= 15
+        assert json.loads(body)["reason"] == "rate_limited"
+        # another tenant owns its own bucket
+        raw = _recv_all(_post_socket(
+            host, port, {"prompt": prompt, "max_new_tokens": 20,
+                         "stream": False},
+            headers=[("X-Tenant", "other")]))
+        assert _status(raw) == 200
+    finally:
+        front.stop()
+
+
+def test_bad_requests_map_400(model):
+    cfg, params = model
+    eng = _engine(params, cfg)
+    front = HTTPFrontDoor(eng)
+    host, port = front.start()
+    try:
+        for doc in (
+                {"max_new_tokens": 4},                    # no prompt
+                {"prompt": "text"},                       # not token ids
+                {"prompt": [1, 2], "max_new_tokens": "x"},
+                {"prompt": [1, 2], "max_new_tokens": 400}):  # > model len
+            raw = _recv_all(_post_socket(host, port, doc))
+            assert _status(raw) == 400, doc
+    finally:
+        front.stop()
+
+
+# ---------------------------------------------------------------------------
+# client timeout -> deadline -> partial-result terminal frame
+# ---------------------------------------------------------------------------
+def test_timeout_returns_partial_result_frame(model):
+    cfg, params = model
+    rng = np.random.default_rng(5)
+    # the engine is warmed before the front door opens, then every step
+    # is slowed 20 ms by the injector — the 0.25 s budget deterministically
+    # expires mid-decode with SOME tokens already streamed
+    inj = FaultInjector([("slow_step", s) for s in range(1, 80)])
+    eng = _engine(params, cfg, max_slots=1)
+    warm = eng.add_request(_prompt(rng, 6), max_new_tokens=2)
+    eng.run()
+    assert eng.finish_reasons[warm] == "finished"
+    eng.injector = inj
+    front = HTTPFrontDoor(eng)
+    host, port = front.start()
+    try:
+        raw = _recv_all(_post_socket(
+            host, port, {"prompt": _prompt(rng, 6), "max_new_tokens": 50,
+                         "timeout_s": 0.25}))
+        _head, body = _split_response(raw)
+        frames = [json.loads(c.split(b"\n", 1)[0])
+                  for c in body.split(b"data: ")[1:]]
+        terminal = frames[-1]
+        assert terminal["done"] and terminal["reason"] \
+            == "deadline_exceeded"
+        streamed = [f["token"] for f in frames if "token" in f]
+        assert streamed == terminal["tokens"]      # partial, exactly-once
+        assert 0 < len(streamed) < 50
+    finally:
+        front.stop()
+    _assert_blocks_balanced(eng)
+
+
+# ---------------------------------------------------------------------------
+# graceful drain + health endpoints
+# ---------------------------------------------------------------------------
+def test_drain_finishes_streams_and_flips_readyz(model):
+    import paddle_tpu.observability as obs
+
+    cfg, params = model
+    rng = np.random.default_rng(6)
+    obs.get_registry().reset()
+    obs.enable()
+    try:
+        eng = _engine(params, cfg)
+        front = HTTPFrontDoor(eng)
+        host, port = front.start()
+        try:
+            assert _status(_get(host, port, "/readyz")) == 200
+            s = _post_socket(host, port, {"prompt": _prompt(rng, 6),
+                                          "max_new_tokens": 20})
+            _read_frames(s, 1)
+            front.begin_drain(drain_s=30)
+            assert _status(_get(host, port, "/readyz")) == 503
+            assert _status(_get(host, port, "/healthz")) == 200
+            raw = _recv_all(_post_socket(
+                host, port, {"prompt": _prompt(rng, 4),
+                             "max_new_tokens": 2}))
+            head, body = _split_response(raw)
+            assert _status(raw) == 503
+            assert b"Connection: close" in head
+            assert json.loads(body)["reason"] == "draining"
+            # the in-flight stream finishes normally inside the budget
+            rest = _recv_all(s)
+            terminal = json.loads(
+                rest.split(b"data: ")[-1].split(b"\n", 1)[0])
+            assert terminal["reason"] == "finished"
+            assert len(terminal["tokens"]) == 20
+            assert front.wait_drained(30)
+            assert front.active_streams == 0
+            reg = obs.get_registry()
+            snap = reg.histogram(
+                "serving_http_drain_seconds").labels()
+            assert sum(snap.counts) >= 1
+        finally:
+            front.stop()
+    finally:
+        obs.disable()
+        obs.get_registry().reset()
+    assert eng.finish_reasons[0] == "finished"
+    _assert_blocks_balanced(eng)
+
+
+def test_drain_budget_cuts_stragglers(model):
+    cfg, params = model
+    rng = np.random.default_rng(7)
+    eng = _engine(params, cfg, max_slots=1)
+    warm = eng.add_request(_prompt(rng, 6), max_new_tokens=2)
+    eng.run()
+    # every step stalls 20 ms: the 0.2 s drain budget cannot cover the
+    # 40-token stream, so the drain must CUT it with reason "drained"
+    eng.injector = FaultInjector([("slow_step", s) for s in range(1, 99)])
+    front = HTTPFrontDoor(eng)
+    host, port = front.start()
+    try:
+        s = _post_socket(host, port, {"prompt": _prompt(rng, 6),
+                                      "max_new_tokens": 40})
+        _read_frames(s, 1)
+        front.begin_drain(drain_s=0.2)
+        raw = _recv_all(s)
+        terminal = json.loads(raw.split(b"data: ")[-1].split(b"\n", 1)[0])
+        assert terminal["reason"] == "drained"
+        assert 0 < len(terminal["tokens"]) < 40
+        assert front.wait_drained(30)
+    finally:
+        front.stop()
+    rid = max(eng.finish_reasons)
+    assert eng.finish_reasons[rid] == "drained"
+    assert eng.block_accounting()["backed"] == 0
+    _assert_blocks_balanced(eng)
+
+
+def test_health_endpoints_and_routing(model):
+    cfg, params = model
+    eng = _engine(params, cfg)
+    front = HTTPFrontDoor(eng)
+    host, port = front.start()
+    try:
+        raw = _get(host, port, "/healthz")
+        assert _status(raw) == 200
+        assert json.loads(_split_response(raw)[1])["ok"] is True
+        assert _status(_get(host, port, "/readyz")) == 200
+        assert _status(_get(host, port, "/nope")) == 404
+        assert _status(_get(host, port, "/v1/generate")) == 405
+    finally:
+        front.stop()
+
+
+# ---------------------------------------------------------------------------
+# resilience: recoveries surface as SSE retrying comments
+# ---------------------------------------------------------------------------
+def test_recovery_emits_retrying_comment_and_stays_exactly_once(model):
+    cfg, params = model
+    rng = np.random.default_rng(8)
+    prompt = _prompt(rng, 6)
+    ref = _engine(params, cfg)
+    rid = ref.add_request(list(prompt), max_new_tokens=12)
+    ref_toks = ref.run()[rid]
+
+    eng = _engine(params, cfg)
+    warm = eng.add_request(_prompt(rng, 4), max_new_tokens=2)
+    eng.run()
+    assert eng.finish_reasons[warm] == "finished"
+    # a readback crash two steps into the stream: ResilientEngine must
+    # recover AND the client must see a retrying comment, not a stall
+    eng.injector = FaultInjector([("readback_fail", eng._step_idx + 3)])
+    reng = ResilientEngine(eng)
+    front = HTTPFrontDoor(reng)
+    host, port = front.start()
+    try:
+        raw = _recv_all(_post_socket(
+            host, port, {"prompt": prompt, "max_new_tokens": 12}))
+        _head, body = _split_response(raw)
+        assert sse_retry_frame(1) in body
+        frames = [json.loads(c.split(b"\n", 1)[0])
+                  for c in body.split(b"data: ")[1:]]
+        terminal = frames[-1]
+        assert terminal["reason"] == "finished"
+        streamed = [f["token"] for f in frames if "token" in f]
+        assert streamed == terminal["tokens"]     # exactly-once
+        assert terminal["tokens"] == ref_toks     # greedy parity held
+        assert reng.recoveries == 1
+    finally:
+        front.stop()
+    _assert_blocks_balanced(eng)
+
+
+# ---------------------------------------------------------------------------
+# concurrency + tenants
+# ---------------------------------------------------------------------------
+def test_concurrent_multi_tenant_smoke(model):
+    import paddle_tpu.observability as obs
+
+    cfg, params = model
+    obs.get_registry().reset()
+    obs.enable()
+    try:
+        eng = _engine(params, cfg,
+                      admission=AdmissionConfig(max_queue=16))
+        front = HTTPFrontDoor(eng)
+        host, port = front.start()
+        results = {}
+
+        def client(i):
+            raw = _recv_all(_post_socket(
+                host, port,
+                {"prompt": _prompt(np.random.default_rng(100 + i), 6),
+                 "max_new_tokens": 6, "stream": False},
+                headers=[("X-Tenant", f"tenant{i % 2}")]))
+            results[i] = (_status(raw),
+                          json.loads(_split_response(raw)[1]))
+
+        try:
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(120)
+            assert sorted(results) == [0, 1, 2, 3]
+            for i, (code, doc) in results.items():
+                assert code == 200
+                assert doc["reason"] == "finished"
+                assert len(doc["tokens"]) == 6
+            # the tenant column rides the trace summaries
+            rows = obs.requests_payload()["requests"]
+            tenants = {r.get("tenant") for r in rows}
+            assert {"tenant0", "tenant1"} <= tenants
+        finally:
+            front.stop()
+    finally:
+        obs.disable()
+        obs.get_registry().reset()
+    assert set(eng.finish_reasons.values()) == {"finished"}
+    _assert_blocks_balanced(eng)
+
+
+# ---------------------------------------------------------------------------
+# tooling (slow lane)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_chaos_run_http():
+    """tools/chaos_run.py --http: seeded disconnects + stalled readers +
+    2x overload burst + SIGTERM mid-stream end with every id terminal,
+    a balanced ledger at every step, and a clean drain."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_run.py"),
+         "--http", "--requests", "18", "--seed", "7"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, timeout=600,
+        cwd=REPO, env=env)
+    out = proc.stdout.decode(errors="replace")
+    assert proc.returncode == 0, out[-2000:]
+    assert "HTTP_CHAOS: OK" in out
+    assert "disconnect_cancels=" in out and "recoveries=" in out
+
+
+@pytest.mark.slow
+def test_serve_cli_smoke():
+    """tools/serve.py subprocess: binds, answers health + one generate,
+    and a SIGINT drains to a clean exit 0."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tools", "serve.py"),
+         "--port", "0", "--vocab", "64", "--hidden", "32",
+         "--layers", "1", "--max-len", "64", "--block-size", "8",
+         "--max-slots", "2", "--flags", "serve_drain_s=10"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        cwd=REPO, env=env)
+    port = None
+    try:
+        t0 = time.time()
+        while time.time() - t0 < 180:
+            line = proc.stdout.readline().decode(errors="replace")
+            m = re.search(r"serving on http://127\.0\.0\.1:(\d+)", line)
+            if m:
+                port = int(m.group(1))
+                break
+        assert port, "server never printed its address"
+        raw = _get("127.0.0.1", port, "/healthz")
+        assert _status(raw) == 200
+        raw = _recv_all(_post_socket(
+            "127.0.0.1", port,
+            {"prompt": [1, 2, 3], "max_new_tokens": 4, "stream": False},
+            timeout=180))
+        assert _status(raw) == 200
+        assert len(json.loads(_split_response(raw)[1])["tokens"]) == 4
+        proc.send_signal(signal.SIGINT)
+        out, _ = proc.communicate(timeout=120)
+        assert proc.returncode == 0, out[-2000:]
+        assert b"drained; bye" in out
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate(timeout=30)

@@ -368,10 +368,18 @@ def test_obs_dump_demo_serving_smoke(tmp_path):
     # r10: the re-sent first prompt hits the cache and skips its prefix
     assert "prefix cache: hits=1" in out, out[-2000:]
     assert "prefill_tokens_skipped=8" in out
+    # r14: one real HTTP round-trip through the SSE front door with the
+    # serving_http_* counters
+    assert "http front door: one round-trip -> 6 tokens" in out, \
+        out[-2000:]
+    # the generate POST and the /readyz probe both count under code=200
+    assert "requests_total[200]=2" in out
     # r7: the demo ends with the per-request table + exemplar pointer
-    # (5 rows: the r10 cache-hit request rides the original four)
-    assert "requests: 5 traced" in out, out[-2000:]
+    # (8 rows: the original four + the r10 cache hit + the r13 spec
+    # engine's two + the r14 HTTP round-trip)
+    assert "requests: 8 traced" in out, out[-2000:]
     assert "ttft_ms" in out and "preempt" in out and "cached" in out
+    assert "tenant" in out                           # r14 tenant column
     assert "shed" in out and "deadline" in out     # reason column
     assert "exemplar: request" in out
     assert (tmp_path / "snapshot.json").exists()

@@ -37,8 +37,27 @@ blocks, so the 4-term invariant is unchanged with spec on).
 
     JAX_PLATFORMS=cpu python tools/chaos_run.py --serving --steps 24 --seed 7
 
-Wired into the suite as tests/test_resilience.py::test_chaos_run_llama_parity
-and tests/test_serving_resilience.py::test_chaos_run_serving
+HTTP mode (``--http``) — chaos at the NETWORK layer (r14): a real
+HTTPFrontDoor (asyncio HTTP/1.1 + SSE over a ResilientEngine with
+seeded readback crashes and pool squeezes) is driven by concurrent
+stdlib-socket clients with seeded behaviors — mid-stream disconnects,
+readers that never consume their stream, an offered-load burst at ~2x
+slot capacity against a bounded admission queue, short client timeouts,
+and a SIGTERM fired while streams are live (drain). A run passes when
+every request id the engine minted ends in exactly one terminal reason
+({finished, shed, deadline_exceeded, client_disconnected, drained}),
+the 4-term block ledger balances at EVERY engine step (asserted from
+the front door's step hook), completed SSE streams are exactly-once
+(streamed frames == terminal frame token list), at least one shed and
+one disconnect-cancel actually fired, the injected crash was recovered,
+and after the drain there are zero live streams, zero backed blocks and
+an empty swap tier.
+
+    JAX_PLATFORMS=cpu python tools/chaos_run.py --http --requests 18 --seed 7
+
+Wired into the suite as tests/test_resilience.py::test_chaos_run_llama_parity,
+tests/test_serving_resilience.py::test_chaos_run_serving and
+tests/test_http_server.py::test_chaos_run_http
 (slow lane: PADDLE_TPU_FULL_TESTS=1).
 """
 import argparse
@@ -235,12 +254,251 @@ def serving_main(args):
     return 0 if ok else 1
 
 
+def http_main(args):
+    """Network-layer chaos: seeded client misbehavior against a live
+    HTTPFrontDoor, engine invariants asserted from the socket inward."""
+    import dataclasses
+    import json
+    import signal
+    import socket
+    import threading
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu.observability as obs
+    from paddle_tpu.distributed.resilience import FaultInjector
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.models import llama
+    from paddle_tpu.serving import (AdmissionConfig, HTTPFrontDoor,
+                                    LLMEngine, ResilientEngine)
+
+    obs.enable()
+    set_flags({"serve_drain_s": 20.0})
+    cfg = dataclasses.replace(
+        llama.tiny_llama(vocab=64, hidden=32, layers=2, heads=4, kv_heads=2,
+                         seq=128, ffn=64),
+        dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(args.seed))
+    eng = LLMEngine(params, cfg, max_slots=2, block_size=8,
+                    max_model_len=64, num_blocks=9, prompt_buckets=[8, 32],
+                    kv_swap_bytes=1 << 20,
+                    admission=AdmissionConfig(max_queue=3))
+    # warm the compile caches BEFORE opening traffic (threads not
+    # started yet, so driving the engine here is safe): cold-start
+    # compilation would otherwise stall the first burst for seconds and
+    # turn the whole offered load into queue_full sheds — chaos should
+    # exercise a SERVING engine, not a compiling one
+    wrng = np.random.default_rng(args.seed)
+    for _ in range(2):
+        eng.add_request(wrng.integers(1, 64, size=6).tolist(),
+                        max_new_tokens=4)
+    eng.run()
+    # the injector arms only now, with steps keyed past the warmup:
+    # readback crashes timed to hit live streams (retrying comment
+    # frames + recovery), one squeeze for pool pressure
+    base = eng._step_idx
+    inj = FaultInjector([("readback_fail", base + 4),
+                         ("readback_fail", base + 12),
+                         ("pool_squeeze", base + 8)])
+    eng.injector = inj
+    reng = ResilientEngine(eng)
+
+    violations = []
+
+    def ledger_hook(e):
+        acct = e.block_accounting()
+        if acct["free"] + acct["backed"] + acct["cached"] \
+                + acct["squeezed"] != acct["total"]:
+            violations.append((e._step_idx, acct))
+
+    front = HTTPFrontDoor(reng, step_hook=ledger_hook)
+    host, port = front.start()
+    # SIGTERM mid-stream = the orchestrator's restart signal: drain
+    signal.signal(signal.SIGTERM, lambda *_a: front.begin_drain())
+
+    rng = np.random.default_rng(args.seed)
+    records = []
+    rec_lock = threading.Lock()
+
+    def draw_workload(behavior):
+        # drawn on the MAIN thread only: numpy Generators are not
+        # thread-safe, and same-seed reruns must offer the same
+        # prompts whatever the client-thread scheduling
+        doc = {"prompt": rng.integers(
+                   1, 64, size=int(rng.integers(3, 12))).tolist(),
+               "max_new_tokens": int(rng.integers(8, 20))}
+        if behavior == "deadline":
+            doc["timeout_s"] = 0.05
+        return doc
+
+    def run_client(i, behavior, doc):
+        rec = {"i": i, "behavior": behavior, "code": None,
+               "streamed": [], "terminal": None, "reason": None}
+        try:
+            body = json.dumps(doc).encode()
+            s = socket.create_connection((host, port), timeout=30)
+            s.sendall((f"POST /v1/generate HTTP/1.1\r\nHost: x\r\n"
+                       f"Content-Length: {len(body)}\r\n"
+                       f"X-Tenant: t{i % 3}\r\n\r\n").encode() + body)
+            buf = b""
+            while b"\r\n\r\n" not in buf:
+                c = s.recv(4096)
+                if not c:
+                    break
+                buf += c
+            rec["code"] = int(buf.split(b" ", 2)[1]) if buf else None
+            if rec["code"] != 200:
+                s.close()
+                return
+            if behavior == "disconnect":
+                # slam the connection after the first token frame: the
+                # server must cancel the request and free its blocks
+                while buf.count(b"data:") < 1:
+                    c = s.recv(1)
+                    if not c:
+                        break
+                    buf += c
+                s.close()
+                return
+            if behavior == "stall":
+                # never consume the stream: the server must not wedge
+                # (tiny streams fit the kernel buffers, so the engine
+                # finishes the request; the stall-cancel sweep itself
+                # is white-box-tested — tests/test_http_server.py)
+                time.sleep(0.6)
+                s.close()
+                return
+            while True:                    # normal / deadline readers
+                c = s.recv(65536)
+                if not c:
+                    break
+                buf += c
+            s.close()
+            for chunk in buf.split(b"data: ")[1:]:
+                payload = chunk.split(b"\n", 1)[0]
+                obj = json.loads(payload)
+                if "token" in obj:
+                    rec["streamed"].append(obj["token"])
+                elif obj.get("done"):
+                    rec["terminal"] = obj["tokens"]
+                    rec["reason"] = obj["reason"]
+        except (OSError, ValueError) as e:
+            rec.setdefault("error", repr(e))
+        finally:
+            with rec_lock:
+                records.append(rec)
+
+    # seeded behavior mix; bursts of 6 concurrent clients offer ~2x the
+    # 2-slot + 3-queue capacity, so the bounded queue MUST shed
+    behaviors = []
+    for i in range(args.requests):
+        r = rng.random()
+        behaviors.append("disconnect" if r < 0.2 else
+                         "stall" if r < 0.35 else
+                         "deadline" if r < 0.5 else "normal")
+    workloads = [draw_workload(b) for b in behaviors]
+    late_doc = draw_workload("normal")
+    threads = []
+    for burst_start in range(0, len(behaviors), 6):
+        burst = behaviors[burst_start:burst_start + 6]
+        for j, b in enumerate(burst):
+            t = threading.Thread(
+                target=run_client,
+                args=(burst_start + j, b, workloads[burst_start + j]))
+            t.start()
+            threads.append(t)
+        time.sleep(0.4)
+    # SIGTERM while the last burst's streams are in flight: drain must
+    # let them finish and 503 every later arrival
+    os.kill(os.getpid(), signal.SIGTERM)
+    late = threading.Thread(target=run_client,
+                            args=(len(behaviors), "normal", late_doc))
+    late.start()
+    threads.append(late)
+    for t in threads:
+        t.join(60)
+    ok = front.wait_drained(30)
+    front.stop()
+
+    reasons = dict(eng.finish_reasons)
+    counts = {}
+    for r in reasons.values():
+        counts[r] = counts.get(r, 0) + 1
+    codes = {}
+    for rec in records:
+        codes[rec["code"]] = codes.get(rec["code"], 0) + 1
+    reg = obs.get_registry()
+    disconnects = int(reg.counter(
+        "serving_http_client_disconnects_total").labels().value)
+    print(f"http chaos: {args.requests} clients {codes} | terminal "
+          f"{counts} | recoveries={reng.recoveries} "
+          f"disconnect_cancels={disconnects} faults fired={inj.fired}")
+
+    if not ok:
+        print("drain never completed")
+    terminal = {"finished", "shed", "deadline_exceeded",
+                "client_disconnected", "drained"}
+    minted = set(range(eng._next_id))
+    if set(reasons) != minted:
+        print(f"requests without a terminal state: "
+              f"{sorted(minted - set(reasons))}")
+        ok = False
+    if not set(reasons.values()) <= terminal:
+        print(f"non-terminal reasons: {set(reasons.values()) - terminal}")
+        ok = False
+    if violations:
+        print(f"block ledger violations: {violations[:3]}")
+        ok = False
+    for rec in records:
+        if rec["behavior"] in ("normal", "deadline") \
+                and rec["terminal"] is not None \
+                and rec["reason"] == "finished" \
+                and rec["streamed"] != rec["terminal"]:
+            print(f"client {rec['i']}: streamed/terminal mismatch "
+                  f"{rec['streamed']} != {rec['terminal']}")
+            ok = False
+    acct = eng.block_accounting()
+    if not (acct["free"] + acct["cached"] == acct["total"]
+            and acct["backed"] == 0 and acct["squeezed"] == 0
+            and acct["swapped_host_blocks"] == 0):
+        print(f"drained ledger not clean: {acct}")
+        ok = False
+    if front.active_streams != 0:
+        print(f"{front.active_streams} streams survived the drain")
+        ok = False
+    if eng.swap_pool.bytes_used != 0:
+        print(f"host swap pool leaked {eng.swap_pool.bytes_used} bytes")
+        ok = False
+    if counts.get("shed", 0) < 1:
+        print("the 2x overload burst never hit the bounded queue")
+        ok = False
+    draining_503 = any(rec["code"] == 503 for rec in records
+                       if rec["i"] >= len(behaviors))
+    if not draining_503:
+        print("the post-SIGTERM arrival was not refused with 503")
+        ok = False
+    if disconnects < 1:
+        print("no disconnect was cancelled server-side")
+        ok = False
+    if reng.recoveries < 1:
+        print("the injected readback crash never fired/recovered")
+        ok = False
+
+    print("HTTP_CHAOS: OK" if ok else "HTTP_CHAOS: FAIL")
+    return 0 if ok else 1
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     mode = ap.add_mutually_exclusive_group()
     mode.add_argument("--serving", action="store_true",
                       help="run the serving-engine chaos suite instead "
                            "of the train-loop parity run")
+    mode.add_argument("--http", action="store_true",
+                      help="run the network-layer chaos suite against a "
+                           "live HTTP/SSE front door")
     mode.add_argument("--train", action="store_true",
                       help="run the train-loop chaos parity suite "
                            "(the default; the flag names it explicitly)")
@@ -257,6 +515,8 @@ def main():
 
     if args.serving:
         return serving_main(args)
+    if args.http:
+        return http_main(args)
 
     import jax
     import jax.numpy as jnp

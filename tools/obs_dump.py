@@ -85,20 +85,23 @@ def print_request_table(payload, out=sys.stdout):
         out.write("(no traced requests — enable observability and "
                   "serve traffic)\n")
         return rows
-    hdr = (f"{'request':>8} {'state':>6} {'queue_ms':>9} {'ttft_ms':>9} "
-           f"{'tpot_ms':>8} {'tok/s':>8} {'tokens':>6} {'cached':>6} "
-           f"{'preempt':>7} {'reason':>9}\n")
+    hdr = (f"{'request':>8} {'state':>6} {'tenant':>8} {'queue_ms':>9} "
+           f"{'ttft_ms':>9} {'tpot_ms':>8} {'tok/s':>8} {'tokens':>6} "
+           f"{'cached':>6} {'preempt':>7} {'reason':>9}\n")
     out.write(hdr)
     out.write("-" * (len(hdr) - 1) + "\n")
     for r in rows:
         tps = r.get("decode_tps")
         tps_s = f"{tps:.1f}" if isinstance(tps, (int, float)) else "-"
-        # terminal disposition (finished/shed/deadline_exceeded);
-        # live rows and pre-r8 payloads have none
+        # terminal disposition (finished/shed/deadline_exceeded/
+        # client_disconnected/drained); live rows and pre-r8 payloads
+        # have none
         reason = r.get("reason") or "-"
-        reason = {"deadline_exceeded": "deadline"}.get(reason, reason)
+        reason = {"deadline_exceeded": "deadline",
+                  "client_disconnected": "gone"}.get(reason, reason)
         out.write(f"{str(r.get('request_id')):>8} "
                   f"{'live' if r.get('live') else 'done':>6} "
+                  f"{str(r.get('tenant') or '-')[:8]:>8} "
                   f"{_fmt_ms(r.get('queue_ms')):>9} "
                   f"{_fmt_ms(r.get('ttft_ms')):>9} "
                   f"{_fmt_ms(r.get('tpot_ms')):>8} "
@@ -308,6 +311,34 @@ def demo_serving():
           f"{reg.gauge('serving_spec_tokens_per_wave').labels().value:.2f} "
           f"draft_steps={seng.spec_draft_steps} "
           f"verify_calls={seng.spec_verify_calls}")
+    # r14: one real HTTP round-trip through the SSE front door — the
+    # speculative engine serves one request over a socket, then the
+    # serving_http_* family has non-zero evidence in the table
+    import json as _json
+    import urllib.request
+
+    from paddle_tpu.serving import HTTPFrontDoor
+    front = HTTPFrontDoor(seng)
+    host, port = front.start()
+    req = urllib.request.Request(
+        f"http://{host}:{port}/v1/generate",
+        data=_json.dumps({"prompt": rng.integers(1, 64, size=6).tolist(),
+                          "max_new_tokens": 6,
+                          "stream": False}).encode(),
+        headers={"X-Tenant": "demo"}, method="POST")
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        doc = _json.loads(resp.read())
+    ready = urllib.request.urlopen(
+        f"http://{host}:{port}/readyz", timeout=30).status
+    front.stop()
+    print(f"http front door: one round-trip -> {len(doc['tokens'])} "
+          f"tokens ({doc['reason']}), readyz={ready}; "
+          f"requests_total[200]={_c('serving_http_requests_total', code='200')} "
+          f"client_disconnects={_c('serving_http_client_disconnects_total')} "
+          "active_streams="
+          f"{int(reg.gauge('serving_http_active_streams').labels().value)} "
+          "send_queue_depth="
+          f"{int(reg.gauge('serving_http_send_queue_depth').labels().value)}")
     print(f"finish reasons: {eng.finish_reasons}")
     print()
     print_request_table(obs.requests_payload())
