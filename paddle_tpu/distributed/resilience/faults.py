@@ -33,9 +33,13 @@ __all__ = ["FaultInjector", "SimulatedCrash", "FAULT_KINDS",
 # pool pressure — the preemption/swap path's trigger), spec_verify_fail
 # crashes a speculative wave between its verify dispatch and readback
 # (nothing of the wave is host-visible yet: recovery must roll back to
-# the last committed token with zero emitted-stream divergence)
+# the last committed token with zero emitted-stream divergence),
+# offload_crash crashes the engine's offload tick while async KV
+# transfers may be in flight (r15: the poisoned-wave rule must extend
+# to transfers — abandoned spills release reservations and return
+# custody blocks, no half-landed payload ever commits)
 SERVING_FAULT_KINDS = ("readback_fail", "slow_step", "pool_squeeze",
-                       "spec_verify_fail")
+                       "spec_verify_fail", "offload_crash")
 
 # nan_inject poisons ONE named layer group of the model state for one
 # attempt (the forward then goes NaN from that layer on) — the seeded,

@@ -137,6 +137,38 @@ CATALOG = {
         "counter", (), "crashed engine steps recovered by "
                        "ResilientEngine (poisoned in-flight wave "
                        "dropped, requests re-enqueued)"),
+    # -- serving async KV offload tier (serving.offload, r15) ---------------
+    "serving_kv_offload_prefetch_hits_total": (
+        "counter", (), "restores (swap-in re-admissions / spilled "
+                       "prefix-node matches) whose payload the "
+                       "prefetch-ahead engine had already staged on "
+                       "device — consumed with zero inline h2d wait"),
+    "serving_kv_offload_stalls_total": (
+        "counter", (), "restores that found nothing staged and paid "
+                       "the h2d transfer inline (plus admissions that "
+                       "had to force-land a still-in-flight spill); "
+                       "counted in async AND forced-sync modes, so the "
+                       "async/sync bench comparison reads one counter"),
+    "serving_kv_offload_stall_seconds_total": (
+        "counter", (), "observed seconds restores spent blocked on "
+                       "inline transfers (the latency the prefetch "
+                       "tier exists to hide). Async mode measures the "
+                       "full transfer wait; forced-sync mode records "
+                       "only host-side dispatch time — its transfer "
+                       "wait overlaps into the scatter, as pre-r15 — "
+                       "so compare stall COUNTS across modes, never "
+                       "seconds"),
+    "serving_kv_offload_inflight_bytes": (
+        "gauge", (), "bytes of async d2h spill transfers currently in "
+                     "flight (their source blocks ride the block "
+                     "ledger's transient in_flight term until the "
+                     "step-boundary completion sweep lands them)"),
+    "serving_kv_offload_proactive_spills_total": (
+        "counter", (), "refcount-0 LRU cached blocks whose payload was "
+                       "copied host-side in the BACKGROUND under pool "
+                       "pressure — a later reclaim then frees the "
+                       "device block instantly instead of paying the "
+                       "d2h inline"),
     # -- serving prefix cache + chunked prefill (serving.prefix_cache) -----
     "serving_prefix_cache_hits_total": (
         "counter", (), "admissions whose prompt matched >= 1 cached "

@@ -126,10 +126,17 @@ class RequestContext:
         cached = next((int(ev["cached_tokens"]) for ev in self.events
                        if ev["kind"] in ("admitted", "resumed")
                        and "cached_tokens" in ev), 0)
+        # how the request's last swap-in restore met the offload tier
+        # (r15): "hit" = payload was prefetch-staged on device, "stall"
+        # = it paid the h2d inline; None when it never swapped in
+        offload = next((str(ev["offload"]) for ev in reversed(self.events)
+                        if ev["kind"] in ("admitted", "resumed")
+                        and ev.get("offload") is not None), None)
         s = {
             "request_id": self.request_id,
             "reason": reason,
             "cached_tokens": cached,
+            "offload": offload,
             "queued_unix": t_q,
             "finished_unix": t_end,
             "duration_ms": (t_end - t_q) * 1e3,

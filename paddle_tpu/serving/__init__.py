@@ -39,15 +39,25 @@ prefill-shaped target call, longest agreeing prefix committed — for up
 to ``spec_tokens`` tokens per target forward with token streams exactly
 equal to non-speculative greedy — see docs/serving.md §Speculative
 decoding.
+
+Async two-tier KV offload (offload.py, r15): the host tiers stop
+blocking the step thread — swap-outs and prefix-cache spills dispatch
+non-blocking d2h (blocks accounted under a transient ``in_flight``
+ledger term until the transfer lands at a step boundary), queued
+restores prefetch h2d into staging buffers ahead of admission
+(``prefetch_hit`` vs counted inline ``stall``), and refcount-0 cached
+blocks spill proactively under pool pressure so reclaim stops paying
+d2h inline — see docs/serving.md §KV offload tier.
 """
 from .admission import (AdmissionConfig, AdmissionController, ShedError,
                         TokenBucket)
 from .engine import LLMEngine, Request
 from .http import HTTPFrontDoor
 from .kv_swap import HostKVPool
+from .offload import OffloadEngine
 from .prefix_cache import PrefixCache
 from .resilient import ResilientEngine
 
 __all__ = ["LLMEngine", "Request", "ResilientEngine", "AdmissionConfig",
            "AdmissionController", "ShedError", "TokenBucket",
-           "HostKVPool", "PrefixCache", "HTTPFrontDoor"]
+           "HostKVPool", "PrefixCache", "HTTPFrontDoor", "OffloadEngine"]

@@ -133,6 +133,19 @@ class AdmissionController:
             _M_SHED.inc(reason=reason)
         return reason
 
+    def spill_free_frac(self, default: float) -> float:
+        """Proactive-spill pressure threshold (r15) derived from this
+        controller's own shed signal: background cold-block spilling
+        must engage BEFORE ``pool_pressure`` starts shedding, so when a
+        ``shed_free_frac`` is configured the spiller arms at twice it
+        (never below the engine's flag ``default``). With no pool-shed
+        policy the flag stands alone — the two knobs share one
+        ``free_frac`` signal, not two definitions of pressure."""
+        c = self.config
+        if c.shed_free_frac > 0:
+            return max(float(default), 2.0 * c.shed_free_frac)
+        return float(default)
+
     def retry_after(self, tenant: str, cost: float) -> float:
         """Seconds until ``tenant``'s bucket could afford ``cost``
         tokens — the HTTP front door's ``Retry-After`` derivation for a

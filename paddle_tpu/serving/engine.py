@@ -59,6 +59,15 @@ TPU-native design — everything the chip executes has STATIC shapes:
   ``prefill_chunk=K`` splits long suffixes into K-token chunks fed one
   per step between decode waves, so prefill cost scales with NEW tokens
   and never monopolizes a step.
+- Async two-tier KV offload (r15, on whenever a host tier exists):
+  preemption swap-outs and prefix-cache spills dispatch non-blocking
+  d2h (serving/offload.py; blocks ride a transient ``in_flight``
+  ledger term until the step-boundary sweep lands them), queue-head
+  restores prefetch h2d into staging buffers ahead of admission
+  (prefetch_hit vs counted inline stall), and cold cached blocks
+  spill proactively under pool pressure so reclaim never pays d2h
+  inline. Greedy streams are bit-identical to the forced-sync tier
+  (``kv_offload="sync"`` / FLAGS_serve_kv_offload_sync).
 - Draft-model speculative decoding (optional, r13): the engine hosts a
   SECOND, smaller llama (``draft_params``/``draft_config``) whose KV
   pools ride in the same pool dict under ``dk``/``dv`` keys, indexed by
@@ -81,11 +90,12 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import itertools
 import math
 import threading
 import time
 from collections import deque
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -105,8 +115,10 @@ from ..observability import profiling as _profiling
 from ..observability import request_trace as _rt
 from ..observability import trace_span
 from ..observability.catalog import instrument as _instrument
+from ..framework.flags import get_flag
 from .admission import AdmissionConfig, AdmissionController, ShedError
 from .kv_swap import HostKVPool
+from .offload import OffloadEngine
 from .prefix_cache import PrefixCache
 
 __all__ = ["LLMEngine", "Request"]
@@ -822,7 +834,8 @@ class LLMEngine:
                  prefix_cache_host_bytes: int = 0,
                  decode_kernel: str = "auto",
                  draft_params=None, draft_config: Optional[LlamaConfig]
-                 = None, spec_tokens: int = 4, spec: bool = True):
+                 = None, spec_tokens: int = 4, spec: bool = True,
+                 kv_offload: str = "auto"):
         """``params`` may be dense (bf16/f32) or int8 weight-only
         (llama.quantize_params) — quantized leaves feed the decode/prefill
         matmuls unconverted (kernels/quant_matmul.weight_only_matmul).
@@ -923,7 +936,20 @@ class LLMEngine:
         host decision, so a spec wave DRAINS the pipeline and syncs
         once per wave — the draft/verify pair replaces multi-step
         chaining as the round-trip amortizer (and, unlike the chained
-        path, composes with per-request eos)."""
+        path, composes with per-request eos).
+
+        ``kv_offload`` (r15): how the host tiers move their bytes.
+        ``"async"`` — swap-outs and prefix-cache spills dispatch
+        non-blocking d2h (blocks stay accounted until the transfer
+        lands at a step boundary), queued restores prefetch h2d into
+        staging buffers ahead of admission, and refcount-0 cached
+        blocks spill proactively under pool pressure
+        (:mod:`paddle_tpu.serving.offload`). ``"sync"`` — the pre-r15
+        inline transfers (the parity-test reference). ``"auto"``
+        (default) follows ``FLAGS_serve_kv_offload_sync``. Greedy token
+        streams are bit-identical either way (test-enforced, bf16 and
+        int8); only the stall profile differs. Ignored when no host
+        tier is configured."""
         c = config
         assert max_model_len % block_size == 0
         self.params = params
@@ -1055,6 +1081,10 @@ class LLMEngine:
         # _dispatch_decode) — bench evidence, kept whether or not the
         # metrics registry is enabled
         self.kv_read_bytes_total = 0
+        # swap-enabled preemptions that fell back to recompute (host
+        # evidence for the offload bench row: the async tier's
+        # acceptance is ZERO of these under a fitting host pool)
+        self.swap_fallbacks = 0
         # device-resident decode carry (last/lengths/done/budgets/key) +
         # static per-slot vectors; the carry chains from call to call and
         # is only rebuilt from host state when the pipeline is drained
@@ -1085,6 +1115,26 @@ class LLMEngine:
                           else admission)
         self.swap_pool = (HostKVPool(kv_swap_bytes) if kv_swap_bytes
                           else None)
+        # -- async two-tier offload (r15): one transfer engine whenever
+        # ANY host tier exists. "auto" defers the sync decision to
+        # FLAGS_serve_kv_offload_sync (the version-shimmed d2h start
+        # degrades by itself off-TPU / on old jax — see offload.py)
+        if kv_offload not in ("auto", "async", "sync"):
+            raise ValueError(
+                f"kv_offload must be 'auto', 'async' or 'sync', got "
+                f"{kv_offload!r}")
+        self.offload = (OffloadEngine(
+            sync=None if kv_offload == "auto" else kv_offload == "sync")
+            if (kv_swap_bytes or (prefix_cache and prefix_cache_host_bytes))
+            else None)
+        # proactive-spill pressure threshold: the flag default, raised
+        # to 2x the admission shed threshold when one is configured
+        # (spilling must engage before shedding — one free_frac signal)
+        self._spill_free_frac = float(
+            get_flag("serve_kv_offload_spill_free_frac"))
+        if isinstance(self.admission, AdmissionController):
+            self._spill_free_frac = self.admission.spill_free_frac(
+                self._spill_free_frac)
         self.injector = injector
         # terminal disposition per request id: every id that entered
         # add_request ends in exactly one of finished / shed /
@@ -1238,6 +1288,7 @@ class LLMEngine:
             self.step()
         if self._inflight is not None:      # defensive: step() drains first
             self._process_inflight()
+        self.drain_offload()                # land stragglers: in_flight→0
         return self.results
 
     # -- internals ----------------------------------------------------------
@@ -1309,27 +1360,67 @@ class LLMEngine:
         return {name: np.asarray(jax.device_get(pool[:, idx]))
                 for name, pool in self.pools.items()}
 
-    def _restore_blocks(self, blks: List[int], datas: List[Dict]) -> None:
+    def _restore_blocks(self, blks: List[int], ents: List) -> None:
         """h2d a matched path's spilled blocks in ONE batched scatter
         (the kv_swap restore at block count len(blks), pools donated) —
-        never a transfer per block on the admission path."""
-        names = sorted(datas[0])
-        stacked = {n: np.concatenate([np.asarray(d[n]) for d in datas],
-                                     axis=1) for n in names}
+        never a transfer per block on the admission path. Entries the
+        offload engine staged ahead of time (``SwapEntry.staged``, r15)
+        contribute device-resident buffers (prefetch hits); the rest
+        start their h2d here and the observed wait counts as a stall."""
+        names = sorted(ents[0].data)
+        staged_i = [i for i, e in enumerate(ents) if e.staged is not None]
+        fresh_i = [i for i, e in enumerate(ents) if e.staged is None]
+        # reorder entries staged-first WITH their blocks (the scatter
+        # pairs blks[i] with slice i, so any consistent permutation is
+        # exact) — every fresh payload then batches into ONE host-side
+        # concat + one h2d per pool entry, the r10 contract, whatever
+        # mix of staged/unstaged the path carries
+        blks = [blks[i] for i in staged_i + fresh_i]
+        t0 = time.perf_counter()
+        fresh_up = {}
+        if fresh_i:
+            fresh_up = {n: jnp.asarray(np.concatenate(
+                [np.asarray(ents[i].data[n]) for i in fresh_i], axis=1)
+                if len(fresh_i) > 1 else np.asarray(
+                    ents[fresh_i[0]].data[n])) for n in names}
+        if self.offload is not None and fresh_i:
+            if not self.offload.sync:
+                # async miss: observe the true inline wait. Sync mode
+                # skips the barrier — the pre-r15 behavior let the
+                # transfer overlap into the scatter dispatch, and the
+                # forced-sync leg is the bench baseline for exactly
+                # that behavior (dt then measures the host-side cost)
+                jax.block_until_ready(list(fresh_up.values()))
+            self.offload.note_stall(time.perf_counter() - t0,
+                                    n=len(fresh_i))
+        if self.offload is not None and staged_i:
+            self.offload.note_hit(len(staged_i))
+        stacked = {}
+        for n in names:
+            parts = [ents[i].staged[n] for i in staged_i]
+            if fresh_i:
+                parts.append(fresh_up[n])
+            stacked[n] = (jnp.concatenate(parts, axis=1)
+                          if len(parts) > 1 else parts[0])
+        for i in staged_i:
+            ents[i].staged = None
         self.pools = self._swapin_fn(len(blks))(
             self.pools, jnp.asarray(np.asarray(blks, np.int32)),
-            *[jnp.asarray(stacked[n]) for n in names])
+            *[stacked[n] for n in names])
 
     def _free_slot(self, slot: int, requeue: bool = False,
                    reason: str = "finished", swap: bool = True):
         req = self.slot_req[slot]
         out = self.slot_out[slot]
-        swapped = False
+        swapped, held = False, []
         if requeue and req is not None and swap \
                 and self.swap_pool is not None:
             # swap-instead-of-recompute: move the victim's blocks to the
-            # host tier BEFORE they are freed (fallback: plain recompute)
-            swapped = self._swap_out(slot, req, out)
+            # host tier BEFORE they are freed (fallback: plain recompute;
+            # async mode parks `held` with the in-flight transfer)
+            swapped, held = self._swap_out(slot, req, out)
+            if not swapped:
+                self.swap_fallbacks += 1
         # blocks [0, keep) are cache-owned: shared, unpinned below, never
         # freed here. A finishing request first offers its decode-grown
         # FULL blocks to the trie (multi-turn prefix reuse: the next turn
@@ -1348,8 +1439,11 @@ class LLMEngine:
                     [int(self.table[slot, j]) for j in range(keep, full)],
                     pin=False)
                 keep += len(adopted)
+        held_set = set(held)
         for j in range(keep, int(self.n_alloc[slot])):
-            self.free_blocks.append(int(self.table[slot, j]))
+            blk = int(self.table[slot, j])
+            if blk not in held_set:     # custody: frees when the spill lands
+                self.free_blocks.append(blk)
         if self._pinned[slot]:
             self.prefix_cache.unpin(self._pinned[slot])
             self._pinned[slot] = []
@@ -1391,6 +1485,11 @@ class LLMEngine:
                 self._deadline_live = max(0, self._deadline_live - 1)
             if self.swap_pool is not None:
                 self.swap_pool.discard(req.req_id)
+                if self.offload is not None:
+                    # an in-flight spill for a terminal request is moot:
+                    # drop it, reclaim its custody blocks now
+                    self.free_blocks.extend(
+                        self.offload.cancel(req.req_id))
             if reason == "deadline_exceeded":
                 _M_DEADLINE.inc()
                 _flight.record("deadline_exceeded", req_id=req.req_id,
@@ -1433,28 +1532,45 @@ class LLMEngine:
                               reason=reason)
 
     # -- survivability: swap, deadlines, chaos ------------------------------
-    def _swap_out(self, slot: int, req: Request, out: List[int]) -> bool:
+    def _swap_out(self, slot: int, req: Request,
+                  out: List[int]) -> Tuple[bool, List[int]]:
         """Copy the slot's live KV blocks to the host tier. Keeps
         ``len(ctx) - 1`` positions where ``ctx = prompt + generated +
         out``: the context tail is the re-admission's next decode input,
         whose K/V the first restored decode step rewrites — so a slot
         whose sampled-but-unread first token died with it (KV covers ALL
         of ctx) and a mid-decode victim (KV covers ctx[:-1]) restore
-        through one invariant. Returns False on fallback (host pool
-        full / nothing to keep) — the caller then recomputes."""
+        through one invariant.
+
+        Returns ``(swapped, held)``. Async mode (r15) dispatches a
+        NON-BLOCKING d2h and parks the victim's private blocks in the
+        offload engine's custody (``held`` — the ledger's transient
+        ``in_flight`` term; cache-pinned head blocks stay ``cached``,
+        the transfer reads them safely by stream order): the step
+        thread never waits on the spill, and the blocks return to the
+        free list at the step boundary after it lands. Sync mode blocks
+        inline and holds nothing. ``swapped=False`` on fallback (host
+        pool full / nothing to keep) — the caller then recomputes."""
         n_keep = len(req.prompt) + len(req.generated) + len(out) - 1
         if n_keep <= 0 or self.lengths[slot] < n_keep:
             # every swap-enabled preemption lands in swap_out OR fallback
             # — an uncounted recompute would hide a swap-tier regression
             _M_SWAP_FALLBACK.inc(reason="nothing_to_keep")
-            return False
+            return False, []
         nb_keep = -(-n_keep // self.bs)
         blocks = np.asarray(self.table[slot, :nb_keep], np.int32)
-        # one bounded d2h per pool entry: int8 payload AND scales move
-        # verbatim, so the restore is bit-exact (no requantization drift)
-        data = {name: np.asarray(jax.device_get(pool[:, blocks]))
-                for name, pool in self.pools.items()}
-        return self.swap_pool.put(req.req_id, data, n_tokens=n_keep)
+        # both modes route through the offload engine (a swap pool
+        # implies one exists): spill_async owns the sync/async decision
+        # — async parks `held` in custody, sync completes inline and
+        # holds nothing. Payload AND scales move verbatim either way,
+        # so the restore is bit-exact (no requantization drift).
+        keep = len(self._pinned[slot])
+        held = ([] if self.offload.sync else
+                [int(b) for b in self.table[slot, keep:nb_keep]])
+        ok = self.offload.spill_async(
+            req.req_id, self.pools, blocks, n_keep, self.swap_pool,
+            hold_blocks=held)
+        return ok, (held if ok else [])
 
     def _swapin_fn(self, nb: int):
         """One compiled restore per block count: scatter every host pool
@@ -1496,21 +1612,47 @@ class LLMEngine:
         self.admit_order.append(slot)
         self._table_dirty = True
         self._slots_dirty = True
+        offload_mode = None
         if ent.n_blocks:
             names = sorted(ent.data)
             blk = jnp.asarray(np.asarray(blocks[:ent.n_blocks], np.int32))
+            staged = ent.staged
+            if staged is not None:
+                # prefetch hit (r15): the offload engine staged this
+                # entry's payload h2d ahead of admission — the scatter
+                # consumes already-resident buffers, zero inline wait
+                datas = [staged[n] for n in names]
+                ent.staged = None
+                offload_mode = "hit"
+                if self.offload is not None:
+                    self.offload.note_hit()
+            else:
+                t0 = time.perf_counter()
+                datas = [jnp.asarray(ent.data[n]) for n in names]
+                if self.offload is not None:
+                    # the inline h2d is the stall the prefetch tier
+                    # exists to hide: observe exactly what it cost.
+                    # Sync mode skips the barrier — pre-r15 let the
+                    # transfer overlap into the scatter dispatch, and
+                    # the forced-sync leg must stay that baseline
+                    if not self.offload.sync:
+                        jax.block_until_ready(datas)
+                    self.offload.note_stall(time.perf_counter() - t0)
+                    offload_mode = "stall"
             self.pools = self._swapin_fn(ent.n_blocks)(
-                self.pools, blk, *[jnp.asarray(ent.data[n])
-                                   for n in names])
+                self.pools, blk, *datas)
         self._pending_swapin.append((slot, req.req_id))
         self._fresh_swapins.add(slot)
         _M_ADMISSIONS.inc()
         _flight.record("kv_swap_in", req_id=req.req_id,
-                       tokens=ent.n_tokens, blocks=ent.n_blocks)
+                       tokens=ent.n_tokens, blocks=ent.n_blocks,
+                       offload=offload_mode)
         if _obs.enabled():
+            kw = ({"offload": offload_mode} if offload_mode is not None
+                  else {})
             _rt.get_request_tracer().admitted(
                 req.req_id, slot=slot, context_tokens=ent.n_tokens,
-                swapped_in=True)
+                swapped_in=True, **kw)
 
     def _finish_expired(self, req: Request, out: List[int],
                         queued: bool,
@@ -1525,6 +1667,8 @@ class LLMEngine:
             self._deadline_live = max(0, self._deadline_live - 1)
         if self.swap_pool is not None:
             self.swap_pool.discard(rid)
+            if self.offload is not None:
+                self.free_blocks.extend(self.offload.cancel(rid))
         if reason == "deadline_exceeded":
             _M_DEADLINE.inc()
         _flight.record(reason, req_id=rid, queued=queued,
@@ -1629,6 +1773,91 @@ class LLMEngine:
             _flight.record("injected_pool_squeeze", step=self._step_idx,
                            blocks=len(taken))
 
+    def _offload_tick(self) -> None:
+        """The r15 step-boundary offload sweep, in three moves:
+
+        1. **Land** — commit every finished async spill into its host
+           pool and return the custody blocks to the free list (this is
+           where a swap-out's ``in_flight`` blocks become ``free``).
+        2. **Proactive spill** — when the allocatable-block fraction
+           drops below the pressure threshold (admission's ``free_frac``
+           signal), start background d2h for the coldest refcount-0
+           cached blocks, so a later reclaim frees them without paying
+           the transfer inline (``_take_up_to`` never runs dry into a
+           blocking d2h storm).
+        3. **Prefetch** — scan the first ``prefetch_depth`` queued
+           requests: a swapped one's host entry, or the host-resident
+           trie nodes its prompt would match, start staging h2d NOW so
+           the admission-time restore is a ``prefetch_hit``.
+
+        The seeded ``offload_crash`` chaos fault fires here — with
+        transfers potentially in flight — to prove the poisoned-wave
+        recovery extends to the transfer engine."""
+        off = self.offload
+        if off is None:
+            return
+        freed = off.poll()
+        if freed:
+            self.free_blocks.extend(freed)
+        pc = self.prefix_cache
+        if not off.sync:
+            if pc is not None and pc.host is not None:
+                frac = self._avail_blocks() / max(1, self.nb - 1)
+                # one arithmetic headroom probe before the O(trie)
+                # candidate sweep: a saturated host tier must not be
+                # re-asked every step (doomed reserves would spam the
+                # drop_host_full cause counter and re-sort the trie)
+                blk_bytes = sum(
+                    a.shape[0] * int(np.prod(a.shape[2:]))
+                    * a.dtype.itemsize for a in self.pools.values())
+                room = (pc.host.capacity_bytes - pc.host.bytes_used
+                        - pc.host.reserved_bytes)
+                # cap the batch by the room that actually exists, so a
+                # partially-full tier never dispatches doomed reserves
+                # (each would spuriously count a drop_host_full cause
+                # with no drop following)
+                n_spill = min(off.spill_batch(),
+                              room // max(1, blk_bytes))
+                if frac < self._spill_free_frac and n_spill > 0:
+                    for nd in pc.spill_candidates(n_spill):
+                        if not off.spill_async(
+                                ("pfx", nd.uid), self.pools, [nd.block],
+                                self.bs, pc.host, hold_blocks=[],
+                                on_land=functools.partial(
+                                    pc.finish_spill, nd),
+                                proactive=True):
+                            pc.abort_spill(nd)
+            depth = off.prefetch_depth()
+            if depth:
+                for req in itertools.islice(self.queue, depth):
+                    if self.swap_pool is not None:
+                        ent = self.swap_pool.get(req.req_id)
+                        if ent is not None:
+                            off.stage(self.swap_pool, req.req_id, ent)
+                            continue
+                    if pc is not None and pc.host is not None:
+                        ctx = req.prompt + req.generated
+                        for key, ent in pc.host_path_entries(
+                                ctx, (len(ctx) - 1) // self.bs):
+                            off.stage(pc.host, key, ent)
+        if self.injector is not None and \
+                self.injector.fires("offload_crash", self._step_idx):
+            _flight.record("injected_offload_crash",
+                           step=self._step_idx,
+                           in_flight=off.held_blocks,
+                           inflight_bytes=off.inflight_bytes)
+            raise SimulatedCrash(
+                f"injected offload crash at serving step "
+                f"{self._step_idx}")
+
+    def drain_offload(self) -> None:
+        """Land every in-flight offload transfer NOW (blocking) — the
+        run()-exit / quiescence hook, so a drained engine's ledger
+        shows ``in_flight == 0`` and the host tiers hold exactly their
+        committed entries."""
+        if self.offload is not None:
+            self.free_blocks.extend(self.offload.poll(block=True))
+
     def recover_crashed_step(self) -> None:
         """Recovery surface for a crashed ``step()`` (ResilientEngine):
         drop the poisoned in-flight wave — its tokens were never
@@ -1646,6 +1875,13 @@ class LLMEngine:
         for slot in self._active_slots():
             self._free_slot(slot, requeue=True, swap=False)
         self._chunks = {}
+        if self.offload is not None:
+            # the poisoned-wave rule extends to transfers (r15): every
+            # in-flight spill is abandoned (host reservations released,
+            # nothing half-landed ever commits) and its custody blocks
+            # return to the free list; staged prefetch buffers drop too
+            # — the queued requests re-stage or recompute
+            self.free_blocks.extend(self.offload.abandon())
         if self.prefix_cache is not None:
             # cached KV is as suspect as the rest of the pools: drop the
             # whole trie (host tier included) and recycle its blocks
@@ -1653,12 +1889,17 @@ class LLMEngine:
 
     def block_accounting(self) -> Dict[str, int]:
         """Device block-pool ledger: ``free + backed + cached +
-        squeezed == total`` at every step boundary, whatever mix of
-        eviction / shed / preempt-swap / cache-spill / crash-requeue ran
-        — the leak-regression invariant. ``backed`` counts blocks a slot
-        owns PRIVATELY; a cache-owned block counts once under ``cached``
-        however many slots pin it. ``host_spilled_blocks`` (prefix-cache
-        blocks resident only in the host tier) and
+        squeezed + in_flight == total`` at every step boundary, whatever
+        mix of eviction / shed / preempt-swap / cache-spill /
+        crash-requeue ran — the leak-regression invariant. ``backed``
+        counts blocks a slot owns PRIVATELY; a cache-owned block counts
+        once under ``cached`` however many slots pin it. ``in_flight``
+        (r15) counts blocks custody-parked behind an async swap-out d2h
+        still moving — a TRANSIENT term that is zero whenever no
+        transfer is in flight, collapsing the ledger back to its 4-term
+        form (a proactively spilling cache block stays under ``cached``:
+        its node keeps it until reclaim). ``host_spilled_blocks``
+        (prefix-cache blocks resident only in the host tier) and
         ``swapped_host_blocks`` ride along — those blocks were freed on
         device and are NOT in the sum.
 
@@ -1676,6 +1917,8 @@ class LLMEngine:
                               for i in range(self.N))),
             "cached": pc.device_blocks if pc is not None else 0,
             "squeezed": sum(len(b) for _, b in self._squeezed),
+            "in_flight": (self.offload.held_blocks
+                          if self.offload is not None else 0),
             "host_spilled_blocks": (pc.host_blocks if pc is not None
                                     else 0),
             "swapped_host_blocks": (self.swap_pool.swapped_blocks
@@ -1709,13 +1952,25 @@ class LLMEngine:
             req = self.queue[0]
             ent = (self.swap_pool.get(req.req_id)
                    if self.swap_pool is not None else None)
+            if ent is None and self.offload is not None \
+                    and self.swap_pool is not None \
+                    and self.offload.pending(req.req_id):
+                # the request's swap-out is still in flight but its
+                # re-admission is due NOW: land it (blocking — counted
+                # as a stall) so the swap-in path sees a committed entry
+                freed = self.offload.force_land(req.req_id)
+                if freed:
+                    self.free_blocks.extend(freed)
+                ent = self.swap_pool.get(req.req_id)
             if ent is not None:
                 # swap-in re-admission: restore the preempted KV blocks
                 # from the host tier — no prefill, no sampled first token
                 # (the tail of prompt+generated is the next decode input)
                 if self._avail_blocks() < max(1, ent.n_blocks):
                     if not any(r is not None for r in self.slot_req) \
-                            and not self._squeezed:
+                            and not self._squeezed \
+                            and not (self.offload is not None
+                                     and self.offload.held_blocks):
                         raise RuntimeError(
                             f"request {req.req_id}: swap-in needs "
                             f"{ent.n_blocks} blocks but the pool only has "
@@ -1742,7 +1997,9 @@ class LLMEngine:
                 if nodes:
                     self.prefix_cache.unpin(nodes)
                 if not any(r is not None for r in self.slot_req) \
-                        and not self._squeezed:
+                        and not self._squeezed \
+                        and not (self.offload is not None
+                                 and self.offload.held_blocks):
                     # (an injected pool_squeeze releases its hostage
                     # blocks in a step or two — starvation then is
                     # pressure, not an impossible request)
@@ -2004,6 +2261,14 @@ class LLMEngine:
                     emitted += self._process_inflight()
                     if self.slot_req[slot] is None:
                         break
+                    continue
+                if self.offload is not None \
+                        and self.offload.held_blocks:
+                    # blocks are custody-parked behind an in-flight
+                    # spill: landing them (blocking) beats preempting
+                    # ANOTHER victim — a cascade the async tier must
+                    # never cause (held > 0 guarantees progress)
+                    self.drain_offload()
                     continue
                 victim = self.admit_order[-1]
                 if victim == slot and len(self.admit_order) == 1 \
@@ -2679,6 +2944,10 @@ class LLMEngine:
         self._apply_faults()
         self._expire_deadlines()
         self._apply_cancels()
+        # offload sweep AFTER cancellations (a dead request must not be
+        # staged) and BEFORE admission (blocks a landed spill just freed
+        # are allocatable THIS step; staged payloads meet their restore)
+        self._offload_tick()
         # stale FLOPs from an earlier dispatch must not divide a
         # no-decode step's wall time (a bogus MFU spike on idle steps)
         self._last_decode_flops = None

@@ -10,17 +10,28 @@ deployment is the same diagnosis one tier down).
 
 The TPU-native fix is a pinned host-RAM tier under HBM: a preempted
 slot's pool blocks — the int8 payload AND its per-entry scales, so the
-restore is bit-exact — are ``device_get`` into a bounded
-:class:`HostKVPool`, and re-admission ``device_put``-scatters them back
-into freshly allocated blocks instead of re-prefilling. A swap-in costs
-one h2d copy of the blocks; a recompute costs the full prefill forward.
-When the host pool is full, preemption falls back to recompute — the
-tier degrades, it never breaks.
+restore is bit-exact — move into a bounded :class:`HostKVPool`, and
+re-admission scatters them back into freshly allocated blocks instead
+of re-prefilling. A swap-in costs one h2d copy of the blocks; a
+recompute costs the full prefill forward. When the host pool is full,
+preemption falls back to recompute — the tier degrades, it never
+breaks.
+
+r15 (serving/offload.py) makes the tier ASYNC: spills dispatch
+non-blocking d2h and land at step boundaries, so the pool gained a
+reservation protocol (:meth:`HostKVPool.reserve` /
+:meth:`HostKVPool.commit` / :meth:`HostKVPool.unreserve`) that
+guarantees a dispatched transfer can always commit, and
+:class:`SwapEntry` carries an optional ``staged`` dict of
+device-resident prefetch buffers the restore consumes without an
+inline h2d wait.
 
 Accounting contract: swapped KV holds NO device blocks (they were freed
-at swap-out) — the engine's device invariant stays
-``free + backed + squeezed == pool size`` while the host tier tracks
-its own bytes/blocks (``serving_kv_swap_host_bytes``).
+at swap-out, or parked under the ledger's transient ``in_flight`` term
+while the async d2h is still moving) — the engine's device invariant
+stays ``free + backed + cached + squeezed (+ in_flight) == pool size``
+while the host tier tracks its own bytes/blocks
+(``serving_kv_swap_host_bytes``).
 """
 from __future__ import annotations
 
@@ -35,20 +46,27 @@ _M_SWAP_IN = _instrument("serving_kv_swap_in_total")
 _M_SWAP_FALLBACK = _instrument("serving_kv_swap_fallback_total")
 _M_SWAP_BYTES = _instrument("serving_kv_swap_host_bytes")
 _M_PREFIX_BYTES = _instrument("serving_prefix_cache_host_bytes")
+_M_PREFIX_EVICT = _instrument("serving_prefix_cache_evictions_total")
 
 
 class SwapEntry:
     """One preempted request's KV blocks on the host: a dict of numpy
     arrays (one per engine pool entry — k/v payload plus ks/vs scales
-    under int8 pools), each shaped ``[L, n_blocks, block_size, ...]``."""
+    under int8 pools), each shaped ``[L, n_blocks, block_size, ...]``.
 
-    __slots__ = ("data", "n_tokens", "n_blocks", "nbytes")
+    ``staged`` (r15 prefetch): device-resident h2d copies of ``data``
+    started ahead of admission by the offload engine — a restore that
+    finds them consumes them directly (a ``prefetch_hit``) instead of
+    paying the transfer inline. ``None`` when nothing is staged."""
+
+    __slots__ = ("data", "n_tokens", "n_blocks", "nbytes", "staged")
 
     def __init__(self, data: Dict, n_tokens: int):
         self.data = data
         self.n_tokens = int(n_tokens)
         self.n_blocks = int(next(iter(data.values())).shape[1])
         self.nbytes = int(sum(a.nbytes for a in data.values()))
+        self.staged = None
 
 
 class HostKVPool:
@@ -56,13 +74,19 @@ class HostKVPool:
 
     ``put`` refuses (and counts a recompute fallback) rather than exceed
     ``capacity_bytes`` — the swap tier must never become the OOM.
+    Reservations (:meth:`reserve`) participate in every capacity check,
+    so an async spill dispatched against reserved room can never be
+    refused at landing time.
 
     ``kind`` selects the metric surface: ``"swap"`` (default) emits the
     preemption-swap counters and ``serving_kv_swap_host_bytes``;
     ``"prefix"`` is the prefix-cache spill tier
-    (:mod:`paddle_tpu.serving.prefix_cache`) — it drives only
-    ``serving_prefix_cache_host_bytes`` (the cache counts its own
-    spills under ``serving_prefix_cache_evictions_total``).
+    (:mod:`paddle_tpu.serving.prefix_cache`) — it drives
+    ``serving_prefix_cache_host_bytes``, and a capacity refusal counts
+    ``serving_prefix_cache_evictions_total{kind="drop_host_full"}`` (the
+    CAUSE marker — the caller's subsequent subtree drop still counts its
+    ``kind="drop"`` per node), so a saturated prefix host tier is
+    visible on a dashboard instead of silently degrading to drops.
     """
 
     def __init__(self, capacity_bytes: int, kind: str = "swap"):
@@ -74,23 +98,84 @@ class HostKVPool:
         self._g_bytes = _M_SWAP_BYTES if kind == "swap" else _M_PREFIX_BYTES
         self._entries: Dict = {}
         self._bytes = 0
+        # incrementally maintained population counts: block_accounting
+        # reads swapped_blocks at EVERY step boundary, so it must never
+        # be an O(entries) walk (cross-checked against the walk in
+        # tests, the PrefixCache incremental-count pattern)
+        self._blocks = 0
+        # outstanding async-spill reservations (offload engine): counted
+        # by every capacity check so a dispatched transfer always fits
+        self._resv: Dict = {}
+        self._reserved = 0
+        # host evidence (bench rows read this without the registry):
+        # capacity refusals — swap: recompute fallbacks, prefix: drops
+        self.refusals = 0
+
+    def _count_refusal(self) -> None:
+        self.refusals += 1
+        if self.kind == "swap":
+            _M_SWAP_FALLBACK.inc(reason="host_pool_full")
+        else:
+            _M_PREFIX_EVICT.inc(kind="drop_host_full")
+
+    # -- async-spill reservation protocol (r15) ---------------------------
+    def reserve(self, rid, nbytes: int) -> bool:
+        """Reserve room for an in-flight spill of ``nbytes`` keyed
+        ``rid``; ``False`` (+ the kind's refusal counter) when the pool
+        cannot fit it. Re-reserving a key replaces its reservation, and
+        an existing entry under the same key counts as replaced."""
+        nbytes = int(nbytes)
+        self._reserved -= self._resv.pop(rid, 0)
+        old = self._entries.get(rid)
+        occupied = self._bytes + self._reserved \
+            - (old.nbytes if old is not None else 0)
+        if occupied + nbytes > self.capacity_bytes:
+            self._count_refusal()
+            return False
+        self._resv[rid] = nbytes
+        self._reserved += nbytes
+        return True
+
+    def commit(self, rid, data: Dict, n_tokens: int) -> bool:
+        """Turn ``rid``'s reservation into a stored entry (the async
+        spill's landing point). Fits by construction when the
+        reservation was honest; falls through to :meth:`put` either
+        way so the accounting stays in one place."""
+        self._reserved -= self._resv.pop(rid, 0)
+        return self.put(rid, data, n_tokens)
+
+    def unreserve(self, rid) -> None:
+        """Release a reservation whose transfer was cancelled or
+        abandoned (terminal request, crash recovery)."""
+        self._reserved -= self._resv.pop(rid, 0)
+
+    @property
+    def reserved_bytes(self) -> int:
+        return self._reserved
 
     # -- engine-facing ----------------------------------------------------
     def put(self, rid, data: Dict, n_tokens: int) -> bool:
-        """Store one request's blocks; ``False`` (+ fallback counter) when
-        the pool lacks room. A re-preemption of the same rid replaces its
-        previous entry."""
+        """Store one request's blocks; ``False`` (+ the kind's refusal
+        counter) when the pool lacks room. A re-preemption of the same
+        rid replaces its previous entry."""
         ent = SwapEntry(data, n_tokens)
         old = self._entries.pop(rid, None)
         if old is not None:
             self._bytes -= old.nbytes
-        if self._bytes + ent.nbytes > self.capacity_bytes:
-            if self.kind == "swap":
-                _M_SWAP_FALLBACK.inc(reason="host_pool_full")
+            self._blocks -= old.n_blocks
+        # a reservation under THIS key is room held for this very
+        # payload (an inline reclaim racing its own in-flight proactive
+        # spill) — credit it, or the pool refuses a spill it reserved
+        # for and the caller drops a perfectly spillable subtree
+        resv_self = self._resv.get(rid, 0)
+        if self._bytes + self._reserved - resv_self + ent.nbytes \
+                > self.capacity_bytes:
+            self._count_refusal()
             self._g_bytes.set(self._bytes)
             return False
         self._entries[rid] = ent
         self._bytes += ent.nbytes
+        self._blocks += ent.n_blocks
         if self.kind == "swap":
             _M_SWAP_OUT.inc()
         self._g_bytes.set(self._bytes)
@@ -106,6 +191,7 @@ class HostKVPool:
         ent = self._entries.pop(rid, None)
         if ent is not None:
             self._bytes -= ent.nbytes
+            self._blocks -= ent.n_blocks
             if self.kind == "swap":
                 _M_SWAP_IN.inc()
             self._g_bytes.set(self._bytes)
@@ -116,7 +202,9 @@ class HostKVPool:
         or expired while queued)."""
         ent = self._entries.pop(rid, None)
         if ent is not None:
+            ent.staged = None
             self._bytes -= ent.nbytes
+            self._blocks -= ent.n_blocks
             self._g_bytes.set(self._bytes)
 
     # -- accounting -------------------------------------------------------
@@ -126,7 +214,10 @@ class HostKVPool:
 
     @property
     def swapped_blocks(self) -> int:
-        return sum(e.n_blocks for e in self._entries.values())
+        """Blocks resident in the tier — incrementally maintained (the
+        engine ledger reads this per step; tests cross-check it against
+        the entry walk)."""
+        return self._blocks
 
     def __len__(self) -> int:
         return len(self._entries)

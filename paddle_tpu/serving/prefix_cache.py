@@ -71,10 +71,19 @@ _uid = itertools.count()
 class _Node:
     """One cached block: ``key`` is the exact token tuple it spells,
     ``block`` the physical pool block id (``None`` while the payload is
-    host-resident), ``refcount`` the number of slots pinning it."""
+    host-resident), ``refcount`` the number of slots pinning it.
+
+    r15 proactive-spill states: ``spilling`` marks an in-flight
+    background d2h of this node's payload (the node KEEPS its device
+    block — still matchable, still ``cached`` in the ledger);
+    ``host_clean`` marks a landed one — the payload now lives in BOTH
+    tiers, so a later reclaim frees the device block instantly with
+    zero inline d2h (cached blocks are immutable, so the host copy can
+    never go stale). ``dead`` marks a dropped node so a spill landing
+    after the drop discards its host entry instead of leaking it."""
 
     __slots__ = ("uid", "key", "parent", "children", "block", "refcount",
-                 "stamp")
+                 "stamp", "spilling", "host_clean", "dead")
 
     def __init__(self, key: Tuple[int, ...], parent: "_Node"):
         self.uid = next(_uid)
@@ -84,6 +93,9 @@ class _Node:
         self.block: Optional[int] = None
         self.refcount = 0
         self.stamp = 0
+        self.spilling = False
+        self.host_clean = False
+        self.dead = False
 
 
 class PrefixCache:
@@ -128,7 +140,7 @@ class PrefixCache:
     # -- lookup -----------------------------------------------------------
     def match_and_pin(self, tokens: List[int], max_blocks: int,
                       alloc_fn: Callable[[int], List[int]],
-                      restore_fn: Callable[[List[int], List[Dict]], None]
+                      restore_fn: Callable[[List[int], List], None]
                       ) -> Tuple[List[_Node], List[int]]:
         """Walk the longest cached path for ``tokens`` (at most
         ``max_blocks`` blocks — the engine caps at ``(len(ctx)-1)//bs``
@@ -136,8 +148,11 @@ class PrefixCache:
         sampling hidden state), pinning every matched node. Host-resident
         nodes on the path are pinned DURING the walk (a reclaim fired by
         a later restore allocation can neither spill nor drop them) and
-        restored afterwards in ONE batched ``restore_fn(blocks, datas)``
-        h2d scatter — never a transfer per block. If allocation runs dry
+        restored afterwards in ONE batched ``restore_fn(blocks,
+        entries)`` h2d scatter — never a transfer per block
+        (``entries`` are the host pool's ``SwapEntry`` objects, r15:
+        the engine reads ``.staged`` prefetch buffers when present,
+        ``.data`` payload dicts otherwise). If allocation runs dry
         mid-restore the match truncates at the first unrestorable node
         (the tail is unpinned; already-restored prefix blocks stay
         cached).
@@ -177,13 +192,38 @@ class PrefixCache:
                 nodes = nodes[:cut]
                 pend = pend[:len(blks)]
             if pend:
-                restore_fn(blks, [ent.data for _i, _nd, ent in pend])
+                # entries (not raw payloads) ride to the engine so a
+                # prefetch-staged restore (SwapEntry.staged, r15) can
+                # consume device-resident buffers instead of paying h2d
+                restore_fn(blks, [ent for _i, _nd, ent in pend])
                 for blk, (_i, nd, _ent) in zip(blks, pend):
                     self.host.pop(("pfx", nd.uid))
                     nd.block = blk
+                    nd.host_clean = False
                     self._n_host -= 1
                     self._n_device += 1   # pinned: not evictable
         return nodes, [nd.block for nd in nodes]
+
+    def host_path_entries(self, tokens: List[int], max_blocks: int):
+        """Read-only prefetch peek (r15): walk the cached path for
+        ``tokens`` and yield ``(key, entry)`` for every host-resident
+        node on it — the offload engine stages their payloads h2d ahead
+        of the admission that will :meth:`match_and_pin` them. Nothing
+        is pinned, restored, or restamped."""
+        if self.host is None:
+            return
+        node = self.root
+        for b in range(max_blocks):
+            child = node.children.get(
+                tuple(tokens[b * self.bs:(b + 1) * self.bs]))
+            if child is None:
+                return
+            if child.block is None:
+                ent = self.host.get(("pfx", child.uid))
+                if ent is None:
+                    return
+                yield ("pfx", child.uid), ent
+            node = child
 
     def note_lookup(self, cached_tokens: int) -> None:
         """Count one admission-time lookup (hit ⇔ >= 1 block matched)."""
@@ -244,31 +284,84 @@ class PrefixCache:
             self._unpin(nd)
 
     # -- eviction ---------------------------------------------------------
+    def spill_candidates(self, n: int) -> List["_Node"]:
+        """Pick up to ``n`` coldest refcount-0 device nodes for a
+        PROACTIVE background spill (r15) — not yet host-backed and not
+        already mid-spill — and mark them ``spilling``. The caller (the
+        engine's offload tick, under pool pressure only) dispatches the
+        async d2h and reports back via :meth:`finish_spill` /
+        :meth:`abort_spill`. The walk is O(trie), same order as one
+        reclaim sweep, and runs only while pressure holds."""
+        cands = sorted((nd for nd in self._iter_nodes()
+                        if nd.block is not None and nd.refcount == 0
+                        and not nd.spilling and not nd.host_clean),
+                       key=lambda x: x.stamp)[:max(0, n)]
+        for nd in cands:
+            nd.spilling = True
+        return cands
+
+    def finish_spill(self, nd: "_Node", ok: bool) -> None:
+        """Landing callback for a proactive spill. ``ok=False`` (the
+        transfer was abandoned) just clears the mark. On success the
+        node becomes ``host_clean`` — resident in BOTH tiers — unless
+        it was dropped (discard the orphaned host entry) or already
+        moved host-side by an inline reclaim (nothing to do: the
+        commit replaced the entry with identical bytes)."""
+        nd.spilling = False
+        if not ok:
+            return
+        if nd.dead:
+            if self.host is not None:
+                self.host.discard(("pfx", nd.uid))
+            return
+        if nd.block is not None:
+            nd.host_clean = True
+
+    def abort_spill(self, nd: "_Node") -> None:
+        """The engine could not dispatch the spill (host tier full):
+        unmark, so the node stays an ordinary reclaim candidate."""
+        nd.spilling = False
+
     def reclaim(self, n: int,
                 fetch_fn: Optional[Callable[[List[int]], Dict]]
                 ) -> List[int]:
         """Free at least ``n`` device blocks (when reclaimable) from
-        refcount-0 nodes, least recently matched first: spill payloads
-        to the host tier when they fit (the node stays matchable), else
-        drop the node and its whole subtree (a pinned descendant is
-        impossible — pinning pins the full path). ONE LRU sweep and ONE
-        batched d2h (``fetch_fn(blocks)`` returning per-pool arrays
-        stacked on the block axis — one transfer per pool entry) per
-        call, however many blocks move: callers needing k blocks must
-        ask for k, not call this k times. May over-deliver when a drop
-        frees a subtree."""
+        refcount-0 nodes, least recently matched first: ``host_clean``
+        nodes (their payload already landed host-side via a proactive
+        background spill) free INSTANTLY — zero inline d2h, the r15
+        point — others spill to the host tier when they fit (the node
+        stays matchable), else drop the node and its whole subtree (a
+        pinned descendant is impossible — pinning pins the full path).
+        ONE LRU sweep and ONE batched d2h (``fetch_fn(blocks)``
+        returning per-pool arrays stacked on the block axis — one
+        transfer per pool entry) per call, however many blocks move:
+        callers needing k blocks must ask for k, not call this k times.
+        May over-deliver when a drop frees a subtree."""
         freed: List[int] = []
         # one LRU-ordered sweep (stamps are stable during the reclaim;
         # nodes a subtree drop already freed show block=None and skip)
         cands = sorted((nd for nd in self._iter_nodes()
                         if nd.block is not None and nd.refcount == 0),
                        key=lambda x: x.stamp)
-        idx = 0
-        if self.host is not None and fetch_fn is not None and cands:
-            batch = cands[:n]
-            idx = len(batch)
-            datas = fetch_fn([nd.block for nd in batch])
-            for i, nd in enumerate(batch):
+        batch, idx = cands[:n], min(n, len(cands))
+        fetch = []
+        for nd in batch:
+            if nd.host_clean:
+                # the proactive spill already paid the d2h in the
+                # background: complete the eviction for free
+                freed.append(nd.block)
+                nd.block = None
+                nd.host_clean = False
+                nd.spilling = False
+                self._n_device -= 1
+                self._n_evictable -= 1
+                self._n_host += 1
+                _M_EVICTIONS.inc(kind="spill")
+            else:
+                fetch.append(nd)
+        if self.host is not None and fetch_fn is not None and fetch:
+            datas = fetch_fn([nd.block for nd in fetch])
+            for i, nd in enumerate(fetch):
                 if nd.block is None:   # freed by an earlier subtree drop
                     continue
                 # contiguous copy — a numpy view would pin the whole
@@ -284,6 +377,13 @@ class PrefixCache:
                     _M_EVICTIONS.inc(kind="spill")
                 else:
                     freed.extend(self._drop_subtree(nd))
+        else:
+            for nd in fetch:
+                if len(freed) >= n:
+                    break
+                if nd.block is None or nd.refcount:
+                    continue
+                freed.extend(self._drop_subtree(nd))
         for nd in cands[idx:]:
             if len(freed) >= n:
                 break
@@ -305,6 +405,7 @@ class PrefixCache:
         while stack:
             nd = stack.pop()
             assert nd.refcount == 0, "dropping a pinned cache node"
+            nd.dead = True          # a spill landing later must discard
             if nd.block is not None:
                 freed.append(nd.block)
                 nd.block = None
@@ -312,6 +413,10 @@ class PrefixCache:
                 self._n_evictable -= 1
                 if count:
                     _M_EVICTIONS.inc(kind="drop")
+                if nd.host_clean and self.host is not None:
+                    # dual-resident node: its host copy dies with it
+                    self.host.discard(("pfx", nd.uid))
+                nd.host_clean = False
             else:
                 self._n_host -= 1
                 if self.host is not None:

@@ -97,4 +97,5 @@ class ResilientEngine:
             self.step()
         if self.engine._inflight is not None:   # defensive, as engine.run
             self.engine._process_inflight()
+        self.engine.drain_offload()
         return self.engine.results

@@ -105,3 +105,26 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.slow)
             if not full:
                 item.add_marker(skip)
+
+
+def assert_blocks_balanced(eng):
+    """Shared leak-regression helper (r8/r10/r15): the block ledger
+    ``free + backed + cached + squeezed + in_flight == total``, no
+    block id counted twice (async-offload custody blocks included), and
+    the host swap tier's incrementally-maintained block count
+    cross-checked against the entry walk it replaced."""
+    acct = eng.block_accounting()
+    assert acct["free"] + acct["backed"] + acct["cached"] \
+        + acct["squeezed"] + acct["in_flight"] == acct["total"], acct
+    used = [int(eng.table[i, j]) for i in range(eng.N)
+            for j in range(int(eng.n_alloc[i]))]
+    squeezed = [b for _, blocks in eng._squeezed for b in blocks]
+    held = ([b for t in eng.offload._spills.values() for b in t.blocks]
+            if eng.offload is not None else [])
+    all_ids = list(eng.free_blocks) + used + squeezed + held
+    assert len(all_ids) == len(set(all_ids)), "duplicate block ids"
+    assert 0 not in all_ids, "trash block leaked into the allocator"
+    if eng.swap_pool is not None:
+        walk = sum(e.n_blocks for e in eng.swap_pool._entries.values())
+        assert eng.swap_pool.swapped_blocks == walk
+    return acct

@@ -134,10 +134,11 @@ def _wait(pred, timeout=20.0):
     return False
 
 
-def _assert_blocks_balanced(eng):
-    acct = eng.block_accounting()
-    assert acct["free"] + acct["backed"] + acct["cached"] \
-        + acct["squeezed"] == acct["total"], acct
+# the shared 5-term ledger + custody/duplicate/cross-check helper lives
+# in tests/conftest.py — one copy, every serving suite enforces one
+# invariant (incl. r15's in_flight term, should these engines gain a
+# swap tier)
+from conftest import assert_blocks_balanced as _assert_blocks_balanced  # noqa: E402
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,401 @@
+"""r15 async two-tier KV offload (serving/offload.py).
+
+Contracts under test:
+- async offload produces greedy token streams BIT-IDENTICAL to the
+  forced-sync tier on the same swapped workload (model-dtype and int8
+  payload+scales);
+- the block ledger balances ``free + backed + cached + squeezed +
+  in_flight == total`` at EVERY step boundary, including steps where a
+  swap-out's custody blocks are riding an unlanded d2h;
+- prefetch-ahead staging turns admission-time restores into
+  ``prefetch_hit``s, and an unstaged restore is a counted ``stall``
+  with observed stall seconds;
+- a crash with transfers in flight recovers via ResilientEngine with
+  no stream divergence and no leaked blocks / reservations (the
+  poisoned-wave rule extended to transfers);
+- proactive cold-block spills land host-side in the background so a
+  later reclaim frees the device block with zero inline d2h;
+- HostKVPool satellites: the incrementally-maintained ``swapped_blocks``
+  counter matches the entry walk, the reservation protocol guards
+  capacity, and a prefix-kind capacity refusal is VISIBLE
+  (``serving_prefix_cache_evictions_total{kind="drop_host_full"}``).
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401  (forces the CPU/virtual-device conftest setup)
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.observability as obs
+from paddle_tpu.distributed.resilience import FaultInjector
+from paddle_tpu.framework.flags import get_flag, set_flags
+from paddle_tpu.serving import HostKVPool, LLMEngine, ResilientEngine
+
+
+@pytest.fixture(scope="module")
+def model():
+    from paddle_tpu.models import llama
+    cfg = dataclasses.replace(
+        llama.tiny_llama(vocab=64, hidden=32, layers=2, heads=4, kv_heads=2,
+                         seq=128, ffn=64),
+        dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+@pytest.fixture
+def flags_guard():
+    """Snapshot/restore the serve_kv_offload_* flags a test flips."""
+    names = ["serve_kv_offload_sync", "serve_kv_offload_prefetch_depth",
+             "serve_kv_offload_staging_bytes",
+             "serve_kv_offload_spill_free_frac",
+             "serve_kv_offload_spill_batch"]
+    saved = {n: get_flag(n) for n in names}
+    yield
+    set_flags(saved)
+
+
+def _prompt(rng, n):
+    return rng.integers(1, 64, size=n).tolist()
+
+
+# the shared 5-term ledger + custody/duplicate/cross-check helper lives
+# in tests/conftest.py — one copy, both suites enforce one invariant
+from conftest import assert_blocks_balanced as _assert_balanced  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# HostKVPool satellites: incremental counter, reservations, visibility
+# ---------------------------------------------------------------------------
+def _entry(nbytes, n_blocks=1):
+    per = max(1, nbytes // n_blocks)
+    return {"k": np.zeros((1, n_blocks, per), np.int8)}
+
+
+def test_swapped_blocks_incremental_matches_walk():
+    pool = HostKVPool(1 << 20)
+
+    def check():
+        assert pool.swapped_blocks == sum(
+            e.n_blocks for e in pool._entries.values())
+
+    check()
+    pool.put("a", _entry(64, 2), n_tokens=16)
+    check()
+    pool.put("b", _entry(128, 4), n_tokens=32)
+    check()
+    pool.put("a", _entry(256, 3), n_tokens=24)      # replace
+    check()
+    assert pool.swapped_blocks == 7
+    assert pool.pop("b") is not None
+    check()
+    pool.discard("a")
+    check()
+    assert pool.swapped_blocks == 0 and pool.bytes_used == 0
+    # a refused put changes nothing
+    assert not HostKVPool(8).put("x", _entry(64), n_tokens=8)
+
+
+def test_reservation_protocol_guards_capacity():
+    pool = HostKVPool(100)
+    assert pool.reserve("a", 60)
+    assert pool.reserved_bytes == 60
+    # a direct put must respect the outstanding reservation
+    assert not pool.put("b", _entry(60), n_tokens=8)
+    assert pool.refusals == 1
+    # a second reservation past capacity refuses
+    assert not pool.reserve("c", 60)
+    # commit converts the reservation into a stored entry
+    assert pool.commit("a", _entry(60), n_tokens=8)
+    assert pool.reserved_bytes == 0
+    assert pool.bytes_used >= 60 and len(pool) == 1
+    # unreserve releases without storing
+    assert pool.reserve("d", 30)
+    pool.unreserve("d")
+    assert pool.reserved_bytes == 0
+    # a put under a key holding its OWN reservation credits it: an
+    # inline reclaim racing its in-flight proactive spill must not be
+    # refused room reserved for exactly this payload (a refusal there
+    # would drop a perfectly spillable subtree)
+    pool2 = HostKVPool(100, kind="prefix")
+    assert pool2.reserve(("pfx", 9), 80)
+    assert pool2.put(("pfx", 9), _entry(80), n_tokens=8)
+    assert pool2.refusals == 0
+    # the in-flight transfer then lands: commit releases the
+    # reservation and replaces the entry with identical bytes
+    assert pool2.commit(("pfx", 9), _entry(80), n_tokens=8)
+    assert pool2.reserved_bytes == 0 and pool2.bytes_used >= 80
+    assert len(pool2) == 1
+
+
+def test_prefix_host_full_put_counts_drop_host_full():
+    obs.get_registry().reset()
+    obs.enable()
+    try:
+        pool = HostKVPool(8, kind="prefix")
+        assert not pool.put(("pfx", 1), _entry(64), n_tokens=8)
+        assert not pool.reserve(("pfx", 2), 64)
+        reg = obs.get_registry()
+        assert reg.counter(
+            "serving_prefix_cache_evictions_total").labels(
+                kind="drop_host_full").value == 2
+        # the swap-kind pool keeps its own fallback counter instead
+        assert not HostKVPool(8).put("r", _entry(64), n_tokens=8)
+        assert reg.counter("serving_kv_swap_fallback_total").labels(
+            reason="host_pool_full").value == 1
+        assert reg.counter(
+            "serving_prefix_cache_evictions_total").labels(
+                kind="drop_host_full").value == 2
+    finally:
+        obs.disable()
+        obs.get_registry().reset()
+
+
+# ---------------------------------------------------------------------------
+# async ≡ sync parity + the in-flight ledger
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("dtype,kv_dtype", [
+    ("f32", None),
+    ("f32", "int8"),
+    ("bf16", None),          # the acceptance pair: bf16 AND int8
+    ("bf16", "int8"),
+])
+def test_async_equals_sync_greedy_parity(model, dtype, kv_dtype,
+                                         flags_guard):
+    """The acceptance parity: a pool squeezed hard enough to force
+    preempt-swap runs the SAME workload with the async tier and the
+    forced-sync tier — greedy token streams must be bit-identical
+    (model-dtype AND int8 payload+scales move verbatim either way)."""
+    cfg, params = model
+    if dtype == "bf16":
+        cfg = dataclasses.replace(cfg, dtype=jnp.bfloat16)
+        params = jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.bfloat16)
+            if a.dtype == jnp.float32 else a, params)
+    rng = np.random.default_rng(3)
+    p1, p2 = _prompt(rng, 8), _prompt(rng, 7)
+
+    def run(mode):
+        eng = LLMEngine(params, cfg, max_slots=2, block_size=8,
+                        max_model_len=64, num_blocks=5,
+                        prompt_buckets=[8], kv_dtype=kv_dtype,
+                        kv_swap_bytes=1 << 20, kv_offload=mode)
+        i1 = eng.add_request(list(p1), max_new_tokens=16)
+        i2 = eng.add_request(list(p2), max_new_tokens=16)
+        streamed = {i1: [], i2: []}
+        saw_inflight = False
+        while eng.has_work():
+            for rid, tok in eng.step():
+                streamed[rid].append(tok)
+            acct = _assert_balanced(eng)
+            saw_inflight |= acct["in_flight"] > 0
+        eng.drain_offload()
+        assert _assert_balanced(eng)["in_flight"] == 0
+        assert len(eng.free_blocks) == eng.nb - 1
+        assert len(eng.swap_pool) == 0
+        assert eng.swap_pool.reserved_bytes == 0
+        # exactly-once streaming on both paths
+        assert streamed[i1] == eng.results[i1]
+        assert streamed[i2] == eng.results[i2]
+        restores = eng.offload.prefetch_hits + eng.offload.stalls
+        return (eng.results[i1], eng.results[i2], restores,
+                saw_inflight)
+
+    r1s, r2s, restores_s, _ = run("sync")
+    r1a, r2a, restores_a, saw_inflight = run("async")
+    assert r1a == r1s and r2a == r2s
+    # the squeeze forced the tier on both legs, and the async leg
+    # actually had transfers in flight across a step boundary
+    assert restores_s >= 1 and restores_a >= 1
+    assert saw_inflight, \
+        "async leg never parked blocks behind an in-flight d2h"
+    assert len(r1a) == 16 and len(r2a) == 16
+
+
+def test_sync_flag_forces_sync_mode(model, flags_guard):
+    cfg, params = model
+    set_flags({"serve_kv_offload_sync": True})
+    eng = LLMEngine(params, cfg, max_slots=1, block_size=8,
+                    max_model_len=64, prompt_buckets=[8],
+                    kv_swap_bytes=1 << 20)
+    assert eng.offload is not None and eng.offload.sync
+    set_flags({"serve_kv_offload_sync": False})
+    eng2 = LLMEngine(params, cfg, max_slots=1, block_size=8,
+                     max_model_len=64, prompt_buckets=[8],
+                     kv_swap_bytes=1 << 20)
+    assert not eng2.offload.sync
+    # explicit constructor mode wins over the flag
+    set_flags({"serve_kv_offload_sync": True})
+    eng3 = LLMEngine(params, cfg, max_slots=1, block_size=8,
+                     max_model_len=64, prompt_buckets=[8],
+                     kv_swap_bytes=1 << 20, kv_offload="async")
+    assert not eng3.offload.sync
+    # no host tier: no offload engine at all
+    eng4 = LLMEngine(params, cfg, max_slots=1, block_size=8,
+                     max_model_len=64, prompt_buckets=[8])
+    assert eng4.offload is None
+    with pytest.raises(ValueError, match="kv_offload"):
+        LLMEngine(params, cfg, max_slots=1, block_size=8,
+                  max_model_len=64, prompt_buckets=[8],
+                  kv_swap_bytes=1, kv_offload="bogus")
+
+
+# ---------------------------------------------------------------------------
+# prefetch hits, inline stalls, force-land
+# ---------------------------------------------------------------------------
+def test_prefetch_hit_vs_stall_counters(model, flags_guard):
+    """With prefetch on, a queued swapped request's payload is staged
+    ahead of its re-admission (hit); with prefetch depth 0 the restore
+    pays the h2d inline (stall, with observed seconds)."""
+    cfg, params = model
+    rng = np.random.default_rng(4)
+    p1, p2 = _prompt(rng, 8), _prompt(rng, 7)
+
+    def run(depth):
+        set_flags({"serve_kv_offload_prefetch_depth": depth})
+        eng = LLMEngine(params, cfg, max_slots=2, block_size=8,
+                        max_model_len=64, num_blocks=5,
+                        prompt_buckets=[8], kv_swap_bytes=1 << 20,
+                        kv_offload="async")
+        i1 = eng.add_request(list(p1), max_new_tokens=16)
+        i2 = eng.add_request(list(p2), max_new_tokens=16)
+        out = eng.run()
+        assert len(out[i1]) == 16 and len(out[i2]) == 16
+        assert eng.offload.prefetch_hits + eng.offload.stalls >= 1, \
+            "the squeezed pool never swapped"
+        return eng.offload
+
+    off_hit = run(depth=4)
+    assert off_hit.prefetch_hits >= 1
+    off_stall = run(depth=0)
+    assert off_stall.prefetch_hits == 0
+    assert off_stall.stalls >= 1
+    assert off_stall.stall_seconds > 0.0
+
+
+def test_force_land_serves_admission_midflight(model, flags_guard):
+    """White-box: an admission that arrives while the victim's spill is
+    still in flight must land it inline (counted stall) and restore —
+    never recompute, never read a half-committed entry."""
+    cfg, params = model
+    rng = np.random.default_rng(5)
+    eng = LLMEngine(params, cfg, max_slots=2, block_size=8,
+                    max_model_len=64, prompt_buckets=[8],
+                    kv_swap_bytes=1 << 20, kv_offload="async")
+    rid = eng.add_request(_prompt(rng, 8), max_new_tokens=12)
+    for _ in range(3):
+        eng.step()
+    streamed = list(eng.results.get(rid, []))
+    # preempt the live slot: the async spill is now in flight
+    slot = next(i for i in range(eng.N) if eng.slot_req[i] is not None)
+    n_out = len(eng.slot_out[slot])
+    eng._free_slot(slot, requeue=True)
+    assert eng.offload.pending(rid)
+    assert eng.offload.held_blocks > 0
+    _assert_balanced(eng)
+    # immediate re-admission: force-land, swap-in, no recompute
+    before = eng.offload.stalls
+    eng._admit()
+    assert not eng.offload.pending(rid)
+    assert eng.offload.stalls > before
+    assert eng.swap_fallbacks == 0
+    out = eng.run()
+    assert len(out[rid]) == 12
+    # the re-admission continued the stream (no re-emission)
+    assert out[rid][:n_out] == eng.results[rid][:n_out]
+    assert _assert_balanced(eng)["in_flight"] == 0
+
+
+# ---------------------------------------------------------------------------
+# crash mid-transfer (poisoned-wave semantics extended to transfers)
+# ---------------------------------------------------------------------------
+def test_crash_mid_transfer_recovers_without_divergence(model):
+    """offload_crash fires at the offload tick right after a squeeze
+    forced a preempt-swap: ResilientEngine must drop the in-flight
+    transfers cleanly (reservations released, custody blocks recycled)
+    and the recovered streams must equal an un-faulted run's."""
+    cfg, params = model
+    rng = np.random.default_rng(6)
+    prompts = [_prompt(rng, 8), _prompt(rng, 7), _prompt(rng, 5)]
+    news = [12, 10, 8]
+
+    def run(injector):
+        eng = LLMEngine(params, cfg, max_slots=2, block_size=8,
+                        max_model_len=64, num_blocks=5,
+                        prompt_buckets=[8], kv_swap_bytes=1 << 20,
+                        kv_offload="async", injector=injector)
+        reng = ResilientEngine(eng)
+        rids = [eng.add_request(list(p), max_new_tokens=n)
+                for p, n in zip(prompts, news)]
+        streamed = {r: [] for r in rids}
+        while reng.has_work():
+            for rid, tok in reng.step():
+                streamed[rid].append(tok)
+            _assert_balanced(eng)
+        eng.drain_offload()
+        acct = _assert_balanced(eng)
+        assert acct["in_flight"] == 0
+        assert eng.swap_pool.reserved_bytes == 0
+        assert len(eng.free_blocks) == eng.nb - 1
+        assert eng.swap_pool.bytes_used == 0
+        for rid in rids:
+            assert streamed[rid] == eng.results[rid]
+        return [eng.results[r] for r in rids], reng.recoveries
+
+    clean, _ = run(None)
+    faulted, recoveries = run(FaultInjector(
+        [("pool_squeeze", 2), ("offload_crash", 3),
+         ("offload_crash", 6)]))
+    assert recoveries >= 1, "the mid-transfer crash never fired"
+    assert faulted == clean
+
+
+# ---------------------------------------------------------------------------
+# proactive cold-block spill
+# ---------------------------------------------------------------------------
+def test_proactive_spill_lands_and_reclaim_frees_instantly(model,
+                                                           flags_guard):
+    """Under pool pressure the offload tick spills refcount-0 cached
+    blocks in the background (node keeps its block, payload lands
+    host-side); a later reclaim then frees the device block with no
+    inline d2h, and a warm re-send still restores bit-exactly."""
+    cfg, params = model
+    rng = np.random.default_rng(7)
+    shared = _prompt(rng, 16)        # 2 full blocks to cache
+    set_flags({"serve_kv_offload_spill_free_frac": 1.0})  # always pressed
+    eng = LLMEngine(params, cfg, max_slots=2, block_size=8,
+                    max_model_len=64, num_blocks=9, prompt_buckets=[8, 32],
+                    prefix_cache=True, prefix_cache_host_bytes=1 << 20,
+                    kv_offload="async")
+    cold = eng.add_request(list(shared), max_new_tokens=4)
+    eng.run()
+    pc = eng.prefix_cache
+    assert pc.device_blocks >= 2          # adopted at finish
+    # one more step: the tick (under forced pressure) starts background
+    # spills, the next poll lands them as host_clean dual residency
+    probe = eng.add_request(_prompt(rng, 5), max_new_tokens=2)
+    eng.run()
+    eng.drain_offload()
+    assert eng.offload.proactive_spills >= 1
+    clean = [nd for nd in pc._iter_nodes() if nd.host_clean]
+    assert clean, "no spill landed as host_clean dual residency"
+    _assert_balanced(eng)
+    # force a reclaim big enough to hit the clean nodes: the device
+    # blocks free with ZERO inline d2h (the nodes turn host-resident)
+    host_before = pc.host_blocks
+    freed = pc.reclaim(pc.evictable_blocks, eng._fetch_blocks)
+    assert len(freed) >= len(clean)
+    assert pc.host_blocks >= host_before + len(clean)
+    eng.free_blocks.extend(freed)
+    _assert_balanced(eng)
+    # warm re-send restores the spilled prefix bit-exactly (prefetch or
+    # inline, both counted) and streams match the cold run
+    warm = eng.add_request(list(shared), max_new_tokens=4)
+    out = eng.run()
+    assert out[warm] == out[cold]
+    assert eng.offload.prefetch_hits + eng.offload.stalls >= 1
+    assert pc.hits >= 1
+    _assert_balanced(eng)

@@ -357,8 +357,12 @@ def test_obs_dump_demo_serving_smoke(tmp_path):
                  # r10: the prefix-cache family rides along
                  "serving_prefix_cache_hits_total",
                  "serving_prefill_tokens_skipped_total",
-                 "serving_prefix_cache_blocks"):
+                 "serving_prefix_cache_blocks",
+                 # r15: the async offload tier's line (the demo's
+                 # swap traffic runs through it)
+                 "serving_kv_offload_prefetch_hits_total"):
         assert name in out, (name, out[-2000:])
+    assert "kv offload:" in out
     # r12: the kernel-path line — off-TPU the bucketed fallback serves
     # every dispatch and the ragged count stays 0
     assert "decode kernel paths: ragged=0" in out, out[-2000:]

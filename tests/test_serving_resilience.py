@@ -51,17 +51,9 @@ def _prompt(rng, n):
     return rng.integers(1, 64, size=n).tolist()
 
 
-def _assert_blocks_balanced(eng):
-    """The leak-regression invariant, plus no block id counted twice."""
-    acct = eng.block_accounting()
-    assert acct["free"] + acct["backed"] + acct["squeezed"] \
-        == acct["total"], acct
-    used = [int(eng.table[i, j]) for i in range(eng.N)
-            for j in range(int(eng.n_alloc[i]))]
-    squeezed = [b for _, blocks in eng._squeezed for b in blocks]
-    all_ids = list(eng.free_blocks) + used + squeezed
-    assert len(all_ids) == len(set(all_ids)), "duplicate block ids"
-    assert 0 not in all_ids, "trash block leaked into the allocator"
+# the shared 5-term ledger + custody/duplicate/cross-check helper lives
+# in tests/conftest.py — one copy, both suites enforce one invariant
+from conftest import assert_blocks_balanced as _assert_blocks_balanced  # noqa: E402
 
 
 # ---------------------------------------------------------------------------

@@ -24,10 +24,15 @@ stream (some with unmeetable deadlines, half sharing a system-prompt
 prefix) hits a bounded admission queue WITH the prefix cache and
 chunked prefill on. A run passes when EVERY submitted request ends in
 exactly one of {finished, shed, deadline_exceeded}, the block-pool
-ledger balances ``free + backed + cached + squeezed == total`` at every
-step boundary (zero KV block leaks — a pool_squeeze stealing blocks
-while the cache holds others must still balance), the host swap tier
-drains to empty, and the shared prefix actually hit the cache. A second
+ledger balances ``free + backed + cached + squeezed + in_flight ==
+total`` at every step boundary (zero KV block leaks — a pool_squeeze
+stealing blocks while the cache holds others, or an r15 async spill
+parking blocks behind an in-flight d2h, must still balance), the host
+swap tier drains to empty, and the shared prefix actually hit the
+cache. The schedule carries a seeded ``offload_crash`` — a crash fired
+at the offload tick with transfers potentially in flight: recovery
+must abandon them cleanly (reservations released, custody blocks
+recycled, nothing half-committed). A second
 phase runs the r13 speculative engine (draft-then-verify waves) under
 ``spec_verify_fail`` faults: a crash between the verify dispatch and
 its readback must roll back to the last committed token — the recovered
@@ -95,7 +100,10 @@ def serving_main(args):
         kinds=("readback_fail", "pool_squeeze", "slow_step"),
         rate=args.rate)
     menu = [("readback_fail", max(2, args.steps // 3)),
-            ("pool_squeeze", max(3, args.steps // 2))]
+            ("pool_squeeze", max(3, args.steps // 2)),
+            # fired right after a squeeze so the preempt-swap it forces
+            # is likely still in flight — the mid-transfer crash
+            ("offload_crash", max(4, args.steps // 2 + 1))]
     inj = FaultInjector(inj.pending + menu)
     print(f"fault schedule: {inj.pending}")
 
@@ -119,6 +127,7 @@ def serving_main(args):
     all_ids, streamed = [], {}
     submitted = 0
     ok = True
+    saw_inflight = False
     while eng.has_work() or submitted < args.requests:
         # offered load: up to two submissions per step (over capacity for
         # 2 slots), every 5th with a deadline that cannot be met, every
@@ -142,12 +151,14 @@ def serving_main(args):
             streamed[rid].append(tok)
         acct = eng.block_accounting()
         if acct["free"] + acct["backed"] + acct["cached"] \
-                + acct["squeezed"] != acct["total"]:
+                + acct["squeezed"] + acct["in_flight"] != acct["total"]:
             print(f"block ledger out of balance at step "
                   f"{eng._step_idx}: {acct}")
             ok = False
             break
+        saw_inflight = saw_inflight or acct["in_flight"] > 0
 
+    eng.drain_offload()
     reasons = eng.finish_reasons
     counts = {}
     for r in reasons.values():
@@ -162,6 +173,11 @@ def serving_main(args):
     print(f"prefix cache: hits={pc.hits} misses={pc.misses} "
           f"prefill_tokens_skipped={pc.tokens_skipped} "
           f"device_blocks={pc.device_blocks} host_blocks={pc.host_blocks}")
+    off = eng.offload
+    print(f"kv offload: sync={off.sync} saw_inflight={saw_inflight} "
+          f"prefetch_hits={off.prefetch_hits} stalls={off.stalls} "
+          f"stall_seconds={off.stall_seconds:.4f} "
+          f"proactive_spills={off.proactive_spills}")
 
     terminal = {"finished", "shed", "deadline_exceeded"}
     if set(reasons) != set(all_ids):
@@ -191,6 +207,14 @@ def serving_main(args):
         ok = False
     if eng.swap_pool.bytes_used != 0:
         print(f"host swap pool leaked {eng.swap_pool.bytes_used} bytes")
+        ok = False
+    if acct["in_flight"] != 0 or off.held_blocks != 0:
+        print(f"drained engine still holds in-flight transfer blocks: "
+              f"{acct['in_flight']}")
+        ok = False
+    if eng.swap_pool.reserved_bytes != 0 \
+            or (pc.host is not None and pc.host.reserved_bytes != 0):
+        print("host tier leaked async-spill reservations")
         ok = False
     if pc.hits < 1 or pc.tokens_skipped < 1:
         print(f"shared-prefix workload never hit the cache "
@@ -229,7 +253,7 @@ def serving_main(args):
             streamed2[rid].append(tok)
         acct = spec.block_accounting()
         if acct["free"] + acct["backed"] + acct["cached"] \
-                + acct["squeezed"] != acct["total"]:
+                + acct["squeezed"] + acct["in_flight"] != acct["total"]:
             print(f"spec ledger out of balance at step "
                   f"{spec._step_idx}: {acct}")
             ok = False
@@ -310,7 +334,8 @@ def http_main(args):
     def ledger_hook(e):
         acct = e.block_accounting()
         if acct["free"] + acct["backed"] + acct["cached"] \
-                + acct["squeezed"] != acct["total"]:
+                + acct["squeezed"] + acct.get("in_flight", 0) \
+                != acct["total"]:
             violations.append((e._step_idx, acct))
 
     front = HTTPFrontDoor(reng, step_hook=ledger_hook)
@@ -459,6 +484,7 @@ def http_main(args):
             print(f"client {rec['i']}: streamed/terminal mismatch "
                   f"{rec['streamed']} != {rec['terminal']}")
             ok = False
+    eng.drain_offload()
     acct = eng.block_accounting()
     if not (acct["free"] + acct["cached"] == acct["total"]
             and acct["backed"] == 0 and acct["squeezed"] == 0
@@ -470,6 +496,11 @@ def http_main(args):
         ok = False
     if eng.swap_pool.bytes_used != 0:
         print(f"host swap pool leaked {eng.swap_pool.bytes_used} bytes")
+        ok = False
+    if acct["in_flight"] != 0 or eng.offload.held_blocks != 0 \
+            or eng.swap_pool.reserved_bytes != 0:
+        print("drained front-door engine still holds in-flight "
+              "transfer state")
         ok = False
     if counts.get("shed", 0) < 1:
         print("the 2x overload burst never hit the bounded queue")

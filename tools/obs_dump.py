@@ -87,7 +87,7 @@ def print_request_table(payload, out=sys.stdout):
         return rows
     hdr = (f"{'request':>8} {'state':>6} {'tenant':>8} {'queue_ms':>9} "
            f"{'ttft_ms':>9} {'tpot_ms':>8} {'tok/s':>8} {'tokens':>6} "
-           f"{'cached':>6} {'preempt':>7} {'reason':>9}\n")
+           f"{'cached':>6} {'offload':>7} {'preempt':>7} {'reason':>9}\n")
     out.write(hdr)
     out.write("-" * (len(hdr) - 1) + "\n")
     for r in rows:
@@ -108,6 +108,10 @@ def print_request_table(payload, out=sys.stdout):
                   f"{tps_s:>8} "
                   f"{r.get('tokens', 0):>6} "
                   f"{r.get('cached_tokens', 0):>6} "
+                  # r15: how the last swap-in restore met the offload
+                  # tier ("hit" = prefetch-staged, "stall" = inline h2d;
+                  # "-" = never swapped in)
+                  f"{str(r.get('offload') or '-')[:7]:>7} "
                   f"{r.get('preemptions', 0):>7} "
                   f"{reason[:9]:>9}\n")
     for name, qs in (payload.get("exemplar_quantiles") or {}).items():
@@ -283,6 +287,19 @@ def demo_serving():
           f"deadline_exceeded={_c('serving_deadline_exceeded_total')} "
           f"kv_swap_out={_c('serving_kv_swap_out_total')} "
           f"kv_swap_in={_c('serving_kv_swap_in_total')}")
+    # r15: the async offload tier behind the swap/spill traffic above —
+    # prefetch hits consumed staged payloads, stalls paid h2d inline,
+    # proactive spills moved cold cached blocks host-side in the
+    # background (in-flight bytes are 0 at this drained point)
+    print("kv offload: "
+          f"prefetch_hits={_c('serving_kv_offload_prefetch_hits_total')} "
+          f"stalls={_c('serving_kv_offload_stalls_total')} "
+          "stall_seconds="
+          f"{reg.counter('serving_kv_offload_stall_seconds_total').labels().value:.4f} "
+          "proactive_spills="
+          f"{_c('serving_kv_offload_proactive_spills_total')} "
+          "inflight_bytes="
+          f"{int(reg.gauge('serving_kv_offload_inflight_bytes').labels().value)}")
     print("prefix cache: "
           f"hits={_c('serving_prefix_cache_hits_total')} "
           f"misses={_c('serving_prefix_cache_misses_total')} "
