@@ -1006,6 +1006,77 @@ def bench_serving(dev, results):
             "recompute_fallbacks": st["fallbacks"],
         }))
 
+    def attempt_router(make_params):
+        """Replica scale-out row (r16): the SAME offered load against 2
+        router-fronted replicas vs 1 bare engine (identical config,
+        identical prompts). vs_baseline = 2-replica/1-engine kept
+        tok/s. Both replicas share ONE chip here, so this measures the
+        router's TAX, not a speedup — the bar is ~1.0 (placement is
+        host-side and rides the step threads' idle time; a multi-chip
+        deployment is where the factor exceeds 1). A half-shared-prefix
+        workload exercises the affinity scorer (hit rate reported), and
+        the clean leg's acceptance bar is failovers == resumes == 0 —
+        failover COST is chaos_run --router's job, not bench's."""
+        from paddle_tpu.serving import LLMEngine, ReplicaRouter
+        params = make_params()
+        n_reqs, new_tok = 4 * SLOTS, 64
+        rng = np.random.default_rng(0)
+        shared = rng.integers(1, 32768, size=128).tolist()
+        prompts = []
+        for i, ln in enumerate(rng.integers(64, 320, size=n_reqs)):
+            tail = rng.integers(1, 32768, size=int(ln)).tolist()
+            prompts.append(shared + tail if i % 2 == 0 else tail)
+
+        def mk_engine():
+            return LLMEngine(params, cfg, max_slots=SLOTS, block_size=64,
+                             max_model_len=1024,
+                             prompt_buckets=[128, 512, 1024],
+                             decode_steps=16, kv_dtype="int8",
+                             prefix_cache=True)
+
+        # 1-engine baseline on the identical workload (warm first)
+        eng = mk_engine()
+        for p in prompts[:2]:
+            eng.add_request(list(p), max_new_tokens=8, temperature=0.0)
+        eng.run()
+        t0 = time.perf_counter()
+        rids = [eng.add_request(list(p), max_new_tokens=new_tok,
+                                temperature=0.0) for p in prompts]
+        out = eng.run()
+        base_tps = sum(len(out[r]) for r in rids) \
+            / (time.perf_counter() - t0)
+        _release()
+
+        engines = [mk_engine() for _ in range(2)]
+        for e in engines:
+            for p in prompts[:2]:
+                e.add_request(list(p), max_new_tokens=8, temperature=0.0)
+            e.run()
+        router = ReplicaRouter(engines, names=["r0", "r1"])
+        router.start()
+        try:
+            t0 = time.perf_counter()
+            rrids = [router.submit(list(p), max_new_tokens=new_tok,
+                                   temperature=0.0) for p in prompts]
+            gen = sum(len(router.wait(r, timeout=1800)) for r in rrids)
+            dt = time.perf_counter() - t0
+        finally:
+            router.stop()
+        hits, misses = router.affinity_hits, router.affinity_misses
+        results.append(_efficiency({
+            "metric": "llama-2.6b_serving_router_tokens_per_sec",
+            "value": round(gen / dt, 1),
+            "unit": "tokens/s",
+            # acceptance: vs_baseline ~1.0 (the router's tax on a
+            # shared chip), failovers == resumes == 0 in this clean leg
+            "vs_baseline": round(gen / dt / max(base_tps, 1e-9), 4),
+            "single_engine_tokens_per_sec": round(base_tps, 1),
+            "replicas": 2,
+            "affinity_hit_rate": round(hits / max(1, hits + misses), 3),
+            "failovers": router.failovers,
+            "resumed_streams": router.resumed_streams,
+        }))
+
     try:
         _retry(lambda: attempt("bf16", lambda: _init_bf16_params(cfg)))
         _release()
@@ -1054,6 +1125,12 @@ def bench_serving(dev, results):
         # r15 async KV offload: a KV working set ~1.5x the pool, async
         # spill/prefetch vs the forced-sync tier on the same workload
         _retry(lambda: attempt_offload(
+            lambda: jax.jit(llama.quantize_params)(_init_bf16_params(cfg))))
+        _release()
+        # r16 replica router: 2 router-fronted replicas vs 1 bare
+        # engine on the same half-shared-prefix load (scale-out factor,
+        # affinity hit rate, zero failovers in the clean leg)
+        _retry(lambda: attempt_router(
             lambda: jax.jit(llama.quantize_params)(_init_bf16_params(cfg))))
     except Exception as e:
         results.append({"metric": "serving_bench_failed", "value": 0.0,

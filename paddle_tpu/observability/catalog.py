@@ -169,6 +169,50 @@ CATALOG = {
                        "pressure — a later reclaim then frees the "
                        "device block instantly instead of paying the "
                        "d2h inline"),
+    # -- serving replica router (serving.router, r16) ----------------------
+    "serving_router_dispatch_total": (
+        "counter", ("replica",),
+        "streams placed on each replica (initial placement, failover "
+        "resumes and drain migrations all count — placement evidence "
+        "for the affinity/least-loaded policy)"),
+    "serving_router_affinity_total": (
+        "counter", ("outcome",),
+        "placement decisions by prefix-affinity outcome (hit = a "
+        "replica's shadow index held >= 1 leading block key of the "
+        "prompt and won placement; miss = no replica had any, "
+        "least-loaded fallback chose)"),
+    "serving_router_shed_total": (
+        "counter", (), "router-level sheds: every healthy replica "
+                       "refused the request (admission ShedError or "
+                       "death mid-dispatch on all candidates) — maps "
+                       "to 503 + Retry-After at the front door"),
+    "serving_router_failovers_total": (
+        "counter", (), "in-flight streams orphaned by a replica death "
+                       "and handed to the resume path (each increments "
+                       "once per death event it survives)"),
+    "serving_router_resumed_streams_total": (
+        "counter", (), "streams re-dispatched to a survivor with "
+                       "prompt + delivered tokens as the new prompt "
+                       "(greedy parity keeps the spliced stream "
+                       "token-identical to an uninterrupted run)"),
+    "serving_router_dedup_drops_total": (
+        "counter", (), "tokens emitted by a zombie replica for a "
+                       "stream the router already failed over — "
+                       "dropped at the router so the client never "
+                       "sees a duplicate (the exactly-once guard)"),
+    "serving_router_state_transitions_total": (
+        "counter", ("state",),
+        "replica health-state entries (healthy / suspect / dead / "
+        "half_open / draining / drained) — the circuit breaker's "
+        "audit trail"),
+    "serving_router_healthy_replicas": (
+        "gauge", (), "replicas currently in the healthy state (the "
+                     "placeable pool; 0 means every submit sheds)"),
+    "serving_cancel_noop_total": (
+        "counter", (), "cancel_request / _finish_expired calls against "
+                       "an already-terminal rid — counted no-ops (the "
+                       "router's failover path races natural finishes "
+                       "by design; this must never double-free)"),
     # -- serving prefix cache + chunked prefill (serving.prefix_cache) -----
     "serving_prefix_cache_hits_total": (
         "counter", (), "admissions whose prompt matched >= 1 cached "

@@ -216,6 +216,18 @@ class RequestTracer:
                 return
             ctx.events.append({"t": w, "kind": str(kind), **fields})
 
+    def annotate(self, rid, **meta) -> None:
+        """Attach metadata to a live request's summary without adding a
+        timeline event — the replica router stamps ``replica=<name>``
+        here so ``obs_dump --requests`` can show placement. Unknown or
+        finished rids no-op (same contract as :meth:`record`)."""
+        if not state.enabled():
+            return
+        with self._lock:
+            ctx = self._ctx(rid)
+            if ctx is not None:
+                ctx.meta.update(meta)
+
     def admitted(self, rid, **fields) -> None:
         """Record a slot admission — ``admitted`` the first time,
         ``resumed`` after a preemption (the id follows the request

@@ -48,6 +48,16 @@ restores prefetch h2d into staging buffers ahead of admission
 (``prefetch_hit`` vs counted inline ``stall``), and refcount-0 cached
 blocks spill proactively under pool pressure so reclaim stops paying
 d2h inline — see docs/serving.md §KV offload tier.
+
+Replica router (router.py, r16): a ``ReplicaRouter`` fronts N engine
+replicas on dedicated step threads — prefix-affinity placement over the
+same block-granular token keys the radix cache uses, tenant-aware
+least-loaded fallback, step-progress heartbeats driving a
+healthy/suspect/dead state machine with a half-open circuit breaker,
+exactly-once failover resume (replay ``prompt + delivered`` on a
+survivor, overlap deduped, greedy streams token-identical to an
+uninterrupted run), and per-replica drain that migrates stragglers —
+see docs/serving.md §Replica router.
 """
 from .admission import (AdmissionConfig, AdmissionController, ShedError,
                         TokenBucket)
@@ -57,7 +67,9 @@ from .kv_swap import HostKVPool
 from .offload import OffloadEngine
 from .prefix_cache import PrefixCache
 from .resilient import ResilientEngine
+from .router import Replica, ReplicaRouter
 
 __all__ = ["LLMEngine", "Request", "ResilientEngine", "AdmissionConfig",
            "AdmissionController", "ShedError", "TokenBucket",
-           "HostKVPool", "PrefixCache", "HTTPFrontDoor", "OffloadEngine"]
+           "HostKVPool", "PrefixCache", "HTTPFrontDoor", "OffloadEngine",
+           "Replica", "ReplicaRouter"]

@@ -149,6 +149,7 @@ _M_SPEC_PROPOSED = _instrument("serving_spec_proposed_total")
 _M_SPEC_ACCEPTED = _instrument("serving_spec_accepted_total")
 _M_SPEC_ACCEPT_RATE = _instrument("serving_spec_acceptance_rate")
 _M_SPEC_TOKENS_PER_WAVE = _instrument("serving_spec_tokens_per_wave")
+_M_CANCEL_NOOP = _instrument("serving_cancel_noop_total")
 
 
 @dataclasses.dataclass
@@ -1051,6 +1052,7 @@ class LLMEngine:
         self.admit_order: List[int] = []           # slots, oldest first
         self.queue: deque = deque()
         self.results: Dict[int, List[int]] = {}
+        self.cancel_noops = 0   # cancels/finishes that raced a terminal
         self._next_id = 0
         self._key = jax.random.PRNGKey(seed)
         self._prefill = {}
@@ -1659,8 +1661,14 @@ class LLMEngine:
                         reason: str = "deadline_exceeded") -> None:
         """Terminal bookkeeping for a QUEUED request evicted before any
         slot (deadline expiry or a front-door cancellation): partial
-        tokens delivered, its trace closes with ``reason``."""
+        tokens delivered, its trace closes with ``reason``. Idempotent:
+        a rid that already reached a terminal reason is a counted
+        no-op — never a double-free of its swap/offload state."""
         rid = req.req_id
+        if rid in self.finish_reasons:
+            self.cancel_noops += 1
+            _M_CANCEL_NOOP.inc()
+            return
         self.results[rid] = out
         self.finish_reasons[rid] = reason
         if req.t_deadline is not None:
@@ -1689,8 +1697,14 @@ class LLMEngine:
         immediately with their partial tokens, in-slot victims ride the
         deadline-eviction path — slot freed, KV blocks returned, the
         unread in-flight wave's lanes skipped at readback via the
-        (slot, rid) snapshot check. Unknown or already-terminal rids
-        no-op (the disconnect raced the natural finish)."""
+        (slot, rid) snapshot check. Already-terminal rids are a
+        COUNTED no-op (``cancel_noops`` / ``serving_cancel_noop_total``)
+        — the router's failover path races natural finishes by design,
+        and the race must never KeyError or double-free."""
+        if rid in self.finish_reasons:
+            self.cancel_noops += 1
+            _M_CANCEL_NOOP.inc()
+            return
         with self._cancel_lock:
             self._cancels[rid] = str(reason)
 
@@ -1705,8 +1719,16 @@ class LLMEngine:
             cancels, self._cancels = self._cancels, {}
         live = {req.req_id for req in self.queue} \
             | {r.req_id for r in self.slot_req if r is not None}
-        cancels = {rid: rsn for rid, rsn in cancels.items()
-                   if rid in live}
+        kept_markers = {rid: rsn for rid, rsn in cancels.items()
+                        if rid in live}
+        dropped = len(cancels) - len(kept_markers)
+        if dropped:
+            # Markers that raced a natural finish between the write and
+            # this step boundary: counted no-ops, same contract as the
+            # early return in cancel_request.
+            self.cancel_noops += dropped
+            _M_CANCEL_NOOP.inc(dropped)
+        cancels = kept_markers
         if not cancels:
             return
         if any(req.req_id in cancels for req in self.queue):

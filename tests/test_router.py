@@ -1,0 +1,402 @@
+"""r16 replica router: health-checked failover with exactly-once
+stream resume, prefix-affinity placement, per-replica drain — the
+multi-replica contracts over paddle_tpu.serving.router.
+
+Contracts under test:
+- kill-a-replica mid-stream: every orphaned stream resumes on a
+  survivor from ``prompt + delivered`` and the spliced stream is
+  token-identical to an uninterrupted single-engine greedy run (f32
+  and int8-KV pools);
+- placement: a prompt sharing a block-aligned prefix with an earlier
+  stream lands on the replica that served it (affinity hit); disjoint
+  prompts fall back to least-loaded (counted miss);
+- the circuit breaker's full cycle under an injectable clock: stale
+  heartbeat -> suspect -> dead (streams failed over, a zombie's late
+  tokens deduped), recovery -> half_open after the re-probe delay,
+  one successful probe -> healthy; no wall-clock sleeps;
+- per-replica drain: traffic steers away, in-flight streams finish
+  (or migrate via the resume path past the drain budget), and the
+  drained replica's block ledger is clean — zero orphaned blocks;
+- engine cancel idempotence (the satellite): cancelling an
+  already-terminal rid — or double-finishing one — is a COUNTED no-op
+  (``cancel_noops`` / serving_cancel_noop_total), never a KeyError or
+  a double-free.
+"""
+import dataclasses
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401  (forces the CPU/virtual-device conftest setup)
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models import llama
+from paddle_tpu.serving import LLMEngine, ReplicaRouter
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = dataclasses.replace(
+        llama.tiny_llama(vocab=64, hidden=32, layers=2, heads=4, kv_heads=2,
+                         seq=128, ffn=64),
+        dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _engine(params, cfg, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_model_len", 64)
+    kw.setdefault("prompt_buckets", [8, 48])
+    return LLMEngine(params, cfg, **kw)
+
+
+def _router(params, cfg, n=2, engine_kw=None, **kw):
+    engines = [_engine(params, cfg, **(engine_kw or {})) for _ in range(n)]
+    r = ReplicaRouter(engines, names=[f"r{i}" for i in range(n)], **kw)
+    r.start()
+    return r
+
+
+def _owner(router, rid):
+    with router._lock:
+        return router._streams[rid].replica
+
+
+def _wait_mid_stream(router, rid, min_tokens=2, timeout=30.0):
+    """Block until ``rid`` is live on a replica with >= min_tokens
+    delivered — the kill must land MID-stream, not before or after."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        with router._lock:
+            rec = router._streams[rid]
+            if rec.done.is_set():
+                raise AssertionError(
+                    f"stream {rid} finished before it could be orphaned "
+                    f"(delivered {len(rec.delivered)})")
+            if rec.replica is not None and len(rec.delivered) >= min_tokens:
+                return rec.replica
+        time.sleep(0.002)
+    raise AssertionError(f"stream {rid} never reached {min_tokens} tokens")
+
+
+def _drained_clean(eng):
+    acct = eng.block_accounting()
+    return (acct["free"] + acct["cached"] == acct["total"]
+            and acct["backed"] == 0 and acct["squeezed"] == 0)
+
+
+# ---------------------------------------------------------------------------
+# failover + exactly-once resume
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("variant", ["f32", "f32_int8kv"])
+def test_failover_resume_matches_uninterrupted_greedy(model, variant):
+    cfg, params = model
+    ekw = {"kv_dtype": "int8"} if variant == "f32_int8kv" else {}
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, 64, size=6).tolist() for _ in range(3)]
+
+    ref = _engine(params, cfg, **ekw)
+    ref_ids = [ref.add_request(list(p), max_new_tokens=20) for p in prompts]
+    ref_out = ref.run()
+
+    router = _router(params, cfg, n=2, engine_kw=ekw)
+    try:
+        rids = [router.submit(list(p), max_new_tokens=20) for p in prompts]
+        victim = _wait_mid_stream(router, rids[0])
+        router.kill_replica(victim)
+        outs = {rid: router.wait(rid, timeout=120) for rid in rids}
+        assert router.failovers >= 1 and router.resumed_streams >= 1
+        for rid, refid in zip(rids, ref_ids):
+            assert router.finish_reasons[rid] == "finished"
+            assert outs[rid] == ref_out[refid], (
+                f"stream {rid} diverged after failover")
+    finally:
+        router.stop()
+
+
+def test_every_minted_id_exactly_one_terminal_reason(model):
+    cfg, params = model
+    router = _router(params, cfg, n=2)
+    try:
+        rng = np.random.default_rng(5)
+        rids = [router.submit(rng.integers(1, 64, size=5).tolist(),
+                              max_new_tokens=12) for _ in range(4)]
+        victim = _wait_mid_stream(router, rids[0])
+        router.kill_replica(victim)
+        for rid in rids:
+            router.wait(rid, timeout=120)
+        assert set(router.finish_reasons) == set(rids)
+        assert set(router.finish_reasons.values()) <= {
+            "finished", "shed", "deadline_exceeded",
+            "client_disconnected", "drained"}
+    finally:
+        router.stop()
+
+
+# ---------------------------------------------------------------------------
+# prefix-affinity placement
+# ---------------------------------------------------------------------------
+def test_affinity_hit_on_shared_prefix_miss_on_disjoint(model):
+    cfg, params = model
+    router = _router(params, cfg, n=2)
+    try:
+        rng = np.random.default_rng(11)
+        # two full 8-token blocks of shared prefix — the affinity
+        # scorer only sees block-aligned keys, same as the radix cache
+        shared = rng.integers(1, 64, size=16).tolist()
+        r1 = router.submit(shared + [7, 8], max_new_tokens=4)
+        router.wait(r1, timeout=60)
+        first = _owner(router, r1)
+        misses0 = router.affinity_misses
+
+        r2 = router.submit(shared + [9, 10, 11], max_new_tokens=4)
+        router.wait(r2, timeout=60)
+        assert _owner(router, r2) == first, \
+            "shared-prefix request was routed off the warm replica"
+        assert router.affinity_hits >= 1
+
+        r3 = router.submit(rng.integers(1, 64, size=18).tolist(),
+                           max_new_tokens=4)
+        router.wait(r3, timeout=60)
+        assert router.affinity_misses > misses0
+    finally:
+        router.stop()
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker under an injectable clock
+# ---------------------------------------------------------------------------
+def test_circuit_breaker_open_halfopen_close(model):
+    cfg, params = model
+    clock = [0.0]
+    router = _router(params, cfg, n=2, now_fn=lambda: clock[0],
+                     suspect_s=2.0, dead_s=6.0, halfopen_s=3.0)
+    try:
+        rng = np.random.default_rng(17)
+        ref = _engine(params, cfg)
+        prompt = rng.integers(1, 64, size=6).tolist()
+        refid = ref.add_request(list(prompt), max_new_tokens=24)
+        ref_out = ref.run()[refid]
+
+        rid = router.submit(list(prompt), max_new_tokens=24)
+        stuck = _wait_mid_stream(router, rid)
+        rep = router.replicas[stuck]
+        # freeze the heartbeat: the replica keeps stepping (a zombie)
+        # but its pulse goes stale
+        rep.hb_frozen = True
+        clock[0] += 2.5
+        time.sleep(0.05)     # live replicas stamp a fresh pulse first
+        assert router.check()[stuck] == "suspect"
+        clock[0] += 4.0
+        time.sleep(0.05)
+        assert router.check()[stuck] == "dead"
+        # the orphaned stream resumed elsewhere, parity intact
+        assert router.wait(rid, timeout=120) == ref_out
+        assert router.finish_reasons[rid] == "finished"
+        assert router.failovers >= 1
+
+        # recovery: a fresh pulse after the re-probe delay earns ONE
+        # half-open probe; a finished probe closes the circuit
+        rep.hb_frozen = False
+        deadline = time.monotonic() + 10
+        while router.check()[stuck] == "dead" \
+                and time.monotonic() < deadline:
+            clock[0] += 3.5      # past halfopen_s; the live thread
+            time.sleep(0.01)     # re-stamps hb so age stays < suspect_s
+        assert router.states()[stuck] == "half_open"
+        probe_deadline = time.monotonic() + 30
+        while router.states()[stuck] != "healthy" \
+                and time.monotonic() < probe_deadline:
+            pr = router.submit(rng.integers(1, 64, size=4).tolist(),
+                               max_new_tokens=3)
+            router.wait(pr, timeout=60)
+            router.check()
+        assert router.states()[stuck] == "healthy"
+    finally:
+        router.stop()
+
+
+def test_zombie_tokens_deduped_after_failover(model):
+    cfg, params = model
+    clock = [0.0]
+    router = _router(params, cfg, n=2, now_fn=lambda: clock[0],
+                     suspect_s=2.0, dead_s=6.0)
+    try:
+        rng = np.random.default_rng(23)
+        prompt = rng.integers(1, 64, size=6).tolist()
+        rid = router.submit(list(prompt), max_new_tokens=30)
+        stuck = _wait_mid_stream(router, rid, min_tokens=2)
+        rep = router.replicas[stuck]
+        rep.hb_frozen = True
+        clock[0] += 7.0
+        # age-driven death takes two stale observations (suspect, then
+        # dead) — one clock step must never mass-kill live replicas
+        time.sleep(0.05)     # live replicas stamp a fresh pulse first
+        assert router.check()[stuck] == "suspect"
+        time.sleep(0.05)
+        assert router.check()[stuck] == "dead"
+        out = router.wait(rid, timeout=120)
+        # the zombie replica kept decoding the moved stream; its late
+        # tokens must be dropped at the router, never double-delivered
+        eng = _engine(params, cfg)
+        refid = eng.add_request(list(prompt), max_new_tokens=30)
+        assert out == eng.run()[refid]
+        # let the zombie finish its copy, then the drops are visible
+        deadline = time.monotonic() + 60
+        while rep.raw.has_work() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert router.dedup_drops >= 1
+    finally:
+        router.stop()
+
+
+# ---------------------------------------------------------------------------
+# per-replica drain
+# ---------------------------------------------------------------------------
+def test_drain_steers_traffic_and_leaves_zero_orphaned_blocks(model):
+    cfg, params = model
+    router = _router(params, cfg, n=2)
+    try:
+        rng = np.random.default_rng(29)
+        rid = router.submit(rng.integers(1, 64, size=6).tolist(),
+                            max_new_tokens=8)
+        busy = _wait_mid_stream(router, rid, min_tokens=1)
+        router.begin_drain(busy)
+        # new traffic must land on the other replica while the drain
+        # lets the in-flight stream finish in place
+        other = [n for n in router.replicas if n != busy][0]
+        r2 = router.submit(rng.integers(1, 64, size=5).tolist(),
+                           max_new_tokens=4)
+        assert _owner(router, r2) == other
+        router.wait(rid, timeout=60)
+        router.wait(r2, timeout=60)
+        assert router.finish_reasons[rid] == "finished"
+        deadline = time.monotonic() + 30
+        while router.check()[busy] != "drained" \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert router.states()[busy] == "drained"
+        assert _drained_clean(router.replicas[busy].raw), \
+            router.replicas[busy].raw.block_accounting()
+    finally:
+        router.stop()
+
+
+def test_drain_stragglers_migrate_via_resume(model):
+    cfg, params = model
+    # drain budget 0: any in-flight stream is immediately a straggler
+    router = _router(params, cfg, n=2, drain_s=0.0)
+    try:
+        rng = np.random.default_rng(31)
+        prompt = rng.integers(1, 64, size=6).tolist()
+        ref = _engine(params, cfg)
+        refid = ref.add_request(list(prompt), max_new_tokens=24)
+        ref_out = ref.run()[refid]
+
+        rid = router.submit(list(prompt), max_new_tokens=24)
+        busy = _wait_mid_stream(router, rid, min_tokens=2)
+        router.begin_drain(busy)
+        deadline = time.monotonic() + 60
+        while router.check()[busy] != "drained" \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert router.states()[busy] == "drained"
+        # the straggler moved mid-stream and still matches a clean run
+        assert router.wait(rid, timeout=120) == ref_out
+        assert router.finish_reasons[rid] == "finished"
+        assert router.resumed_streams >= 1
+        assert _drained_clean(router.replicas[busy].raw)
+    finally:
+        router.stop()
+
+
+# ---------------------------------------------------------------------------
+# engine cancel idempotence (satellite)
+# ---------------------------------------------------------------------------
+def test_cancel_already_terminal_is_counted_noop(model):
+    cfg, params = model
+    eng = _engine(params, cfg)
+    rid = eng.add_request([1, 2, 3], max_new_tokens=3)
+    eng.run()
+    assert eng.finish_reasons[rid] == "finished"
+    before = dict(eng.results)
+    assert eng.cancel_noops == 0
+    eng.cancel_request(rid)                       # races a natural finish
+    eng.cancel_request(rid, reason="drained")     # and again
+    assert eng.cancel_noops == 2
+    assert eng.results == before                  # no double-free, no edit
+    assert not eng._cancels                       # no marker ever written
+
+    # a marker written for a rid the engine never minted is dropped —
+    # and counted — at the next step boundary
+    eng.cancel_request(999)
+    live = eng.add_request([4, 5, 6], max_new_tokens=2)
+    eng.run()
+    assert eng.cancel_noops == 3
+    assert eng.finish_reasons[live] == "finished"
+    assert 999 not in eng.finish_reasons
+
+    acct = eng.block_accounting()
+    assert acct["free"] + acct["cached"] == acct["total"]
+
+
+def test_finish_expired_double_call_is_counted_noop(model):
+    cfg, params = model
+    eng = _engine(params, cfg)
+    # an unmeetable deadline finishes the request from the queue
+    rid = eng.add_request([1, 2, 3], max_new_tokens=4, deadline_s=0.0)
+    req = eng.queue[0]
+    eng.step()
+    assert eng.finish_reasons[rid] == "deadline_exceeded"
+    tokens = list(eng.results[rid])
+    eng._finish_expired(req, [9, 9, 9], queued=True)   # the race, replayed
+    assert eng.cancel_noops == 1
+    assert eng.results[rid] == tokens                  # first write wins
+    assert eng.finish_reasons[rid] == "deadline_exceeded"
+
+
+# ---------------------------------------------------------------------------
+# tooling (slow lane)
+# ---------------------------------------------------------------------------
+def test_chaos_repro_line_format():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import chaos_run
+    finally:
+        sys.path.pop(0)
+    import argparse
+    ns = argparse.Namespace(seed=3, replicas=2, requests=9, steps=5,
+                            rate=0.1)
+    assert chaos_run._repro(ns, "router") == \
+        "repro: chaos_run --router --seed 3 --replicas 2 --requests 9"
+    assert chaos_run._repro(ns, "train") == \
+        "repro: chaos_run --train --seed 3 --steps 5 --rate 0.1"
+    assert chaos_run._repro(ns, "http") == \
+        "repro: chaos_run --http --seed 3 --requests 9"
+
+
+@pytest.mark.slow
+def test_chaos_run_router():
+    """tools/chaos_run.py --router: a seeded replica kill mid-stream
+    ends with every id terminal, resumed streams token-identical to a
+    clean single-engine run, balanced per-replica ledgers, traffic on
+    survivors only, and a clean full drain."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_run.py"),
+         "--router", "--requests", "12", "--seed", "7"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, timeout=600,
+        cwd=REPO, env=env)
+    out = proc.stdout.decode(errors="replace")
+    assert proc.returncode == 0, out[-2000:]
+    assert "ROUTER_CHAOS: OK" in out
+    assert "failovers=" in out and "resumed=" in out

@@ -60,9 +60,28 @@ an empty swap tier.
 
     JAX_PLATFORMS=cpu python tools/chaos_run.py --http --requests 18 --seed 7
 
+Router mode (``--router``) — kill-a-replica chaos (r16): a
+ReplicaRouter fronts N in-process engine replicas on dedicated step
+threads under a half-shared-prefix workload; a seeded victim replica is
+killed MID-STREAM (its thread dies with slots occupied and tokens
+already delivered). A run passes when every router-minted id ends in
+exactly one terminal reason, every stream that finished — including the
+failed-over ones resumed on a survivor from ``prompt + delivered`` — is
+token-identical to an uninterrupted single-engine greedy run, the
+per-replica block ledgers balance at every replica step (asserted from
+the router's step hook), post-kill traffic lands only on survivors, the
+revived victim rejoins through the half-open probe, and a full drain
+leaves every replica's ledger clean.
+
+    JAX_PLATFORMS=cpu python tools/chaos_run.py --router --requests 12 --seed 7
+
+Any failed run prints a one-line ``repro: chaos_run --<mode> --seed N
+...`` command, so a red CI log hands you the exact seeded invocation.
+
 Wired into the suite as tests/test_resilience.py::test_chaos_run_llama_parity,
-tests/test_serving_resilience.py::test_chaos_run_serving and
-tests/test_http_server.py::test_chaos_run_http
+tests/test_serving_resilience.py::test_chaos_run_serving,
+tests/test_http_server.py::test_chaos_run_http and
+tests/test_router.py::test_chaos_run_router
 (slow lane: PADDLE_TPU_FULL_TESTS=1).
 """
 import argparse
@@ -73,6 +92,19 @@ import tempfile
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
+
+
+def _repro(args, mode):
+    """The one-line reproduction command printed on any failed run —
+    the seeded invocation itself, not a traceback to reverse-engineer."""
+    parts = [f"repro: chaos_run --{mode}", f"--seed {args.seed}"]
+    if mode == "router":
+        parts.append(f"--replicas {args.replicas}")
+    if mode in ("serving", "http", "router"):
+        parts.append(f"--requests {args.requests}")
+    if mode in ("train", "serving"):
+        parts += [f"--steps {args.steps}", f"--rate {args.rate}"]
+    return " ".join(parts)
 
 
 def serving_main(args):
@@ -274,6 +306,8 @@ def serving_main(args):
             print(f"spec request {rid}: streamed/result mismatch")
             ok = False
 
+    if not ok:
+        print(_repro(args, "serving"))
     print("SERVING_CHAOS: OK" if ok else "SERVING_CHAOS: FAIL")
     return 0 if ok else 1
 
@@ -517,7 +551,212 @@ def http_main(args):
         print("the injected readback crash never fired/recovered")
         ok = False
 
+    if not ok:
+        print(_repro(args, "http"))
     print("HTTP_CHAOS: OK" if ok else "HTTP_CHAOS: FAIL")
+    return 0 if ok else 1
+
+
+def router_main(args):
+    """Kill-a-replica chaos: a seeded mid-stream replica death under a
+    ReplicaRouter, exactly-once resume parity asserted against a clean
+    single-engine run."""
+    import dataclasses
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu.observability as obs
+    from paddle_tpu.models import llama
+    from paddle_tpu.serving import LLMEngine, ReplicaRouter
+
+    obs.enable()
+    cfg = dataclasses.replace(
+        llama.tiny_llama(vocab=64, hidden=32, layers=2, heads=4, kv_heads=2,
+                         seq=128, ffn=64),
+        dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(args.seed))
+
+    def mk_engine():
+        return LLMEngine(params, cfg, max_slots=2, block_size=8,
+                         max_model_len=64, prompt_buckets=[8, 48])
+
+    engines = [mk_engine() for _ in range(args.replicas)]
+    # warm every replica's compile caches BEFORE the step threads exist
+    # (both prefill buckets + the decode wave): a cold first step takes
+    # seconds and would let wall-clock health timers mistake compilation
+    # for death — chaos should kill a SERVING replica, not a compiling one
+    wrng = np.random.default_rng(args.seed)
+    for eng in engines:
+        eng.add_request(wrng.integers(1, 64, size=6).tolist(),
+                        max_new_tokens=4)
+        eng.add_request(wrng.integers(1, 64, size=20).tolist(),
+                        max_new_tokens=4)
+        eng.run()
+
+    violations = []
+
+    def ledger_hook(name, eng):
+        acct = eng.block_accounting()
+        if acct["free"] + acct["backed"] + acct["cached"] \
+                + acct["squeezed"] + acct.get("in_flight", 0) \
+                != acct["total"]:
+            violations.append((name, eng._step_idx, acct))
+
+    names = [f"r{i}" for i in range(args.replicas)]
+    # generous wall-clock thresholds: this run drives death/revival
+    # explicitly (kill_replica/revive_replica), and a CI box under load
+    # must not see a slow-but-alive replica declared dead on its own
+    router = ReplicaRouter(engines, names=names, step_hook=ledger_hook,
+                           suspect_s=15.0, dead_s=30.0, halfopen_s=0.2)
+    router.start()
+
+    # seeded workload: half the prompts share an 8-token system prefix
+    # (the affinity scorer's food), long-ish decodes so the kill lands
+    # mid-stream; prompt(<=20) + delivered(<16) stays inside bucket 48
+    rng = np.random.default_rng(args.seed)
+    shared = rng.integers(1, 64, size=8).tolist()
+    workload = []
+    for i in range(args.requests):
+        tail = rng.integers(1, 64, size=int(rng.integers(3, 12))).tolist()
+        prompt = shared + tail if i % 2 == 0 else tail
+        workload.append((prompt, int(rng.integers(8, 16))))
+
+    ok = True
+    first = workload[:max(2, args.requests // 2)]
+    rest = workload[len(first):]
+    rids = [router.submit(p, max_new_tokens=n) for p, n in first]
+
+    # wait for a mid-stream moment: some replica owns a stream that has
+    # already delivered tokens but is not finished
+    victim = None
+    deadline = time.monotonic() + 30
+    while victim is None and time.monotonic() < deadline:
+        with router._lock:
+            live = [rec for rec in router._streams.values()
+                    if rec.replica is not None and not rec.done.is_set()
+                    and len(rec.delivered) >= 2]
+            if live:
+                # seeded victim choice among replicas with live streams
+                owners = sorted({rec.replica for rec in live})
+                victim = owners[int(rng.integers(0, len(owners)))]
+        time.sleep(0.002)
+    if victim is None:
+        print("no stream was ever mid-flight — workload too small")
+        ok = False
+        victim = names[0]
+    pre_kill = {n: rep.dispatches for n, rep in router.replicas.items()}
+    print(f"killing {victim} mid-stream "
+          f"(dispatches so far: {pre_kill})")
+    router.kill_replica(victim)
+
+    # post-kill offered load must land on survivors only
+    rids += [router.submit(p, max_new_tokens=n) for p, n in rest]
+    for rid in rids:
+        router.wait(rid, timeout=120)
+
+    reasons = dict(router.finish_reasons)
+    counts = {}
+    for r in reasons.values():
+        counts[r] = counts.get(r, 0) + 1
+    print(f"router chaos: {len(rids)} offered, {counts} | "
+          f"failovers={router.failovers} resumed={router.resumed_streams} "
+          f"affinity={router.affinity_hits}/{router.affinity_misses} "
+          f"dedup_drops={router.dedup_drops} sheds={router.router_sheds}")
+
+    # every minted id: exactly one terminal reason, from the closed set
+    terminal = {"finished", "shed", "deadline_exceeded",
+                "client_disconnected", "drained"}
+    if set(reasons) != set(rids):
+        print(f"requests without a terminal state: "
+              f"{sorted(set(rids) - set(reasons))}")
+        ok = False
+    if not set(reasons.values()) <= terminal:
+        print(f"non-terminal reasons: {set(reasons.values()) - terminal}")
+        ok = False
+    if router.failovers < 1 or router.resumed_streams < 1:
+        print("the kill never orphaned a live stream — nothing failed over")
+        ok = False
+    if router.affinity_hits < 1:
+        print("shared-prefix workload never scored an affinity hit")
+        ok = False
+
+    # exactly-once resume parity: EVERY finished stream — resumed or
+    # not — must be token-identical to an uninterrupted single-engine
+    # greedy run of the same workload
+    ref = mk_engine()
+    ref_ids = [ref.add_request(p, max_new_tokens=n) for p, n in workload]
+    ref_out = ref.run()
+    for rid, refid in zip(rids, ref_ids):
+        if reasons.get(rid) != "finished":
+            continue
+        if router.results[rid] != ref_out[refid]:
+            print(f"request {rid} diverged from the clean greedy run: "
+                  f"{router.results[rid]} != {ref_out[refid]}")
+            ok = False
+
+    # rebalance: the dead victim took no post-kill dispatches; every
+    # survivor kept serving
+    post_kill = {n: rep.dispatches for n, rep in router.replicas.items()}
+    if post_kill[victim] != pre_kill[victim]:
+        print(f"dead replica {victim} was dispatched to after the kill: "
+              f"{pre_kill[victim]} -> {post_kill[victim]}")
+        ok = False
+    survivors = [n for n in names if n != victim]
+    if rest and not any(post_kill[n] > pre_kill[n] for n in survivors):
+        print(f"post-kill traffic never landed on a survivor: "
+              f"{pre_kill} -> {post_kill}")
+        ok = False
+
+    # circuit breaker: the revived victim rejoins through the half-open
+    # probe under fresh traffic, never by fiat
+    router.revive_replica(victim)
+    router.check()
+    if router.states()[victim] not in ("dead", "half_open"):
+        print(f"revived {victim} skipped the circuit breaker: "
+              f"{router.states()[victim]}")
+        ok = False
+    probe_rids = []
+    deadline = time.monotonic() + 30
+    while router.states()[victim] != "healthy" \
+            and time.monotonic() < deadline:
+        router.check()
+        probe_rids.append(router.submit(
+            rng.integers(1, 64, size=4).tolist(), max_new_tokens=4))
+        for rid in probe_rids[-1:]:
+            router.wait(rid, timeout=60)
+    router.check()
+    if router.states()[victim] != "healthy":
+        print(f"revived {victim} never closed the circuit: "
+              f"{router.states()}")
+        ok = False
+
+    # full drain: every replica's ledger clean, no stream left behind
+    if not router.drain_all(timeout=60):
+        print("drain never completed")
+        ok = False
+    for name, rep in router.replicas.items():
+        acct = rep.raw.block_accounting()
+        if not (acct["free"] + acct["cached"] == acct["total"]
+                and acct["backed"] == 0 and acct["squeezed"] == 0):
+            print(f"replica {name} drained ledger not clean: {acct}")
+            ok = False
+    if router.live_streams():
+        print(f"streams survived the drain: {router.live_streams()}")
+        ok = False
+    if violations:
+        print(f"per-replica ledger violations: {violations[:3]}")
+        ok = False
+    noops = sum(rep.raw.cancel_noops for rep in router.replicas.values())
+    print(f"post-drain states: {router.states()} | "
+          f"cancel_noops={noops} ledger_checks_per_replica="
+          f"{ {n: rep.steps for n, rep in router.replicas.items()} }")
+    router.stop()
+
+    if not ok:
+        print(_repro(args, "router"))
+    print("ROUTER_CHAOS: OK" if ok else "ROUTER_CHAOS: FAIL")
     return 0 if ok else 1
 
 
@@ -530,6 +769,9 @@ def main():
     mode.add_argument("--http", action="store_true",
                       help="run the network-layer chaos suite against a "
                            "live HTTP/SSE front door")
+    mode.add_argument("--router", action="store_true",
+                      help="run the kill-a-replica chaos suite against a "
+                           "ReplicaRouter over N in-process replicas")
     mode.add_argument("--train", action="store_true",
                       help="run the train-loop chaos parity suite "
                            "(the default; the flag names it explicitly)")
@@ -538,7 +780,10 @@ def main():
     ap.add_argument("--rate", type=float, default=0.2,
                     help="per-step fault probability for the random schedule")
     ap.add_argument("--requests", type=int, default=14,
-                    help="--serving: requests offered over the run")
+                    help="--serving/--http/--router: requests offered "
+                         "over the run")
+    ap.add_argument("--replicas", type=int, default=3,
+                    help="--router: engine replicas behind the router")
     ap.add_argument("--workdir", default=None)
     ap.add_argument("--no-corrupt-newest", action="store_true",
                     help="skip the corrupt-newest-checkpoint tier")
@@ -548,6 +793,8 @@ def main():
         return serving_main(args)
     if args.http:
         return http_main(args)
+    if args.router:
+        return router_main(args)
 
     import jax
     import jax.numpy as jnp
@@ -627,6 +874,7 @@ def main():
                           f"(step {ckpts[-1][0]}) to exercise fallback")
                     corrupted = True
         if crashes > 8:
+            print(_repro(args, "train"))
             print("CHAOS_PARITY: FAIL (crash loop)")
             return 1
 
@@ -675,6 +923,8 @@ def main():
         print(f"unexpected skipped batches: {loop.skipped_batches}")
         ok = False
 
+    if not ok:
+        print(_repro(args, "train"))
     print("CHAOS_PARITY: OK" if ok else "CHAOS_PARITY: FAIL")
     return 0 if ok else 1
 

@@ -85,7 +85,8 @@ def print_request_table(payload, out=sys.stdout):
         out.write("(no traced requests — enable observability and "
                   "serve traffic)\n")
         return rows
-    hdr = (f"{'request':>8} {'state':>6} {'tenant':>8} {'queue_ms':>9} "
+    hdr = (f"{'request':>8} {'state':>6} {'tenant':>8} {'replica':>7} "
+           f"{'queue_ms':>9} "
            f"{'ttft_ms':>9} {'tpot_ms':>8} {'tok/s':>8} {'tokens':>6} "
            f"{'cached':>6} {'offload':>7} {'preempt':>7} {'reason':>9}\n")
     out.write(hdr)
@@ -102,6 +103,9 @@ def print_request_table(payload, out=sys.stdout):
         out.write(f"{str(r.get('request_id')):>8} "
                   f"{'live' if r.get('live') else 'done':>6} "
                   f"{str(r.get('tenant') or '-')[:8]:>8} "
+                  # r16: which router replica hosted the stream
+                  # (RequestTracer.annotate; "-" = single-engine)
+                  f"{str(r.get('replica') or '-')[:7]:>7} "
                   f"{_fmt_ms(r.get('queue_ms')):>9} "
                   f"{_fmt_ms(r.get('ttft_ms')):>9} "
                   f"{_fmt_ms(r.get('tpot_ms')):>8} "
