@@ -85,8 +85,23 @@ _define_flag("obs_numerics_capacity", 512,
              "bounded retention for landed numerics stat vectors "
              "(oldest evicted; the provenance walk and the obs_dump "
              "stats table read this ring)")
+_define_flag("obs_fleet_placements_capacity", 256,
+             "bounded retention for router placement-audit entries "
+             "(/fleet/placements.json ring; oldest evicted)")
+_define_flag("obs_fleet_slo_target", 0.99,
+             "fleet SLO attainment target; a replica's burn rate is "
+             "(1 - attainment) / (1 - target) — above 1.0 it is "
+             "burning its error budget")
+_define_flag("obs_fleet_slo_min_requests", 20,
+             "minimum per-replica histogram samples before the fleet "
+             "SLO burn-rate check judges a replica (avoids flapping "
+             "on a cold replica's first requests)")
+_define_flag("obs_fleet_slo_advisory", False,
+             "let a replica's SLO burn feed the router health check "
+             "as an advisory suspect signal (healthy -> suspect only; "
+             "liveness still decides dead)")
 
-_LAZY_SUBMODULES = ("request_trace", "profiling", "numerics")
+_LAZY_SUBMODULES = ("request_trace", "profiling", "numerics", "fleet")
 _LAZY_NAMES = {
     "RequestContext": "request_trace", "RequestTracer": "request_trace",
     "exemplar_for_quantile": "request_trace",
@@ -98,6 +113,12 @@ _LAZY_NAMES = {
     "request_capture": "profiling",
     "tensor_stats": "numerics",
     "record_quant_error": "numerics",
+    "FleetAggregator": "fleet",
+    "PlacementLog": "fleet",
+    "get_aggregator": "fleet",
+    "get_placement_log": "fleet",
+    "merge_snapshots": "fleet",
+    "filter_snapshot": "fleet",
 }
 
 
@@ -127,4 +148,6 @@ __all__ = [
     "profiling", "ProfileController", "get_profile_controller",
     "request_capture",
     "numerics", "tensor_stats", "record_quant_error",
+    "fleet", "FleetAggregator", "PlacementLog", "get_aggregator",
+    "get_placement_log", "merge_snapshots", "filter_snapshot",
 ]

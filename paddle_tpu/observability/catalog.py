@@ -208,6 +208,24 @@ CATALOG = {
     "serving_router_healthy_replicas": (
         "gauge", (), "replicas currently in the healthy state (the "
                      "placeable pool; 0 means every submit sheds)"),
+    # -- fleet observability (observability.fleet, r17) --------------------
+    "serving_fleet_slo_attainment": (
+        "gauge", ("replica", "slo"),
+        "per-replica SLO attainment (slo=ttft|tpot) computed from the "
+        "replica-labeled latency histograms against the FLAGS_obs_slo_* "
+        "targets — the burn-rate input (refreshed on every fleet SLO "
+        "check / router health tick)"),
+    "serving_fleet_slo_breaches_total": (
+        "counter", ("replica", "slo"),
+        "transitions of one replica INTO SLO-budget breach (burn rate "
+        "> 1 with enough samples) — each also lands an slo_breach "
+        "flight event and, with FLAGS_obs_fleet_slo_advisory on, "
+        "advises the router's health machine to stop placing on it"),
+    "serving_fleet_scrapes_total": (
+        "counter", ("endpoint",),
+        "fleet federation reads by endpoint (metrics / replicas / "
+        "placements) — evidence the aggregation layer is actually "
+        "being consumed"),
     "serving_cancel_noop_total": (
         "counter", (), "cancel_request / _finish_expired calls against "
                        "an already-terminal rid — counted no-ops (the "

@@ -14,8 +14,8 @@ from typing import Dict, Optional, Sequence
 
 from .metrics import Registry, get_registry
 
-__all__ = ["render_prometheus", "snapshot", "dump_snapshot",
-           "load_snapshot", "snapshot_rows", "quantile",
+__all__ = ["render_prometheus", "render_snapshot_prometheus", "snapshot",
+           "dump_snapshot", "load_snapshot", "snapshot_rows", "quantile",
            "fraction_at_or_below"]
 
 
@@ -45,6 +45,25 @@ def _hist_state(child):
     histogram_quantile/rate on the Prometheus side."""
     with child._lock:
         return list(child.counts), child.sum, child.count
+
+
+def merged_hist_state(fam):
+    """Elementwise-summed ``(counts, sum, count)`` across every child of
+    one histogram family (all children share the family's bounds by
+    construction). This is the family-wide reading consumers like the
+    SLO gauges and exemplar quantiles need under r17 replica scoping,
+    where observations land in ``{replica=...}`` children and the
+    labelless child stays empty."""
+    counts = [0] * (len(fam.bounds) + 1)
+    total_sum = 0.0
+    total = 0
+    for child in fam.series():
+        c, s, n = _hist_state(child)
+        for i, v in enumerate(c):
+            counts[i] += v
+        total_sum += s
+        total += n
+    return counts, total_sum, total
 
 
 def quantile(bounds: Sequence[float], counts: Sequence[int],
@@ -130,6 +149,38 @@ def render_prometheus(registry: Optional[Registry] = None) -> str:
                 out.append(f"{fam.name}_sum{_label_str(ls)} "
                            f"{_fmt(total_sum)}")
                 out.append(f"{fam.name}_count{_label_str(ls)} {total}")
+    return "\n".join(out) + "\n"
+
+
+def render_snapshot_prometheus(snap: Dict) -> str:
+    """Prometheus text from a snapshot DICT rather than a live registry
+    — the federation path (r17): :class:`~.fleet.FleetAggregator` merges
+    per-replica snapshots (in-process today, the same JSON format over
+    HTTP for the multi-process rung) and exposes the merged dict as
+    ``/fleet/metrics`` through here."""
+    out = []
+    for fam in snap.get("metrics", []):
+        name, kind = fam["name"], fam["kind"]
+        out.append(f"# HELP {name} {_escape(fam.get('help', ''))}")
+        out.append(f"# TYPE {name} {kind}")
+        for s in fam.get("series", []):
+            ls = s.get("labels", {})
+            if kind in ("counter", "gauge"):
+                out.append(f"{name}{_label_str(ls)} {_fmt(s['value'])}")
+                continue
+            counts = s.get("counts", [])
+            bounds = s.get("bounds", [])
+            cum = 0
+            for bound, n in zip(bounds, counts):
+                cum += n
+                le = 'le="%s"' % _fmt(bound)
+                out.append(f"{name}_bucket{_label_str(ls, le)} {cum}")
+            total = s.get("count", sum(counts))
+            inf = 'le="+Inf"'
+            out.append(f"{name}_bucket{_label_str(ls, inf)} {total}")
+            out.append(f"{name}_sum{_label_str(ls)} "
+                       f"{_fmt(s.get('sum', 0.0))}")
+            out.append(f"{name}_count{_label_str(ls)} {total}")
     return "\n".join(out) + "\n"
 
 

@@ -19,6 +19,18 @@ scheduled captures cannot provide):
 - **histograms**: fixed log-spaced buckets chosen at construction
   (:func:`log_buckets`), Prometheus ``le`` semantics (inclusive upper
   bound, cumulative on exposition).
+- **scoped views** (r17): ``registry.scoped(replica="r0")`` returns a
+  :class:`ScopedView` whose :meth:`~ScopedView.activate` installs a
+  THREAD-LOCAL label set that family-level mutations auto-merge — the
+  replica router activates one per step thread, so every instrument an
+  engine touches from that thread lands in a ``{replica="r0"}`` series
+  without the engine knowing it runs behind a router. The scope check
+  sits AFTER the ``state.enabled()`` early return (disabled cost is
+  unchanged) and behind one module-global read that stays False until
+  the first scope ever activates (unscoped enabled cost is one extra
+  global read). Direct child access (``fam.labels()``) bypasses the
+  scope on purpose — process-global series stay reachable from scoped
+  threads (perf's fleet-wide SLO gauges use this).
 """
 from __future__ import annotations
 
@@ -30,8 +42,23 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from ..framework.flags import get_flag
 from . import state
 
-__all__ = ["Registry", "Counter", "Gauge", "Histogram", "log_buckets",
-           "time_buckets", "get_registry", "counter", "gauge", "histogram"]
+__all__ = ["Registry", "Counter", "Gauge", "Histogram", "ScopedView",
+           "log_buckets", "time_buckets", "get_registry", "counter",
+           "gauge", "histogram"]
+
+# Thread-scoped auto-labels (r17). _SCOPES_SEEN stays False until the
+# FIRST ScopedView ever activates, so processes that never scope (every
+# engine outside a router) pay one module-global read per enabled
+# mutation and nothing else; the thread-local lookup only happens once
+# a scope exists somewhere in the process.
+_SCOPES_SEEN = False
+_tls_scope = threading.local()
+
+
+def _scope_labels() -> Optional[Dict[str, str]]:
+    if not _SCOPES_SEEN:
+        return None
+    return getattr(_tls_scope, "labels", None)
 
 
 def log_buckets(lo: float, hi: float, per_decade: int = 4) -> List[float]:
@@ -191,6 +218,19 @@ class _Family:
                 return self._overflow
             return self._make(key)
 
+    def _target(self, labels: Dict) -> _Child:
+        """Resolve a family-level mutation to its child, merging the
+        calling thread's scope labels (explicit labels win on a key
+        collision). Runs AFTER the enabled() check — disabled cost is
+        untouched, unscoped enabled cost is one global read."""
+        sl = _scope_labels()
+        if sl:
+            merged = dict(sl)
+            if labels:
+                merged.update(labels)
+            return self.labels(**merged)
+        return self._default if not labels else self.labels(**labels)
+
     def series(self) -> List[_Child]:
         with self._lock:
             return list(self._children.values())
@@ -211,7 +251,7 @@ class Counter(_Family):
     def inc(self, amount: float = 1.0, **labels) -> None:
         if not state.enabled():
             return
-        (self._default if not labels else self.labels(**labels)).inc(amount)
+        self._target(labels).inc(amount)
 
 
 class Gauge(_Family):
@@ -220,12 +260,12 @@ class Gauge(_Family):
     def set(self, value: float, **labels) -> None:
         if not state.enabled():
             return
-        (self._default if not labels else self.labels(**labels)).set(value)
+        self._target(labels).set(value)
 
     def inc(self, amount: float = 1.0, **labels) -> None:
         if not state.enabled():
             return
-        (self._default if not labels else self.labels(**labels)).inc(amount)
+        self._target(labels).inc(amount)
 
     def dec(self, amount: float = 1.0, **labels) -> None:
         self.inc(-amount, **labels)
@@ -242,8 +282,100 @@ class Histogram(_Family):
     def observe(self, value: float, **labels) -> None:
         if not state.enabled():
             return
-        child = self._default if not labels else self.labels(**labels)
-        child.observe(value)
+        self._target(labels).observe(value)
+
+
+class ScopedView:
+    """Label-scoped view of a registry (r17 fleet observability).
+
+    Two uses: (1) :meth:`activate` installs the labels as the calling
+    thread's scope — every family-level mutation from that thread then
+    auto-merges them (and spans recorded from it carry them as args) —
+    this is what the replica router does per step thread; (2) the bound
+    ``counter/gauge/histogram`` accessors stamp the labels explicitly,
+    for cross-thread writes on a replica's behalf. Also usable as a
+    context manager around a scoped block on the current thread.
+    """
+
+    __slots__ = ("_registry", "labels", "_prev")
+
+    def __init__(self, registry: "Registry", labels: Dict[str, str]):
+        if not labels:
+            raise ValueError("ScopedView needs at least one label")
+        self._registry = registry
+        self.labels = {k: str(v) for k, v in labels.items()}
+        self._prev: Optional[Dict[str, str]] = None
+
+    def activate(self) -> "ScopedView":
+        """Install the scope on the CURRENT thread (replacing any prior
+        scope, which :meth:`deactivate` restores). Also stamps the same
+        labels as thread-local span attrs so Chrome-trace exports stay
+        attributable per replica."""
+        global _SCOPES_SEEN
+        _SCOPES_SEEN = True
+        self._prev = getattr(_tls_scope, "labels", None)
+        _tls_scope.labels = dict(self.labels)
+        from . import tracing
+        tracing.set_thread_attrs(self.labels)
+        return self
+
+    def deactivate(self) -> None:
+        _tls_scope.labels = self._prev
+        self._prev = None
+        from . import tracing
+        tracing.set_thread_attrs(getattr(_tls_scope, "labels", None))
+
+    def __enter__(self) -> "ScopedView":
+        return self.activate()
+
+    def __exit__(self, *exc) -> bool:
+        self.deactivate()
+        return False
+
+    def counter(self, name: str, help: str = "") -> "_BoundInstrument":  # noqa: A002
+        return _BoundInstrument(self._registry.counter(name, help),
+                                self.labels)
+
+    def gauge(self, name: str, help: str = "") -> "_BoundInstrument":  # noqa: A002
+        return _BoundInstrument(self._registry.gauge(name, help),
+                                self.labels)
+
+    def histogram(self, name: str, help: str = "",  # noqa: A002
+                  **kw) -> "_BoundInstrument":
+        return _BoundInstrument(self._registry.histogram(name, help, **kw),
+                                self.labels)
+
+
+class _BoundInstrument:
+    """A family with a scope's labels pre-applied (explicit labels on a
+    call still win on key collisions — same merge rule as the
+    thread-scope path)."""
+
+    __slots__ = ("_fam", "_labels")
+
+    def __init__(self, fam: _Family, labels: Dict[str, str]):
+        self._fam = fam
+        self._labels = dict(labels)
+
+    def _merged(self, labels: Dict) -> Dict:
+        merged = dict(self._labels)
+        merged.update(labels)
+        return merged
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        self._fam.inc(amount, **self._merged(labels))
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self._fam.dec(amount, **self._merged(labels))
+
+    def set(self, value: float, **labels) -> None:
+        self._fam.set(value, **self._merged(labels))
+
+    def observe(self, value: float, **labels) -> None:
+        self._fam.observe(value, **self._merged(labels))
+
+    def child(self, **labels) -> _Child:
+        return self._fam.labels(**self._merged(labels))
 
 
 class Registry:
@@ -279,6 +411,11 @@ class Registry:
                   max_series: Optional[int] = None) -> Histogram:
         return self._get_or_create(Histogram, name, help, buckets=buckets,
                                    max_series=max_series)
+
+    def scoped(self, **labels) -> ScopedView:
+        """A cheap label-scoped child view (r17): ``registry.scoped(
+        replica="r0")``. See :class:`ScopedView`."""
+        return ScopedView(self, labels)
 
     def families(self) -> List[_Family]:
         with self._lock:

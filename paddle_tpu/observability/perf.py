@@ -29,7 +29,8 @@ from typing import Dict, Optional, Tuple
 from ..framework.flags import define_flag, get_flag
 from . import state
 from .catalog import instrument as _instrument
-from .exposition import _hist_state, fraction_at_or_below
+from .exposition import _hist_state, fraction_at_or_below, \
+    merged_hist_state
 
 __all__ = ["DEVICE_SPECS", "peak_flops", "hbm_bytes", "hbm_bandwidth",
            "flops_of", "mfu", "token_count", "hbm_stats",
@@ -200,21 +201,28 @@ def update_hbm_gauges(device_id: int = 0) -> Dict[str, int]:
 def slo_attainment(hist, threshold_seconds: float) -> Optional[float]:
     """Fraction of a histogram's observations at or under the target
     (log-bucket interpolated); ``None`` while it is empty. ``hist`` is a
-    Histogram family (its labelless series is read) or a child."""
-    child = hist.labels() if hasattr(hist, "labels") and callable(
-        getattr(hist, "labels")) else hist
-    counts, _sum, count = _hist_state(child)
+    Histogram family (read family-wide, merged across children — under
+    r17 replica scoping the observations live in ``{replica=...}``
+    series) or a single child (the per-replica burn-rate path)."""
+    if callable(getattr(hist, "series", None)):
+        counts, _sum, count = merged_hist_state(hist)
+    else:
+        counts, _sum, count = _hist_state(hist)
     if not count:
         return None
-    return fraction_at_or_below(child.bounds, counts, threshold_seconds)
+    return fraction_at_or_below(hist.bounds, counts, threshold_seconds)
 
 
 def update_serving_slo_gauges(ttft_hist, tpot_hist) -> None:
     """Refresh both SLO-attainment gauges from the live TTFT/TPOT
-    histograms against the FLAGS_obs_slo_* targets."""
+    histograms against the FLAGS_obs_slo_* targets. The gauges are
+    process-global (fleet-wide under a router), so they write through
+    the labelless child directly — bypassing any replica scope on the
+    calling step thread, which would mislabel the fleet-wide value as
+    one replica's."""
     a = slo_attainment(ttft_hist, float(get_flag("obs_slo_ttft_ms")) / 1e3)
     if a is not None:
-        _M_SLO_TTFT.set(a)
+        _M_SLO_TTFT.labels().set(a)
     a = slo_attainment(tpot_hist, float(get_flag("obs_slo_tpot_ms")) / 1e3)
     if a is not None:
-        _M_SLO_TPOT.set(a)
+        _M_SLO_TPOT.labels().set(a)

@@ -43,7 +43,7 @@ from typing import Dict, List, Optional
 from ..framework.flags import get_flag, watch_flag
 from . import state
 from .catalog import instrument as _instrument
-from .exposition import _hist_state
+from .exposition import _hist_state, merged_hist_state
 
 __all__ = ["RequestContext", "RequestTracer", "ExemplarStore",
            "get_request_tracer", "get_exemplar_store",
@@ -64,7 +64,7 @@ _M_EVENTS_DROPPED = _instrument("serving_request_events_dropped_total")
 # lifecycle kinds that must never fall to the per-request event cap
 _LIFECYCLE = frozenset((
     "queued", "admitted", "resumed", "prefill", "first_token",
-    "preempt", "finish"))
+    "preempt", "finish", "failover"))
 
 
 class RequestContext:
@@ -108,18 +108,29 @@ class RequestContext:
         t_first = self._first("first_token")
         # the finish event's explicit count is authoritative (the engine
         # retires a request BEFORE its step records the final decode
-        # tick); live requests sum their ticks
-        tokens = next((int(ev["tokens"]) for ev in reversed(self.events)
-                       if ev["kind"] == "finish" and "tokens" in ev),
-                      None)
-        if tokens is None:
+        # tick); live requests sum their ticks. One scan handles r17
+        # failover continuity: a finish BEFORE a failover hop is the old
+        # owner's cut (drain migration), not the stream's terminal — its
+        # count and reason reset, and the surviving leg's finish counts
+        # only its own tokens, so the pre-hop delivered total rides in
+        # on the failover event itself.
+        tokens = reason = None
+        fo_delivered = 0
+        for ev in self.events:
+            kind = ev["kind"]
+            if kind == "failover":
+                fo_delivered = int(ev.get("delivered", 0))
+                tokens = reason = None
+            elif kind == "finish":
+                if "tokens" in ev:
+                    tokens = int(ev["tokens"])
+                if "reason" in ev:
+                    reason = str(ev["reason"])
+        if tokens is not None:
+            tokens += fo_delivered
+        else:
             tokens = sum(int(ev.get("tokens", 0)) for ev in self.events
                          if ev["kind"] in ("decode", "first_token"))
-        # terminal disposition ("finished" / "shed" / "deadline_exceeded"
-        # from the engine's finish reason); None while the request lives
-        # or when the finisher predates reason reporting
-        reason = next((str(ev["reason"]) for ev in reversed(self.events)
-                       if ev["kind"] == "finish" and "reason" in ev), None)
         # prompt tokens served from the prefix cache at the FIRST slot
         # admission (re-admissions after preemption restore or recompute
         # — the initial hit is the one that shaped TTFT)
@@ -142,6 +153,7 @@ class RequestContext:
             "duration_ms": (t_end - t_q) * 1e3,
             "tokens": tokens,
             "preemptions": self._count("preempt"),
+            "failovers": self._count("failover"),
             "queue_ms": (t_admit - t_q) * 1e3
             if t_admit is not None else None,
             "ttft_ms": (t_first - t_q) * 1e3
@@ -169,6 +181,12 @@ class RequestTracer:
         self._audit: collections.deque = collections.deque(
             maxlen=int(get_flag("obs_audit_capacity")))
         self._audit_written = 0
+        # rid -> rid forwarding for failover-resumed streams (r17):
+        # READS (get) chase the chain to the surviving timeline, WRITES
+        # stay keyed by the current owner's rid only — a zombie owner's
+        # late events and its ghost-cancel finish fall into the
+        # unknown-rid no-op, never onto the live timeline
+        self._alias: Dict = {}
         # cached: get_flag takes the global flags lock — too expensive
         # for every decode tick (watch_flag keeps it fresh, same pattern
         # as the ring capacities)
@@ -246,6 +264,92 @@ class RequestTracer:
             t_q = ctx.events[0]["t"]
         if first:
             _M_QUEUE_SECONDS.observe(max(0.0, w - t_q))
+
+    def _resolve(self, rid):
+        """Chase the failover alias chain (bounded; caller holds the
+        lock). A pre-failover exemplar or ``/request/<id>.json`` fetch
+        by the ORIGINAL rid lands on the surviving timeline."""
+        for _ in range(16):
+            nxt = self._alias.get(rid)
+            if nxt is None:
+                return rid
+            rid = nxt
+        return rid
+
+    def _pop_ctx(self, rid) -> Optional[RequestContext]:
+        """Remove ``rid``'s context from the live table, or — when its
+        owner already closed it (drain migration finishes the old leg
+        with reason ``drained`` BEFORE the router resumes it; a tiny
+        resumed leg can finish before the router stamps the hop) — from
+        the done ring. Caller holds the lock."""
+        ctx = self._live.pop(rid, None)
+        if ctx is not None:
+            return ctx
+        for c in reversed(self._done):
+            if c.request_id == rid:
+                self._done.remove(c)
+                return c
+        return None
+
+    def reassign(self, old_rid, new_rid, **fields) -> bool:
+        """Failover continuation (r17): the stream that lived on
+        ``old_rid`` resumed as ``new_rid`` on another replica. The
+        ORIGINAL timeline absorbs a structured ``failover`` event (the
+        router passes ``from``/``to``/``delivered``), adopts the resumed
+        leg's events (its redundant ``queued`` drops, its ``admitted``
+        becomes ``resumed``), and moves under ``new_rid`` so the
+        survivor's future events land on the ONE timeline; ``old_rid``
+        forwards there for reads. Returns False when the original trace
+        was never seen (obs enabled mid-flight) — the resumed leg then
+        keeps its own context."""
+        if not state.enabled():
+            return False
+        _p, w = self._now()
+        with self._lock:
+            ctx = self._pop_ctx(old_rid)
+            if ctx is None:
+                return False
+            ctx.summary = None            # live again until the new leg ends
+            ctx.events.append({"t": w, "kind": "failover", **fields})
+            # the grafted timeline now answers to the NEW rid everywhere
+            # (finish() and the done-ring scan match on request_id); the
+            # first leg's id survives in meta and via the read alias
+            ctx.meta.setdefault("origin_request_id", ctx.request_id)
+            ctx.request_id = new_rid
+            fresh = self._pop_ctx(new_rid)
+            finished = fresh is not None and fresh.summary is not None
+            if fresh is not None:
+                self._fold(ctx, fresh)
+            self._alias[old_rid] = new_rid
+            if len(self._alias) > 4096:   # bound the forwarding table
+                self._alias.pop(next(iter(self._alias)))
+            if finished:
+                # the resumed leg already finished (races the router's
+                # post-dispatch stamp): close the grafted timeline now
+                ctx.summary = ctx.summarize(fresh.summary["finished_unix"])
+                self._done.append(ctx)
+            else:
+                self._live[new_rid] = ctx
+        return True
+
+    @staticmethod
+    def _fold(ctx: RequestContext, fresh: RequestContext) -> None:
+        """Adopt the resumed leg's context into the surviving timeline:
+        its mint event is redundant (the failover hop records the move),
+        its first slot admission is a resume, and a second first_token
+        is just a decode tick when the original already saw one."""
+        have_first = ctx._first("first_token") is not None
+        for ev in fresh.events:
+            kind = ev.get("kind")
+            if kind == "queued":
+                continue
+            if kind == "admitted":
+                ev = dict(ev, kind="resumed")
+            elif kind == "first_token" and have_first:
+                ev = dict(ev, kind="decode")
+            ctx.events.append(ev)
+        ctx.dropped += fresh.dropped
+        ctx.meta.update(fresh.meta)
 
     def finish(self, rid, **fields) -> Optional[Dict]:
         """Close the request: append ``finish``, derive the summary,
@@ -338,6 +442,7 @@ class RequestTracer:
         """Full timeline document for one request id (live or retained);
         ``None`` when it was never seen or already evicted."""
         with self._lock:
+            rid = self._resolve(rid)
             ctx = self._live.get(rid)
             if ctx is None:
                 for c in reversed(self._done):
@@ -381,6 +486,7 @@ class RequestTracer:
             self._live.clear()
             self._done.clear()
             self._audit.clear()
+            self._alias.clear()
             self._audit_written = 0
 
     def set_capacity(self, capacity: int) -> None:
@@ -475,10 +581,15 @@ def exemplar_for_quantile(hist, q: float) -> Optional[Dict]:
     bucket's exemplar — falling back to the nearest populated bucket
     above, then below (an adjacent observation is still the right
     request to look at). ``None`` on an empty histogram or when the
-    metric never attached exemplars."""
-    child = hist.labels() if callable(getattr(hist, "labels", None)) \
-        else hist
-    counts, _sum, total = _hist_state(child)
+    metric never attached exemplars. Given a family, the bucket counts
+    are merged across ALL its children — under a replica-scoped router
+    (r17) the observations live in ``{replica=...}`` series, and the
+    exemplar store is bucket-indexed per metric NAME, so the merged
+    walk is the one that matches it."""
+    if callable(getattr(hist, "series", None)):
+        counts, _sum, total = merged_hist_state(hist)
+    else:
+        counts, _sum, total = _hist_state(hist)
     if not total:
         return None
     target = min(1.0, max(0.0, q)) * total

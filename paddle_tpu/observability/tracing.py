@@ -29,7 +29,20 @@ from ..framework.flags import get_flag, watch_flag
 from . import state
 
 __all__ = ["Span", "SpanTracer", "trace_span", "get_tracer",
-           "export_chrome_trace"]
+           "export_chrome_trace", "set_thread_attrs"]
+
+# Thread-local attrs stamped onto every span recorded FROM this thread
+# (r17): the replica router's scoped step threads set {"replica": name}
+# here (via metrics.ScopedView.activate), so serving.step and every
+# nested span in a Chrome-trace export is attributable to its replica.
+# Explicit span attrs win on a key collision.
+_tls_attrs = threading.local()
+
+
+def set_thread_attrs(attrs: Optional[Dict[str, str]]) -> None:
+    """Install (or clear, with ``None``) the calling thread's ambient
+    span attrs."""
+    _tls_attrs.attrs = dict(attrs) if attrs else None
 
 # perf_counter gives monotonic high-resolution intervals; anchor it once
 # against the wall clock so exported timestamps are epoch-comparable
@@ -107,6 +120,12 @@ class SpanTracer:
     def record(self, name: str, t0: float, t1: float,
                attrs: Optional[Dict] = None, depth: Optional[int] = None):
         """Append one completed span (deque append is GIL-atomic)."""
+        ambient = getattr(_tls_attrs, "attrs", None)
+        if ambient:
+            merged = dict(ambient)
+            if attrs:
+                merged.update(attrs)
+            attrs = merged
         self._ring.append(Span(
             name, t0, t1, threading.get_ident(),
             len(self._stack()) if depth is None else depth, attrs or {}))

@@ -42,6 +42,11 @@ step-loop thread, with robustness wired end to end:
 - **Orchestration probes** — ``GET /healthz`` answers 200 while the
   process lives; ``GET /readyz`` answers 200 only while the step loop
   is healthy AND not draining (the load-balancer eviction signal).
+- **Telemetry (r17)** — when observability is enabled the door also
+  serves ``GET /metrics`` (Prometheus text) / ``/metrics.json`` (JSON
+  snapshot) and the fleet federation views ``/fleet/metrics``,
+  ``/fleet/replicas.json``, ``/fleet/placements.json`` — a scraper
+  needs only the serving port; 503 while obs is off.
 - **Recovery visibility** — a :class:`ResilientEngine` recovery during
   an active stream surfaces as an SSE ``: retrying`` comment frame on
   every live stream instead of a silent stall.
@@ -626,6 +631,51 @@ class HTTPFrontDoor:
             head += f"{k}: {v}\r\n"
         writer.write(head.encode("latin1") + b"\r\n" + body)
 
+    def _respond_text(self, writer, code: int, text: str,
+                      ctype: str = "text/plain; version=0.0.4; "
+                                   "charset=utf-8") -> None:
+        body = text.encode()
+        head = (f"HTTP/1.1 {code} {_REASONS.get(code, 'Unknown')}\r\n"
+                f"Content-Type: {ctype}\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                "Connection: close\r\n")
+        writer.write(head.encode("latin1") + b"\r\n" + body)
+
+    def _telemetry(self, method, path, writer) -> Optional[int]:
+        """Serve the observability surface off the front door itself
+        (r17): /metrics + /metrics.json (this process's registry) and
+        the /fleet/* federation views — a scraper needs only the door's
+        port, no separate obs server. None when ``path`` is not a
+        telemetry route; all are read-only GETs."""
+        if path not in ("/metrics", "/metrics.json", "/fleet/metrics",
+                        "/fleet/replicas.json", "/fleet/placements.json"):
+            return None
+        if method != "GET":
+            self._respond(writer, 405, {"error": "GET only"})
+            return 405
+        import paddle_tpu.observability as _obs
+
+        if not _obs.enabled():
+            self._respond(writer, 503,
+                          {"error": "observability disabled "
+                                    "(FLAGS_obs_enabled)"})
+            return 503
+        from paddle_tpu.observability import fleet as _fleet
+        from paddle_tpu.observability.exposition import (
+            render_prometheus, snapshot)
+
+        if path == "/metrics":
+            self._respond_text(writer, 200, render_prometheus())
+        elif path == "/metrics.json":
+            self._respond(writer, 200, snapshot())
+        elif path == "/fleet/metrics":
+            self._respond_text(writer, 200, _fleet.fleet_metrics_text())
+        elif path == "/fleet/replicas.json":
+            self._respond(writer, 200, _fleet.replicas_payload())
+        else:
+            self._respond(writer, 200, _fleet.placements_payload())
+        return 200
+
     async def _dispatch(self, method, path, headers, body, reader,
                         writer) -> int:
         path = path.split("?", 1)[0]
@@ -644,6 +694,9 @@ class HTTPFrontDoor:
             self._respond(writer, code,
                           {"ready": self.ready,
                            "draining": self.draining})
+            return code
+        code = self._telemetry(method, path, writer)
+        if code is not None:
             return code
         if path != "/v1/generate":
             self._respond(writer, 404, {"error": f"no route {path}"})

@@ -262,6 +262,13 @@ class Replica:
     # -- the step loop -----------------------------------------------------
     def _loop(self) -> None:
         router = self._router
+        # r17 fleet scoping: everything this step thread touches — every
+        # engine counter/gauge/histogram AND every span — lands under a
+        # {replica=<name>} label, so one process registry carries N
+        # attributable replicas. Metric mutators still short-circuit on
+        # the enabled() check first, so the disabled path is unchanged.
+        scope = _obs.get_registry().scoped(replica=self.name)
+        scope.activate()
         try:
             while not self._stop:
                 if self._killed:
@@ -294,6 +301,7 @@ class Replica:
                            error=self.crashed[:160])
             router._note_crash(self)
         finally:
+            scope.deactivate()
             self._fail_pending_ops(
                 RuntimeError(f"replica {self.name} stopped"))
 
@@ -384,13 +392,22 @@ class ReplicaRouter:
             rep = Replica(name, eng, self, resilient=resilient)
             # disjoint engine-rid spaces across replicas: request traces
             # land in ONE process-global tracer, and obs_dump's replica
-            # column is only meaningful when ids never collide
-            rep.raw._next_id += i * 1_000_000
+            # column is only meaningful when ids never collide. The base
+            # is 1-indexed so no replica shares the 0-based space that
+            # standalone engines (reference replays, warmups) mint from —
+            # a collision there makes tracer.get() resolve a router
+            # stream's rid to the bystander's newer timeline
+            rep.raw._next_id += (i + 1) * 1_000_000
             self.replicas[name] = rep
         self._drain_t0: Dict[str, float] = {}
         self._monitor_interval = float(monitor_interval)
         self._monitor: Optional[threading.Thread] = None
         self._stopping = False
+        # fleet federation (r17): the aggregator holds us weakly and
+        # carves one per-replica snapshot out of the scoped registry for
+        # /fleet/* — latest router wins the singleton
+        from ..observability import fleet as _fleet
+        _fleet.get_aggregator().attach_router(self)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "ReplicaRouter":
@@ -478,12 +495,14 @@ class ReplicaRouter:
         else:
             rep.load.pop(rec.tenant, None)
 
-    def _place(self, prompt: List[int], tenant: str,
-               exclude: Set[str]) -> List[Replica]:
+    def _place(self, prompt: List[int], tenant: str, exclude: Set[str]
+               ) -> Tuple[List[Replica], Optional[Dict]]:
         """Candidate replicas, best first. Affinity wins when any
         candidate holds >= 1 leading block of the prompt; otherwise a
         pending half-open probe takes the request (the circuit
-        breaker's re-probe), then tenant-aware least-loaded order."""
+        breaker's re-probe), then tenant-aware least-loaded order.
+        Second return: the placement-audit record (candidate scores,
+        loads, decision reason) when observability is on, else None."""
         with self._lock:
             cands = [rep for rep in self.replicas.values()
                      if rep.state in _PLACEABLE
@@ -493,7 +512,7 @@ class ReplicaRouter:
                           rep.probe_pending and rep.name not in exclude),
                          None)
             if not cands and probe is None:
-                return []
+                return [], None
             bs = cands[0].raw.bs if cands else probe.raw.bs
             keys = self._block_keys(prompt, bs)
             scored = sorted(
@@ -504,17 +523,32 @@ class ReplicaRouter:
                                  rep.name))
             best_aff = (self._affinity_score(scored[0], keys)
                         if scored else 0)
+            reason = ("affinity" if best_aff > 0
+                      else "half_open_probe" if probe is not None
+                      else "least_loaded")
+            audit = None
+            if _obs.enabled():
+                audit = {"tenant": tenant, "blocks": len(keys),
+                         "reason": reason,
+                         "candidates": [
+                             {"replica": rep.name,
+                              "affinity": self._affinity_score(rep, keys),
+                              "tenant_load":
+                                  round(rep.load.get(tenant, 0.0), 1),
+                              "load": round(sum(rep.load.values()), 1)}
+                             for rep in scored]}
             if best_aff > 0:
                 self.affinity_hits += 1
                 _M_AFFINITY.inc(outcome="hit")
                 # the probe still rides along as a fallback candidate
-                return scored + ([probe] if probe is not None else [])
+                return (scored + ([probe] if probe is not None else []),
+                        audit)
             if keys:
                 self.affinity_misses += 1
                 _M_AFFINITY.inc(outcome="miss")
             if probe is not None:
-                return [probe] + scored
-            return scored
+                return [probe] + scored, audit
+            return scored, audit
 
     # -- submission --------------------------------------------------------
     def submit(self, prompt: List[int], **kw) -> int:
@@ -544,7 +578,7 @@ class ReplicaRouter:
         ShedError when every candidate refused."""
         last: Optional[ShedError] = None
         tried = set(exclude)
-        cands = self._place(prompt, rec.tenant, tried)
+        cands, audit = self._place(prompt, rec.tenant, tried)
         if not cands:
             raise ShedError("no_healthy_replica")
         for rep in cands:
@@ -574,6 +608,15 @@ class ReplicaRouter:
                                              rec.max_new)))
             if _obs.enabled():
                 _rt.get_request_tracer().annotate(erid, replica=rep.name)
+                if audit is not None:
+                    from ..observability import fleet as _fleet
+                    _fleet.get_placement_log().record(
+                        rid=rec.rid, chosen=rep.name,
+                        skipped=len(tried) - len(exclude),
+                        resume=rec.resumes > 0, **audit)
+                    _flight.record("router_placement", rid=rec.rid,
+                                   chosen=rep.name,
+                                   reason=audit["reason"])
             return
         raise last if last is not None else ShedError("no_healthy_replica")
 
@@ -761,7 +804,32 @@ class ReplicaRouter:
             self._failover(rep)
         for rep in migrate:
             self._migrate_stragglers(rep)
+        if _obs.enabled():
+            self._slo_tick()
         return self.states()
+
+    def _slo_tick(self) -> None:
+        """Fleet SLO burn-rate tick (r17): refresh per-replica
+        attainment gauges + breach events every health tick; with
+        FLAGS_obs_fleet_slo_advisory on, a burning replica is demoted
+        healthy -> suspect — advisory only: placement steers away for a
+        tick, the heartbeat machine re-promotes it when its latency
+        recovers, and liveness alone still decides dead."""
+        from ..observability import fleet as _fleet
+
+        try:
+            burning = _fleet.check_slo(list(self.replicas))
+        except Exception as e:      # telemetry must never kill a tick
+            _flight.record("router_slo_tick_error", error=repr(e)[:120])
+            return
+        if not burning or not bool(get_flag("obs_fleet_slo_advisory")):
+            return
+        with self._lock:
+            for name in burning:
+                rep = self.replicas.get(name)
+                if rep is not None and rep.state == "healthy":
+                    _flight.record("router_slo_advisory", replica=name)
+                    self._transition(rep, "suspect")
 
     # -- failover / resume -------------------------------------------------
     def _failover(self, rep: Replica) -> None:
@@ -808,6 +876,7 @@ class ReplicaRouter:
         rec.resumes += 1
         self.resumed_streams += 1
         _M_RESUMED.inc()
+        prev_replica, prev_erid = rec.replica, rec.engine_rid
         try:
             retry_call(self._dispatch, rec, prompt, kw, exclude,
                        retries=2, base_delay=0.05,
@@ -829,6 +898,22 @@ class ReplicaRouter:
                 self._terminal(rec, "shed")
             self.router_sheds += 1
             _M_SHED.inc()
+        else:
+            # failover-continuous tracing (r17): graft the old leg's
+            # timeline onto the resumed engine rid, so the client's ONE
+            # stream stays ONE trace — with a structured failover hop —
+            # through the kill. Old-rid lookups alias forward; the dead
+            # replica's zombie writes hit an unknown rid and no-op.
+            if _obs.enabled() and prev_erid is not None:
+                grafted = _rt.get_request_tracer().reassign(
+                    prev_erid, rec.engine_rid,
+                    **{"from": prev_replica, "to": rec.replica,
+                       "delivered": len(rec.delivered)})
+                _flight.record(
+                    "router_failover", rid=rec.rid,
+                    **{"from": prev_replica, "to": rec.replica,
+                       "delivered": len(rec.delivered),
+                       "trace_grafted": bool(grafted)})
 
     # -- chaos / recovery hooks -------------------------------------------
     def kill_replica(self, name: str) -> None:

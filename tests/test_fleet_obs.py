@@ -1,0 +1,479 @@
+"""Fleet observability (r17): per-replica metric scoping, federated
+snapshot merging (counter conservation + bucket-wise histogram merge),
+the placement audit ring, per-replica SLO burn, failover-continuous
+request traces, and the /fleet/* surface on both HTTP servers.
+
+The merge properties here are the unit-level half of the contract the
+router chaos driver (``chaos_run --router``) enforces live at every
+health tick through a seeded kill.
+"""
+import json
+import socket
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401  (forces the CPU/virtual-device conftest setup)
+import paddle_tpu.observability as obs
+from paddle_tpu.observability import exposition, fleet
+from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.observability import request_trace as rt
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    """One tiny-llama cfg+params shared by every engine-building test
+    here — param init is the slow part and all three use identical
+    shapes, so building it once keeps this file cheap inside tier-1."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import llama
+
+    cfg = dataclasses.replace(
+        llama.tiny_llama(vocab=64, hidden=32, layers=2, heads=4,
+                         kv_heads=2, seq=128, ffn=64),
+        dtype=jnp.float32)
+    return cfg, llama.init_params(cfg, jax.random.PRNGKey(0))
+
+
+@pytest.fixture
+def obs_on():
+    obs.get_registry().reset()
+    obs.get_tracer().clear()
+    rt.get_request_tracer().clear()
+    fleet.get_placement_log().clear()
+    fleet._breach_state.clear()
+    fleet.get_aggregator().clear_sources()
+    fleet.get_aggregator().detach_router()
+    obs.enable()
+    try:
+        yield
+    finally:
+        obs.disable()
+        obs.get_registry().reset()
+        obs.get_tracer().clear()
+        rt.get_request_tracer().clear()
+        fleet.get_placement_log().clear()
+        fleet._breach_state.clear()
+        fleet.get_aggregator().clear_sources()
+        fleet.get_aggregator().detach_router()
+
+
+# -- scoping ----------------------------------------------------------------
+def test_scoped_activation_stamps_replica_label(obs_on):
+    reg = obs.get_registry()
+    c = reg.counter("t_fleet_scoped_total")
+    with reg.scoped(replica="r0"):
+        c.inc(3)
+    c.inc(2)                       # unscoped: lands on the default child
+    series = {tuple(sorted(ch.labels.items())): ch.value
+              for ch in c.series()}
+    assert series[(("replica", "r0"),)] == 3
+    assert c.labels().value == 2   # default child untouched by the scope
+
+
+def test_scoped_explicit_labels_win_and_nesting_restores(obs_on):
+    reg = obs.get_registry()
+    c = reg.counter("t_fleet_scope_nest_total")
+    outer = reg.scoped(replica="r0")
+    outer.activate()
+    try:
+        with reg.scoped(replica="r1"):
+            c.inc()                          # inner scope wins
+        c.inc()                              # outer restored
+        c.inc(replica="rX")                  # explicit label beats scope
+    finally:
+        outer.deactivate()
+    got = {ch.labels["replica"]: ch.value for ch in c.series()
+           if "replica" in ch.labels}
+    assert got == {"r1": 1, "r0": 1, "rX": 1}
+
+
+def test_scope_is_thread_local(obs_on):
+    reg = obs.get_registry()
+    c = reg.counter("t_fleet_scope_thread_total")
+
+    def worker(name):
+        with reg.scoped(replica=name):
+            for _ in range(50):
+                c.inc()
+
+    ts = [threading.Thread(target=worker, args=(f"r{i}",)) for i in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    got = {ch.labels["replica"]: ch.value for ch in c.series()
+           if "replica" in ch.labels}
+    assert got == {f"r{i}": 50 for i in range(4)}
+
+
+def test_scope_stamps_span_attrs(obs_on):
+    from paddle_tpu.observability import tracing
+
+    reg = obs.get_registry()
+    with reg.scoped(replica="r7"):
+        with tracing.trace_span("t_fleet.span", depth=1):
+            pass
+    with tracing.trace_span("t_fleet.unscoped"):
+        pass
+    spans = {s.name: s for s in obs.get_tracer().spans()}
+    # ambient replica attr rides every span from a scoped thread;
+    # explicit span attrs survive next to it, unscoped spans untouched
+    assert spans["t_fleet.span"].attrs == {"replica": "r7", "depth": 1}
+    assert not spans["t_fleet.unscoped"].attrs.get("replica")
+
+
+# -- federation: filter + merge ---------------------------------------------
+def _scoped_snapshots(reg, names):
+    full = exposition.snapshot(reg)
+    return {n: fleet.filter_snapshot(full, replica=n) for n in names}
+
+
+def test_merge_counters_conserve_fleet_sum(obs_on):
+    reg = obs.get_registry()
+    c = reg.counter("t_fleet_conserve_total")
+    rng = np.random.default_rng(0)
+    per = {f"r{i}": int(rng.integers(1, 100)) for i in range(3)}
+    for name, n in per.items():
+        with reg.scoped(replica=name):
+            c.inc(n)
+            c.inc(1, tenant="a")           # scoped + explicit extra label
+    snaps = _scoped_snapshots(reg, per)
+    merged = fleet.merge_snapshots(snaps)
+    fam = next(f for f in merged["metrics"]
+               if f["name"] == "t_fleet_conserve_total")
+    got = {tuple(sorted(s["labels"].items())): s["value"]
+           for s in fam["series"]}
+    # replica label dropped, values summed; the tenant dimension survives
+    assert got[()] == sum(per.values())
+    assert got[(("tenant", "a"),)] == len(per)
+
+
+def test_merge_then_quantile_equals_union_then_quantile(obs_on):
+    reg = obs.get_registry()
+    bounds = [0.01, 0.1, 0.5, 1.0, 5.0]
+    h = reg.histogram("t_fleet_quantile_seconds", buckets=bounds)
+    rng = np.random.default_rng(7)
+    union = []
+    for name in ("r0", "r1", "r2"):
+        vals = rng.uniform(0.001, 6.0, size=int(rng.integers(5, 40)))
+        union.extend(vals)
+        with reg.scoped(replica=name):
+            for v in vals:
+                h.observe(float(v))
+    snaps = _scoped_snapshots(reg, ("r0", "r1", "r2"))
+    merged = fleet.merge_snapshots(snaps)
+    fam = next(f for f in merged["metrics"]
+               if f["name"] == "t_fleet_quantile_seconds")
+    assert len(fam["series"]) == 1          # identical bounds: ONE series
+    s = fam["series"][0]
+    assert s["count"] == len(union)
+    assert s["sum"] == pytest.approx(sum(union))
+    # reference: one histogram observing the union directly
+    ref = reg.histogram("t_fleet_quantile_ref_seconds", buckets=bounds)
+    for v in union:
+        ref.observe(float(v))
+    ref_child = ref.labels()
+    for q in (0.5, 0.9, 0.99):
+        assert exposition.quantile(s["bounds"], s["counts"], q) == \
+            exposition.quantile(ref_child.bounds, list(ref_child.counts), q)
+
+
+def test_merge_gauges_stay_replica_labeled(obs_on):
+    reg = obs.get_registry()
+    g = reg.gauge("t_fleet_gauge_depth")
+    for name, v in (("r0", 3.0), ("r1", 5.0)):
+        with reg.scoped(replica=name):
+            g.set(v)
+    snaps = _scoped_snapshots(reg, ("r0", "r1"))
+    # an unscoped remote snapshot: its gauge series gets replica=<src>
+    snaps["remote"] = {"version": 1, "metrics": [{
+        "name": "t_fleet_gauge_depth", "kind": "gauge",
+        "series": [{"labels": {}, "value": 9.0}]}]}
+    merged = fleet.merge_snapshots(snaps)
+    fam = next(f for f in merged["metrics"]
+               if f["name"] == "t_fleet_gauge_depth")
+    got = {s["labels"]["replica"]: s["value"] for s in fam["series"]}
+    assert got == {"r0": 3.0, "r1": 5.0, "remote": 9.0}
+
+
+def test_merge_histogram_bound_skew_stays_separate():
+    mk = lambda bounds, counts: {"version": 1, "metrics": [{  # noqa: E731
+        "name": "h_seconds", "kind": "histogram",
+        "series": [{"labels": {}, "bounds": bounds, "counts": counts,
+                    "sum": 1.0, "count": sum(counts)}]}]}
+    merged = fleet.merge_snapshots({
+        "a": mk([0.1, 1.0], [1, 2, 3]),
+        "b": mk([0.5, 2.0], [4, 5, 6])})   # version skew: other edges
+    fam = merged["metrics"][0]
+    assert len(fam["series"]) == 2         # never summed apples into oranges
+    by_replica = {s["labels"].get("replica"): s for s in fam["series"]}
+    # the first bounds seen own the fleet consensus series; the skewed
+    # latecomer stays separate, attributed to its source
+    assert by_replica[None]["bounds"] == [0.1, 1.0]
+    assert by_replica["b"]["bounds"] == [0.5, 2.0]
+
+
+def test_aggregator_sources_and_failing_source(obs_on):
+    agg = fleet.get_aggregator()
+    snap_a = {"version": 1, "metrics": [{
+        "name": "t_fleet_src_total", "kind": "counter",
+        "series": [{"labels": {}, "value": 4.0}]}]}
+    agg.add_source("a", lambda: snap_a)
+    agg.add_source("b", lambda: (_ for _ in ()).throw(OSError("down")))
+    snaps = agg.snapshots()
+    assert snaps["b"]["error"] == "source_unavailable"
+    assert agg.fleet_counter_value("t_fleet_src_total") == 4.0
+    text = agg.prometheus()
+    assert "t_fleet_src_total 4" in text
+
+
+def test_replica_names_fall_back_to_registry_scan(obs_on):
+    reg = obs.get_registry()
+    c = reg.counter("t_fleet_names_total")
+    for name in ("r2", "r0"):
+        c.inc(replica=name)
+    assert fleet.get_aggregator().replica_names() == ["r0", "r2"]
+
+
+# -- placement audit ring ---------------------------------------------------
+def test_placement_log_ring_and_disabled_gate(obs_on):
+    log = fleet.PlacementLog(capacity=3)
+    for i in range(5):
+        log.record(rid=i, chosen="r0", reason="affinity")
+    entries = log.entries()
+    assert [e["rid"] for e in entries] == [2, 3, 4]   # ring keeps newest
+    assert log.recorded == 5
+    obs.disable()
+    try:
+        log.record(rid=99, chosen="r0", reason="affinity")
+    finally:
+        obs.enable()
+    assert [e["rid"] for e in log.entries()] == [2, 3, 4]  # gated off
+    log.set_capacity(2)
+    assert [e["rid"] for e in log.entries()] == [3, 4]
+
+
+# -- per-replica SLO burn ---------------------------------------------------
+def test_check_slo_breach_edge_and_recovery(obs_on):
+    from paddle_tpu.framework.flags import get_flag
+
+    reg = obs.get_registry()
+    h = reg.histogram("serving_ttft_seconds")
+    min_n = int(get_flag("obs_fleet_slo_min_requests"))
+    # r0 blows the TTFT SLO (default 1000ms): every observation at 5s
+    for _ in range(min_n + 5):
+        h.observe(5.0, replica="r0")
+    # r1 is comfortably inside it
+    for _ in range(min_n + 5):
+        h.observe(0.01, replica="r1")
+    breaches = reg.counter("serving_fleet_slo_breaches_total")
+
+    assert fleet.check_slo(["r0", "r1"]) == {"r0"}
+    slo = fleet.replica_slo("r0")
+    assert slo["ttft_attainment"] == 0.0
+    assert slo["burn_rate"] > 1.0
+    assert fleet.replica_slo("r1")["burn_rate"] <= 1.0
+    first = sum(ch.value for ch in breaches.series())
+    assert first == 1                      # entering breach: ONE edge
+    assert fleet.check_slo(["r0", "r1"]) == {"r0"}
+    assert sum(ch.value for ch in breaches.series()) == first  # no re-fire
+    # attainment gauge refreshed for both replicas
+    att = reg.gauge("serving_fleet_slo_attainment")
+    got = {ch.labels["replica"]: ch.value for ch in att.series()
+           if ch.labels.get("slo") == "ttft"}
+    assert got["r0"] == 0.0 and got["r1"] == 1.0
+
+
+def test_check_slo_needs_min_samples(obs_on):
+    reg = obs.get_registry()
+    h = reg.histogram("serving_ttft_seconds")
+    for _ in range(3):                     # terrible, but too few to act on
+        h.observe(9.0, replica="r0")
+    assert fleet.check_slo(["r0"]) == set()
+
+
+# -- failover-continuous traces ---------------------------------------------
+def test_reassign_grafts_one_timeline(obs_on):
+    tr = rt.get_request_tracer()
+    tr.submit(100, prompt_tokens=4)
+    tr.record(100, "prefill")
+    tr.record(100, "first_token")
+    tr.record(100, "decode")
+    # the resumed leg is already live on the new replica when the router
+    # grafts (its add_request traced first)
+    tr.submit(200, prompt_tokens=4)
+    tr.admitted(200)
+    assert tr.reassign(100, 200, **{"from": "r1", "to": "r0",
+                                    "delivered": 1})
+    tr.record(200, "first_token")
+    tr.finish(200, reason="finished", tokens=3)
+    doc = tr.get(200)
+    kinds = [e["kind"] for e in doc["events"]]
+    assert "failover" in kinds
+    assert kinds.index("failover") < kinds.index("resumed")
+    assert "queued" not in kinds[kinds.index("failover"):]  # folded away
+    hop = next(e for e in doc["events"] if e["kind"] == "failover")
+    assert hop["from"] == "r1" and hop["to"] == "r0"
+    assert hop["delivered"] == 1
+    # ONE timeline: the old rid aliases to it, meta remembers the origin
+    assert tr.get(100)["events"] == doc["events"]
+    assert doc["meta"]["origin_request_id"] == 100
+    assert doc["summary"]["failovers"] == 1
+
+
+def test_reassign_survives_rid_reuse_by_bystanders(obs_on):
+    """A standalone engine minting the same small rids (a reference
+    replay, a warmup) must not shadow the grafted timeline — the exact
+    collision the router's 1-indexed replica rid bases prevent."""
+    tr = rt.get_request_tracer()
+    tr.submit(1_000_000, prompt_tokens=2)
+    tr.record(1_000_000, "first_token")
+    tr.submit(2_000_000, prompt_tokens=2)
+    assert tr.reassign(1_000_000, 2_000_000,
+                       **{"from": "r0", "to": "r1", "delivered": 1})
+    tr.finish(2_000_000, reason="finished", tokens=2)
+    # a bystander engine reuses rid 0..N in the same process afterwards
+    tr.submit(0, prompt_tokens=9)
+    tr.finish(0, reason="finished", tokens=1)
+    kinds = [e["kind"] for e in tr.get(2_000_000)["events"]]
+    assert "failover" in kinds
+
+
+# -- the /fleet/* surface ---------------------------------------------------
+def _http_get(url):
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        return resp.status, resp.read().decode()
+
+
+def test_obs_http_server_fleet_endpoints(obs_on):
+    from paddle_tpu.observability.http_server import MetricsServer
+
+    reg = obs.get_registry()
+    c = reg.counter("serving_tokens_total")
+    for name, n in (("r0", 7), ("r1", 5)):
+        c.inc(n, replica=name)
+    fleet.get_placement_log().record(rid=1, chosen="r0", reason="affinity")
+    srv = MetricsServer(port=0)
+    base = f"http://{srv.host}:{srv.port}"
+    try:
+        code, text = _http_get(base + "/fleet/metrics")
+        assert code == 200
+        assert "serving_tokens_total 12" in text      # fleet-summed
+        code, body = _http_get(base + "/fleet/replicas.json")
+        doc = json.loads(body)
+        rows = {r["replica"]: r for r in doc["replicas"]}
+        assert rows["r0"]["tokens"] == 7 and rows["r1"]["tokens"] == 5
+        assert doc["totals"]["replicas"] == 2
+        code, body = _http_get(base + "/fleet/placements.json")
+        doc = json.loads(body)
+        assert doc["placements"][0]["chosen"] == "r0"
+    finally:
+        srv.close()
+
+
+def test_front_door_serves_metrics_and_fleet(obs_on, tiny_model):
+    from paddle_tpu.serving import HTTPFrontDoor, LLMEngine
+
+    cfg, params = tiny_model
+    eng = LLMEngine(params, cfg,
+                    max_slots=2, block_size=8, max_model_len=64,
+                    prompt_buckets=[8, 32])
+    front = HTTPFrontDoor(eng)
+    host, port = front.start()
+    try:
+        rid = eng.add_request([1, 2, 3], max_new_tokens=2)
+        eng.run()
+        base = f"http://{host}:{port}"
+        code, text = _http_get(base + "/metrics")
+        assert code == 200 and "serving_tokens_total" in text
+        code, body = _http_get(base + "/metrics.json")
+        assert code == 200 and json.loads(body)["version"] == 1
+        code, body = _http_get(base + "/fleet/replicas.json")
+        assert code == 200
+        assert "replicas" in json.loads(body)
+        # non-GET on a telemetry path: 405, not a generate attempt
+        s = socket.create_connection((host, port), timeout=30)
+        s.sendall(b"POST /metrics HTTP/1.1\r\nHost: x\r\n"
+                  b"Content-Length: 0\r\n\r\n")
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            c = s.recv(4096)
+            if not c:
+                break
+            buf += c
+        s.close()
+        assert b" 405 " in buf.split(b"\r\n", 1)[0]
+        del rid
+    finally:
+        front.stop()
+
+
+def test_front_door_telemetry_503_when_obs_disabled(tiny_model):
+    from paddle_tpu.serving import HTTPFrontDoor, LLMEngine
+
+    assert not obs.enabled()
+    cfg, params = tiny_model
+    eng = LLMEngine(params, cfg,
+                    max_slots=2, block_size=8, max_model_len=64,
+                    prompt_buckets=[8, 32])
+    front = HTTPFrontDoor(eng)
+    host, port = front.start()
+    try:
+        req = urllib.request.Request(f"http://{host}:{port}/metrics")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=30)
+        assert err.value.code == 503
+        assert "obs_enabled" in err.value.read().decode()
+    finally:
+        front.stop()
+
+
+# -- router integration: scoping + audit + SLO advisory end to end ----------
+def test_router_scopes_metrics_and_audits_placements(obs_on, tiny_model):
+    from paddle_tpu.serving import LLMEngine, ReplicaRouter
+
+    cfg, params = tiny_model
+
+    def mk():
+        return LLMEngine(params, cfg, max_slots=2, block_size=8,
+                         max_model_len=64, prompt_buckets=[8, 32])
+
+    router = ReplicaRouter([mk(), mk()], idle_wait=0.001)
+    router.start()
+    try:
+        rng = np.random.default_rng(0)
+        rids = [router.submit(rng.integers(1, 64, size=5).tolist(),
+                              max_new_tokens=3) for _ in range(3)]
+        for rid in rids:
+            router.wait(rid, timeout=120)
+        # the aggregator auto-attached: per-replica carve-outs exist and
+        # the fleet token sum equals the full-registry family sum
+        agg = fleet.get_aggregator()
+        assert agg.router() is router
+        assert agg.replica_names() == ["r0", "r1"]
+        reg = obs.get_registry()
+        tokens = reg.counter("serving_tokens_total")
+        total = sum(ch.value for ch in tokens.series())
+        assert total > 0
+        assert agg.fleet_counter_value("serving_tokens_total") == total
+        # every series the engines wrote carries a replica label
+        assert all(ch.labels.get("replica") in ("r0", "r1")
+                   for ch in tokens.series() if ch.value)
+        # each dispatch left an audit entry naming a real replica
+        entries = fleet.get_placement_log().entries()
+        assert len(entries) >= len(rids)
+        assert all(e["chosen"] in ("r0", "r1") for e in entries)
+        assert all(e["reason"] in ("affinity", "half_open_probe",
+                                   "least_loaded") for e in entries)
+        assert all("candidates" in e for e in entries)
+        doc = fleet.placements_payload()
+        assert doc["recorded"] == len(entries)
+    finally:
+        router.stop()

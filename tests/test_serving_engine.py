@@ -378,12 +378,22 @@ def test_obs_dump_demo_serving_smoke(tmp_path):
         out[-2000:]
     # the generate POST and the /readyz probe both count under code=200
     assert "requests_total[200]=2" in out
+    # r17: the demo ends with one fleet scrape over a 2-replica router —
+    # per-replica rows in the dashboard table, fleet-wide dispatch sum
+    assert "fleet scrape: 2 replicas (2 healthy)" in out, out[-2000:]
+    assert "dispatches fleet-wide 4" in out, out[-2000:]
+    assert "fleet: 2 replica(s), 2 healthy" in out, out[-2000:]
+    assert "ttft_p95" in out and "burn" in out   # dashboard columns
     # r7: the demo ends with the per-request table + exemplar pointer
-    # (8 rows: the original four + the r10 cache hit + the r13 spec
-    # engine's two + the r14 HTTP round-trip)
-    assert "requests: 8 traced" in out, out[-2000:]
+    # (12 rows: the original four + the r10 cache hit + the r13 spec
+    # engine's two + the r14 HTTP round-trip + the r17 router's four)
+    assert "requests: 12 traced" in out, out[-2000:]
     assert "ttft_ms" in out and "preempt" in out and "cached" in out
     assert "tenant" in out                           # r14 tenant column
     assert "shed" in out and "deadline" in out     # reason column
     assert "exemplar: request" in out
+    # r17: the router requests carry their replica from the trace
+    # annotation (the table's replica column reads the annotation, the
+    # registry's replica-labeled series prove the scoped step threads)
+    assert "replica=r0" in out, out[-2000:]
     assert (tmp_path / "snapshot.json").exists()

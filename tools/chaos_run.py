@@ -569,6 +569,7 @@ def router_main(args):
 
     import paddle_tpu.observability as obs
     from paddle_tpu.models import llama
+    from paddle_tpu.observability import fleet
     from paddle_tpu.serving import LLMEngine, ReplicaRouter
 
     obs.enable()
@@ -612,6 +613,67 @@ def router_main(args):
                            suspect_s=15.0, dead_s=30.0, halfopen_s=0.2)
     router.start()
 
+    # r17 counter conservation: at EVERY health tick, for every counter
+    # in the merged fleet snapshot, the fleet-aggregated value must
+    # equal the sum over the per-replica scoped series OF THE SAME
+    # snapshot set (one atomic registry read per tick — comparing
+    # against a later live read would race in-flight increments)
+    import math
+
+    agg = fleet.get_aggregator()
+    conservation_failures = []
+    conservation_ticks = [0]
+
+    def _counter_sums(snaps):
+        sums = {}
+        for snap in snaps.values():
+            for fam in snap.get("metrics", []):
+                if fam["kind"] != "counter":
+                    continue
+                for s in fam.get("series", []):
+                    labels = {k: v for k, v
+                              in s.get("labels", {}).items()
+                              if k != "replica"}
+                    key = (fam["name"], tuple(sorted(labels.items())))
+                    sums[key] = sums.get(key, 0.0) \
+                        + float(s.get("value", 0.0))
+        return sums
+
+    def conservation_tick():
+        conservation_ticks[0] += 1
+        snaps = agg.snapshots()
+        merged = fleet.merge_snapshots(snaps)
+        expect = _counter_sums(snaps)
+        got = {}
+        for fam in merged["metrics"]:
+            if fam["kind"] != "counter":
+                continue
+            for s in fam["series"]:
+                key = (fam["name"], tuple(sorted(s["labels"].items())))
+                got[key] = float(s["value"])
+        bad = {k: (got.get(k), expect.get(k))
+               for k in set(got) | set(expect)
+               if not math.isclose(got.get(k, 0.0), expect.get(k, 0.0),
+                                   rel_tol=1e-9, abs_tol=1e-12)}
+        if bad and len(conservation_failures) < 3:
+            conservation_failures.append(bad)
+
+    def wait_ticking(rids, timeout=120.0):
+        """Wait for every rid, calling a health tick + the conservation
+        check every ~25ms — the check runs DURING the kill/failover
+        window, not just at quiescence."""
+        deadline = time.monotonic() + timeout
+        pending = list(rids)
+        while pending and time.monotonic() < deadline:
+            pending = [rid for rid in pending
+                       if not router._streams[rid].done.is_set()]
+            router.check()
+            conservation_tick()
+            time.sleep(0.025)
+        for rid in rids:
+            router.wait(rid, timeout=max(0.0,
+                                         deadline - time.monotonic()))
+
     # seeded workload: half the prompts share an 8-token system prefix
     # (the affinity scorer's food), long-ish decodes so the kill lands
     # mid-stream; prompt(<=20) + delivered(<16) stays inside bucket 48
@@ -651,10 +713,10 @@ def router_main(args):
           f"(dispatches so far: {pre_kill})")
     router.kill_replica(victim)
 
-    # post-kill offered load must land on survivors only
+    # post-kill offered load must land on survivors only; the wait runs
+    # health ticks + the conservation check straight through the kill
     rids += [router.submit(p, max_new_tokens=n) for p, n in rest]
-    for rid in rids:
-        router.wait(rid, timeout=120)
+    wait_ticking(rids, timeout=120)
 
     reasons = dict(router.finish_reasons)
     counts = {}
@@ -695,6 +757,76 @@ def router_main(args):
             print(f"request {rid} diverged from the clean greedy run: "
                   f"{router.results[rid]} != {ref_out[refid]}")
             ok = False
+
+    # r17 fleet conservation verdict: the per-tick merge-vs-sum checks
+    # ran through the kill window, plus one quiescent check against the
+    # live registry now that streams are terminal
+    conservation_tick()
+    print(f"fleet conservation: {conservation_ticks[0]} ticks, "
+          f"{len(conservation_failures)} violation(s)")
+    if conservation_failures:
+        print(f"counter conservation violated: "
+              f"{conservation_failures[0]}")
+        ok = False
+    if conservation_ticks[0] < 3:
+        print("too few conservation ticks — the check never ran "
+              "through the kill window")
+        ok = False
+
+    # r17 failover-continuous traces: every resumed stream keeps ONE
+    # timeline — reachable under its new engine rid AND the old one
+    # (alias), carrying a structured failover hop with the delivered
+    # count, its summary totals spanning both legs
+    tracer = obs.request_trace.get_request_tracer()
+    resumed_recs = [rec for rec in router._streams.values()
+                    if rec.resumes >= 1 and not rec.cancelled
+                    and reasons.get(rec.rid) == "finished"]
+    if not resumed_recs:
+        print("no resumed stream finished — trace continuity unchecked")
+        ok = False
+    for rec in resumed_recs:
+        doc = tracer.get(rec.engine_rid)
+        if doc is None:
+            print(f"resumed stream {rec.rid}: no timeline under engine "
+                  f"rid {rec.engine_rid}")
+            ok = False
+            continue
+        kinds = [ev["kind"] for ev in doc["events"]]
+        hops = [ev for ev in doc["events"] if ev["kind"] == "failover"]
+        if not hops:
+            print(f"resumed stream {rec.rid}: timeline has no failover "
+                  f"hop: {kinds}")
+            ok = False
+            continue
+        hop = hops[0]
+        if hop.get("to") != rec.replica or "from" not in hop \
+                or "delivered" not in hop:
+            print(f"resumed stream {rec.rid}: malformed failover hop "
+                  f"{hop}")
+            ok = False
+        if doc.get("summary", {}).get("failovers", 0) < rec.resumes:
+            print(f"resumed stream {rec.rid}: summary counts "
+                  f"{doc.get('summary', {}).get('failovers')} failovers,"
+                  f" router counts {rec.resumes}")
+            ok = False
+        if doc.get("summary", {}).get("tokens") != len(rec.delivered):
+            print(f"resumed stream {rec.rid}: grafted summary tokens "
+                  f"{doc.get('summary', {}).get('tokens')} != delivered "
+                  f"{len(rec.delivered)}")
+            ok = False
+
+    # exemplars stay valid through the kill: the p99 TTFT exemplar must
+    # resolve to a request the (grafted) tracer still knows
+    reg = obs.get_registry()
+    ex = obs.exemplar_for_quantile(
+        reg.histogram("serving_ttft_seconds"), 0.99)
+    if ex is None:
+        print("no TTFT p99 exemplar after the chaos run")
+        ok = False
+    elif tracer.get(ex["request_id"]) is None:
+        print(f"TTFT p99 exemplar points at unknown request "
+              f"{ex['request_id']}")
+        ok = False
 
     # rebalance: the dead victim took no post-kill dispatches; every
     # survivor kept serving

@@ -32,6 +32,13 @@ Two modes (slow-lane tooling, like tools/chaos_run.py):
       python tools/obs_dump.py --requests http://127.0.0.1:9464
       python tools/obs_dump.py --requests reqs.json --sort tpot
       python tools/obs_dump.py --requests http://127.0.0.1:9464 --watch
+
+- print the live fleet dashboard (per-replica state, streams, queue,
+  tokens, p95 TTFT/TPOT, cache hit rate, SLO burn) from a server's
+  ``/fleet/replicas.json`` — obs server or serving front door both
+  carry it; ``--watch`` refreshes it top-style::
+
+      python tools/obs_dump.py --fleet http://127.0.0.1:9464 --watch
 """
 import argparse
 import os
@@ -201,6 +208,87 @@ def requests_mode(src, sort, watch, interval):
         return 0
 
 
+def print_fleet_table(doc, out=sys.stdout):
+    """Render a ``/fleet/replicas.json`` payload: one row per replica
+    (state, streams, queue/slots, tokens, p95 latencies, cache hit
+    rate, SLO burn) plus the fleet totals line."""
+    rows = doc.get("replicas") or []
+    totals = doc.get("totals") or {}
+    out.write(f"fleet: {totals.get('replicas', len(rows))} replica(s), "
+              f"{totals.get('healthy', '-')} healthy, "
+              f"{totals.get('live_streams', '-')} live stream(s), "
+              f"{totals.get('tokens', 0)} tokens"
+              f"{'' if doc.get('router') else ' (no router attached)'}\n")
+    if not rows:
+        out.write("(no replicas in view — run a router with "
+                  "observability enabled)\n")
+        return rows
+    hdr = (f"{'replica':>8} {'state':>9} {'hb_age':>7} {'streams':>7} "
+           f"{'queue':>5} {'slots':>5} {'tokens':>7} {'ttft_p95':>9} "
+           f"{'tpot_p95':>9} {'cache':>6} {'burn':>6}\n")
+    out.write(hdr)
+    out.write("-" * (len(hdr) - 1) + "\n")
+    for r in rows:
+        slo = r.get("slo") or {}
+        burn = slo.get("burn_rate")
+        cache = r.get("cache_hit_rate")
+        cache_s = f"{cache:.0%}" if isinstance(cache, (int, float)) \
+            else "-"
+        burn_s = f"{burn:.2f}" if isinstance(burn, (int, float)) else "-"
+        out.write(
+            f"{str(r.get('replica')):>8} "
+            f"{str(r.get('state') or '-'):>9} "
+            f"{_fmt_ms(r.get('hb_age_s')):>7} "
+            f"{r.get('streams', 0):>7} "
+            f"{r.get('queue_depth', 0):>5} "
+            f"{r.get('active_slots', 0):>5} "
+            f"{r.get('tokens', 0):>7} "
+            f"{_fmt_ms(r.get('ttft_p95_ms')):>9} "
+            f"{_fmt_ms(r.get('tpot_p95_ms')):>9} "
+            f"{cache_s:>6} {burn_s:>6}\n")
+    return rows
+
+
+def _fetch_fleet(src):
+    """The payload behind --fleet: a base URL (live obs server or
+    serving front door; /fleet/replicas.json appended) or a saved JSON
+    file."""
+    import json
+    import urllib.parse
+    import urllib.request
+
+    if src.startswith(("http://", "https://")):
+        parts = urllib.parse.urlsplit(src)
+        path = parts.path.rstrip("/")
+        if not path.endswith("/fleet/replicas.json"):
+            path += "/fleet/replicas.json"
+        url = urllib.parse.urlunsplit(
+            (parts.scheme, parts.netloc, path, parts.query, ""))
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return json.load(r)
+    with open(src) as f:
+        return json.load(f)
+
+
+def fleet_mode(src, watch, interval):
+    if not watch:
+        print_fleet_table(_fetch_fleet(src))
+        return 0
+    import io as _io
+    import time as _time
+
+    try:
+        while True:
+            doc = _fetch_fleet(src)
+            buf = _io.StringIO()
+            print_fleet_table(doc, out=buf)
+            sys.stdout.write("\x1b[2J\x1b[H" + buf.getvalue())
+            sys.stdout.flush()
+            _time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 def demo_serving():
     """int8-everywhere serving demo under fire: int8 weight-only params
     AND int8 KV pools through the decode path (off-TPU this counts the
@@ -361,6 +449,35 @@ def demo_serving():
           "send_queue_depth="
           f"{int(reg.gauge('serving_http_send_queue_depth').labels().value)}")
     print(f"finish reasons: {eng.finish_reasons}")
+
+    # r17: two replicas behind a ReplicaRouter, then ONE fleet scrape —
+    # every engine metric above lands replica-labeled from the router's
+    # step threads, counters sum fleet-wide, gauges stay per-replica
+    from paddle_tpu.observability import fleet as _fleet
+    from paddle_tpu.serving import ReplicaRouter
+
+    def _mk():
+        return LLMEngine(llama.init_params(cfg, jax.random.PRNGKey(0)),
+                         cfg, max_slots=2, block_size=8, max_model_len=64,
+                         prompt_buckets=[8, 32])
+
+    router = ReplicaRouter([_mk(), _mk()], idle_wait=0.001).start()
+    shared = rng.integers(1, 64, size=16).tolist()
+    rids = [router.submit(shared[:8] + shared[8:][:4 * i],
+                          max_new_tokens=6) for i in range(4)]
+    for rid in rids:
+        router.wait(rid, timeout=120)
+    router.check()
+    fdoc = _fleet.replicas_payload()
+    per = {r["replica"]: r.get("tokens", 0) for r in fdoc["replicas"]}
+    fleet_tokens = _fleet.get_aggregator().fleet_counter_value(
+        "serving_router_dispatch_total")
+    print(f"fleet scrape: {fdoc['totals']['replicas']} replicas "
+          f"({fdoc['totals'].get('healthy')} healthy), per-replica "
+          f"tokens {per}, dispatches fleet-wide "
+          f"{int(fleet_tokens)}")
+    print_fleet_table(fdoc)
+    router.stop()
     print()
     print_request_table(obs.requests_payload())
 
@@ -581,9 +698,14 @@ def main():
                     choices=("ttft", "tpot", "queue", "tokens",
                              "finished"),
                     help="--requests sort column (worst/highest first)")
+    ap.add_argument("--fleet", default=None, metavar="URL_OR_FILE",
+                    help="print the per-replica fleet table from a live "
+                         "server base URL (/fleet/replicas.json is "
+                         "appended; obs server or serving front door) "
+                         "or a saved payload file")
     ap.add_argument("--watch", action="store_true",
-                    help="with --requests URL: refresh the table "
-                         "top-style until interrupted")
+                    help="with --requests/--fleet URL: refresh the "
+                         "table top-style until interrupted")
     ap.add_argument("--interval", type=float, default=2.0,
                     help="--watch refresh period in seconds")
     ap.add_argument("--flags", default=None, metavar="PREFIX",
@@ -609,6 +731,8 @@ def main():
     if args.requests:
         return requests_mode(args.requests, args.sort, args.watch,
                              args.interval)
+    if args.fleet:
+        return fleet_mode(args.fleet, args.watch, args.interval)
     if args.flags is not None:
         import paddle_tpu.observability  # noqa: F401  (registers FLAGS_obs_*)
         from paddle_tpu.framework.flags import flag_entries
@@ -620,7 +744,8 @@ def main():
         return 0
     if args.demo is None:
         ap.error("pass --snapshot PATH, --postmortem PATH, --requests "
-                 "URL_OR_FILE or --demo {serving,train,moe,goodput}")
+                 "URL_OR_FILE, --fleet URL_OR_FILE or --demo "
+                 "{serving,train,moe,goodput}")
 
     import paddle_tpu.observability as obs
 

@@ -116,6 +116,13 @@ def main():
     ap.add_argument("--kv-swap-bytes", type=int, default=0)
     ap.add_argument("--obs", action="store_true",
                     help="enable the observability registry + tracer")
+    ap.add_argument("--obs-port", type=int, default=None,
+                    help="also start the standalone observability HTTP "
+                         "server on this port (0 = ephemeral, printed; "
+                         "implies --obs). The front door itself serves "
+                         "/metrics and /fleet/* too — this adds the "
+                         "full obs surface: /trace.json, /requests.json,"
+                         " /control/profile")
     ap.add_argument("--flags", default=None,
                     help="comma list of name=value paddle flags "
                          "(e.g. serve_drain_s=5)")
@@ -131,13 +138,17 @@ def main():
             name, _, val = item.partition("=")
             staged[name.strip()] = val.strip()
         set_flags(staged)
-    if args.obs:
+    if args.obs or args.obs_port is not None:
         obs.enable()
 
     reng = build_engine(args)
     front = HTTPFrontDoor(reng, host=args.host, port=args.port)
     host, port = front.start()
     print(f"serving on http://{host}:{port}", flush=True)
+    if args.obs_port is not None:
+        srv = obs.start_http_server(port=args.obs_port)
+        print(f"observability on http://{srv.host}:{srv.port}",
+              flush=True)
 
     # SIGTERM (orchestrator) and SIGINT (Ctrl-C) both drain: stop
     # admission, finish in-flight streams up to FLAGS_serve_drain_s,
