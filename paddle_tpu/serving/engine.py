@@ -103,6 +103,8 @@ import numpy as np
 
 from .. import observability as _obs
 from ..distributed.resilience.faults import SimulatedCrash
+from ..kernels.mega_decode import (mega_decode_loop, mega_decode_step,
+                                   mega_supported)
 from ..kernels.paged_attention import ragged_decode_partial
 from ..kernels.quant_matmul import (attn_pv, attn_qk, quantize_kv,
                                     weight_only_matmul as _wo_mm)
@@ -150,6 +152,7 @@ _M_SPEC_ACCEPTED = _instrument("serving_spec_accepted_total")
 _M_SPEC_ACCEPT_RATE = _instrument("serving_spec_acceptance_rate")
 _M_SPEC_TOKENS_PER_WAVE = _instrument("serving_spec_tokens_per_wave")
 _M_CANCEL_NOOP = _instrument("serving_cancel_noop_total")
+_M_MEGA_FALLBACK = _instrument("serving_mega_fallback_total")
 
 
 @dataclasses.dataclass
@@ -421,6 +424,7 @@ def _paged_decode(params, last_tokens, lengths, done0, budgets, key, active,
                   eos_ids, *, config: LlamaConfig, n_steps: int,
                   sample_flags=(True, True, True), kv_int8: bool = False,
                   numerics: bool = False, ragged: bool = False,
+                  mega: bool = False, mega_multistep: bool = False,
                   kv_prefix: str = ""):
     """``n_steps`` decode iterations in ONE compiled program (multi-step
     scheduling): the host loop syncs once per call instead of once per
@@ -490,6 +494,19 @@ def _paged_decode(params, last_tokens, lengths, done0, budgets, key, active,
     ``dk``/``dv`` pool entries — reusing the identical ragged/bucketed
     machinery at draft scale. Target pool entries pass through the
     donated dict untouched.
+
+    Mega path (``mega``, r18): the whole layer stack of each step runs
+    as ONE persistent Pallas launch (kernels/mega_decode) — the r12
+    block walk, the per-layer ring write and the FFN fused, weights
+    streamed in tiles — so a decode step costs one kernel launch instead
+    of L, and the hidden state never round-trips HBM between layers. The
+    scan, the sampling epilogue and the end-of-call ring->pool scatter
+    below are SHARED with the ragged path verbatim: that is the greedy
+    stream-parity contract, and it keeps the variant cache at ONE entry
+    per sampling-flag set. ``mega_multistep`` (greedy draft waves only)
+    additionally hoists the scan itself into the kernel: the draft's k
+    sequential steps — lm_head argmax, embed gather, done/budget
+    bookkeeping included — become one persistent launch instead of k.
     """
     c = config
     dt = c.dtype
@@ -506,7 +523,7 @@ def _paged_decode(params, last_tokens, lengths, done0, budgets, key, active,
     lens0 = lengths                       # frozen prefix lengths
     scale = 1.0 / math.sqrt(D)
 
-    if ragged:
+    if ragged or mega:
         # true-length walk: no gather, no mask — the kernel reads only
         # real blocks. Slots outside the decode set (inactive or
         # mid-chunked-prefill) walk zero blocks.
@@ -547,59 +564,74 @@ def _paged_decode(params, last_tokens, lengths, done0, budgets, key, active,
         last, lens, done, rem, rk, rv, k = carry
         k, sub = jax.random.split(k)
         act = active & ~done
-        x = params["embed"].astype(dt)[last][:, None]      # [N, 1, h]
-        ang = lens.astype(jnp.float32)[:, None] * freq[None, :]
-        ring_mask = (jnp.arange(S) <= t)[None, None, None, :]  # [1,1,1,S]
-        for l in range(Lc):
-            p = jax.tree_util.tree_map(lambda a: a[l], params["layers"])
-            hn = _rms_norm(x, p["attn_norm"], c.rms_eps)
-            q = _wo_mm(hn[:, 0], p["wq"], dt).reshape(N, Hkv * G, D)
-            kk = _wo_mm(hn[:, 0], p["wk"], dt).reshape(N, Hkv, D)
-            vv = _wo_mm(hn[:, 0], p["wv"], dt).reshape(N, Hkv, D)
-            q, kk = rope1(q, ang), rope1(kk, ang)
-            # uniform step index: dynamic_update_slice, never a scatter
-            rk = jax.lax.dynamic_update_slice(
-                rk, kk[None, :, None], (l, 0, t, 0, 0))
-            rv = jax.lax.dynamic_update_slice(
-                rv, vv[None, :, None], (l, 0, t, 0, 0))
-            qg = q.reshape(N, Hkv, G, D)
-            s_rng = jnp.einsum("nhgd,nshd->nhgs", qg, rk[l],
-                               preferred_element_type=jnp.float32) * scale
-            s_rng = jnp.where(ring_mask, s_rng, -1e30)
-            if ragged:
-                # flash-decoding combine: the kernel's online-softmax
-                # partials over the pool prefix merge with the in-call
-                # ring's scores — one softmax over [prefix ; ring],
-                # computed blockwise (exact up to f32 rounding). The
-                # ring always holds >= 1 live position, so l_tot >= 1.
-                acc_p, m_p, l_p = ragged_decode_partial(
-                    q, pools[pk], pools[pv], block_table, walk_lens,
-                    layer=l, ks_pool=pools.get(pks),
-                    vs_pool=pools.get(pvs))
-                m_tot = jnp.maximum(m_p, jnp.max(s_rng, axis=-1))
-                corr = jnp.exp(m_p - m_tot)
-                p_rng = jnp.exp(s_rng - m_tot[..., None])
-                l_tot = l_p * corr + jnp.sum(p_rng, axis=-1)
-                acc_tot = (acc_p * corr[..., None]
-                           + jnp.einsum("nhgs,nshd->nhgd", p_rng, rv[l],
-                                        preferred_element_type=jnp.float32))
-                att = acc_tot / l_tot[..., None]
-            else:
-                s_pre = attn_qk(qg, kd[l],
-                                ksc[l] if kv_int8 else None) * scale
-                s_pre = jnp.where(pre_mask, s_pre, -1e30)
-                probs = jax.nn.softmax(
-                    jnp.concatenate([s_pre, s_rng], axis=-1), axis=-1)
-                p_rng = probs[..., P:].astype(dt)
-                att = (attn_pv(probs[..., :P], vd[l],
-                               vsc[l] if kv_int8 else None, out_dtype=dt)
-                       + jnp.einsum("nhgs,nshd->nhgd", p_rng, rv[l]))
-            att = att.reshape(N, 1, Hkv * G * D).astype(dt)
-            x = x + _wo_mm(att, p["wo"], dt)
-            hn = _rms_norm(x, p["mlp_norm"], c.rms_eps)
-            gate = jax.nn.silu(_wo_mm(hn, p["w_gate"], dt))
-            x = x + _wo_mm(gate * _wo_mm(hn, p["w_up"], dt),
-                           p["w_down"], dt)
+        if mega:
+            # one persistent launch replaces the whole per-layer loop;
+            # the sampling epilogue below stays shared with ragged
+            xh, rk, rv = mega_decode_step(
+                params, c, x0=params["embed"].astype(dt)[last], t=t,
+                block_table=block_table, walk_lens=walk_lens, lens=lens,
+                ring_k=rk, ring_v=rv, k_pool=pools[pk], v_pool=pools[pv],
+                ks_pool=pools.get(pks), vs_pool=pools.get(pvs))
+            x = xh[:, None]
+        else:
+            x = params["embed"].astype(dt)[last][:, None]   # [N, 1, h]
+            ang = lens.astype(jnp.float32)[:, None] * freq[None, :]
+            ring_mask = (jnp.arange(S) <= t)[None, None, None, :]
+            for l in range(Lc):
+                p = jax.tree_util.tree_map(lambda a: a[l],
+                                           params["layers"])
+                hn = _rms_norm(x, p["attn_norm"], c.rms_eps)
+                q = _wo_mm(hn[:, 0], p["wq"], dt).reshape(N, Hkv * G, D)
+                kk = _wo_mm(hn[:, 0], p["wk"], dt).reshape(N, Hkv, D)
+                vv = _wo_mm(hn[:, 0], p["wv"], dt).reshape(N, Hkv, D)
+                q, kk = rope1(q, ang), rope1(kk, ang)
+                # uniform step index: dynamic_update_slice, no scatter
+                rk = jax.lax.dynamic_update_slice(
+                    rk, kk[None, :, None], (l, 0, t, 0, 0))
+                rv = jax.lax.dynamic_update_slice(
+                    rv, vv[None, :, None], (l, 0, t, 0, 0))
+                qg = q.reshape(N, Hkv, G, D)
+                s_rng = jnp.einsum(
+                    "nhgd,nshd->nhgs", qg, rk[l],
+                    preferred_element_type=jnp.float32) * scale
+                s_rng = jnp.where(ring_mask, s_rng, -1e30)
+                if ragged:
+                    # flash-decoding combine: the kernel's online-softmax
+                    # partials over the pool prefix merge with the
+                    # in-call ring's scores — one softmax over
+                    # [prefix ; ring], computed blockwise (exact up to
+                    # f32 rounding). The ring always holds >= 1 live
+                    # position, so l_tot >= 1.
+                    acc_p, m_p, l_p = ragged_decode_partial(
+                        q, pools[pk], pools[pv], block_table, walk_lens,
+                        layer=l, ks_pool=pools.get(pks),
+                        vs_pool=pools.get(pvs))
+                    m_tot = jnp.maximum(m_p, jnp.max(s_rng, axis=-1))
+                    corr = jnp.exp(m_p - m_tot)
+                    p_rng = jnp.exp(s_rng - m_tot[..., None])
+                    l_tot = l_p * corr + jnp.sum(p_rng, axis=-1)
+                    acc_tot = (acc_p * corr[..., None]
+                               + jnp.einsum(
+                                   "nhgs,nshd->nhgd", p_rng, rv[l],
+                                   preferred_element_type=jnp.float32))
+                    att = acc_tot / l_tot[..., None]
+                else:
+                    s_pre = attn_qk(qg, kd[l],
+                                    ksc[l] if kv_int8 else None) * scale
+                    s_pre = jnp.where(pre_mask, s_pre, -1e30)
+                    probs = jax.nn.softmax(
+                        jnp.concatenate([s_pre, s_rng], axis=-1), axis=-1)
+                    p_rng = probs[..., P:].astype(dt)
+                    att = (attn_pv(probs[..., :P], vd[l],
+                                   vsc[l] if kv_int8 else None,
+                                   out_dtype=dt)
+                           + jnp.einsum("nhgs,nshd->nhgd", p_rng, rv[l]))
+                att = att.reshape(N, 1, Hkv * G * D).astype(dt)
+                x = x + _wo_mm(att, p["wo"], dt)
+                hn = _rms_norm(x, p["mlp_norm"], c.rms_eps)
+                gate = jax.nn.silu(_wo_mm(hn, p["w_gate"], dt))
+                x = x + _wo_mm(gate * _wo_mm(hn, p["w_up"], dt),
+                               p["w_down"], dt)
 
         xf = _rms_norm(x, params["final_norm"], c.rms_eps)
         if head_w is not None:
@@ -619,9 +651,25 @@ def _paged_decode(params, last_tokens, lengths, done0, budgets, key, active,
 
     ring_k = jnp.zeros((Lc, N, S, Hkv, D), dt)
     ring_v = jnp.zeros((Lc, N, S, Hkv, D), dt)
-    init = (last_tokens, lengths, done0, budgets, ring_k, ring_v, key)
-    (last_tokens, lens_end, done0, budgets, ring_k, ring_v, key), emitted = \
-        jax.lax.scan(body, init, jnp.arange(S))
+    if mega and mega_multistep:
+        # draft fusion: the scan itself lives in the kernel — S greedy
+        # steps, argmax + embed gather + bookkeeping included, in ONE
+        # persistent launch. ``done0`` must be all-false (the spec
+        # wave's contract) and the PRNG key rides through untouched.
+        assert sample_flags == (False, False, False), \
+            "mega_multistep is greedy-only"
+        (emitted, last_tokens, lens_end, done0, budgets, ring_k,
+         ring_v) = mega_decode_loop(
+            params, c, x0=params["embed"].astype(dt)[last_tokens],
+            n_steps=S, block_table=block_table, walk_lens=walk_lens,
+            lens=lengths, active=active, last0=last_tokens,
+            budgets=budgets, eos_ids=eos_ids, ring_k=ring_k,
+            ring_v=ring_v, k_pool=pools[pk], v_pool=pools[pv])
+    else:
+        init = (last_tokens, lengths, done0, budgets, ring_k, ring_v,
+                key)
+        (last_tokens, lens_end, done0, budgets, ring_k, ring_v, key), \
+            emitted = jax.lax.scan(body, init, jnp.arange(S))
 
     # ---- writeback: the ring's valid entries → pools, one scatter -------
     cnt = lens_end - lens0                                # [N]
@@ -1025,6 +1073,16 @@ class LLMEngine:
             self.pools["dk"] = jnp.zeros(dshape, dc.dtype)
             self.pools["dv"] = jnp.zeros(dshape, dc.dtype)
         self.mesh = mesh
+        if decode_kernel in ("ragged", "mega") and mesh is not None:
+            # GSPMD cannot partition the Pallas block-walk (or the
+            # fused megakernel) over a 'tp' mesh — the kernel would run
+            # replicated against sharded pools; tp serving keeps the
+            # bucketed path, which shards through its plain
+            # gathers/dots. Fail loudly BEFORE any device placement.
+            raise ValueError(
+                f"decode_kernel={decode_kernel!r} does not compose with "
+                f"a tp mesh yet — use 'auto' (falls back to bucketed) "
+                f"or 'bucketed'")
         if mesh is not None:
             from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as P
@@ -1057,19 +1115,10 @@ class LLMEngine:
         self._key = jax.random.PRNGKey(seed)
         self._prefill = {}
         self.decode_steps = max(1, int(decode_steps))
-        if decode_kernel not in ("auto", "ragged", "bucketed"):
+        if decode_kernel not in ("auto", "ragged", "bucketed", "mega"):
             raise ValueError(
-                f"decode_kernel must be 'auto', 'ragged' or 'bucketed', "
-                f"got {decode_kernel!r}")
-        if decode_kernel == "ragged" and mesh is not None:
-            # GSPMD cannot partition the Pallas block-walk over a 'tp'
-            # mesh (the kernel would run replicated against sharded
-            # pools); tp serving keeps the bucketed path, which shards
-            # through its plain gathers/dots. Fail loudly rather than
-            # compile something silently wrong.
-            raise ValueError(
-                "decode_kernel='ragged' does not compose with a tp mesh "
-                "yet — use 'auto' (falls back to bucketed) or 'bucketed'")
+                f"decode_kernel must be 'auto', 'ragged', 'bucketed' or "
+                f"'mega', got {decode_kernel!r}")
         self.decode_kernel = decode_kernel
         # decode compile cache. Ragged path (r12): keyed ("ragged",
         # flags) — ONE variant per sampling-flag tuple (≤8 total; an
@@ -2445,6 +2494,32 @@ class LLMEngine:
             self.decode_kernel == "auto" and self.mesh is None
             and jax.default_backend() == "tpu")
 
+    def _decode_path(self) -> str:
+        """Kernel path for the next decode dispatch: ``"mega"`` (the
+        r18 persistent fused megakernel — forced, or picked by
+        ``"auto"`` on TPU at batch <= 4 where decode is launch-bound),
+        ``"ragged"`` (the r12 block-walk kernel) or ``"bucketed"`` (the
+        dense-gather fallback; the per-dispatch label refines to
+        ``dense`` at the full-width bucket). An ineligible mega pick
+        falls back to the ragged walk (bucketed off-TPU) and is COUNTED
+        in serving_mega_fallback_total{reason} — never silent."""
+        want_mega = (self.decode_kernel == "mega"
+                     or (self.decode_kernel == "auto"
+                         and self.mesh is None and self.N <= 4
+                         and jax.default_backend() == "tpu"))
+        if want_mega:
+            ok, reason = mega_supported(
+                self.params, self.config, n_slots=self.N,
+                n_steps=self.decode_steps, block_size=self.bs,
+                kv_int8=self.kv_int8)
+            if ok:
+                return "mega"
+            _M_MEGA_FALLBACK.inc(reason=reason)
+            if self.decode_kernel == "mega":
+                return ("ragged" if jax.default_backend() == "tpu"
+                        else "bucketed")
+        return "ragged" if self._use_ragged() else "bucketed"
+
     def _pool_block_bytes(self, draft: bool = False) -> int:
         """Bytes one physical block occupies across one MODEL's pool
         entries and layers (int8 pools: payload + scales). The decode
@@ -2477,11 +2552,12 @@ class LLMEngine:
             else:
                 rem_start[i] = req.max_new_tokens - len(req.generated) \
                     - len(self.slot_out[i])
-        ragged = self._use_ragged()
-        # ragged: the table ships at FULL width — one static shape
+        path = self._decode_path()
+        ragged_like = path in ("mega", "ragged")
+        # ragged/mega: the table ships at FULL width — one static shape
         # forever, lengths ride as a runtime operand (no bucket axis in
         # the compile key). Bucketed: host-side power-of-two slice.
-        nbk = self.mb if ragged else self._prefix_blocks(active_slots)
+        nbk = self.mb if ragged_like else self._prefix_blocks(active_slots)
         if self._table_dirty:
             self._table_dev = {}
             self._table_dirty = False
@@ -2498,11 +2574,11 @@ class LLMEngine:
                                  if r.temperature > 0),
                  sampled and any(r.top_p < 1.0 for r in reqs
                                  if r.temperature > 0))
-        vk = ("ragged", flags) if ragged else (nbk, flags)
+        vk = (path, flags) if ragged_like else (nbk, flags)
         decode = self._decode_cache.get(vk)
         if decode is None:
             # numerics gate baked per variant, like _prefill_fn (the key
-            # stays ("ragged"|bucket, flags): a mid-run flag flip
+            # stays ("mega"|"ragged"|bucket, flags): a mid-run flag flip
             # instruments new variants only — docs/observability.md)
             decode = self._decode_cache[vk] = jax.jit(
                 functools.partial(_paged_decode, config=self.config,
@@ -2510,18 +2586,19 @@ class LLMEngine:
                                   sample_flags=flags,
                                   kv_int8=self.kv_int8,
                                   numerics=self.kv_int8 and _nm.active(),
-                                  ragged=ragged),
+                                  ragged=(path == "ragged"),
+                                  mega=(path == "mega")),
                 donate_argnums=(8,))
             _M_DECODE_RECOMPILES.inc()
         # path + traffic accounting (host ints — kept whether or not the
         # registry is on, so bench rows can report evidence without
         # perturbing the measured workload with full telemetry)
-        path = ("ragged" if ragged
-                else ("dense" if nbk >= self.mb else "bucketed"))
+        if not ragged_like:
+            path = "dense" if nbk >= self.mb else "bucketed"
         _M_DECODE_KERNEL.inc(path=path)
         _M_DECODE_VARIANTS.set(len(self._decode_cache))
         pb = self._pool_block_bytes()
-        if ragged:
+        if ragged_like:
             # every scan step re-walks each slot's true-length blocks.
             # The kernel walks the DEVICE carry lengths, which lag the
             # host's view by up to decode_steps for slots chained
@@ -2555,7 +2632,7 @@ class LLMEngine:
                     c_key, v_act, tbl, self.pools, v_t, v_k, v_p, v_eos,
                     allow_compile=False)
             flops = self._decode_flops[vk]
-            if flops and ragged:
+            if flops and ragged_like:
                 # the cost model can't see inside the Mosaic custom
                 # call, and the walk's FLOPs depend on runtime lengths
                 # anyway: add the prefix-attention term analytically —
@@ -2565,6 +2642,18 @@ class LLMEngine:
                 flops += (4 * self.config.num_heads * self.config.head_dim
                           * walk * self.bs * self.config.num_layers
                           * self.decode_steps)
+            if flops and path == "mega":
+                # the mega launch also swallows the hidden-state
+                # matmuls the ragged path left visible to XLA — add
+                # them analytically (2 FLOPs per weight element per
+                # row per step; L is already in the stacked shapes)
+                wels = sum(
+                    int(np.prod((m["q"] if isinstance(m, dict)
+                                 else m).shape))
+                    for m in (self.params["layers"][n]
+                              for n in ("wq", "wk", "wv", "wo",
+                                        "w_gate", "w_up", "w_down")))
+                flops += 2 * wels * self.N * self.decode_steps
             self._last_decode_flops = flops
         with trace_span("serving.decode", slots=len(active_slots),
                         steps=self.decode_steps,
@@ -2623,12 +2712,16 @@ class LLMEngine:
         nbk = 1 << (need - 1).bit_length()
         return min(nbk, self.mb)
 
-    def _spec_draft_fn(self, ragged: bool):
+    def _spec_draft_fn(self, path: str):
         """The draft proposal program: ``_paged_decode`` at draft scale
         — draft config, ``spec_k`` fused steps, greedy flags, the
         ``dk``/``dv`` pool entries. One cached jit per kernel path (the
-        bucketed table width re-specializes inside jax's own cache)."""
-        key = "ragged" if ragged else "bucketed"
+        bucketed table width re-specializes inside jax's own cache).
+        On the mega path the draft is the second fusion target: the k
+        sequential tiny steps run as ONE persistent multi-step launch
+        (argmax, embed gather and bookkeeping in-kernel) instead of k
+        scan iterations of L launches each."""
+        key = path if path in ("mega", "ragged") else "bucketed"
         fn = self._spec_draft_cache.get(key)
         if fn is None:
             fn = self._spec_draft_cache[key] = jax.jit(
@@ -2636,7 +2729,9 @@ class LLMEngine:
                     _paged_decode, config=self.draft_config,
                     n_steps=self.spec_k,
                     sample_flags=(False, False, False),
-                    kv_int8=False, numerics=False, ragged=ragged,
+                    kv_int8=False, numerics=False,
+                    ragged=(key == "ragged"), mega=(key == "mega"),
+                    mega_multistep=(key == "mega"),
                     kv_prefix="d"),
                 donate_argnums=(8,))
         return fn
@@ -2695,7 +2790,20 @@ class LLMEngine:
             return emitted
         k = self.spec_k
         N = self.N
-        ragged = self._use_ragged()
+        path = self._decode_path()
+        if path == "mega":
+            # the draft's eligibility envelope is its own (draft-sized
+            # weights, multi-step epilogue buffers) — screen it
+            # separately and count the fallback
+            ok, reason = mega_supported(
+                self.draft_params, self.draft_config, n_slots=N,
+                n_steps=k, block_size=self.bs, kv_int8=False,
+                multi_step=True)
+            if not ok:
+                _M_MEGA_FALLBACK.inc(reason="draft_" + reason)
+                path = ("ragged" if jax.default_backend() == "tpu"
+                        else "bucketed")
+        ragged_like = path in ("mega", "ragged")
         nbk = self._spec_bucket(active)
         if self._table_dirty:
             self._table_dev = {}
@@ -2709,7 +2817,7 @@ class LLMEngine:
             return t
 
         tbl_v = tdev(nbk)
-        tbl_d = tdev(self.mb) if ragged else tbl_v
+        tbl_d = tdev(self.mb) if ragged_like else tbl_v
         last = np.zeros(N, np.int32)
         budgets = np.zeros(N, np.int32)
         act = np.zeros(N, bool)
@@ -2729,7 +2837,7 @@ class LLMEngine:
         lens_j = jnp.asarray(self.lengths, jnp.int32)
         act_j = jnp.asarray(act)
         rids = [self.slot_req[i].req_id for i in active]
-        draft_fn = self._spec_draft_fn(ragged)
+        draft_fn = self._spec_draft_fn(path)
         with trace_span("serving.spec_draft", slots=len(active), k=k,
                         request_ids=rids):
             (demitted, _dl, _dn, _dd, _db, _dk, self.pools) = draft_fn(
@@ -2800,7 +2908,7 @@ class LLMEngine:
         # dense history gather at target-pool bytes
         pb_t, pb_d = self._pool_block_bytes(), \
             self._pool_block_bytes(draft=True)
-        if ragged:
+        if ragged_like:
             self.kv_read_bytes_total += walk * pb_d * k
         else:
             self.kv_read_bytes_total += pb_d * N * nbk * (2 + k)

@@ -363,9 +363,9 @@ def test_obs_dump_demo_serving_smoke(tmp_path):
                  "serving_kv_offload_prefetch_hits_total"):
         assert name in out, (name, out[-2000:])
     assert "kv offload:" in out
-    # r12: the kernel-path line — off-TPU the bucketed fallback serves
-    # every dispatch and the ragged count stays 0
-    assert "decode kernel paths: ragged=0" in out, out[-2000:]
+    # r12/r18: the kernel-path line — off-TPU the bucketed fallback
+    # serves every dispatch; the mega and ragged counts stay 0
+    assert "decode kernel paths: mega=0 ragged=0" in out, out[-2000:]
     # r8: one shed, one expired deadline, at least one preempt→swap
     assert "load shed: request" in out
     assert "deadline_exceeded=1" in out
