@@ -1148,6 +1148,133 @@ def bench_serving(dev, results):
             "resumed_streams": router.resumed_streams,
         }))
 
+    def attempt_tp2(make_params):
+        """TP-sharded decode hot path (r19): the SAME greedy workload on
+        a 2-device ("tp",) mesh vs the unsharded engine — the ragged
+        decode partials run under shard_map (the KV heads split across
+        the mesh, each device walks half the head dim's blocks), prefill
+        stays GSPMD-sharded. Streams must be bit-identical: sharding is
+        an execution detail, never a numerics fork (per-kv-head online
+        softmax is device-local). vs_baseline = tp2 / unsharded tok/s —
+        two real chips with separate HBM paths is where it exceeds 1;
+        one tunnel-attached chip exposes only the dispatch tax."""
+        from jax.sharding import Mesh
+        if len(jax.devices()) < 2:
+            return   # tp=2 needs 2 local devices
+        params = make_params()
+        rng = np.random.default_rng(0)
+        reqs = [rng.integers(1, 32768, size=int(ln)).tolist()
+                for ln in rng.integers(64, 512, size=2 * SLOTS)]
+
+        def run(mesh):
+            eng = LLMEngine(params, cfg, max_slots=SLOTS, block_size=64,
+                            max_model_len=1024,
+                            prompt_buckets=[128, 512, 1024],
+                            decode_steps=64, kv_dtype="int8",
+                            decode_kernel="ragged", mesh=mesh)
+            for p in reqs[:2]:
+                eng.add_request(list(p), max_new_tokens=8,
+                                temperature=0.0)
+            eng.run()
+            t0 = time.perf_counter()
+            rids = [eng.add_request(list(p), max_new_tokens=NEW,
+                                    temperature=0.0) for p in reqs]
+            out = eng.run()
+            dt = time.perf_counter() - t0
+            streams = [out[r] for r in rids]
+            return sum(len(s) for s in streams) / dt, streams
+
+        base_tps, base_streams = run(None)
+        _release()
+        mesh = Mesh(np.asarray(jax.devices()[:2]), ("tp",))
+        tp_tps, tp_streams = run(mesh)
+        assert tp_streams == base_streams, \
+            "tp2 streams diverged from unsharded greedy"
+        results.append(_efficiency({
+            "metric": "llama-2.6b_serving_tp2_tokens_per_sec",
+            "value": round(tp_tps, 1),
+            "unit": "tokens/s",
+            # acceptance: bit-identical streams (asserted above);
+            # vs_baseline is the tp2 scale factor over one engine
+            "vs_baseline": round(tp_tps / max(base_tps, 1e-9), 4),
+            "unsharded_tokens_per_sec": round(base_tps, 1),
+            "tp": 2,
+            "requests": len(reqs),
+        }))
+
+    def attempt_disagg(make_params):
+        """Disaggregated prefill/decode row (r19): a prefill-role +
+        decode-role replica pair behind the router vs ONE colocated
+        engine on the identical greedy workload. Every stream prefills
+        on p0, spills its KV bit-exact into the shared host relay, and
+        decodes on d0 after one batched h2d restore. Both replicas
+        share one chip here, so vs_baseline measures the HANDOFF TAX
+        (relay d2h+h2d + the re-dispatch hop), not a speedup — the
+        split pays off when prefill and decode get their own chips and
+        neither steals the other's step budget. Acceptance: kept tok/s
+        within noise of colocated, handoffs == restores == streams,
+        relay drained."""
+        from paddle_tpu.serving import LLMEngine, ReplicaRouter
+        from paddle_tpu.serving.kv_swap import HostKVPool
+        params = make_params()
+        n_reqs, new_tok = 4 * SLOTS, 64
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(1, 32768, size=int(ln)).tolist()
+                   for ln in rng.integers(64, 320, size=n_reqs)]
+
+        def mk_engine(**kw):
+            return LLMEngine(params, cfg, max_slots=SLOTS, block_size=64,
+                             max_model_len=1024,
+                             prompt_buckets=[128, 512, 1024],
+                             decode_steps=16, kv_dtype="int8", **kw)
+
+        # colocated baseline (warm first)
+        eng = mk_engine()
+        for p in prompts[:2]:
+            eng.add_request(list(p), max_new_tokens=8, temperature=0.0)
+        eng.run()
+        t0 = time.perf_counter()
+        rids = [eng.add_request(list(p), max_new_tokens=new_tok,
+                                temperature=0.0) for p in prompts]
+        out = eng.run()
+        base_tps = sum(len(out[r]) for r in rids) \
+            / (time.perf_counter() - t0)
+        _release()
+
+        relay = HostKVPool(4 << 30, kind="relay")
+        p_eng = mk_engine(role="prefill", relay=relay)
+        d_eng = mk_engine(role="decode", relay=relay)
+        for e in (p_eng, d_eng):
+            for p in prompts[:2]:
+                e.add_request(list(p), max_new_tokens=8, temperature=0.0)
+            e.run()
+        router = ReplicaRouter([p_eng, d_eng], names=["p0", "d0"])
+        router.start()
+        try:
+            t0 = time.perf_counter()
+            rrids = [router.submit(list(p), max_new_tokens=new_tok,
+                                   temperature=0.0) for p in prompts]
+            gen = sum(len(router.wait(r, timeout=1800)) for r in rrids)
+            dt = time.perf_counter() - t0
+        finally:
+            router.stop()
+        assert len(relay) == 0, "relay pool not drained"
+        results.append(_efficiency({
+            "metric": "llama-2.6b_serving_disagg_tokens_per_sec",
+            "value": round(gen / dt, 1),
+            "unit": "tokens/s",
+            # acceptance: vs_baseline ~1.0 (the handoff tax on one
+            # chip), handoffs == streams, refusals == 0
+            "vs_baseline": round(gen / dt / max(base_tps, 1e-9), 4),
+            "colocated_tokens_per_sec": round(base_tps, 1),
+            "handoffs": p_eng.handoffs,
+            "handoff_mb": round(p_eng.handoff_bytes / 2**20, 2),
+            "handoff_ms_mean": round(
+                1e3 * p_eng.handoff_seconds / max(1, p_eng.handoffs), 2),
+            "relay_refusals": relay.refusals,
+            "handoff_resumes": router.handoff_resumes,
+        }))
+
     try:
         _retry(lambda: attempt("bf16", lambda: _init_bf16_params(cfg)))
         _release()
@@ -1207,6 +1334,18 @@ def bench_serving(dev, results):
         # engine on the same half-shared-prefix load (scale-out factor,
         # affinity hit rate, zero failovers in the clean leg)
         _retry(lambda: attempt_router(
+            lambda: jax.jit(llama.quantize_params)(_init_bf16_params(cfg))))
+        _release()
+        # r19 tp=2 sharded decode hot path: shard_mapped ragged decode
+        # on a 2-device mesh vs unsharded — bit-identical streams
+        # asserted (skips on a single-device host)
+        _retry(lambda: attempt_tp2(
+            lambda: jax.jit(llama.quantize_params)(_init_bf16_params(cfg))))
+        _release()
+        # r19 disaggregated prefill/decode: prefill+decode replica pair
+        # over the shared host relay vs one colocated engine (handoff
+        # tax, bytes, latency; relay drained)
+        _retry(lambda: attempt_disagg(
             lambda: jax.jit(llama.quantize_params)(_init_bf16_params(cfg))))
     except Exception as e:
         results.append({"metric": "serving_bench_failed", "value": 0.0,

@@ -87,12 +87,17 @@ def _head_mode(params, config) -> str:
 
 def mega_supported(params, config, *, n_slots: int, n_steps: int,
                    block_size: int, kv_int8: bool,
-                   multi_step: bool = False):
+                   multi_step: bool = False, mesh=None):
     """(ok, reason) eligibility screen for the mega decode kernel — the
     engine's counted-fallback gate (serving_mega_fallback_total{reason}).
     Estimates the kernel's VMEM scratch envelope (weight tiles, ring
     buffers, walk blocks, hidden-state carry) against the ~12 MiB budget
-    the paged_decode_attention screening established."""
+    the paged_decode_attention screening established. A tp mesh bows
+    out with reason "mesh": GSPMD cannot partition the fused single
+    launch (the ragged path shard_maps instead), so the engine falls
+    back counted rather than raising."""
+    if mesh is not None and dict(getattr(mesh, "shape", {})).get("tp", 1) > 1:
+        return False, "mesh"
     lay = params["layers"]
     mats = [lay[k] for k in _MATS]
     quant = [is_quantized_weight(m) for m in mats]

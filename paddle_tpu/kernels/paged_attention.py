@@ -479,7 +479,7 @@ def _ragged_decode_kernel(layer_ref, table_ref, lens_ref, q_ref,
 
 
 def ragged_decode_partial(q, k_pool, v_pool, block_table, lengths, *,
-                          layer=0, ks_pool=None, vs_pool=None):
+                          layer=0, ks_pool=None, vs_pool=None, mesh=None):
     """Ragged block-walk decode attention over each slot's TRUE length —
     partial (flash-decoding) form. q: [N, Hq, D]; pools:
     [L, NB, BS, Hkv, D] or 4D (bf16/f32, or int8 with per-entry f32
@@ -494,7 +494,44 @@ def ragged_decode_partial(q, k_pool, v_pool, block_table, lengths, *,
     the only shape, and slots read exactly ``ceil(lengths[n]/BS)``
     blocks of it. VMEM use is two double-buffered blocks + the [Hkv, G,
     D] accumulators, independent of context length — no long-context
-    staging-buffer cliff like :func:`paged_decode_attention`'s."""
+    staging-buffer cliff like :func:`paged_decode_attention`'s.
+
+    With ``mesh`` (a Mesh carrying a 'tp' axis of size > 1) the call is
+    wrapped in a shard_map over 'tp': KV heads shard naturally — every
+    shard walks the SAME block tables and lengths (replicated scalars)
+    against its Hkv/tp head slice of q and the pools (the engine's
+    ``P(None,None,None,"tp",None)`` pool shardings). Per-kv-head online
+    softmax is independent, so the sharded partials are bit-identical
+    to the unsharded ones. Hkv must divide by the tp size."""
+    if mesh is not None:
+        tp = dict(mesh.shape).get("tp", 1)
+        if tp > 1:
+            from jax.sharding import PartitionSpec as P
+            from .moe_dispatch import _shard_map
+            Hkv_g = _as5d(k_pool).shape[3]
+            assert Hkv_g % tp == 0, (Hkv_g, tp)
+            pool_s = P(None, None, None, "tp", None) \
+                if k_pool.ndim == 5 else P(None, None, "tp", None)
+            scale_s = None
+            if ks_pool is not None:
+                scale_s = P(None, None, None, "tp") \
+                    if ks_pool.ndim == 4 else P(None, None, "tp")
+            inner = functools.partial(ragged_decode_partial, layer=layer)
+            if ks_pool is not None:
+                inner = lambda q_, k_, v_, t_, l_, ks_, vs_: \
+                    ragged_decode_partial(q_, k_, v_, t_, l_, layer=layer,
+                                          ks_pool=ks_, vs_pool=vs_)
+            fn = _shard_map(
+                inner, mesh,
+                in_specs=(P(None, "tp", None), pool_s, pool_s, P(), P())
+                + ((scale_s, scale_s) if ks_pool is not None else ()),
+                out_specs=(P(None, "tp", None, None), P(None, "tp", None),
+                           P(None, "tp", None)),
+                axis_names=("tp",))
+            args = (q, k_pool, v_pool, block_table, lengths)
+            if ks_pool is not None:
+                args += (ks_pool, vs_pool)
+            return fn(*args)
     N, Hq, D = q.shape
     kp, vp = _as5d(k_pool), _as5d(v_pool)
     bs, Hkv = kp.shape[2], kp.shape[3]
@@ -552,7 +589,7 @@ def ragged_decode_partial(q, k_pool, v_pool, block_table, lengths, *,
 
 
 def ragged_paged_decode(q, cache: PagedKVCache, layer=0, ks_pool=None,
-                        vs_pool=None) -> jax.Array:
+                        vs_pool=None, mesh=None) -> jax.Array:
     """Normalized ragged decode attention: q [N, Hq, D] -> [N, Hq, D],
     attending each slot's first ``cache.lengths[n]`` pool positions via
     the true-length block walk (:func:`ragged_decode_partial`). Same
@@ -560,11 +597,13 @@ def ragged_paged_decode(q, cache: PagedKVCache, layer=0, ks_pool=None,
     reference and the numerics oracle in tests — but lengths are a
     runtime operand: one compiled program serves any length mix, reads
     no block past any slot's length, and holds only two blocks in VMEM
-    however long the context. Zero-length slots return 0."""
+    however long the context. Zero-length slots return 0. ``mesh``
+    shards the walk over the 'tp' axis (see
+    :func:`ragged_decode_partial`)."""
     N, Hq, D = q.shape
     acc, m, l = ragged_decode_partial(
         q, cache.k_pool, cache.v_pool, cache.block_table, cache.lengths,
-        layer=layer, ks_pool=ks_pool, vs_pool=vs_pool)
+        layer=layer, ks_pool=ks_pool, vs_pool=vs_pool, mesh=mesh)
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     out = jnp.where((l > 0)[..., None], out, 0.0)
     return out.reshape(N, Hq, D).astype(q.dtype)

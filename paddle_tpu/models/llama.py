@@ -378,6 +378,17 @@ def make_serving_shardings(params, config: LlamaConfig, mesh: Mesh,
     return out
 
 
+def make_replicated_shardings(params, mesh: Mesh):
+    """A sharding tree placing every leaf fully REPLICATED on ``mesh``
+    (spec ``P()``). The serving engine uses this for the speculative
+    DRAFT under tp serving (r19): the draft is small, so replicating it
+    beats sharding a model whose kv heads may not divide the tp size —
+    every device runs the identical draft program while the target's
+    verify rides the sharded collectives."""
+    rep = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(lambda _: rep, params)
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------

@@ -71,8 +71,9 @@ CATALOG = {
         "decode dispatches that wanted the mega megakernel but fell "
         "back to the ragged walk (vmem = the kernel's scratch envelope "
         "exceeds the ~12 MiB budget, mixed_weights = partially "
-        "quantized layer stack; draft_* = the speculative draft's own "
-        "screen) — the fallback is counted, never silent"),
+        "quantized layer stack, mesh = tp-sharded serving runs the "
+        "shard_mapped ragged walk instead; draft_* = the speculative "
+        "draft's own screen) — the fallback is counted, never silent"),
     # -- serving speculative decoding (r13, draft-then-verify waves) -------
     "serving_spec_proposed_total": (
         "counter", (), "draft tokens proposed to the target's batched "
@@ -217,6 +218,25 @@ CATALOG = {
     "serving_router_healthy_replicas": (
         "gauge", (), "replicas currently in the healthy state (the "
                      "placeable pool; 0 means every submit sheds)"),
+    # -- disaggregated prefill/decode (serving.router roles, r19) ----------
+    "serving_disagg_handoffs_total": (
+        "counter", ("outcome",),
+        "prefill→decode stream handoffs by outcome (ok = the prefill "
+        "replica spilled the slot's KV bit-exact into the shared host "
+        "relay; restored = a decode replica consumed the entry with one "
+        "batched h2d scatter instead of re-prefilling; relay_full = the "
+        "relay refused the spill; missing = the entry vanished before "
+        "restore — both degradations re-prefill the handed-off context, "
+        "streams stay identical, counted never silent)"),
+    "serving_disagg_kv_relay_bytes": (
+        "gauge", (), "bytes resident in the shared prefill→decode host "
+                     "relay pool (HostKVPool kind=\"relay\"); a healthy "
+                     "disagg fleet drains this to 0 between bursts"),
+    "serving_disagg_handoff_seconds": (
+        "histogram", (), "prefill-side handoff latency: slot KV "
+                         "fetch + relay publish, per handed-off "
+                         "stream (the d2h leg of the disagg "
+                         "transfer)"),
     # -- fleet observability (observability.fleet, r17) --------------------
     "serving_fleet_slo_attainment": (
         "gauge", ("replica", "slo"),

@@ -414,9 +414,9 @@ class FleetAggregator:
     # -- dashboard payloads -------------------------------------------------
     def replicas_payload(self) -> Dict:
         """The ``/fleet/replicas.json`` document ``obs_dump --fleet``
-        renders: one row per replica (state, streams, queue/slots,
-        tokens, p95 TTFT/TPOT, cache hit rate, SLO burn) + fleet
-        totals."""
+        renders: one row per replica (state, disagg role, streams,
+        queue/slots, tokens, p95 TTFT/TPOT, cache hit rate, SLO burn)
+        + fleet totals."""
         _M_SCRAPES.inc(endpoint="replicas")
         reg = get_registry()
         router = self.router()
@@ -430,6 +430,7 @@ class FleetAggregator:
                     with router._lock:
                         row.update({
                             "state": rep.state,
+                            "role": rep.role,
                             "hb_age_s": round(max(0.0, now - rep.hb), 3),
                             "streams": len(rep.owned),
                             "dispatches": rep.dispatches,

@@ -153,6 +153,8 @@ _M_SPEC_ACCEPT_RATE = _instrument("serving_spec_acceptance_rate")
 _M_SPEC_TOKENS_PER_WAVE = _instrument("serving_spec_tokens_per_wave")
 _M_CANCEL_NOOP = _instrument("serving_cancel_noop_total")
 _M_MEGA_FALLBACK = _instrument("serving_mega_fallback_total")
+_M_DISAGG_HANDOFFS = _instrument("serving_disagg_handoffs_total")
+_M_DISAGG_SECONDS = _instrument("serving_disagg_handoff_seconds")
 
 
 @dataclasses.dataclass
@@ -176,6 +178,11 @@ class Request:
     # prompt+generated so already-streamed tokens are never re-emitted
     # (vLLM recompute semantics)
     generated: List[int] = dataclasses.field(default_factory=list)
+    # disaggregated serving (r19): key of a relay-pool KV entry spilled
+    # by a prefill replica. Admission restores the entry (batched h2d
+    # scatter) instead of prefilling; a missing entry degrades to a full
+    # prefill of the same context — streams identical either way.
+    relay_key: Optional[int] = None
 
 
 # ---------------------------------------------------------------------------
@@ -425,7 +432,7 @@ def _paged_decode(params, last_tokens, lengths, done0, budgets, key, active,
                   sample_flags=(True, True, True), kv_int8: bool = False,
                   numerics: bool = False, ragged: bool = False,
                   mega: bool = False, mega_multistep: bool = False,
-                  kv_prefix: str = ""):
+                  kv_prefix: str = "", mesh=None):
     """``n_steps`` decode iterations in ONE compiled program (multi-step
     scheduling): the host loop syncs once per call instead of once per
     token — through a remote-attached chip the per-step d2h round-trip
@@ -473,7 +480,10 @@ def _paged_decode(params, last_tokens, lengths, done0, budgets, key, active,
     scale with the tokens actually resident, and inactive / mid-chunk
     slots walk zero blocks (their lengths are zeroed going in). The
     writeback scatter and kv_int8 numerics probes are shared with the
-    bucketed path verbatim.
+    bucketed path verbatim. Under a tp ``mesh`` the kernel call is
+    shard_mapped over the KV heads (r19): every shard walks the same
+    tables against its head slice of the pools — bit-identical partials,
+    no cross-shard collective inside the walk.
 
     The (last, lengths, done, budgets, key) quintet is a device-resident
     carry: the engine feeds each call the previous call's outputs
@@ -605,7 +615,7 @@ def _paged_decode(params, last_tokens, lengths, done0, budgets, key, active,
                     acc_p, m_p, l_p = ragged_decode_partial(
                         q, pools[pk], pools[pv], block_table, walk_lens,
                         layer=l, ks_pool=pools.get(pks),
-                        vs_pool=pools.get(pvs))
+                        vs_pool=pools.get(pvs), mesh=mesh)
                     m_tot = jnp.maximum(m_p, jnp.max(s_rng, axis=-1))
                     corr = jnp.exp(m_p - m_tot)
                     p_rng = jnp.exp(s_rng - m_tot[..., None])
@@ -884,7 +894,8 @@ class LLMEngine:
                  decode_kernel: str = "auto",
                  draft_params=None, draft_config: Optional[LlamaConfig]
                  = None, spec_tokens: int = 4, spec: bool = True,
-                 kv_offload: str = "auto"):
+                 kv_offload: str = "auto", role: str = "both",
+                 relay: Optional[HostKVPool] = None):
         """``params`` may be dense (bf16/f32) or int8 weight-only
         (llama.quantize_params) — quantized leaves feed the decode/prefill
         matmuls unconverted (kernels/quant_matmul.weight_only_matmul).
@@ -894,7 +905,12 @@ class LLMEngine:
         int8 qweights + scales shard with the same specs as their dense
         counterparts), the KV pools shard their kv-head dim over 'tp',
         and GSPMD inserts the serving collectives (the reference's
-        multi-GPU serving via mp_degree).
+        multi-GPU serving via mp_degree). The ragged decode kernel
+        shard_maps its block walk over the sharded KV heads (r19), and
+        spec decode composes by running the DRAFT replicated (params
+        and dk/dv pools carry P()) while the verify rides the sharded
+        prefill-shaped program — greedy streams stay bit-identical to
+        the unsharded engine's across every path.
 
         ``decode_steps``: decode iterations fused into one compiled call
         (multi-step scheduling). 1 = a host sync per token (exact
@@ -949,11 +965,18 @@ class LLMEngine:
         decode compile cache collapses to ONE variant per (batch,
         sampling-flags) set. ``"bucketed"`` — the r6 host-side
         power-of-two prefix buckets over the hoisted dense gather.
-        ``"auto"`` (default) picks ragged on an unsharded TPU backend
-        and bucketed elsewhere (off-TPU the kernel would run in the
-        Pallas interpreter — correct but slow; under a 'tp' mesh GSPMD
-        can't partition it); the choice is counted per dispatch in
-        ``serving_decode_kernel_total{path}``, never silent.
+        ``"auto"`` (default) picks ragged on a TPU backend — sharded or
+        not — and bucketed elsewhere (off-TPU the kernel would run in
+        the Pallas interpreter — correct but slow); the choice is
+        counted per dispatch in
+        ``serving_decode_kernel_total{path}``, never silent. The
+        supported mesh matrix (r19): ragged and bucketed both compose
+        with a 'tp' mesh (ragged shard_maps the block walk over the KV
+        heads; bucketed shards through its plain gathers/dots), spec
+        decode runs its draft replicated under the mesh, and ``"mega"``
+        alone bows out — a tp mesh falls back counted
+        (``serving_mega_fallback_total{reason="mesh"}``) to ragged on
+        TPU / bucketed off it, never raising.
         Both paths share admission, writeback, preemption, the prefix
         cache, chunked prefill, swap and the numerics probes; greedy
         token streams are parity-tested identical.
@@ -998,7 +1021,27 @@ class LLMEngine:
         (default) follows ``FLAGS_serve_kv_offload_sync``. Greedy token
         streams are bit-identical either way (test-enforced, bf16 and
         int8); only the stall profile differs. Ignored when no host
-        tier is configured."""
+        tier is configured.
+
+        ``role`` / ``relay`` (r19, disaggregated serving): ``role``
+        declares which phase of a request this engine serves —
+        ``"both"`` (default: the colocated engine), ``"decode"``
+        (identical engine behavior; a placement hint for the
+        ReplicaRouter, which keeps fresh prefills off it when a
+        prefill-capable replica is healthy), or ``"prefill"``: the
+        engine runs admission + (chunked) prefill ONLY — as soon as a
+        slot's first token is host-visible it spills the slot's pool
+        blocks (payload + scales bit-exact, the swap-out d2h path) into
+        the shared host ``relay`` pool (``HostKVPool(kind="relay")``)
+        keyed by request id, frees the slot, and finishes the request
+        with reason ``"handoff"`` — partial result: the first token.
+        A decode/both engine admitting a request whose ``relay_key``
+        finds a relay entry restores it via the batched h2d scatter
+        instead of prefilling (the swap-in path); a missing or
+        incomplete entry degrades to a full prefill of the same context
+        — greedy streams are bit-identical to a colocated engine's
+        either way (test-enforced, bf16 and int8). ``role="prefill"``
+        requires a ``relay``."""
         c = config
         assert max_model_len % block_size == 0
         self.params = params
@@ -1056,10 +1099,6 @@ class LLMEngine:
             if self.spec_k < 1:
                 raise ValueError(
                     f"spec_tokens must be >= 1, got {spec_tokens}")
-            if mesh is not None:
-                raise NotImplementedError(
-                    "speculative decoding does not compose with a tp "
-                    "mesh yet — serve the draft pair unsharded")
             dc = draft_config
             # draft KV pools share the target's physical block grid
             # (same nb/bs, same block ids): one block backs BOTH
@@ -1073,17 +1112,15 @@ class LLMEngine:
             self.pools["dk"] = jnp.zeros(dshape, dc.dtype)
             self.pools["dv"] = jnp.zeros(dshape, dc.dtype)
         self.mesh = mesh
-        if decode_kernel in ("ragged", "mega") and mesh is not None:
-            # GSPMD cannot partition the Pallas block-walk (or the
-            # fused megakernel) over a 'tp' mesh — the kernel would run
-            # replicated against sharded pools; tp serving keeps the
-            # bucketed path, which shards through its plain
-            # gathers/dots. Fail loudly BEFORE any device placement.
-            raise ValueError(
-                f"decode_kernel={decode_kernel!r} does not compose with "
-                f"a tp mesh yet — use 'auto' (falls back to bucketed) "
-                f"or 'bucketed'")
         if mesh is not None:
+            # tp serving (r19): target params shard Megatron-style, the
+            # KV pools shard over their kv-head axis, and the ragged
+            # block-walk runs under a shard_map over 'tp' (each shard
+            # walks the same tables against its head slice — see
+            # kernels/paged_attention.ragged_decode_partial). The spec
+            # DRAFT stays replicated: its params and dk/dv pools carry
+            # P() shardings (draft kv heads need not divide tp), while
+            # _spec_verify reuses the sharded prefill program via GSPMD.
             from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as P
 
@@ -1096,10 +1133,17 @@ class LLMEngine:
             self.params = params = jax.device_put(
                 params, _llama.make_serving_shardings(params, c, mesh,
                                                       fsdp=False))
+            if self._spec_on:
+                self.draft_params = jax.device_put(
+                    self.draft_params,
+                    _llama.make_replicated_shardings(self.draft_params,
+                                                     mesh))
             pool_sh = NamedSharding(mesh, P(None, None, None, "tp", None))
             scale_sh = NamedSharding(mesh, P(None, None, None, "tp"))
+            rep_sh = NamedSharding(mesh, P())
             self.pools = {
-                k: jax.device_put(v, pool_sh if v.ndim == 5 else scale_sh)
+                k: jax.device_put(v, rep_sh if k.startswith("d")
+                                  else pool_sh if v.ndim == 5 else scale_sh)
                 for k, v in self.pools.items()}
         self.free_blocks = deque(range(1, self.nb))
         self.table = np.zeros((self.N, self.mb), np.int32)
@@ -1136,6 +1180,11 @@ class LLMEngine:
         # evidence for the offload bench row: the async tier's
         # acceptance is ZERO of these under a fitting host pool)
         self.swap_fallbacks = 0
+        # disagg handoff host evidence (r19, bench rows): spills this
+        # prefill-role engine completed, their d2h+relay bytes/seconds
+        self.handoffs = 0
+        self.handoff_bytes = 0
+        self.handoff_seconds = 0.0
         # device-resident decode carry (last/lengths/done/budgets/key) +
         # static per-slot vectors; the carry chains from call to call and
         # is only rebuilt from host state when the pipeline is drained
@@ -1166,6 +1215,21 @@ class LLMEngine:
                           else admission)
         self.swap_pool = (HostKVPool(kv_swap_bytes) if kv_swap_bytes
                           else None)
+        # -- disaggregated prefill/decode (r19) ---------------------------
+        if role not in ("prefill", "decode", "both"):
+            raise ValueError(
+                f"role must be 'prefill', 'decode' or 'both', got "
+                f"{role!r}")
+        if relay is not None and getattr(relay, "kind", None) != "relay":
+            raise ValueError(
+                "relay must be a HostKVPool(kind='relay') shared "
+                "between the prefill and decode replicas")
+        if role == "prefill" and relay is None:
+            raise ValueError(
+                "role='prefill' requires a relay pool — the handed-off "
+                "KV has to live somewhere the decode replica can reach")
+        self.role = role
+        self.relay = relay
         # -- async two-tier offload (r15): one transfer engine whenever
         # ANY host tier exists. "auto" defers the sync decision to
         # FLAGS_serve_kv_offload_sync (the version-shimmed d2h start
@@ -1705,6 +1769,55 @@ class LLMEngine:
                 req.req_id, slot=slot, context_tokens=ent.n_tokens,
                 swapped_in=True, **kw)
 
+    def _handoff(self, slot: int) -> None:
+        """Disaggregated handoff (r19): spill the slot's prefilled KV
+        blocks into the shared relay pool and finish the stream with
+        reason ``"handoff"`` — the prefill replica's terminal. Keeps
+        ``lengths[slot]`` positions (every prefilled token; the sampled
+        first token's KV is written by the decode replica's first
+        restored step — the :meth:`_swap_out` invariant with the first
+        token as ``out``), so a decode replica re-admitting ``prompt +
+        delivered`` finds a relay entry of exactly ``len(ctx) - 1``
+        tokens: the same restore contract as a swap-in, payload +
+        scales bit-exact. A capacity refusal still hands the stream off
+        — the decode replica then re-prefills the identical context
+        (the pool counts outcome="relay_full"; streams match either
+        way, only the transfer saving is lost)."""
+        req = self.slot_req[slot]
+        t0 = time.perf_counter()
+        n_keep = int(self.lengths[slot])
+        nb_keep = -(-n_keep // self.bs)
+        data = self._fetch_blocks(
+            [int(self.table[slot, j]) for j in range(nb_keep)])
+        ok = self.relay.put(req.req_id, data, n_keep)
+        dt = time.perf_counter() - t0
+        nbytes = int(sum(a.nbytes for a in data.values()))
+        self.handoffs += 1
+        self.handoff_bytes += nbytes
+        self.handoff_seconds += dt
+        if ok:
+            _M_DISAGG_HANDOFFS.inc(outcome="ok")
+        _M_DISAGG_SECONDS.observe(dt)
+        _flight.record("kv_handoff", req_id=req.req_id, tokens=n_keep,
+                       blocks=nb_keep, bytes=nbytes, relayed=ok)
+        self._free_slot(slot, reason="handoff")
+
+    def _prefill_handoffs(self):
+        """The ``role="prefill"`` tail of a step (standing in for the
+        decode dispatch): flush pending first tokens (host sync — a
+        handoff must not outrun its stream's delivered prefix), then
+        spill every slot whose prefill completed. Mid-chunk slots keep
+        chunking; a request that finished ON its first token (budget 1
+        or eos) already freed its slot in the flush and never relays."""
+        emitted = []
+        if self._pending_adm:
+            adm, self._pending_adm = self._pending_adm, []
+            emitted += self._flush_adm(adm)
+        for slot in self._decode_slots():
+            if self.slot_req[slot] is not None:
+                self._handoff(slot)
+        return emitted
+
     def _finish_expired(self, req: Request, out: List[int],
                         queued: bool,
                         reason: str = "deadline_exceeded") -> None:
@@ -2050,6 +2163,36 @@ class LLMEngine:
                 self.queue.popleft()
                 self._swap_in(slot, req, self.swap_pool.pop(req.req_id))
                 continue
+            if self.relay is not None and req.relay_key is not None:
+                # disagg restore (r19): a prefill replica's relay entry
+                # stands in for the whole prefill — the same batched h2d
+                # scatter as a swap-in, bit-exact payload + scales. An
+                # entry that vanished with its replica, or whose pool
+                # names don't match this engine's (asymmetric draft
+                # configs), degrades to a full prefill of the identical
+                # context — streams match either way.
+                rent = self.relay.get(req.relay_key)
+                if rent is not None and set(rent.data) == set(self.pools) \
+                        and rent.n_tokens == len(req.prompt) \
+                        + len(req.generated) - 1:
+                    if self._avail_blocks() < max(1, rent.n_blocks):
+                        if not any(r is not None for r in self.slot_req) \
+                                and not self._squeezed \
+                                and not (self.offload is not None
+                                         and self.offload.held_blocks):
+                            raise RuntimeError(
+                                f"request {req.req_id}: relay restore "
+                                f"needs {rent.n_blocks} blocks but the "
+                                f"pool only has {self.nb - 1} usable")
+                        break            # blocks busy: wait for frees
+                    self.queue.popleft()
+                    self._swap_in(slot, req,
+                                  self.relay.pop(req.relay_key))
+                    _M_DISAGG_HANDOFFS.inc(outcome="restored")
+                    continue
+                self.relay.discard(req.relay_key)
+                req.relay_key = None
+                _M_DISAGG_HANDOFFS.inc(outcome="missing")
             ctx = req.prompt + req.generated   # re-admission continues
             true_len = len(ctx)
             nodes, cached_blocks = [], []
@@ -2486,12 +2629,13 @@ class LLMEngine:
     def _use_ragged(self) -> bool:
         """True when decode dispatches the ragged Pallas block-walk
         kernel: forced by ``decode_kernel="ragged"``, or picked by
-        ``"auto"`` on a TPU backend. Off-TPU ``auto`` keeps the bucketed
-        dense-gather path (the kernel would run interpreted), as does a
-        'tp' mesh (GSPMD can't partition the kernel); the choice is
-        counted per dispatch in serving_decode_kernel_total{path}."""
+        ``"auto"`` on a TPU backend — sharded or not (under a 'tp' mesh
+        the walk shard_maps over the KV heads, r19). Off-TPU ``auto``
+        keeps the bucketed dense-gather path (the kernel would run
+        interpreted); the choice is counted per dispatch in
+        serving_decode_kernel_total{path}."""
         return self.decode_kernel == "ragged" or (
-            self.decode_kernel == "auto" and self.mesh is None
+            self.decode_kernel == "auto"
             and jax.default_backend() == "tpu")
 
     def _decode_path(self) -> str:
@@ -2500,9 +2644,11 @@ class LLMEngine:
         ``"auto"`` on TPU at batch <= 4 where decode is launch-bound),
         ``"ragged"`` (the r12 block-walk kernel) or ``"bucketed"`` (the
         dense-gather fallback; the per-dispatch label refines to
-        ``dense`` at the full-width bucket). An ineligible mega pick
-        falls back to the ragged walk (bucketed off-TPU) and is COUNTED
-        in serving_mega_fallback_total{reason} — never silent."""
+        ``dense`` at the full-width bucket). An ineligible mega pick —
+        a 'tp' mesh included (reason="mesh": GSPMD cannot partition the
+        fused launch) — falls back to the ragged walk (bucketed
+        off-TPU) and is COUNTED in serving_mega_fallback_total{reason}
+        — never silent."""
         want_mega = (self.decode_kernel == "mega"
                      or (self.decode_kernel == "auto"
                          and self.mesh is None and self.N <= 4
@@ -2511,7 +2657,7 @@ class LLMEngine:
             ok, reason = mega_supported(
                 self.params, self.config, n_slots=self.N,
                 n_steps=self.decode_steps, block_size=self.bs,
-                kv_int8=self.kv_int8)
+                kv_int8=self.kv_int8, mesh=self.mesh)
             if ok:
                 return "mega"
             _M_MEGA_FALLBACK.inc(reason=reason)
@@ -2587,7 +2733,8 @@ class LLMEngine:
                                   kv_int8=self.kv_int8,
                                   numerics=self.kv_int8 and _nm.active(),
                                   ragged=(path == "ragged"),
-                                  mega=(path == "mega")),
+                                  mega=(path == "mega"),
+                                  mesh=self.mesh),
                 donate_argnums=(8,))
             _M_DECODE_RECOMPILES.inc()
         # path + traffic accounting (host ints — kept whether or not the
@@ -3087,6 +3234,11 @@ class LLMEngine:
         # already decoding)
         self._advance_chunks()
         self._admit()
+        if self.role == "prefill":
+            # disagg (r19): no decode ever dispatches here — slots whose
+            # prefill (chunked included) just completed hand their KV to
+            # the relay and their stream to a decode replica
+            return emitted + self._prefill_handoffs()
         if self._spec_on:
             active = self._decode_slots()
             if active and self._spec_eligible(active):

@@ -47,6 +47,8 @@ _M_SWAP_FALLBACK = _instrument("serving_kv_swap_fallback_total")
 _M_SWAP_BYTES = _instrument("serving_kv_swap_host_bytes")
 _M_PREFIX_BYTES = _instrument("serving_prefix_cache_host_bytes")
 _M_PREFIX_EVICT = _instrument("serving_prefix_cache_evictions_total")
+_M_RELAY_BYTES = _instrument("serving_disagg_kv_relay_bytes")
+_M_DISAGG_HANDOFFS = _instrument("serving_disagg_handoffs_total")
 
 
 class SwapEntry:
@@ -87,15 +89,23 @@ class HostKVPool:
     CAUSE marker — the caller's subsequent subtree drop still counts its
     ``kind="drop"`` per node), so a saturated prefix host tier is
     visible on a dashboard instead of silently degrading to drops.
+    ``"relay"`` (r19) is the disaggregated prefill→decode handoff tier
+    SHARED between replicas — it drives
+    ``serving_disagg_kv_relay_bytes``, and a capacity refusal counts
+    ``serving_disagg_handoffs_total{outcome="relay_full"}`` (the decode
+    replica then degrades to a full prefill of the handed-off context —
+    streams identical, the transfer saving is lost).
     """
 
     def __init__(self, capacity_bytes: int, kind: str = "swap"):
-        if kind not in ("swap", "prefix"):
-            raise ValueError(f"HostKVPool kind must be 'swap' or "
-                             f"'prefix', got {kind!r}")
+        if kind not in ("swap", "prefix", "relay"):
+            raise ValueError(f"HostKVPool kind must be 'swap', 'prefix' "
+                             f"or 'relay', got {kind!r}")
         self.capacity_bytes = int(capacity_bytes)
         self.kind = kind
-        self._g_bytes = _M_SWAP_BYTES if kind == "swap" else _M_PREFIX_BYTES
+        self._g_bytes = (_M_SWAP_BYTES if kind == "swap"
+                         else _M_PREFIX_BYTES if kind == "prefix"
+                         else _M_RELAY_BYTES)
         self._entries: Dict = {}
         self._bytes = 0
         # incrementally maintained population counts: block_accounting
@@ -115,8 +125,10 @@ class HostKVPool:
         self.refusals += 1
         if self.kind == "swap":
             _M_SWAP_FALLBACK.inc(reason="host_pool_full")
-        else:
+        elif self.kind == "prefix":
             _M_PREFIX_EVICT.inc(kind="drop_host_full")
+        else:
+            _M_DISAGG_HANDOFFS.inc(outcome="relay_full")
 
     # -- async-spill reservation protocol (r15) ---------------------------
     def reserve(self, rid, nbytes: int) -> bool:

@@ -85,6 +85,25 @@ replicas under a half-shared-prefix workload, kills one mid-stream
 reason, resumed streams are bit-identical to a clean single-engine
 greedy run, per-replica block ledgers balance at every step, and
 post-kill traffic rebalances onto the survivors.
+
+Disaggregated prefill/decode (r19): replicas built with
+``LLMEngine(..., role="prefill", relay=...)`` run admission + chunked
+prefill only — after the first sampled token the engine spills the
+slot's KV blocks bit-exact into the SHARED host relay pool
+(:class:`~paddle_tpu.serving.kv_swap.HostKVPool` with
+``kind="relay"``) and retires the stream with engine reason
+``"handoff"``. The router treats ``handoff`` as a ROUTING event, never
+a client terminal: the stream re-dispatches — same exactly-once resume
+path as failover — onto a decode-capable replica with
+``relay_key=<prefill engine rid>``, whose admission restores the
+relayed blocks with one batched h2d scatter instead of re-prefilling.
+Greedy streams stay token-identical to a colocated run
+(test-enforced). Degradations are counted, never silent: a full relay
+or a vanished entry means the decode replica re-prefills the
+handed-off context (``serving_disagg_handoffs_total{outcome=
+"relay_full"|"missing"}``); a prefill replica dying mid-handoff fails
+over through the normal from-prompt resume and its orphaned relay
+entry is discarded; no decode-capable replica left sheds the stream.
 """
 from __future__ import annotations
 
@@ -132,7 +151,10 @@ _M_TRANSITIONS = _instrument("serving_router_state_transitions_total")
 _M_HEALTHY = _instrument("serving_router_healthy_replicas")
 
 # terminal reasons a router stream may land in — same contract as the
-# engine's finish_reasons, shed included (router-level or replica-level)
+# engine's finish_reasons, shed included (router-level or replica-level).
+# The engine-level "handoff" reason (disagg prefill replicas) is NOT
+# here on purpose: it is a routing event — the stream resumes on a
+# decode replica and still ends in exactly one of these.
 TERMINAL_REASONS = frozenset(("finished", "shed", "deadline_exceeded",
                               "client_disconnected", "drained"))
 
@@ -169,7 +191,7 @@ class _StreamRec:
 
     __slots__ = ("rid", "prompt", "kw", "tenant", "max_new", "delivered",
                  "replica", "engine_rid", "resumes", "migrating",
-                 "cancelled", "done", "charged")
+                 "cancelled", "done", "charged", "relay_key")
 
     def __init__(self, rid: int, prompt: List[int], kw: Dict):
         self.rid = rid
@@ -184,6 +206,7 @@ class _StreamRec:
         self.resumes = 0
         self.migrating = False   # drain: next terminal resumes elsewhere
         self.cancelled = False   # client cancel: never resurrect
+        self.relay_key = None    # disagg: relay entry id (prefill erid)
         self.done = threading.Event()
 
 
@@ -204,6 +227,11 @@ class Replica:
         # replica is salvaged there, invisible to the router
         self.raw = (engine.engine if isinstance(engine, ResilientEngine)
                     else engine)
+        # disagg (r19): placement honors the engine's role — "prefill"
+        # replicas hand every stream off after prefill, "decode"
+        # replicas are last-resort prefill targets, "both" (default)
+        # serves the whole lifecycle
+        self.role = getattr(self.raw, "role", "both")
         self.stepper = (engine if isinstance(engine, ResilientEngine)
                         else ResilientEngine(engine) if resilient
                         else engine)
@@ -379,6 +407,7 @@ class ReplicaRouter:
         self.finish_reasons: Dict[int, str] = {}
         self.failovers = 0
         self.resumed_streams = 0
+        self.handoff_resumes = 0   # disagg: prefill→decode stream moves
         self.dedup_drops = 0
         self.affinity_hits = 0
         self.affinity_misses = 0
@@ -495,21 +524,33 @@ class ReplicaRouter:
         else:
             rep.load.pop(rec.tenant, None)
 
-    def _place(self, prompt: List[int], tenant: str, exclude: Set[str]
+    def _place(self, prompt: List[int], tenant: str, exclude: Set[str],
+               role_need: str = "prefill"
                ) -> Tuple[List[Replica], Optional[Dict]]:
         """Candidate replicas, best first. Affinity wins when any
         candidate holds >= 1 leading block of the prompt; otherwise a
         pending half-open probe takes the request (the circuit
         breaker's re-probe), then tenant-aware least-loaded order.
         Second return: the placement-audit record (candidate scores,
-        loads, decision reason) when observability is on, else None."""
+        loads, decision reason) when observability is on, else None.
+
+        ``role_need`` (disagg, r19): ``"prefill"`` — the stream starts
+        with a prefill, which EVERY role can run, but decode-role
+        replicas rank last (before affinity: a decode replica's trie
+        shadow must not pull fresh prompts onto it); ``"decode"`` — the
+        stream resumes from relayed KV, so prefill-role replicas are
+        excluded outright (they would hand off again, forever)."""
         with self._lock:
             cands = [rep for rep in self.replicas.values()
                      if rep.state in _PLACEABLE
-                     and rep.name not in exclude]
+                     and rep.name not in exclude
+                     and not (role_need == "decode"
+                              and rep.role == "prefill")]
             probe = next((rep for rep in self.replicas.values()
                           if rep.state == "half_open" and
-                          rep.probe_pending and rep.name not in exclude),
+                          rep.probe_pending and rep.name not in exclude
+                          and not (role_need == "decode"
+                                   and rep.role == "prefill")),
                          None)
             if not cands and probe is None:
                 return [], None
@@ -517,7 +558,9 @@ class ReplicaRouter:
             keys = self._block_keys(prompt, bs)
             scored = sorted(
                 cands,
-                key=lambda rep: (-self._affinity_score(rep, keys),
+                key=lambda rep: (role_need == "prefill"
+                                 and rep.role == "decode",
+                                 -self._affinity_score(rep, keys),
                                  rep.load.get(tenant, 0.0),
                                  sum(rep.load.values()),
                                  rep.name))
@@ -572,13 +615,13 @@ class ReplicaRouter:
         return rid
 
     def _dispatch(self, rec: _StreamRec, prompt: List[int], kw: Dict,
-                  exclude: Set[str]) -> None:
+                  exclude: Set[str], role_need: str = "prefill") -> None:
         """Place ``rec`` on the best candidate, walking down the
         preference order when a replica sheds or dies mid-op. Raises
         ShedError when every candidate refused."""
         last: Optional[ShedError] = None
         tried = set(exclude)
-        cands, audit = self._place(prompt, rec.tenant, tried)
+        cands, audit = self._place(prompt, rec.tenant, tried, role_need)
         if not cands:
             raise ShedError("no_healthy_replica")
         for rep in cands:
@@ -675,6 +718,7 @@ class ReplicaRouter:
     def _on_terminals(self, rep: Replica) -> None:
         eng = rep.raw
         resumes: List[_StreamRec] = []
+        handoffs: List[Tuple[_StreamRec, int]] = []
         with self._lock:
             for erid in list(rep.owned):
                 reason = eng.finish_reasons.get(erid)
@@ -686,12 +730,28 @@ class ReplicaRouter:
                 if erid == rep.probe_rid:
                     rep.probe_rid = None
                     if rep.state == "half_open":
-                        if reason == "finished":
+                        if reason in ("finished", "handoff"):
+                            # a prefill-role replica never finishes a
+                            # stream itself — a clean handoff is its
+                            # proof of life
                             self._transition(rep, "healthy")
                         else:
                             # shed/deadline proves nothing either way:
                             # offer another probe
                             rep.probe_pending = True
+                if reason == "handoff":
+                    # disagg (r19): the prefill leg is done — its KV sits
+                    # in the shared relay under this engine rid. The
+                    # stream continues on a decode-capable replica; this
+                    # is never a client-visible terminal.
+                    if rec.cancelled:
+                        relay = self._relay()
+                        if relay is not None:
+                            relay.discard(erid)
+                        self._terminal(rec, "client_disconnected")
+                    else:
+                        handoffs.append((rec, erid))
+                    continue
                 if rec.migrating and not rec.cancelled \
                         and reason == "drained":
                     rec.migrating = False
@@ -700,6 +760,9 @@ class ReplicaRouter:
                 self._terminal(rec, reason)
         for rec in resumes:
             self._resume(rec, exclude={rep.name})
+        for rec, erid in handoffs:
+            self._resume(rec, exclude=set(), relay_key=erid,
+                         role_need="decode")
 
     def _terminal(self, rec: _StreamRec, reason: str) -> None:
         """Exactly-once terminal bookkeeping (caller holds the lock)."""
@@ -832,11 +895,22 @@ class ReplicaRouter:
                     self._transition(rep, "suspect")
 
     # -- failover / resume -------------------------------------------------
+    def _relay(self):
+        """The shared disagg relay pool, discovered from whichever
+        replica engine carries one (they all share the SAME pool by
+        construction); ``None`` on a non-disagg fleet."""
+        for rep in self.replicas.values():
+            r = getattr(rep.raw, "relay", None)
+            if r is not None:
+                return r
+        return None
+
     def _failover(self, rep: Replica) -> None:
         """Re-dispatch every stream the dead replica owned: ``prompt +
         delivered`` becomes the new prompt, the remaining budget the new
         ``max_new_tokens``. The dead replica's engine rids become ghosts
         so late emissions dedupe instead of double-delivering."""
+        relay = self._relay()
         with self._lock:
             moved = []
             for erid, rrid in list(rep.owned.items()):
@@ -845,6 +919,13 @@ class ReplicaRouter:
                 rec = self._streams[rrid]
                 self._unload(rep, rec)
                 moved.append(rec)
+                # a prefill replica dying between relay.put and the
+                # router observing "handoff" leaves its spilled KV
+                # orphaned under this erid — the stream re-dispatches
+                # from the prompt, so the entry is dead weight (no-op
+                # when nothing was spilled)
+                if relay is not None:
+                    relay.discard(erid)
             # its trie is unreachable until revive+recovery clears it
             rep.prefix_keys.clear()
             rep.load.clear()
@@ -859,32 +940,64 @@ class ReplicaRouter:
                 continue
             self._resume(rec, exclude={rep.name})
 
-    def _resume(self, rec: _StreamRec, exclude: Set[str]) -> None:
+    def _resume(self, rec: _StreamRec, exclude: Set[str],
+                relay_key: Optional[int] = None,
+                role_need: str = "prefill") -> None:
         """Exactly-once stream resume on a healthy replica. Greedy
         determinism + the replayed-as-prefill overlap make the resumed
-        stream token-identical to an uninterrupted run."""
+        stream token-identical to an uninterrupted run.
+
+        Disagg (r19): a handoff resume passes ``relay_key`` (the
+        prefill replica's engine rid, the relay entry's key) and
+        ``role_need="decode"`` — the kw COPY sent to the decode replica
+        carries the key, ``rec.kw`` never does (a later failover must
+        re-prefill, not chase a consumed relay entry). A plain resume
+        (``relay_key=None``) discards any relay entry still parked
+        under the stream's old handoff key."""
         remaining = rec.max_new - len(rec.delivered)
+        relay = (self._relay()
+                 if relay_key is not None or rec.relay_key is not None
+                 else None)
+        if relay_key is None and rec.relay_key is not None:
+            # re-prefilling from the prompt: a relay entry the decode
+            # replica never consumed (it died first) is unreachable now
+            if relay is not None:
+                relay.discard(rec.relay_key)
+            rec.relay_key = None
         if remaining <= 0:
+            if relay_key is not None and relay is not None:
+                relay.discard(relay_key)
             with self._lock:
                 self._terminal(rec, "finished")
             return
         prompt = rec.prompt + rec.delivered
         kw = dict(rec.kw)
         kw["max_new_tokens"] = remaining
+        if relay_key is not None:
+            kw["relay_key"] = relay_key
+            rec.relay_key = relay_key
         # an eos the dead replica already emitted would have finished
         # there; the resumed request keeps the same stopping rule
         rec.resumes += 1
-        self.resumed_streams += 1
-        _M_RESUMED.inc()
+        if relay_key is None:
+            self.resumed_streams += 1
+            _M_RESUMED.inc()
+        else:
+            self.handoff_resumes += 1
         prev_replica, prev_erid = rec.replica, rec.engine_rid
         try:
             retry_call(self._dispatch, rec, prompt, kw, exclude,
-                       retries=2, base_delay=0.05,
+                       role_need, retries=2, base_delay=0.05,
                        exceptions=(TimeoutError,),
                        sleep=self._retry_sleep)
         except ShedError:
             # nowhere to resume: the stream ends in exactly one terminal
-            # reason — shed — with its partial tokens delivered
+            # reason — shed — with its partial tokens delivered. A
+            # disagg fleet with no decode-capable replica left lands
+            # here (the documented degradation); its relay entry goes
+            # with it.
+            if relay_key is not None and relay is not None:
+                relay.discard(relay_key)
             with self._lock:
                 self._terminal(rec, "shed")
             self.router_sheds += 1
@@ -892,6 +1005,8 @@ class ReplicaRouter:
         except (ValueError, RuntimeError) as e:
             # resumed prompt no longer fits (model-len/bucket bound) or
             # every candidate died under the op: terminal, never a hang
+            if relay_key is not None and relay is not None:
+                relay.discard(relay_key)
             _flight.record("router_resume_failed", rid=rec.rid,
                            error=repr(e)[:120])
             with self._lock:
@@ -901,16 +1016,18 @@ class ReplicaRouter:
         else:
             # failover-continuous tracing (r17): graft the old leg's
             # timeline onto the resumed engine rid, so the client's ONE
-            # stream stays ONE trace — with a structured failover hop —
-            # through the kill. Old-rid lookups alias forward; the dead
-            # replica's zombie writes hit an unknown rid and no-op.
+            # stream stays ONE trace — with a structured failover (or
+            # disagg-handoff) hop — through the move. Old-rid lookups
+            # alias forward; the dead replica's zombie writes hit an
+            # unknown rid and no-op.
             if _obs.enabled() and prev_erid is not None:
                 grafted = _rt.get_request_tracer().reassign(
                     prev_erid, rec.engine_rid,
                     **{"from": prev_replica, "to": rec.replica,
                        "delivered": len(rec.delivered)})
                 _flight.record(
-                    "router_failover", rid=rec.rid,
+                    "router_handoff" if relay_key is not None
+                    else "router_failover", rid=rec.rid,
                     **{"from": prev_replica, "to": rec.replica,
                        "delivered": len(rec.delivered),
                        "trace_grafted": bool(grafted)})

@@ -243,11 +243,54 @@ def test_mega_supported_envelope(model):
     assert not ok and reason == "vmem"
 
 
-def test_engine_mega_mesh_rejected(model):
-    """decode_kernel="mega" must fail loudly under a tp mesh, like
-    ragged (GSPMD cannot partition the fused kernel)."""
+def test_engine_mega_mesh_path_choice_counted(model):
+    """Fast-lane half of the mesh contract: a mega engine under a tp
+    mesh constructs (the r18 ValueError is gone) and its path choice
+    bows out counted with reason="mesh" — no decode dispatch needed."""
+    import paddle_tpu.observability as obs
+    from jax.sharding import Mesh
+
     cfg, params = model
-    with pytest.raises(ValueError, match="mesh"):
-        LLMEngine(params, cfg, max_slots=2, block_size=8,
-                  max_model_len=64, decode_kernel="mega",
-                  mesh="not-none-sentinel")
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("tp",))
+    obs.get_registry().reset()
+    obs.enable()
+    try:
+        eng = LLMEngine(params, cfg, max_slots=2, block_size=8,
+                        max_model_len=64, prompt_buckets=[8, 32],
+                        decode_steps=3, decode_kernel="mega", mesh=mesh)
+        assert eng._decode_path() != "mega"
+        assert obs.get_registry().counter("serving_mega_fallback_total") \
+            .labels(reason="mesh").value >= 1
+    finally:
+        obs.disable()
+        obs.get_registry().reset()
+
+
+def test_engine_mega_mesh_counted_fallback(model):
+    """r19: decode_kernel="mega" under a tp mesh no longer raises — it
+    bows out COUNTED (reason="mesh", the fused kernel cannot be
+    shard_mapped) and serves the tp-sharded ragged/bucketed walk with
+    the same stream as an unmeshed non-mega engine."""
+    import paddle_tpu.observability as obs
+    from jax.sharding import Mesh
+
+    cfg, params = model
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(1, 64, size=6).tolist()
+    ref, _ = _streams(params, cfg, "bucketed", [prompt], [4])
+
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("tp",))
+    obs.get_registry().reset()
+    obs.enable()
+    try:
+        out, eng = _streams(params, cfg, "mega", [prompt], [4],
+                            mesh=mesh)
+        reg = obs.get_registry()
+        assert reg.counter("serving_mega_fallback_total") \
+            .labels(reason="mesh").value >= 1
+        assert reg.counter("serving_decode_kernel_total") \
+            .labels(path="mega").value == 0
+        assert out == ref
+    finally:
+        obs.disable()
+        obs.get_registry().reset()

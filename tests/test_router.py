@@ -21,6 +21,16 @@ Contracts under test:
   already-terminal rid — or double-finishing one — is a COUNTED no-op
   (``cancel_noops`` / serving_cancel_noop_total), never a KeyError or
   a double-free.
+
+r19 adds the disaggregated prefill/decode contracts: a prefill+decode
+pair behind the router streams bit-identically to one colocated engine
+(f32 and int8-KV) with every stream handed off exactly once through
+the relay pool (drained to zero afterwards), placement respects roles
+(fresh submits avoid decode-role, post-handoff resumes never land on
+prefill-role), and killing EITHER the prefill or the decode replica
+mid-flight still finishes every stream with clean parity — a
+failed-over stream re-prefills on a prefill replica and hands off
+again.
 """
 import dataclasses
 import os
@@ -400,3 +410,171 @@ def test_chaos_run_router():
     assert proc.returncode == 0, out[-2000:]
     assert "ROUTER_CHAOS: OK" in out
     assert "failovers=" in out and "resumed=" in out
+    assert "handoffs=" in out and "handoff_resumes=" in out
+
+
+# ---------------------------------------------------------------------------
+# r19: disaggregated prefill/decode
+# ---------------------------------------------------------------------------
+def _disagg_router(params, cfg, roles, engine_kw=None):
+    """A role-assigned fleet sharing one relay pool. ``roles`` is an
+    ordered name->role mapping; returns (router, relay, engines)."""
+    from paddle_tpu.serving.kv_swap import HostKVPool
+
+    relay = HostKVPool(1 << 30, kind="relay")
+    engines = [_engine(params, cfg, role=role, relay=relay,
+                       **(engine_kw or {}))
+               for role in roles.values()]
+    r = ReplicaRouter(engines, names=list(roles))
+    r.start()
+    return r, relay, engines
+
+
+@pytest.mark.parametrize("variant", ["f32", "bf16", "f32_int8kv"])
+def test_disagg_pair_matches_colocated_greedy(model, variant):
+    """1 prefill + 1 decode replica behind the router: every stream is
+    handed off exactly once (prefill emits t1, KV travels through the
+    relay, decode resumes with relay_key) and the spliced streams are
+    token-identical to one colocated engine — the relay payload
+    (bf16 or int8+scales) restores bit-exact, so the decode replica's
+    math is the colocated engine's math. The relay pool drains to
+    zero — no leaked handoff payloads."""
+    cfg, params = model
+    ekw = {}
+    if variant == "f32_int8kv":
+        ekw = {"kv_dtype": "int8"}
+    elif variant == "bf16":
+        cfg = dataclasses.replace(cfg, dtype=jnp.bfloat16)
+        params = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.bfloat16), params)
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, 64, size=6).tolist() for _ in range(4)]
+
+    ref = _engine(params, cfg, **ekw)
+    ref_ids = [ref.add_request(list(p), max_new_tokens=12) for p in prompts]
+    ref_out = ref.run()
+
+    router, relay, engines = _disagg_router(
+        params, cfg, {"p0": "prefill", "d0": "decode"}, engine_kw=ekw)
+    try:
+        rids = [router.submit(list(p), max_new_tokens=12) for p in prompts]
+        outs = {rid: router.wait(rid, timeout=120) for rid in rids}
+        for rid, refid in zip(rids, ref_ids):
+            assert router.finish_reasons[rid] == "finished"
+            assert outs[rid] == ref_out[refid], (outs[rid], ref_out[refid])
+        # one handoff per stream, all through the relay, all consumed
+        assert router.handoff_resumes == len(prompts)
+        assert router.resumed_streams == 0        # no failure resumes
+        p_eng, d_eng = engines
+        assert p_eng.handoffs == len(prompts)
+        assert p_eng.handoff_bytes > 0
+        assert d_eng.handoffs == 0                # decode never prefills
+        assert len(relay) == 0
+    finally:
+        router.stop()
+
+
+def test_disagg_decode_replica_kill_recovers_with_parity(model):
+    """Kill a decode replica mid-decode: its streams fail over, which
+    means a fresh prefill on a PREFILL-role replica and a SECOND
+    handoff back to the surviving decode replica — streams still match
+    the colocated reference and the relay drains."""
+    cfg, params = model
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(1, 64, size=6).tolist() for _ in range(6)]
+    ref = _engine(params, cfg)
+    ref_ids = [ref.add_request(list(p), max_new_tokens=16) for p in prompts]
+    ref_out = ref.run()
+
+    roles = {"p0": "prefill", "p1": "prefill", "d0": "decode",
+             "d1": "decode"}
+    router, relay, _ = _disagg_router(params, cfg, roles)
+    try:
+        rids = [router.submit(list(p), max_new_tokens=16) for p in prompts]
+        deadline = time.monotonic() + 30
+        victim = None
+        while time.monotonic() < deadline and victim is None:
+            with router._lock:
+                for rec in router._streams.values():
+                    if rec.replica in ("d0", "d1") \
+                            and not rec.done.is_set() \
+                            and len(rec.delivered) >= 3:
+                        victim = rec.replica
+                        break
+            time.sleep(0.002)
+        assert victim is not None, "no stream ever decoded on decode-role"
+        router.kill_replica(victim)
+        outs = {rid: router.wait(rid, timeout=120) for rid in rids}
+        for rid, refid in zip(rids, ref_ids):
+            assert router.finish_reasons[rid] == "finished", \
+                (rid, router.finish_reasons[rid])
+            assert outs[rid] == ref_out[refid]
+        assert router.failovers >= 1
+        # failed-over streams re-prefill and hand off AGAIN
+        assert router.handoff_resumes > len(prompts)
+        assert len(relay) == 0
+    finally:
+        router.stop()
+
+
+def test_disagg_prefill_replica_kill_recovers_with_parity(model):
+    """Kill a prefill replica while it still owns streams: orphaned
+    relay entries are discarded (never replayed stale) and the streams
+    re-prefill elsewhere with clean parity."""
+    cfg, params = model
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(1, 64, size=6).tolist() for _ in range(6)]
+    ref = _engine(params, cfg)
+    ref_ids = [ref.add_request(list(p), max_new_tokens=16) for p in prompts]
+    ref_out = ref.run()
+
+    roles = {"p0": "prefill", "p1": "prefill", "d0": "decode",
+             "d1": "decode"}
+    router, relay, _ = _disagg_router(params, cfg, roles)
+    try:
+        rids = [router.submit(list(p), max_new_tokens=16) for p in prompts]
+        deadline = time.monotonic() + 30
+        victim = None
+        while time.monotonic() < deadline and victim is None:
+            with router._lock:
+                for rep in router.replicas.values():
+                    if rep.role == "prefill" and rep.owned:
+                        victim = rep.name
+                        break
+            time.sleep(0.001)
+        assert victim is not None, "prefill replicas never owned a stream"
+        router.kill_replica(victim)
+        outs = {rid: router.wait(rid, timeout=120) for rid in rids}
+        for rid, refid in zip(rids, ref_ids):
+            assert router.finish_reasons[rid] == "finished", \
+                (rid, router.finish_reasons[rid])
+            assert outs[rid] == ref_out[refid]
+        assert len(relay) == 0
+    finally:
+        router.stop()
+
+
+def test_disagg_placement_respects_roles(model):
+    """Fresh submits land on the prefill replica even when the decode
+    replica is less loaded, and the post-handoff resume hard-filters
+    prefill-role — d_eng does all the decoding, p_eng none of it."""
+    cfg, params = model
+    router, relay, engines = _disagg_router(
+        params, cfg, {"p0": "prefill", "d0": "decode"})
+    p_eng, d_eng = engines
+    try:
+        rng = np.random.default_rng(1)
+        rids = [router.submit(rng.integers(1, 64, size=5).tolist(),
+                              max_new_tokens=8) for _ in range(3)]
+        for rid in rids:
+            router.wait(rid, timeout=120)
+            assert router.finish_reasons[rid] == "finished"
+        assert p_eng.handoffs == len(rids)     # every prefill spilled here
+        assert d_eng.handoffs == 0
+        # every stream ended life on the decode-role replica
+        with router._lock:
+            assert all(router._streams[rid].replica == "d0"
+                       for rid in rids)
+        assert len(relay) == 0
+    finally:
+        router.stop()

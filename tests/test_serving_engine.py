@@ -243,6 +243,97 @@ def test_tp_sharded_int8_engine_matches_unsharded(qmodel):
         assert out[a] == out0[b]
 
 
+@pytest.mark.parametrize("variant", ["f32", "bf16", "int8kv"])
+def test_tp_sharded_ragged_decode_matches_unsharded(model, variant):
+    """r19 tentpole: the RAGGED decode hot path under a 2-device 'tp'
+    mesh — each per-layer decode partial runs inside shard_map with the
+    KV heads split across the mesh. Per-kv-head online softmax is
+    device-local, so the sharded partials (and therefore the streams)
+    are bit-identical to the unsharded ragged engine. bf16 rides the
+    same caveat as spec parity: the row-parallel contraction splits
+    into per-shard partials + psum, so a knife-edge argmax tie can
+    resolve differently — the bf16 workload is pinned to a decisive
+    one (seed sweep: 0-9 flip-free, 11 hits a tie)."""
+    from jax.sharding import Mesh
+
+    cfg, params = model
+    ekw = {}
+    seed = 11
+    if variant == "int8kv":
+        ekw = {"kv_dtype": "int8"}
+    elif variant == "bf16":
+        cfg = dataclasses.replace(cfg, dtype=jnp.bfloat16)
+        params = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.bfloat16), params)
+        seed = 5
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(1, 64, size=n).tolist() for n in (3, 9, 14)]
+    n_new = [6, 5, 4]
+
+    def run(mesh):
+        eng = LLMEngine(params, cfg, max_slots=3, block_size=8,
+                        max_model_len=64, prompt_buckets=[8, 32],
+                        decode_steps=3, decode_kernel="ragged",
+                        mesh=mesh, **ekw)
+        ids = [eng.add_request(list(p), max_new_tokens=k)
+               for p, k in zip(prompts, n_new)]
+        out = eng.run()
+        return [out[r] for r in ids]
+
+    base = run(None)
+    assert run(Mesh(np.asarray(jax.devices()[:2]), ("tp",))) == base
+
+
+def test_tp_sharded_ragged_int8_weights_matches_unsharded(qmodel):
+    """int8 weight-only serving on the shard_mapped ragged path: the
+    Megatron-sharded qweights+scales compose with the tp-sharded KV
+    walk, streams identical to the unsharded int8 ragged engine."""
+    from jax.sharding import Mesh
+
+    cfg, qp = qmodel
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(1, 64, size=n).tolist() for n in (5, 13)]
+
+    def run(mesh):
+        eng = LLMEngine(qp, cfg, max_slots=2, block_size=8,
+                        max_model_len=64, prompt_buckets=[8, 32],
+                        decode_steps=3, decode_kernel="ragged",
+                        mesh=mesh)
+        ids = [eng.add_request(list(p), max_new_tokens=5)
+               for p in prompts]
+        out = eng.run()
+        return [out[r] for r in ids]
+
+    assert run(None) == run(Mesh(np.asarray(jax.devices()[:2]), ("tp",)))
+
+
+def test_tp_sharded_prefix_cache_chunked_matches_unsharded(model):
+    """Prefix cache + chunked prefill + int8 KV under the tp mesh: the
+    cache-hit resume (restored blocks, suffix-only prefill) stays
+    bit-identical to the unsharded run — sharded pools scatter/gather
+    along unsharded axes, so cached payloads are mesh-agnostic."""
+    from jax.sharding import Mesh
+
+    cfg, params = model
+    rng = np.random.default_rng(5)
+    long_p = rng.integers(1, 64, size=26).tolist()
+
+    def run(mesh):
+        eng = LLMEngine(params, cfg, max_slots=2, block_size=8,
+                        max_model_len=64, prompt_buckets=[8, 32],
+                        decode_steps=2, kv_dtype="int8",
+                        prefix_cache=True, prefill_chunk=8,
+                        decode_kernel="ragged", mesh=mesh)
+        r1 = eng.add_request(list(long_p), max_new_tokens=4)
+        eng.run()
+        r2 = eng.add_request(list(long_p), max_new_tokens=4)
+        out = eng.run()
+        assert eng.prefix_cache.hits >= 1
+        return out[r1], out[r2]
+
+    assert run(None) == run(Mesh(np.asarray(jax.devices()[:2]), ("tp",)))
+
+
 # ---------------------------------------------------------------------------
 # int8 KV pools
 # ---------------------------------------------------------------------------
@@ -384,10 +475,16 @@ def test_obs_dump_demo_serving_smoke(tmp_path):
     assert "dispatches fleet-wide 4" in out, out[-2000:]
     assert "fleet: 2 replica(s), 2 healthy" in out, out[-2000:]
     assert "ttft_p95" in out and "burn" in out   # dashboard columns
+    assert "role" in out                         # r19 disagg role column
+    # r19: the disagg mini-fleet hands both streams prefill→decode —
+    # every spill restored, relay drained back to zero bytes
+    assert "disagg handoff: ok=2 restored=2" in out, out[-2000:]
+    assert "relay_bytes=0 handoff_resumes=2" in out, out[-2000:]
     # r7: the demo ends with the per-request table + exemplar pointer
-    # (12 rows: the original four + the r10 cache hit + the r13 spec
-    # engine's two + the r14 HTTP round-trip + the r17 router's four)
-    assert "requests: 12 traced" in out, out[-2000:]
+    # (14 rows: the original four + the r10 cache hit + the r13 spec
+    # engine's two + the r14 HTTP round-trip + the r17 router's four +
+    # the r19 disagg pair)
+    assert "requests: 14 traced" in out, out[-2000:]
     assert "ttft_ms" in out and "preempt" in out and "cached" in out
     assert "tenant" in out                           # r14 tenant column
     assert "shed" in out and "deadline" in out     # reason column

@@ -124,6 +124,28 @@ def test_spec_parity_with_small_draft(model, small_draft):
     assert eng.spec_waves > 0
 
 
+def test_spec_tp_sharded_parity(model):
+    """r19: spec decode under a 2-device 'tp' mesh — target runs the
+    shard_mapped ragged walk with KV heads split, the draft is
+    replicated — and the verified streams stay equal to the unsharded
+    spec streams (which are themselves the plain greedy streams)."""
+    from jax.sharding import Mesh
+
+    cfg, params = model
+    prompts = _prompts(6, (4, 9, 15))
+    n_new = (8, 7, 10)
+
+    def run(mesh):
+        out, eng = _run(params, cfg, prompts, n_new,
+                        decode_kernel="ragged", mesh=mesh,
+                        draft_params=params, draft_config=cfg,
+                        spec_tokens=3)
+        assert eng.spec_waves > 0
+        return out
+
+    assert run(None) == run(Mesh(np.asarray(jax.devices()[:2]), ("tp",)))
+
+
 def test_spec_parity_with_eos(model):
     """Per-request eos: the chained decode path refuses to pipeline
     with an eos set; the spec wave composes with it — an eos emitted

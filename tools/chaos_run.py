@@ -79,6 +79,15 @@ the router's step hook), post-kill traffic lands only on survivors, the
 revived victim rejoins through the half-open probe, and a full drain
 leaves every replica's ledger clean.
 
+The router run ends with a DISAGG phase (r19): a fresh 2-prefill +
+2-decode fleet over one shared host relay takes the same offered load;
+a seeded prefill replica is killed while it still owns streams (orphan
+relay entries discarded, streams re-prefilled from the prompt), then a
+seeded decode replica is killed mid-decode on relayed KV. Every stream
+must finish token-identical to a clean COLOCATED single-engine run,
+the per-replica ledgers balance at every step, and the relay pool
+drains back to zero entries.
+
     JAX_PLATFORMS=cpu python tools/chaos_run.py --router --requests 12 --seed 7
 
 Any failed run prints a one-line ``repro: chaos_run --<mode> --seed N
@@ -955,6 +964,196 @@ def router_main(args):
           f"cancel_noops={noops} ledger_checks_per_replica="
           f"{ {n: rep.steps for n, rep in router.replicas.items()} }")
     router.stop()
+
+    # ---- disaggregated prefill/decode phase (r19) -------------------------
+    # A fresh 4-replica fleet: 2 prefill-role + 2 decode-role replicas
+    # over ONE shared host relay. Two seeded kills: a prefill replica
+    # while it still owns streams (some may sit spilled in the relay,
+    # unobserved by the router — those entries must be discarded, the
+    # streams re-prefilled from the prompt), then a decode replica
+    # mid-decode on relayed KV (failover re-prefills prompt+delivered).
+    # Asserted: every stream finishes exactly once, token-identical to
+    # a clean COLOCATED single-engine greedy run; per-replica 5-term
+    # ledgers balance at every step; the relay pool drains to zero.
+    from paddle_tpu.serving.kv_swap import HostKVPool
+
+    print()
+    drng = np.random.default_rng(args.seed + 1)
+    relay = HostKVPool(1 << 30, kind="relay")
+
+    def mk_role(role):
+        return LLMEngine(params, cfg, max_slots=2, block_size=8,
+                         max_model_len=64, prompt_buckets=[8, 48],
+                         role=role, relay=relay)
+
+    droles = {"p0": "prefill", "p1": "prefill",
+              "d0": "decode", "d1": "decode"}
+    d_engines = {n: mk_role(r) for n, r in droles.items()}
+    # warm compile caches before the step threads exist; a prefill-role
+    # warmup hands its KV off — drop those entries, they have no
+    # consumer
+    for eng in d_engines.values():
+        w1 = eng.add_request(wrng.integers(1, 64, size=6).tolist(),
+                             max_new_tokens=4)
+        w2 = eng.add_request(wrng.integers(1, 64, size=20).tolist(),
+                             max_new_tokens=4)
+        eng.run()
+        relay.discard(w1)
+        relay.discard(w2)
+    if len(relay):
+        print(f"warmup left {len(relay)} relay entries behind")
+        ok = False
+
+    d_violations = []
+
+    def d_ledger_hook(name, eng):
+        acct = eng.block_accounting()
+        if acct["free"] + acct["backed"] + acct["cached"] \
+                + acct["squeezed"] + acct.get("in_flight", 0) \
+                != acct["total"]:
+            d_violations.append((name, eng._step_idx, acct))
+
+    drouter = ReplicaRouter(list(d_engines.values()),
+                            names=list(d_engines),
+                            step_hook=d_ledger_hook,
+                            suspect_s=15.0, dead_s=30.0, halfopen_s=0.2)
+    drouter.start()
+
+    dworkload = []
+    for _ in range(args.requests):
+        prompt = drng.integers(
+            1, 64, size=int(drng.integers(4, 12))).tolist()
+        dworkload.append((prompt, int(drng.integers(8, 16))))
+    dfirst = dworkload[:max(2, args.requests // 2)]
+    drest = dworkload[len(dfirst):]
+    drids = [drouter.submit(list(p), max_new_tokens=n)
+             for p, n in dfirst]
+
+    # seeded prefill-replica kill: the handoff machinery must be LIVE
+    # (>= 1 spill already happened) and the victim must still own
+    # streams — those die before their own handoff and re-prefill
+    p_victim = None
+    deadline = time.monotonic() + 30
+    while p_victim is None and time.monotonic() < deadline:
+        with drouter._lock:
+            owners = sorted(n for n, rep in drouter.replicas.items()
+                            if droles[n] == "prefill" and rep.owned)
+        spilled = sum(d_engines[n].handoffs for n, r in droles.items()
+                      if r == "prefill")
+        if spilled >= 1 and owners:
+            p_victim = owners[int(drng.integers(0, len(owners)))]
+        time.sleep(0.001)
+    if p_victim is None:
+        print("no prefill replica ever owned a stream post-handoff")
+        ok = False
+        p_victim = "p0"
+    print(f"disagg: killing prefill replica {p_victim} mid-handoff "
+          f"(handoffs so far: "
+          f"{ {n: d_engines[n].handoffs for n in ('p0', 'p1')} })")
+    drouter.kill_replica(p_victim)
+
+    drids += [drouter.submit(list(p), max_new_tokens=n)
+              for p, n in drest]
+
+    # seeded decode-replica kill: a stream must be decoding ON relayed
+    # KV (owner is a decode replica, >= 2 tokens out — the handoff
+    # token plus at least one decoded there)
+    d_victim = None
+    deadline = time.monotonic() + 30
+    while d_victim is None and time.monotonic() < deadline:
+        with drouter._lock:
+            live = sorted({rec.replica
+                           for rec in drouter._streams.values()
+                           if rec.replica in ("d0", "d1")
+                           and not rec.done.is_set()
+                           and len(rec.delivered) >= 2})
+        if live:
+            d_victim = live[int(drng.integers(0, len(live)))]
+        time.sleep(0.001)
+    if d_victim is None:
+        print("no stream was ever mid-decode on a decode replica")
+        ok = False
+        d_victim = "d0"
+    print(f"disagg: killing decode replica {d_victim} post-handoff")
+    drouter.kill_replica(d_victim)
+
+    deadline = time.monotonic() + 120
+    pending = list(drids)
+    while pending and time.monotonic() < deadline:
+        pending = [rid for rid in pending
+                   if not drouter._streams[rid].done.is_set()]
+        drouter.check()
+        time.sleep(0.02)
+    for rid in drids:
+        drouter.wait(rid, timeout=max(0.0,
+                                      deadline - time.monotonic()))
+
+    dreasons = {rid: drouter.finish_reasons.get(rid) for rid in drids}
+    dcounts = {}
+    for r in dreasons.values():
+        dcounts[r] = dcounts.get(r, 0) + 1
+    total_handoffs = sum(e.handoffs for e in d_engines.values())
+    print(f"disagg chaos: {len(drids)} offered, {dcounts} | "
+          f"handoffs={total_handoffs} "
+          f"handoff_resumes={drouter.handoff_resumes} "
+          f"failovers={drouter.failovers} "
+          f"resumed={drouter.resumed_streams} relay_len={len(relay)}")
+
+    # exactly-once, and in THIS phase (no overload, no cancels, two
+    # survivors) every stream must land in "finished"
+    if any(dreasons.get(rid) != "finished" for rid in drids):
+        print(f"disagg streams not all finished: {dcounts}")
+        ok = False
+    if total_handoffs < 1 or drouter.handoff_resumes < 1:
+        print("the disagg fleet never handed a stream off")
+        ok = False
+    if drouter.failovers < 1:
+        print("neither kill orphaned a live stream")
+        ok = False
+
+    # greedy parity: disagg + two kills must equal a clean COLOCATED
+    # single-engine run of the same workload, token for token
+    dref = mk_engine()
+    dref_ids = [dref.add_request(list(p), max_new_tokens=n)
+                for p, n in dworkload]
+    dref_out = dref.run()
+    for rid, refid in zip(drids, dref_ids):
+        if dreasons.get(rid) != "finished":
+            continue
+        if drouter.results[rid] != dref_out[refid]:
+            print(f"disagg request {rid} diverged from the colocated "
+                  f"run: {drouter.results[rid]} != {dref_out[refid]}")
+            ok = False
+
+    # the relay must drain: every spill was either restored on a decode
+    # replica or discarded on the failover path — an entry left behind
+    # is a leak
+    if len(relay):
+        print(f"relay pool not drained: {len(relay)} entries, "
+              f"{relay.bytes_used} bytes")
+        ok = False
+    if not drouter.drain_all(timeout=60):
+        print("disagg drain never completed")
+        ok = False
+    for name, rep in drouter.replicas.items():
+        if name in (p_victim, d_victim):
+            continue       # dead mid-flight: recovered only on revive
+        acct = rep.raw.block_accounting()
+        if not (acct["free"] + acct["cached"] == acct["total"]
+                and acct["backed"] == 0 and acct["squeezed"] == 0):
+            print(f"disagg replica {name} drained ledger not clean: "
+                  f"{acct}")
+            ok = False
+    if drouter.live_streams():
+        print(f"disagg streams survived the drain: "
+              f"{drouter.live_streams()}")
+        ok = False
+    if d_violations:
+        print(f"disagg per-replica ledger violations: "
+              f"{d_violations[:3]}")
+        ok = False
+    print(f"disagg post-drain states: {drouter.states()}")
+    drouter.stop()
 
     if not ok:
         print(_repro(args, "router"))

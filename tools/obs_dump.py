@@ -210,8 +210,8 @@ def requests_mode(src, sort, watch, interval):
 
 def print_fleet_table(doc, out=sys.stdout):
     """Render a ``/fleet/replicas.json`` payload: one row per replica
-    (state, streams, queue/slots, tokens, p95 latencies, cache hit
-    rate, SLO burn) plus the fleet totals line."""
+    (state, disagg role, streams, queue/slots, tokens, p95 latencies,
+    cache hit rate, SLO burn) plus the fleet totals line."""
     rows = doc.get("replicas") or []
     totals = doc.get("totals") or {}
     out.write(f"fleet: {totals.get('replicas', len(rows))} replica(s), "
@@ -223,9 +223,9 @@ def print_fleet_table(doc, out=sys.stdout):
         out.write("(no replicas in view — run a router with "
                   "observability enabled)\n")
         return rows
-    hdr = (f"{'replica':>8} {'state':>9} {'hb_age':>7} {'streams':>7} "
-           f"{'queue':>5} {'slots':>5} {'tokens':>7} {'ttft_p95':>9} "
-           f"{'tpot_p95':>9} {'cache':>6} {'burn':>6}\n")
+    hdr = (f"{'replica':>8} {'state':>9} {'role':>7} {'hb_age':>7} "
+           f"{'streams':>7} {'queue':>5} {'slots':>5} {'tokens':>7} "
+           f"{'ttft_p95':>9} {'tpot_p95':>9} {'cache':>6} {'burn':>6}\n")
     out.write(hdr)
     out.write("-" * (len(hdr) - 1) + "\n")
     for r in rows:
@@ -238,6 +238,7 @@ def print_fleet_table(doc, out=sys.stdout):
         out.write(
             f"{str(r.get('replica')):>8} "
             f"{str(r.get('state') or '-'):>9} "
+            f"{str(r.get('role') or '-'):>7} "
             f"{_fmt_ms(r.get('hb_age_s')):>7} "
             f"{r.get('streams', 0):>7} "
             f"{r.get('queue_depth', 0):>5} "
@@ -458,10 +459,10 @@ def demo_serving():
     from paddle_tpu.observability import fleet as _fleet
     from paddle_tpu.serving import ReplicaRouter
 
-    def _mk():
+    def _mk(**kw):
         return LLMEngine(llama.init_params(cfg, jax.random.PRNGKey(0)),
                          cfg, max_slots=2, block_size=8, max_model_len=64,
-                         prompt_buckets=[8, 32])
+                         prompt_buckets=[8, 32], **kw)
 
     router = ReplicaRouter([_mk(), _mk()], idle_wait=0.001).start()
     shared = rng.integers(1, 64, size=16).tolist()
@@ -480,6 +481,35 @@ def demo_serving():
           f"{int(fleet_tokens)}")
     print_fleet_table(fdoc)
     router.stop()
+
+    # r19: disaggregated prefill/decode — one prefill-role replica spills
+    # finished prefills into the shared host relay, one decode-role
+    # replica restores them with a batched h2d scatter and streams the
+    # decode; the handoff line is the disagg evidence (outcomes counted,
+    # relay drained back to 0 bytes)
+    from paddle_tpu.serving.kv_swap import HostKVPool
+    relay = HostKVPool(64 << 20, kind="relay")
+    p_eng = _mk(role="prefill", relay=relay)
+    d_eng = _mk(role="decode", relay=relay)
+    drouter = ReplicaRouter([p_eng, d_eng], names=["p0", "d0"],
+                            idle_wait=0.001).start()
+    drids = [drouter.submit(rng.integers(1, 64, size=6).tolist(),
+                            max_new_tokens=6) for _ in range(2)]
+    for rid in drids:
+        drouter.wait(rid, timeout=120)
+    drouter.stop()
+    # the handoff outcomes land replica-scoped (p0 spills, d0 restores)
+    # — read them fleet-aggregated, like any dashboard would
+    agg = _fleet.get_aggregator()
+    print("disagg handoff: "
+          "ok="
+          f"{int(agg.fleet_counter_value('serving_disagg_handoffs_total', outcome='ok'))} "
+          "restored="
+          f"{int(agg.fleet_counter_value('serving_disagg_handoffs_total', outcome='restored'))} "
+          f"bytes={p_eng.handoff_bytes} "
+          "relay_bytes="
+          f"{int(reg.gauge('serving_disagg_kv_relay_bytes').labels().value)} "
+          f"handoff_resumes={drouter.handoff_resumes}")
     print()
     print_request_table(obs.requests_payload())
 
