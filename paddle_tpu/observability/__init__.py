@@ -100,8 +100,32 @@ _define_flag("obs_fleet_slo_advisory", False,
              "let a replica's SLO burn feed the router health check "
              "as an advisory suspect signal (healthy -> suspect only; "
              "liveness still decides dead)")
+_define_flag("obs_ts_interval_s", 1.0,
+             "minimum seconds between time-series samples on the "
+             "engine/router step tick (0 samples every step — chaos "
+             "and demos only)")
+_define_flag("obs_ts_capacity", 512,
+             "time-series ring capacity in samples (oldest evicted; "
+             "live-resizable via watch_flag)")
+_define_flag("obs_ts_min_samples", 2,
+             "minimum ring samples before any windowed query answers "
+             "(below it, callers fall back to cumulative — counted)")
+_define_flag("obs_ts_fast_window_s", 60.0,
+             "fast alert window: windowed rates/quantiles and the "
+             "burn-rate alert's spike-catching window")
+_define_flag("obs_ts_slow_window_s", 600.0,
+             "slow alert window: the burn-rate alert's confirmation "
+             "window (clamped to available history on young processes)")
+_define_flag("obs_ts_dir", "",
+             "directory for the derived-signal history JSONL ring "
+             "(obs_ts-<pid>.jsonl); empty keeps the history in-memory "
+             "only (the post-mortem tail embeds either way)")
+_define_flag("obs_ts_history_tail", 120,
+             "bounded retention for derived-signal history entries "
+             "(in-memory tail AND the JSONL ring's compaction cap)")
 
-_LAZY_SUBMODULES = ("request_trace", "profiling", "numerics", "fleet")
+_LAZY_SUBMODULES = ("request_trace", "profiling", "numerics", "fleet",
+                    "timeseries")
 _LAZY_NAMES = {
     "RequestContext": "request_trace", "RequestTracer": "request_trace",
     "exemplar_for_quantile": "request_trace",
@@ -119,6 +143,13 @@ _LAZY_NAMES = {
     "get_placement_log": "fleet",
     "merge_snapshots": "fleet",
     "filter_snapshot": "fleet",
+    "TimeSeriesStore": "timeseries",
+    "AlertEngine": "timeseries",
+    "AlertSpec": "timeseries",
+    "get_store": "timeseries",
+    "get_alert_engine": "timeseries",
+    "alerts_payload": "timeseries",
+    "history_payload": "timeseries",
 }
 
 
@@ -150,4 +181,6 @@ __all__ = [
     "numerics", "tensor_stats", "record_quant_error",
     "fleet", "FleetAggregator", "PlacementLog", "get_aggregator",
     "get_placement_log", "merge_snapshots", "filter_snapshot",
+    "timeseries", "TimeSeriesStore", "AlertEngine", "AlertSpec",
+    "get_store", "get_alert_engine", "alerts_payload", "history_payload",
 ]

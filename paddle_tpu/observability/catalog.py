@@ -443,6 +443,24 @@ CATALOG = {
         "counter", ("site",),
         "landed stat vectors whose NaN/Inf count was nonzero — the "
         "alertable health signal behind the provenance walk"),
+    # -- time-series layer (observability.timeseries, r20) ------------------
+    "obs_ts_samples_total": (
+        "counter", (), "registry snapshots landed in the time-series "
+                       "ring (the engine/router step tick, throttled "
+                       "by FLAGS_obs_ts_interval_s)"),
+    "obs_ts_ring_size": (
+        "gauge", (), "samples currently resident in the time-series "
+                     "ring (bounded by FLAGS_obs_ts_capacity)"),
+    "obs_alerts_total": (
+        "counter", ("alert", "state"),
+        "alert-state EDGES by alert name (state=firing|cleared) — one "
+        "increment per transition, never per evaluation, so the pair "
+        "reads as a fire->clear ledger"),
+    "obs_ts_window_fallbacks_total": (
+        "counter", ("query",),
+        "windowed queries answered by the CUMULATIVE fallback because "
+        "ring history was too short (query=slo: fleet burn-rate check "
+        "judged lifetime attainment instead of the fast window)"),
 }
 
 # Histogram bucket overrides: (lo, hi, per_decade) for metrics whose

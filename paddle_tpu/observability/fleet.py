@@ -64,6 +64,7 @@ __all__ = ["FleetAggregator", "PlacementLog", "filter_snapshot",
 _M_SLO_ATTAIN = _instrument("serving_fleet_slo_attainment")
 _M_SLO_BREACH = _instrument("serving_fleet_slo_breaches_total")
 _M_SCRAPES = _instrument("serving_fleet_scrapes_total")
+_M_TS_FALLBACK = _instrument("obs_ts_window_fallbacks_total")
 
 
 # -- snapshot federation ----------------------------------------------------
@@ -254,19 +255,46 @@ def replica_slo(name: str, registry=None) -> Dict[str, Optional[float]]:
     return out
 
 
+def _windowed_burn(store, metric: str, name: str, thr_s: float,
+                   target: float, min_n: int):
+    """(attainment, burn, window) over the fast window, confirmed by
+    the slow window (SRE multi-window: fast catches the spike, slow —
+    clamped to available history on a young process — confirms it is
+    sustained). ``None`` when ring history or window traffic is too
+    thin to judge — the caller falls back to cumulative, counted."""
+    fast_s = float(get_flag("obs_ts_fast_window_s"))
+    fast = store.windowed_burn(metric, thr_s, target, fast_s,
+                               replica=name)
+    if fast is None or fast["count"] < min_n:
+        return None
+    slow = store.windowed_burn(metric, thr_s, target,
+                               float(get_flag("obs_ts_slow_window_s")),
+                               clamp=True, replica=name)
+    burn_slow = slow["burn"] if slow is not None else fast["burn"]
+    return {"attainment": fast["attainment"], "burn": fast["burn"],
+            "breach": fast["burn"] > 1.0 and burn_slow > 1.0,
+            "window_s": fast_s}
+
+
 def check_slo(names, registry=None) -> Set[str]:
     """One fleet SLO tick over ``names`` (the router's replicas):
     refresh the per-replica attainment gauges, emit ``slo_breach``
     flight events + counters on entering breach, and return the set of
-    replicas currently burning their budget (burn rate > 1 with at
-    least ``FLAGS_obs_fleet_slo_min_requests`` samples). The router's
+    replicas currently burning their budget. Since r20 the burn is
+    WINDOWED (fast window catches, slow window confirms — a replica
+    degrading after an hour of good traffic no longer dilutes its
+    breach into the lifetime average); when the time-series ring is too
+    short the lifetime computation answers instead, counted as
+    ``obs_ts_window_fallbacks_total{query="slo"}``. The router's
     :meth:`check` feeds this back as an advisory suspect signal when
     ``FLAGS_obs_fleet_slo_advisory`` is on."""
     if not state.enabled():
         return set()
     from . import flight_recorder as _flight
+    from . import timeseries as _ts
 
     reg = registry or get_registry()
+    store = _ts.get_store()
     target = min(float(get_flag("obs_fleet_slo_target")), 0.9999)
     min_n = int(get_flag("obs_fleet_slo_min_requests"))
     burning: Set[str] = set()
@@ -275,19 +303,28 @@ def check_slo(names, registry=None) -> Set[str]:
                                    "obs_slo_ttft_ms"),
                                   ("tpot", "serving_tpot_seconds",
                                    "obs_slo_tpot_ms")):
-            child = _find_child(reg.histogram(metric), replica=name)
-            if child is None or child.count < min_n:
-                _breach_state.pop((name, slo), None)
-                continue
-            with child._lock:
-                counts = list(child.counts)
-            att = fraction_at_or_below(child.bounds, counts,
-                                       float(get_flag(flag)) / 1e3)
-            if att is None:
-                continue
+            thr_s = float(get_flag(flag)) / 1e3
+            win = _windowed_burn(store, metric, name, thr_s, target,
+                                 min_n)
+            if win is not None:
+                att, burn = win["attainment"], win["burn"]
+                breach = win["breach"]
+                window_s = win["window_s"]
+            else:
+                child = _find_child(reg.histogram(metric), replica=name)
+                if child is None or child.count < min_n:
+                    _breach_state.pop((name, slo), None)
+                    continue
+                _M_TS_FALLBACK.inc(query="slo")
+                with child._lock:
+                    counts = list(child.counts)
+                att = fraction_at_or_below(child.bounds, counts, thr_s)
+                if att is None:
+                    continue
+                burn = (1.0 - att) / (1.0 - target)
+                breach = burn > 1.0
+                window_s = None
             _M_SLO_ATTAIN.set(att, replica=name, slo=slo)
-            burn = (1.0 - att) / (1.0 - target)
-            breach = burn > 1.0
             if breach:
                 burning.add(name)
                 if not _breach_state.get((name, slo)):
@@ -295,7 +332,8 @@ def check_slo(names, registry=None) -> Set[str]:
                     _flight.record("slo_breach", replica=name, slo=slo,
                                    attainment=round(att, 4),
                                    burn_rate=round(burn, 3),
-                                   target=target)
+                                   target=target,
+                                   window_s=window_s)
             _breach_state[(name, slo)] = breach
     return burning
 
@@ -417,6 +455,8 @@ class FleetAggregator:
         renders: one row per replica (state, disagg role, streams,
         queue/slots, tokens, p95 TTFT/TPOT, cache hit rate, SLO burn)
         + fleet totals."""
+        from . import timeseries as _ts
+
         _M_SCRAPES.inc(endpoint="replicas")
         reg = get_registry()
         router = self.router()
@@ -439,6 +479,11 @@ class FleetAggregator:
                         })
             row.update(self._replica_metrics(reg, name))
             row["slo"] = replica_slo(name, reg)
+            # r20: per-replica tok/s trend from the time-series ring —
+            # the sparkline column obs_dump --fleet renders
+            row["spark"] = [round(v, 1) for v in _ts.get_store()
+                            .rate_series("serving_tokens_total", n=12,
+                                         replica=name)]
             rows.append(row)
         doc = {"version": 1, "unix_time": time.time(),
                "router": router is not None, "replicas": rows,

@@ -13,6 +13,8 @@ GET /fleet/metrics      -> fleet-merged Prometheus text (counters
                            per-replica-labeled)
 GET /fleet/replicas.json    -> per-replica state/throughput/SLO table
 GET /fleet/placements.json  -> router placement-decision audit ring
+GET /alerts.json        -> windowed burn-rate + anomaly-watcher alert
+                           table (evaluated fresh per scrape)
 GET /healthz            -> "ok" (liveness for load balancers)
 
 Serves from a daemon thread; ``port=0`` binds an OS-assigned ephemeral
@@ -67,6 +69,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_profile_control(qs)
         elif path.startswith("/fleet/"):
             self._send_fleet(path)
+        elif path in ("/alerts.json", "/alerts"):
+            self._send_alerts()
         elif path == "/healthz":
             self._send(b"ok", "text/plain")
         else:
@@ -118,6 +122,11 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(fleet.placements_payload())
         else:
             self._send(b"not found", "text/plain", 404)
+
+    def _send_alerts(self):
+        from . import timeseries
+
+        self._send_json(timeseries.alerts_payload())
 
     def _send_profile_control(self, qs):
         from . import profiling

@@ -115,6 +115,7 @@ from ..observability import numerics as _nm
 from ..observability import perf as _perf
 from ..observability import profiling as _profiling
 from ..observability import request_trace as _rt
+from ..observability import timeseries as _ts
 from ..observability import trace_span
 from ..observability.catalog import instrument as _instrument
 from ..framework.flags import get_flag
@@ -3208,6 +3209,10 @@ class LLMEngine:
         _M_KV_USED.set(self.nb - 1 - len(self.free_blocks))
         if self.prefix_cache is not None:
             self.prefix_cache.update_gauges()
+        # time-series sampler (r20): throttled by FLAGS_obs_ts_interval_s,
+        # contention-free — a concurrent replica already sampling means
+        # this step skips instead of waiting
+        _ts.step_tick()
         return emitted
 
     def _step_inner(self):

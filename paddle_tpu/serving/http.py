@@ -648,7 +648,8 @@ class HTTPFrontDoor:
         port, no separate obs server. None when ``path`` is not a
         telemetry route; all are read-only GETs."""
         if path not in ("/metrics", "/metrics.json", "/fleet/metrics",
-                        "/fleet/replicas.json", "/fleet/placements.json"):
+                        "/fleet/replicas.json", "/fleet/placements.json",
+                        "/alerts.json"):
             return None
         if method != "GET":
             self._respond(writer, 405, {"error": "GET only"})
@@ -672,6 +673,10 @@ class HTTPFrontDoor:
             self._respond_text(writer, 200, _fleet.fleet_metrics_text())
         elif path == "/fleet/replicas.json":
             self._respond(writer, 200, _fleet.replicas_payload())
+        elif path == "/alerts.json":
+            from paddle_tpu.observability import timeseries as _ts
+
+            self._respond(writer, 200, _ts.alerts_payload())
         else:
             self._respond(writer, 200, _fleet.placements_payload())
         return 200

@@ -872,16 +872,23 @@ class ReplicaRouter:
         return self.states()
 
     def _slo_tick(self) -> None:
-        """Fleet SLO burn-rate tick (r17): refresh per-replica
-        attainment gauges + breach events every health tick; with
-        FLAGS_obs_fleet_slo_advisory on, a burning replica is demoted
-        healthy -> suspect — advisory only: placement steers away for a
-        tick, the heartbeat machine re-promotes it when its latency
-        recovers, and liveness alone still decides dead."""
+        """Fleet SLO burn-rate tick (r17, windowed since r20): sample
+        the time-series ring (the router tick keeps history flowing
+        even when every engine idles), refresh per-replica attainment
+        gauges + breach events, and evaluate the anomaly watchers; with
+        FLAGS_obs_fleet_slo_advisory on, a replica burning its windowed
+        budget OR firing an advisory watcher (e.g. tok/s divergence vs
+        the fleet median) is demoted healthy -> suspect — advisory
+        only: placement steers away for a tick, the heartbeat machine
+        re-promotes it when its latency recovers, and liveness alone
+        still decides dead."""
         from ..observability import fleet as _fleet
+        from ..observability import timeseries as _ts
 
         try:
+            _ts.step_tick()
             burning = _fleet.check_slo(list(self.replicas))
+            burning |= _ts.get_alert_engine().burning_replicas()
         except Exception as e:      # telemetry must never kill a tick
             _flight.record("router_slo_tick_error", error=repr(e)[:120])
             return

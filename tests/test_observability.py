@@ -411,9 +411,12 @@ def test_registry_import_cost_under_50ms():
 def test_disabled_overhead_under_5pct_on_decode_shaped_microbench():
     """Acceptance guard: with observability DISABLED, the per-step cost of
     the serving decode loop's instrumentation (1 enabled() check + a few
-    no-op spans/counters per step, exactly what LLMEngine.step adds) must
-    stay under 5% of a decode-step-shaped CPU workload."""
+    no-op spans/counters per step + the r20 time-series sampler tick,
+    exactly what LLMEngine.step adds) must stay under 5% of a
+    decode-step-shaped CPU workload."""
     import numpy as np
+
+    from paddle_tpu.observability import timeseries as ts
 
     obs.disable()
     c = obs.counter("bench_total")
@@ -449,6 +452,7 @@ def test_disabled_overhead_under_5pct_on_decode_shaped_microbench():
             c.inc()
             g.set(1.0)
             h.observe(0.0)
+            ts.step_tick()                  # r20 sampler: gated no-op off
         return time.perf_counter() - t0
 
     n = 40

@@ -457,6 +457,10 @@ def test_obs_dump_demo_serving_smoke(tmp_path):
     # r12/r18: the kernel-path line — off-TPU the bucketed fallback
     # serves every dispatch; the mega and ragged counts stay 0
     assert "decode kernel paths: mega=0 ragged=0" in out, out[-2000:]
+    # r20: the demo ends with the windowed alert table + a sparkline
+    # over the per-step time-series samples
+    assert "alerts:" in out, out[-2000:]
+    assert "tok/s spark:" in out, out[-2000:]
     # r8: one shed, one expired deadline, at least one preempt→swap
     assert "load shed: request" in out
     assert "deadline_exceeded=1" in out

@@ -1,0 +1,518 @@
+"""r20 time-series layer: the bounded sample ring + windowed queries
+(delta/rate/quantile from bucket-count deltas), the multi-window
+burn-rate rewire of ``fleet.check_slo`` (dilution regression + counted
+cumulative fallback), the alert engine's firing/cleared EDGES, the
+anomaly watchers feeding ``ReplicaRouter`` advisory demotion, the
+``/alerts.json`` surface on both HTTP servers, and the derived-signal
+history (JSONL ring + post-mortem embed).
+
+The windowed-quantile exactness tests here are the unit half of the
+contract the chaos drivers (``chaos_run --serving`` / ``--router``)
+enforce live: alerts judged on window deltas, not process lifetime.
+"""
+import json
+import socket
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401  (forces the CPU/virtual-device conftest setup)
+import paddle_tpu.observability as obs
+from paddle_tpu.framework.flags import get_flag, set_flags
+from paddle_tpu.observability import exposition, fleet, flight_recorder
+from paddle_tpu.observability import timeseries as ts
+
+_TS_FLAGS = ("obs_ts_interval_s", "obs_ts_capacity", "obs_ts_min_samples",
+             "obs_ts_fast_window_s", "obs_ts_slow_window_s", "obs_ts_dir",
+             "obs_ts_history_tail")
+
+
+@pytest.fixture
+def ts_on():
+    """Enabled obs over a zeroed registry + empty ring/alert state, with
+    every obs_ts_* flag restored afterwards (several tests shrink the
+    windows to make short synthetic histories judgeable)."""
+    saved = {f: get_flag(f) for f in _TS_FLAGS}
+    obs.get_registry().reset()
+    flight_recorder.get_recorder().clear()
+    fleet._breach_state.clear()
+    ts.reset()
+    obs.enable()
+    try:
+        yield
+    finally:
+        obs.disable()
+        set_flags(saved)
+        obs.get_registry().reset()
+        flight_recorder.get_recorder().clear()
+        fleet._breach_state.clear()
+        ts.reset()
+
+
+def _counter_snap(series):
+    """{name: {label_tuple_or_None: value}} -> snapshot-shaped dict."""
+    metrics = []
+    for name, by_labels in series.items():
+        rows = [{"labels": dict(labels or ()), "value": v}
+                for labels, v in by_labels.items()]
+        metrics.append({"name": name, "kind": "counter", "series": rows})
+    return {"version": 1, "metrics": metrics}
+
+
+# -- the ring ---------------------------------------------------------------
+def test_ring_is_bounded_and_flag_resizable(ts_on):
+    store = ts.get_store()
+    set_flags({"obs_ts_capacity": 6})
+    for i in range(20):
+        store.sample(_counter_snap({"t_ring_total": {None: float(i)}}),
+                     t=float(i))
+    assert len(store) == 6
+    assert store.sampled == 20                # lifetime, not ring size
+    assert store.latest().t == 19.0
+    set_flags({"obs_ts_capacity": 3})         # live shrink keeps newest
+    assert len(store) == 3
+    assert [s.t for s in store.samples()] == [17.0, 18.0, 19.0]
+    # sampler bookkeeping: one process-global series each
+    reg = obs.get_registry()
+    assert reg.counter("obs_ts_samples_total").labels().value == 20
+    assert reg.gauge("obs_ts_ring_size").labels().value == 3.0
+
+
+def test_delta_rate_reset_and_default_now(ts_on):
+    store = ts.TimeSeriesStore(capacity=8)
+    for t, v in ((0.0, 5.0), (10.0, 11.0), (20.0, 17.0)):
+        store.sample(_counter_snap({"t_d_total": {None: v}}), t=t)
+    # now defaults to the newest sample's timestamp: window 15 reaches
+    # back to t=0 (5.0 -> 17.0 over 20 covered seconds)
+    assert store.delta("t_d_total", 15.0) == 12.0
+    assert store.rate("t_d_total", 15.0) == pytest.approx(12.0 / 20.0)
+    # window 5: baseline is t=10 (newest sample at least 5 old)
+    assert store.delta("t_d_total", 5.0) == 6.0
+    # a metric that never moved is 0.0, NOT None (None = no history)
+    assert store.delta("t_absent_total", 5.0) == 0.0
+    assert ts.TimeSeriesStore(capacity=8).delta("t_d_total", 5.0) is None
+    # counter reset (restart): value moved backwards -> the post-reset
+    # value stands in for the delta, never a negative
+    store.sample(_counter_snap({"t_d_total": {None: 3.0}}), t=30.0)
+    assert store.delta("t_d_total", 5.0) == 3.0
+
+
+def test_label_filter_sums_matching_series_only(ts_on):
+    store = ts.TimeSeriesStore(capacity=8)
+    mk = lambda a, b: _counter_snap(  # noqa: E731
+        {"t_l_total": {(("replica", "r0"),): a, (("replica", "r1"),): b}})
+    store.sample(mk(0.0, 0.0), t=0.0)
+    store.sample(mk(4.0, 10.0), t=10.0)
+    assert store.delta("t_l_total", 5.0) == 14.0            # both series
+    assert store.delta("t_l_total", 5.0, replica="r0") == 4.0
+    assert store.delta("t_l_total", 5.0, replica="r1") == 10.0
+
+
+# -- windowed-quantile exactness (ISSUE 20 satellite) -----------------------
+_BOUNDS = [0.01, 0.05, 0.1, 0.5, 1.0, 5.0]
+
+
+def test_window_quantile_exact_single_replica(ts_on):
+    """The bucket-delta quantile over a window must EQUAL the quantile
+    of a histogram that only ever saw that window's traffic — deltas of
+    integer counts lose nothing."""
+    reg = obs.get_registry()
+    h = reg.histogram("t_ts_exact_seconds", buckets=_BOUNDS)
+    store = ts.TimeSeriesStore(capacity=8,
+                               source=lambda: exposition.snapshot(reg))
+    rng = np.random.default_rng(3)
+    for v in rng.uniform(0.001, 6.0, size=40):
+        h.observe(float(v))
+    store.sample(t=0.0)
+    window_vals = [float(v) for v in rng.uniform(0.001, 6.0, size=55)]
+    for v in window_vals:
+        h.observe(v)
+    store.sample(t=10.0)
+    ref = reg.histogram("t_ts_exact_ref_seconds", buckets=_BOUNDS)
+    for v in window_vals:
+        ref.observe(v)
+    hd = store.hist_delta("t_ts_exact_seconds", 5.0)
+    assert hd is not None and hd[3] == len(window_vals)
+    assert list(hd[1]) == list(ref.labels().counts)
+    for q in (0.1, 0.5, 0.9, 0.99):
+        assert store.window_quantile("t_ts_exact_seconds", q, 5.0) \
+            == exposition.quantile(_BOUNDS, ref.labels().counts, q)
+    assert store.window_fraction_at_or_below(
+        "t_ts_exact_seconds", 0.5, 5.0) \
+        == exposition.fraction_at_or_below(_BOUNDS, ref.labels().counts,
+                                           0.5)
+
+
+def test_window_quantile_exact_on_fleet_union(ts_on):
+    """Same exactness through the r17 federation path: sampling MERGED
+    fleet snapshots, the windowed quantile equals the quantile over the
+    union of every replica's window traffic (delta-of-merged ==
+    merge-of-deltas on integer bucket counts)."""
+    reg = obs.get_registry()
+    h = reg.histogram("t_ts_fleet_seconds", buckets=_BOUNDS)
+    names = ("r0", "r1", "r2")
+
+    def merged_snap():
+        full = exposition.snapshot(reg)
+        return fleet.merge_snapshots(
+            {n: fleet.filter_snapshot(full, replica=n) for n in names})
+
+    store = ts.TimeSeriesStore(capacity=8, source=merged_snap)
+    rng = np.random.default_rng(11)
+    for n in names:                                  # pre-window traffic
+        with reg.scoped(replica=n):
+            for v in rng.uniform(0.001, 6.0, size=int(rng.integers(5, 30))):
+                h.observe(float(v))
+    store.sample(t=0.0)
+    union = []
+    for n in names:                                  # the window's traffic
+        vals = [float(v) for v in
+                rng.uniform(0.001, 6.0, size=int(rng.integers(5, 30)))]
+        union.extend(vals)
+        with reg.scoped(replica=n):
+            for v in vals:
+                h.observe(v)
+    store.sample(t=10.0)
+    ref = reg.histogram("t_ts_fleet_ref_seconds", buckets=_BOUNDS)
+    for v in union:
+        ref.observe(v)
+    hd = store.hist_delta("t_ts_fleet_seconds", 5.0)
+    assert hd is not None and hd[3] == len(union)
+    for q in (0.5, 0.9, 0.99):
+        assert store.window_quantile("t_ts_fleet_seconds", q, 5.0) \
+            == exposition.quantile(_BOUNDS, ref.labels().counts, q)
+
+
+# -- check_slo: windowed with counted cumulative fallback -------------------
+def test_fast_window_breach_demotes_despite_healthy_lifetime(ts_on):
+    """THE dilution regression: a replica breaching over the fast
+    window must be caught even behind a long healthy prefix — exactly
+    what the old cumulative-only check_slo could never see."""
+    reg = obs.get_registry()
+    h = reg.histogram("serving_ttft_seconds")
+    set_flags({"obs_ts_fast_window_s": 10.0, "obs_ts_slow_window_s": 60.0})
+    for _ in range(6000):                     # a long, healthy lifetime
+        h.observe(0.005, replica="r0")
+    ts.get_store().sample(t=0.0)
+    for _ in range(30):                       # then 30 requests at 5s TTFT
+        h.observe(5.0, replica="r0")
+    ts.get_store().sample(t=100.0)
+
+    assert fleet.check_slo(["r0"]) == {"r0"}  # windowed: caught
+    breaches = reg.counter("serving_fleet_slo_breaches_total")
+    assert sum(ch.value for ch in breaches.series()) == 1
+    assert fleet.check_slo(["r0"]) == {"r0"}  # still breaching: no re-edge
+    assert sum(ch.value for ch in breaches.series()) == 1
+
+    # control: drop the ring and the SAME registry falls back to the
+    # cumulative path — lifetime attainment 6000/6030 dilutes the burn
+    # under 1.0, the breach vanishes, and the fallback is COUNTED
+    ts.reset()
+    fleet._breach_state.clear()
+    assert fleet.check_slo(["r0"]) == set()
+    fb = reg.counter("obs_ts_window_fallbacks_total")
+    assert fleet._find_child(fb, query="slo") is not None
+    assert fb.labels(query="slo").value >= 1
+
+
+def test_check_slo_cumulative_fallback_when_window_too_thin(ts_on):
+    """Under min_requests window samples the windowed path must DEFER
+    to cumulative, not mint a breach off a handful of requests."""
+    reg = obs.get_registry()
+    h = reg.histogram("serving_ttft_seconds")
+    set_flags({"obs_ts_fast_window_s": 10.0})
+    min_n = int(get_flag("obs_fleet_slo_min_requests"))
+    for _ in range(min_n + 5):                # lifetime: all terrible
+        h.observe(5.0, replica="r0")
+    ts.get_store().sample(t=0.0)
+    for _ in range(3):                        # window: too few to judge
+        h.observe(5.0, replica="r0")
+    ts.get_store().sample(t=100.0)
+    assert fleet.check_slo(["r0"]) == {"r0"}  # cumulative still catches
+    fb = reg.counter("obs_ts_window_fallbacks_total")
+    assert fb.labels(query="slo").value >= 1
+
+
+# -- the alert engine -------------------------------------------------------
+def _shed_snap(v):
+    return _counter_snap({"serving_shed_total": {None: v}})
+
+
+def test_shed_rate_alert_fires_and_clears_once_per_transition(ts_on):
+    set_flags({"obs_ts_fast_window_s": 4.0})
+    store = ts.get_store()
+    engine = ts.get_alert_engine()
+    reg = obs.get_registry()
+
+    store.sample(_shed_snap(0.0), t=0.0)
+    store.sample(_shed_snap(10.0), t=5.0)     # 2 sheds/s > 0.5/s
+    rows = engine.evaluate(now=5.0)
+    row = next(r for r in rows if r["alert"] == "shed_rate")
+    assert row["state"] == "firing" and row["value"] == pytest.approx(2.0)
+    assert row["since"] == 5.0
+    assert engine.edge_count("shed_rate", "firing") == 1
+    engine.evaluate(now=5.0)                  # still firing: no re-edge
+    assert engine.edge_count("shed_rate", "firing") == 1
+
+    store.sample(_shed_snap(10.0), t=10.0)    # the storm stops
+    store.sample(_shed_snap(10.0), t=15.0)
+    rows = engine.evaluate(now=15.0)
+    row = next(r for r in rows if r["alert"] == "shed_rate")
+    assert row["state"] == "ok" and row["value"] == 0.0
+    assert engine.edge_count("shed_rate", "cleared") == 1
+    # edges are COUNTED once per transition, and land as flight events
+    alerts = reg.counter("obs_alerts_total")
+    got = {(ch.labels["alert"], ch.labels["state"]): ch.value
+           for ch in alerts.series() if "alert" in ch.labels}
+    assert got[("shed_rate", "firing")] == 1
+    assert got[("shed_rate", "cleared")] == 1
+    kinds = [e["kind"] for e in flight_recorder.get_recorder().events()]
+    assert "alert_firing" in kinds and "alert_cleared" in kinds
+
+
+def test_no_traffic_is_no_data_not_firing(ts_on):
+    engine = ts.get_alert_engine()
+    rows = engine.evaluate(now=0.0)           # empty ring: nothing judgeable
+    assert rows and all(r["state"] == "no_data" for r in rows
+                        if r["alert"] != "slo_burn")
+    assert engine.firing() == []
+    assert engine.edge_count("shed_rate", "firing") == 0
+
+
+def test_slo_burn_alert_is_per_replica_and_advisory(ts_on):
+    reg = obs.get_registry()
+    h = reg.histogram("serving_ttft_seconds")
+    set_flags({"obs_ts_fast_window_s": 10.0, "obs_ts_slow_window_s": 60.0})
+    for _ in range(50):
+        h.observe(0.005, replica="r0")
+        h.observe(0.005, replica="r1")
+    ts.get_store().sample(t=0.0)
+    for _ in range(30):
+        h.observe(5.0, replica="r0")          # r0 burns, r1 stays clean
+        h.observe(0.005, replica="r1")
+    ts.get_store().sample(t=100.0)
+    engine = ts.get_alert_engine()
+    rows = {r["instance"]: r for r in engine.evaluate(now=100.0)
+            if r["alert"] == "slo_burn"}
+    assert rows["r0"]["state"] == "firing" and rows["r0"]["advisory"]
+    assert rows["r1"]["state"] == "ok"
+    assert engine.burning_replicas() == {"r0"}
+
+
+def test_divergence_watcher_flags_the_frozen_replica(ts_on):
+    set_flags({"obs_ts_fast_window_s": 10.0})
+    store = ts.get_store()
+    mk = lambda a, b, c: _counter_snap({"serving_tokens_total": {  # noqa: E731
+        (("replica", "r0"),): a, (("replica", "r1"),): b,
+        (("replica", "r2"),): c}})
+    store.sample(mk(100.0, 100.0, 100.0), t=0.0)
+    store.sample(mk(100.0, 400.0, 380.0), t=10.0)   # r0 froze, fleet busy
+    engine = ts.get_alert_engine()
+    rows = {r["instance"]: r for r in engine.evaluate(now=10.0)
+            if r["alert"] == "replica_tok_s_divergence"}
+    assert rows["r0"]["state"] == "firing"
+    assert rows["r1"]["state"] == "ok" and rows["r2"]["state"] == "ok"
+    assert engine.burning_replicas() == {"r0"}
+    # an idle FLEET never fires the watcher (median under the floor)
+    store.sample(mk(100.0, 400.0, 380.0), t=120.0)
+    rows = {r["instance"]: r for r in engine.evaluate(now=120.0)
+            if r["alert"] == "replica_tok_s_divergence"}
+    assert all(r["state"] == "ok" for r in rows.values())
+    assert engine.edge_count("replica_tok_s_divergence", "cleared") == 1
+
+
+# -- router advisory demotion ----------------------------------------------
+@pytest.fixture(scope="module")
+def tiny_model():
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import llama
+
+    cfg = dataclasses.replace(
+        llama.tiny_llama(vocab=64, hidden=32, layers=2, heads=4,
+                         kv_heads=2, seq=128, ffn=64),
+        dtype=jnp.float32)
+    return cfg, llama.init_params(cfg, jax.random.PRNGKey(0))
+
+
+def test_router_demotes_replica_on_firing_advisory_watcher(
+        ts_on, tiny_model):
+    """The r20 wiring: a firing ADVISORY watcher instance joins the SLO
+    burn set in the router's health tick — healthy -> suspect, gated on
+    FLAGS_obs_fleet_slo_advisory, and never past suspect."""
+    from paddle_tpu.serving import LLMEngine, ReplicaRouter
+
+    cfg, params = tiny_model
+    engines = [LLMEngine(params, cfg, max_slots=2, block_size=8,
+                         max_model_len=64, prompt_buckets=[8, 32])
+               for _ in range(2)]
+    router = ReplicaRouter(engines, names=["r0", "r1"], idle_wait=0.001)
+    router.start()                            # step threads heartbeat
+    engine = ts.get_alert_engine()
+    spec = next(s for s in engine.specs
+                if s.name == "replica_tok_s_divergence")
+    firing_row = engine._row(spec, "r0", 0.0, 1.0, firing=True)
+    try:
+        engine._last = [firing_row]
+        router.check()                        # advisory flag off: no-op
+        assert router.states() == {"r0": "healthy", "r1": "healthy"}
+        set_flags({"obs_fleet_slo_advisory": True})
+        engine._last = [firing_row]           # check() re-evaluates; re-arm
+        with _pinned_evaluate(engine):
+            router.check()
+        assert router.states()["r0"] == "suspect"
+        assert router.states()["r1"] == "healthy"
+    finally:
+        set_flags({"obs_fleet_slo_advisory": False})
+        router.stop()
+
+
+class _pinned_evaluate:
+    """Freeze an AlertEngine's row table for the duration: the router
+    tick re-evaluates against the (empty) store, which would wipe the
+    hand-planted firing row before burning_replicas() reads it."""
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    def __enter__(self):
+        self._saved = self.engine.evaluate
+        rows = list(self.engine._last)
+        self.engine.evaluate = lambda now=None: rows
+        return self
+
+    def __exit__(self, *exc):
+        self.engine.evaluate = self._saved
+        return False
+
+
+# -- /alerts.json on both servers -------------------------------------------
+def test_alerts_json_on_obs_server(ts_on):
+    from paddle_tpu.observability.http_server import MetricsServer
+
+    set_flags({"obs_ts_fast_window_s": 4.0})
+    store = ts.get_store()
+    store.sample(_shed_snap(0.0), t=0.0)
+    store.sample(_shed_snap(10.0), t=5.0)
+    srv = MetricsServer(port=0, registry=obs.get_registry())
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/alerts.json") as r:
+            doc = json.loads(r.read())
+    finally:
+        srv.close()
+    assert doc["version"] == 1 and doc["ring_size"] == 2
+    assert "shed_rate" in doc["firing"]
+    row = next(a for a in doc["alerts"] if a["alert"] == "shed_rate")
+    assert row["state"] == "firing" and row["window_s"] == 4.0
+
+
+def _front_get(host, port, path):
+    s = socket.create_connection((host, port), timeout=10)
+    try:
+        s.sendall(f"GET {path} HTTP/1.1\r\nHost: x\r\n"
+                  f"Connection: close\r\n\r\n".encode())
+        buf = b""
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                return buf
+            buf += chunk
+    finally:
+        s.close()
+
+
+def test_alerts_json_on_front_door_gated_on_obs(ts_on, tiny_model):
+    from paddle_tpu.serving import LLMEngine
+    from paddle_tpu.serving.http import HTTPFrontDoor
+
+    cfg, params = tiny_model
+    eng = LLMEngine(params, cfg, max_slots=2, block_size=8,
+                    max_model_len=64, prompt_buckets=[8, 32])
+    front = HTTPFrontDoor(eng)
+    host, port = front.start()
+    try:
+        raw = _front_get(host, port, "/alerts.json")
+        head, _, body = raw.partition(b"\r\n\r\n")
+        assert b" 200 " in head.split(b"\r\n", 1)[0]
+        doc = json.loads(body)
+        assert "alerts" in doc and "firing" in doc
+        obs.disable()
+        try:
+            raw = _front_get(host, port, "/alerts.json")
+            assert b" 503 " in raw.split(b"\r\n", 1)[0]
+        finally:
+            obs.enable()
+    finally:
+        front.stop()
+
+
+# -- history: JSONL ring + post-mortem embed --------------------------------
+def test_history_jsonl_ring_compacts_and_postmortem_embeds(
+        ts_on, tmp_path):
+    set_flags({"obs_ts_dir": str(tmp_path), "obs_ts_history_tail": 4,
+               "obs_ts_fast_window_s": 4.0})
+    reg = obs.get_registry()
+    shed = reg.counter("serving_shed_total")
+    for i in range(12):
+        shed.inc(5)
+        ts.tick(now=float(i))
+    import os
+
+    path = tmp_path / f"obs_ts-{os.getpid()}.jsonl"
+    lines = [json.loads(x) for x in
+             path.read_text().strip().splitlines()]
+    # the file ring is bounded: compaction rewrites it back to the tail
+    # cap once it doubles it, so 12 appends never exceed 2 * 4 lines
+    assert len(lines) <= 8
+    tail = ts.get_history().tail()
+    assert len(tail) == 4                     # in-memory tail: exactly cap
+    assert [e["t"] for e in tail] == [8.0, 9.0, 10.0, 11.0]
+    assert lines[-1] == tail[-1]              # file tail == memory tail
+    assert any("shed_rate" in e["firing"] for e in tail)
+    assert all("signals" in e for e in tail)
+    # the flight-recorder post-mortem embeds the trajectory
+    pm = flight_recorder.get_recorder().postmortem()
+    assert pm["timeseries"]["entries"] == tail
+    assert any(r["alert"] == "shed_rate"
+               for r in pm["timeseries"]["alerts"])
+
+
+def test_history_payload_bounds_entries(ts_on):
+    for i in range(40):
+        ts.tick(now=float(i))
+    doc = ts.history_payload(n=8)
+    assert len(doc["entries"]) == 8
+    assert doc["entries"][-1]["t"] == 39.0
+
+
+# -- the step tick ----------------------------------------------------------
+def test_step_tick_noops_when_disabled_and_throttles_when_on(ts_on):
+    store = ts.get_store()
+    obs.disable()
+    ts.step_tick()
+    assert len(store) == 0                    # off: not even a sample
+    obs.enable()
+    set_flags({"obs_ts_interval_s": 3600.0})
+    ts.step_tick()
+    for _ in range(50):
+        ts.step_tick()                        # inside the interval: skipped
+    assert len(store) == 1
+    set_flags({"obs_ts_interval_s": 0.0})
+    for _ in range(5):
+        ts.step_tick()
+    assert len(store) == 6                    # interval 0: every step
+
+
+def test_tick_never_raises(ts_on, monkeypatch):
+    def boom():
+        raise RuntimeError("sampler exploded")
+
+    monkeypatch.setattr(ts, "get_store", boom)
+    ts.tick()                                 # must swallow, not propagate
+    kinds = [e["kind"] for e in flight_recorder.get_recorder().events()]
+    assert "ts_tick_error" in kinds

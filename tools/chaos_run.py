@@ -32,7 +32,9 @@ swap tier drains to empty, and the shared prefix actually hit the
 cache. The schedule carries a seeded ``offload_crash`` — a crash fired
 at the offload tick with transfers potentially in flight: recovery
 must abandon them cleanly (reservations released, custody blocks
-recycled, nothing half-committed). A second
+recycled, nothing half-committed). The r20 windowed shed-rate alert —
+fed by the per-step time-series sampler — must FIRE during the storm
+and CLEAR after the drain (one counted edge each way). A second
 phase runs the r13 speculative engine (draft-then-verify waves) under
 ``spec_verify_fail`` faults: a crash between the verify dispatch and
 its readback must roll back to the last committed token — the recovered
@@ -77,7 +79,9 @@ token-identical to an uninterrupted single-engine greedy run, the
 per-replica block ledgers balance at every replica step (asserted from
 the router's step hook), post-kill traffic lands only on survivors, the
 revived victim rejoins through the half-open probe, and a full drain
-leaves every replica's ledger clean.
+leaves every replica's ledger clean. The r20 tok/s-divergence watcher
+must FIRE for the victim on windowed evidence while it is down and
+CLEAR after the drain.
 
 The router run ends with a DISAGG phase (r19): a fresh 2-prefill +
 2-decode fleet over one shared host relay takes the same offered load;
@@ -124,13 +128,16 @@ def _repro(args, mode):
 
 def serving_main(args):
     import dataclasses
+    import time
 
     import jax
     import jax.numpy as jnp
 
     import paddle_tpu.observability as obs
     from paddle_tpu.distributed.resilience import FaultInjector
+    from paddle_tpu.framework.flags import set_flags
     from paddle_tpu.models import llama
+    from paddle_tpu.observability import timeseries
     from paddle_tpu.serving import (AdmissionConfig, LLMEngine,
                                     ResilientEngine, ShedError)
 
@@ -155,6 +162,12 @@ def serving_main(args):
     print(f"fault schedule: {inj.pending}")
 
     obs.enable()
+    # r20 time-series sampler on the engine's own step tick: sample
+    # every step and shrink the alert windows so the shed storm is
+    # judged on windowed evidence inside this short seeded run
+    set_flags({"obs_ts_interval_s": 0.0, "obs_ts_fast_window_s": 0.4,
+               "obs_ts_slow_window_s": 1.0})
+    timeseries.reset()
     # num_blocks=7 with two slots decoding 6-15 fresh tokens each: pool
     # pressure (and the injected squeezes) MUST preempt — the swap tier
     # is load-bearing in this run, not decorative. The r10 prefix cache
@@ -266,6 +279,29 @@ def serving_main(args):
     if pc.hits < 1 or pc.tokens_skipped < 1:
         print(f"shared-prefix workload never hit the cache "
               f"(hits={pc.hits}, skipped={pc.tokens_skipped})")
+        ok = False
+
+    # r20 alert edges: the overload/pool_squeeze storm sheds requests,
+    # and the windowed shed-rate watcher — fed by the per-step sampler
+    # the engine itself drives — must FIRE while the storm is live,
+    # then CLEAR once the engine drains and the fast window slides
+    # past the last shed
+    aeng = timeseries.get_alert_engine()
+    shed_fired = aeng.edge_count("shed_rate", "firing")
+    if shed_fired < 1:
+        print("the shed storm never fired the shed_rate alert")
+        ok = False
+    deadline = time.monotonic() + 10
+    while aeng.edge_count("shed_rate", "cleared") < 1 \
+            and time.monotonic() < deadline:
+        timeseries.tick()
+        time.sleep(0.05)
+    shed_cleared = aeng.edge_count("shed_rate", "cleared")
+    print(f"alerts: shed_rate firing_edges={shed_fired} "
+          f"cleared_edges={shed_cleared} "
+          f"samples={len(timeseries.get_store())}")
+    if shed_cleared < 1:
+        print("the shed_rate alert never cleared after the drain")
         ok = False
 
     # -- phase 2 (r13): speculative chaos ---------------------------------
@@ -647,11 +683,19 @@ def router_main(args):
     import jax.numpy as jnp
 
     import paddle_tpu.observability as obs
+    from paddle_tpu.framework.flags import set_flags
     from paddle_tpu.models import llama
     from paddle_tpu.observability import fleet
+    from paddle_tpu.observability import timeseries
     from paddle_tpu.serving import LLMEngine, ReplicaRouter
 
     obs.enable()
+    # r20 time-series sampler: every health tick / engine step samples,
+    # and the divergence watcher judges the kill on a window short
+    # enough to resolve inside this seeded run
+    set_flags({"obs_ts_interval_s": 0.0, "obs_ts_fast_window_s": 0.5,
+               "obs_ts_slow_window_s": 2.0})
+    timeseries.reset()
     cfg = dataclasses.replace(
         llama.tiny_llama(vocab=64, hidden=32, layers=2, heads=4, kv_heads=2,
                          seq=128, ffn=64),
@@ -823,6 +867,33 @@ def router_main(args):
         print("shared-prefix workload never scored an affinity hit")
         ok = False
 
+    # r20 alert edge: the dead victim's token counter froze while the
+    # survivors kept decoding — the tok/s-divergence watcher must fire
+    # FOR THE VICTIM on windowed evidence. Paired keep-alive traffic
+    # holds both survivors' rates (and so the fleet median) above the
+    # watcher's floor until the fast window slides fully past the kill.
+    aeng = timeseries.get_alert_engine()
+
+    def _victim_diverged():
+        return any(r["alert"] == "replica_tok_s_divergence"
+                   and r["instance"] == victim for r in aeng.firing())
+
+    deadline = time.monotonic() + 20
+    while not _victim_diverged() and time.monotonic() < deadline:
+        kas = [router.submit(rng.integers(1, 64, size=4).tolist(),
+                             max_new_tokens=6) for _ in range(2)]
+        for ka in kas:
+            router.wait(ka, timeout=30)
+        router.check()
+    div_fired = aeng.edge_count("replica_tok_s_divergence", "firing")
+    print(f"alerts: tok/s divergence firing_edges={div_fired} "
+          f"victim_firing={_victim_diverged()} "
+          f"samples={len(timeseries.get_store())}")
+    if not _victim_diverged():
+        print(f"the kill never fired the tok/s-divergence alert for "
+              f"{victim}")
+        ok = False
+
     # exactly-once resume parity: EVERY finished stream — resumed or
     # not — must be token-identical to an uninterrupted single-engine
     # greedy run of the same workload
@@ -963,6 +1034,23 @@ def router_main(args):
     print(f"post-drain states: {router.states()} | "
           f"cancel_noops={noops} ledger_checks_per_replica="
           f"{ {n: rep.steps for n, rep in router.replicas.items()} }")
+
+    # r20 cleared edge: with the fleet drained every replica's token
+    # rate decays to zero, the median falls below the watcher's floor,
+    # and the divergence alert must CLEAR (one cleared edge per
+    # transition — the revived victim must not stay marked diverged)
+    deadline = time.monotonic() + 10
+    while (_victim_diverged()
+           or aeng.edge_count("replica_tok_s_divergence",
+                              "cleared") < 1) \
+            and time.monotonic() < deadline:
+        timeseries.tick()
+        time.sleep(0.05)
+    div_cleared = aeng.edge_count("replica_tok_s_divergence", "cleared")
+    print(f"alerts: tok/s divergence cleared_edges={div_cleared}")
+    if _victim_diverged() or div_cleared < 1:
+        print("the tok/s-divergence alert never cleared after the drain")
+        ok = False
     router.stop()
 
     # ---- disaggregated prefill/decode phase (r19) -------------------------

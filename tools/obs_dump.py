@@ -39,6 +39,12 @@ Two modes (slow-lane tooling, like tools/chaos_run.py):
   carry it; ``--watch`` refreshes it top-style::
 
       python tools/obs_dump.py --fleet http://127.0.0.1:9464 --watch
+
+- print the windowed alert table (burn-rate + anomaly watchers) from a
+  server's ``/alerts.json`` — obs server or serving front door both
+  carry it; ``--watch`` refreshes it top-style::
+
+      python tools/obs_dump.py --alerts http://127.0.0.1:9464 --watch
 """
 import argparse
 import os
@@ -208,6 +214,102 @@ def requests_mode(src, sort, watch, interval):
         return 0
 
 
+_SPARK_GLYPHS = "▁▂▃▄▅▆▇█"
+
+
+def _spark(values, width=12):
+    """Render a value series as a unicode sparkline (r20): scaled to
+    the series' own max, newest value last."""
+    vals = [v for v in (values or [])[-width:]
+            if isinstance(v, (int, float))]
+    if not vals:
+        return "-"
+    hi = max(vals)
+    if hi <= 0:
+        return _SPARK_GLYPHS[0] * len(vals)
+    return "".join(
+        _SPARK_GLYPHS[min(len(_SPARK_GLYPHS) - 1,
+                          int(v / hi * (len(_SPARK_GLYPHS) - 1)))]
+        for v in vals)
+
+
+def print_alert_table(doc, out=sys.stdout):
+    """Render an ``/alerts.json`` payload: one row per (alert,
+    instance) with its windowed signal value vs threshold, firing
+    rows first."""
+    rows = doc.get("alerts") or []
+    firing = doc.get("firing")
+    if firing is None:      # embedded post-mortem tails carry only rows
+        firing = sorted({r.get("alert") for r in rows
+                         if r.get("state") == "firing"})
+    out.write(f"alerts: {len(rows)} row(s), "
+              f"{len(firing)} firing{' (' + ', '.join(firing) + ')' if firing else ''} "
+              f"[windows {doc.get('window_fast_s', '-')}s/"
+              f"{doc.get('window_slow_s', '-')}s, "
+              f"ring {doc.get('ring_size', '-')}/"
+              f"{doc.get('samples', '-')} samples]\n")
+    if not rows:
+        out.write("(no alert specs evaluated — enable observability "
+                  "and serve traffic)\n")
+        return rows
+    hdr = (f"{'alert':>24} {'instance':>9} {'state':>7} "
+           f"{'value':>10} {'threshold':>10} {'window':>7}\n")
+    out.write(hdr)
+    out.write("-" * (len(hdr) - 1) + "\n")
+    order = {"firing": 0, "ok": 1, "no_data": 2}
+    for r in sorted(rows, key=lambda r: (order.get(r.get("state"), 3),
+                                         r.get("alert", ""),
+                                         r.get("instance", ""))):
+        val = r.get("value")
+        val_s = f"{val:.4g}" if isinstance(val, (int, float)) else "-"
+        out.write(f"{str(r.get('alert'))[:24]:>24} "
+                  f"{str(r.get('instance') or '-')[:9]:>9} "
+                  f"{str(r.get('state')):>7} "
+                  f"{val_s:>10} "
+                  f"{r.get('threshold', 0):>10.4g} "
+                  f"{r.get('window_s', 0):>6.0f}s\n")
+    return rows
+
+
+def _fetch_alerts(src):
+    """The payload behind --alerts: a base URL (live obs server or
+    serving front door; /alerts.json appended) or a saved JSON file."""
+    import json
+    import urllib.parse
+    import urllib.request
+
+    if src.startswith(("http://", "https://")):
+        parts = urllib.parse.urlsplit(src)
+        path = parts.path.rstrip("/")
+        if not path.endswith("/alerts.json"):
+            path += "/alerts.json"
+        url = urllib.parse.urlunsplit(
+            (parts.scheme, parts.netloc, path, parts.query, ""))
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return json.load(r)
+    with open(src) as f:
+        return json.load(f)
+
+
+def alerts_mode(src, watch, interval):
+    if not watch:
+        print_alert_table(_fetch_alerts(src))
+        return 0
+    import io as _io
+    import time as _time
+
+    try:
+        while True:
+            doc = _fetch_alerts(src)
+            buf = _io.StringIO()
+            print_alert_table(doc, out=buf)
+            sys.stdout.write("\x1b[2J\x1b[H" + buf.getvalue())
+            sys.stdout.flush()
+            _time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 def print_fleet_table(doc, out=sys.stdout):
     """Render a ``/fleet/replicas.json`` payload: one row per replica
     (state, disagg role, streams, queue/slots, tokens, p95 latencies,
@@ -225,7 +327,8 @@ def print_fleet_table(doc, out=sys.stdout):
         return rows
     hdr = (f"{'replica':>8} {'state':>9} {'role':>7} {'hb_age':>7} "
            f"{'streams':>7} {'queue':>5} {'slots':>5} {'tokens':>7} "
-           f"{'ttft_p95':>9} {'tpot_p95':>9} {'cache':>6} {'burn':>6}\n")
+           f"{'ttft_p95':>9} {'tpot_p95':>9} {'cache':>6} {'burn':>6} "
+           f"{'tok/s':>12}\n")
     out.write(hdr)
     out.write("-" * (len(hdr) - 1) + "\n")
     for r in rows:
@@ -246,7 +349,8 @@ def print_fleet_table(doc, out=sys.stdout):
             f"{r.get('tokens', 0):>7} "
             f"{_fmt_ms(r.get('ttft_p95_ms')):>9} "
             f"{_fmt_ms(r.get('tpot_p95_ms')):>9} "
-            f"{cache_s:>6} {burn_s:>6}\n")
+            f"{cache_s:>6} {burn_s:>6} "
+            f"{_spark(r.get('spark')):>12}\n")
     return rows
 
 
@@ -312,9 +416,14 @@ def demo_serving():
     import numpy as np
 
     import paddle_tpu.observability as obs
+    from paddle_tpu.framework.flags import set_flags
     from paddle_tpu.models import llama
     from paddle_tpu.serving import AdmissionConfig, LLMEngine, ShedError
 
+    # r20: sample the time-series ring on EVERY engine step (the demo
+    # runs seconds, not minutes — the default 1s throttle would leave
+    # the sparkline/alert tail empty)
+    set_flags({"obs_ts_interval_s": 0.0})
     cfg = dataclasses.replace(
         llama.tiny_llama(vocab=64, hidden=32, layers=2, heads=4, kv_heads=2,
                          seq=128, ffn=64),
@@ -513,6 +622,17 @@ def demo_serving():
     print()
     print_request_table(obs.requests_payload())
 
+    # r20: the windowed alert table (burn-rate + anomaly watchers) over
+    # everything the demo just did, plus the process-wide tok/s trend
+    # from the time-series ring — the same rows /alerts.json serves
+    from paddle_tpu.observability import timeseries as _tsmod
+
+    print()
+    print_alert_table(_tsmod.alerts_payload())
+    rates = _tsmod.get_store().rate_series("serving_tokens_total", n=16)
+    print(f"tok/s spark: {_spark(rates, width=16)} "
+          f"(last {len(rates)} sample intervals)")
+
 
 def demo_moe():
     """Two dropless-MoE programs over one routing shape: the second is a
@@ -710,6 +830,34 @@ def print_postmortem(path, out=sys.stdout):
             out.write(f"NaN provenance: first bad layer = "
                       f"{num['provenance']}\n")
         print_numerics_table(num.get("rows") or [], out=out)
+    ts = doc.get("timeseries")
+    if ts:
+        out.write("\ntimeseries tail at dump (the trajectory into the "
+                  "failure):\n")
+        entries = ts.get("entries") or []
+        # one sparkline per watched signal over the embedded tail,
+        # newest value printed beside it
+        signals = {}
+        for e in entries:
+            for k, v in (e.get("signals") or {}).items():
+                signals.setdefault(k, []).append(
+                    v if isinstance(v, (int, float)) else None)
+        t_end = entries[-1]["t"] if entries else 0.0
+        if entries:
+            out.write(f"  {len(entries)} entries spanning "
+                      f"{t_end - entries[0]['t']:.1f}s\n")
+        for k in sorted(signals):
+            vals = [v for v in signals[k] if v is not None]
+            last = f"{vals[-1]:.4g}" if vals else "-"
+            out.write(f"  {k:32s} {_spark(signals[k], width=24):>24} "
+                      f"last={last}\n")
+        fired = [e for e in entries if e.get("firing")]
+        for e in fired[-5:]:
+            out.write(f"  {e['t'] - t_end:+9.3f}s firing: "
+                      f"{', '.join(e['firing'])}\n")
+        if ts.get("alerts"):
+            out.write("final alert table:\n")
+            print_alert_table({"alerts": ts["alerts"]}, out=out)
     metrics = doc.get("metrics")
     if metrics:
         out.write("\nmetrics at dump:\n")
@@ -735,9 +883,14 @@ def main():
                          "server base URL (/fleet/replicas.json is "
                          "appended; obs server or serving front door) "
                          "or a saved payload file")
+    ap.add_argument("--alerts", default=None, metavar="URL_OR_FILE",
+                    help="print the windowed alert table from a live "
+                         "server base URL (/alerts.json is appended; "
+                         "obs server or serving front door) or a saved "
+                         "payload file")
     ap.add_argument("--watch", action="store_true",
-                    help="with --requests/--fleet URL: refresh the "
-                         "table top-style until interrupted")
+                    help="with --requests/--fleet/--alerts URL: refresh "
+                         "the table top-style until interrupted")
     ap.add_argument("--interval", type=float, default=2.0,
                     help="--watch refresh period in seconds")
     ap.add_argument("--flags", default=None, metavar="PREFIX",
@@ -765,6 +918,8 @@ def main():
                              args.interval)
     if args.fleet:
         return fleet_mode(args.fleet, args.watch, args.interval)
+    if args.alerts:
+        return alerts_mode(args.alerts, args.watch, args.interval)
     if args.flags is not None:
         import paddle_tpu.observability  # noqa: F401  (registers FLAGS_obs_*)
         from paddle_tpu.framework.flags import flag_entries
@@ -776,8 +931,8 @@ def main():
         return 0
     if args.demo is None:
         ap.error("pass --snapshot PATH, --postmortem PATH, --requests "
-                 "URL_OR_FILE, --fleet URL_OR_FILE or --demo "
-                 "{serving,train,moe,goodput}")
+                 "URL_OR_FILE, --fleet URL_OR_FILE, --alerts "
+                 "URL_OR_FILE or --demo {serving,train,moe,goodput}")
 
     import paddle_tpu.observability as obs
 
